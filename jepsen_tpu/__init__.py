@@ -1,0 +1,31 @@
+"""jepsen_tpu: a TPU-native distributed-systems correctness testing framework.
+
+A brand-new framework with the capabilities of Jepsen (the reference lives at
+/root/reference): a harness that provisions real distributed systems over SSH,
+drives concurrent client workloads while a nemesis injects faults, records
+every operation into a timestamped history, and checks that history against
+abstract models — with the expensive linearizability search rebuilt as a
+JAX/XLA device kernel (a breadth-first frontier over
+(linearized-op-bitset x model-state) configurations) instead of the JVM
+Knossos solver.
+
+Layer map (mirrors the reference's, SURVEY.md §1):
+
+- :mod:`jepsen_tpu.history`     — op/history interchange format (core.clj:143-217)
+- :mod:`jepsen_tpu.models`      — abstract models (model.clj)
+- :mod:`jepsen_tpu.checker`     — history validators (checker.clj)
+- :mod:`jepsen_tpu.lin`         — the TPU linearizability kernel (replaces knossos)
+- :mod:`jepsen_tpu.generator`   — operation generator DSL (generator.clj)
+- :mod:`jepsen_tpu.client`      — client protocol (client.clj)
+- :mod:`jepsen_tpu.db`          — DB lifecycle protocol (db.clj)
+- :mod:`jepsen_tpu.os_`         — OS provisioning (os.clj)
+- :mod:`jepsen_tpu.net`         — network manipulation (net.clj)
+- :mod:`jepsen_tpu.nemesis`     — fault injection (nemesis.clj)
+- :mod:`jepsen_tpu.control`     — SSH control plane (control.clj)
+- :mod:`jepsen_tpu.core`        — test runner (core.clj)
+- :mod:`jepsen_tpu.store`       — persistence (store.clj)
+- :mod:`jepsen_tpu.cli`         — command line runner (cli.clj)
+- :mod:`jepsen_tpu.web`         — results browser (web.clj)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,240 @@
+"""Operation histories — the single interchange format between the harness
+half and the analysis half of the framework.
+
+An op is a small record ``{type, f, value, process, time, index}`` exactly as
+in the reference (`jepsen/src/jepsen/core.clj:143-217`, indexed at
+core.clj:481):
+
+- ``type``    — one of ``invoke`` / ``ok`` / ``fail`` / ``info``
+- ``f``       — the logical function (``read``, ``write``, ``cas``,
+                ``acquire``, ``add``, ``enqueue`` ...)
+- ``value``   — argument or result of the op
+- ``process`` — logical process id (int) or ``"nemesis"``
+- ``time``    — nanoseconds since the test's relative-time origin
+- ``index``   — position in the history
+
+This module also carries the knossos.history API surface the reference relies
+on (`knossos.history/index`, `complete`, `pairs`, `processes` — used at
+core.clj:481, checker.clj:342, checker/timeline.clj:146-149, generator.clj:53):
+those live here natively since knossos is replaced wholesale by
+:mod:`jepsen_tpu.lin`.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, replace as _dc_replace
+from typing import Any, Iterable, Iterator
+
+INVOKE = "invoke"
+OK = "ok"
+FAIL = "fail"
+INFO = "info"
+
+OP_TYPES = (INVOKE, OK, FAIL, INFO)
+
+NEMESIS = "nemesis"
+
+
+@dataclass(frozen=True)
+class Op:
+    """One history event. Frozen; use :meth:`replace` to derive variants."""
+
+    type: str
+    f: Any = None
+    value: Any = None
+    process: Any = None
+    time: int | None = None
+    index: int | None = None
+    extra: dict = field(default_factory=dict, compare=False)
+
+    def replace(self, **kw) -> "Op":
+        extra_updates = {k: v for k, v in kw.items() if k not in _OP_FIELDS}
+        base = {k: v for k, v in kw.items() if k in _OP_FIELDS}
+        if extra_updates:
+            base["extra"] = {**self.extra, **extra_updates}
+        return _dc_replace(self, **base)
+
+    def get(self, k, default=None):
+        if k in _OP_FIELDS:
+            return getattr(self, k)
+        return self.extra.get(k, default)
+
+    def __getitem__(self, k):
+        v = self.get(k, _MISSING)
+        if v is _MISSING:
+            raise KeyError(k)
+        return v
+
+    # --- predicates (knossos.op/invoke? ok? fail?, used checker.clj:119-151)
+    @property
+    def is_invoke(self) -> bool:
+        return self.type == INVOKE
+
+    @property
+    def is_ok(self) -> bool:
+        return self.type == OK
+
+    @property
+    def is_fail(self) -> bool:
+        return self.type == FAIL
+
+    @property
+    def is_info(self) -> bool:
+        return self.type == INFO
+
+    def to_dict(self) -> dict:
+        d = {"type": self.type, "f": self.f, "value": self.value,
+             "process": self.process, "time": self.time, "index": self.index}
+        d.update(self.extra)
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "Op":
+        extra = {k: v for k, v in d.items() if k not in _OP_FIELDS}
+        return Op(type=d.get("type"), f=d.get("f"), value=d.get("value"),
+                  process=d.get("process"), time=d.get("time"),
+                  index=d.get("index"), extra=extra)
+
+
+_OP_FIELDS = {"type", "f", "value", "process", "time", "index"}
+_MISSING = object()
+
+
+# --- op constructors (mirroring knossos.core/invoke-op, ok-op used by the
+# reference's checker tests, test/jepsen/checker_test.clj:5) ----------------
+
+def invoke_op(process, f, value, **extra) -> Op:
+    return Op(INVOKE, f, value, process, extra=extra)
+
+
+def ok_op(process, f, value, **extra) -> Op:
+    return Op(OK, f, value, process, extra=extra)
+
+
+def fail_op(process, f, value, **extra) -> Op:
+    return Op(FAIL, f, value, process, extra=extra)
+
+
+def info_op(process, f, value, **extra) -> Op:
+    return Op(INFO, f, value, process, extra=extra)
+
+
+def op(d) -> Op:
+    return d if isinstance(d, Op) else Op.from_dict(d)
+
+
+# --- history functions ------------------------------------------------------
+
+def index(history: Iterable[Op]) -> list[Op]:
+    """Assign sequential :index to each op (knossos.history/index, applied by
+    the reference runner at core.clj:481)."""
+    return [o.replace(index=i) if o.index != i else o
+            for i, o in enumerate(history)]
+
+
+def processes(history: Iterable[Op]) -> list:
+    """Distinct processes in order of first appearance
+    (knossos.history/processes)."""
+    seen: dict = {}
+    for o in history:
+        if o.process not in seen:
+            seen[o.process] = True
+    return list(seen)
+
+
+def complete(history: list[Op]) -> list[Op]:
+    """Fill in invocation values from their completions.
+
+    Mirrors knossos.history/complete (used by the reference counter checker,
+    checker.clj:342): each invocation is matched with the next op by the same
+    process; if that completion is :ok, the invocation's value is replaced
+    with the completion's value (e.g. a read invoked with value nil completes
+    with the observed value).
+    """
+    out = list(history)
+    pending: dict[Any, int] = {}
+    for i, o in enumerate(out):
+        if o.is_invoke:
+            pending[o.process] = i
+        elif o.process in pending:
+            j = pending.pop(o.process)
+            if o.is_ok:
+                out[j] = out[j].replace(value=o.value)
+    return out
+
+
+def pair_index(history: list[Op]) -> dict[int, int]:
+    """Map from position of each invocation to the position of its completion
+    (and back). Positions without a partner are absent."""
+    pairs: dict[int, int] = {}
+    pending: dict[Any, int] = {}
+    for i, o in enumerate(history):
+        if o.is_invoke:
+            pending[o.process] = i
+        elif o.process in pending:
+            j = pending.pop(o.process)
+            pairs[j] = i
+            pairs[i] = j
+    return pairs
+
+
+def invocations(history: Iterable[Op]) -> list[Op]:
+    return [o for o in history if o.is_invoke]
+
+
+# --- codec (history.txt / JSONL persistence; the reference serializes
+# histories with Fressian, store.clj:26-111 — we use JSONL, a portable
+# equivalent) ----------------------------------------------------------------
+
+def _default(o):
+    if isinstance(o, Op):
+        return o.to_dict()
+    if isinstance(o, (set, frozenset)):
+        return {"__set__": sorted(o, key=repr)}
+    if isinstance(o, tuple):
+        return list(o)
+    return repr(o)
+
+
+def dumps_op(o: Op) -> str:
+    return json.dumps(o.to_dict(), default=_default)
+
+
+def loads_op(s: str) -> Op:
+    return Op.from_dict(json.loads(s))
+
+
+def write_history(path, history: Iterable[Op]) -> None:
+    with open(path, "w") as fh:
+        for o in history:
+            fh.write(dumps_op(o))
+            fh.write("\n")
+
+
+def read_history(path) -> list[Op]:
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(loads_op(line))
+    return out
+
+
+class History(list):
+    """A list of Ops with convenience constructors."""
+
+    @staticmethod
+    def of(*ops) -> "History":
+        h = History()
+        for o in ops:
+            h.append(op(o))
+        return index_history(h)
+
+
+def index_history(h: "History") -> "History":
+    out = History()
+    for i, o in enumerate(h):
+        out.append(o.replace(index=i) if o.index != i else o)
+    return out

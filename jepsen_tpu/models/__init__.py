@@ -1,0 +1,228 @@
+"""Functional abstract models of database behavior.
+
+Pure state machines with a ``step(op) -> model | Inconsistent`` transition,
+mirroring the reference's `jepsen/src/jepsen/model.clj` (which wraps
+knossos.model/Model + inconsistent, model.clj:4-11):
+
+- :class:`NoOp`           — model.clj:13-19
+- :class:`CASRegister`    — model.clj:21-40
+- :class:`Register`       — write/read only (knossos.model/register)
+- :class:`Mutex`          — model.clj:42-56
+- :class:`SetModel`       — model.clj:58-71
+- :class:`UnorderedQueue` — model.clj:73-85
+- :class:`FIFOQueue`      — model.clj:87-105
+
+Each model here is the *semantic reference*; the vmap-able device kernels the
+TPU linearizability search uses live in :mod:`jepsen_tpu.models.kernels` and
+are parity-tested against these.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Any, Tuple
+
+
+@dataclass(frozen=True)
+class Inconsistent:
+    """A sentinel transition result marking an impossible op
+    (knossos.model/inconsistent, used at reference model.clj:29,34)."""
+
+    msg: str
+
+    @property
+    def is_inconsistent(self) -> bool:
+        return True
+
+
+def inconsistent(msg: str) -> Inconsistent:
+    return Inconsistent(msg)
+
+
+def is_inconsistent(x) -> bool:
+    return isinstance(x, Inconsistent)
+
+
+class Model:
+    """Base for abstract models (knossos.model/Model)."""
+
+    def step(self, op) -> "Model | Inconsistent":
+        raise NotImplementedError
+
+    @property
+    def is_inconsistent(self) -> bool:
+        return False
+
+
+@dataclass(frozen=True)
+class NoOp(Model):
+    """Always returns itself, unchanged (reference model.clj:13-19)."""
+
+    def step(self, op):
+        return self
+
+
+noop = NoOp()
+
+
+@dataclass(frozen=True)
+class CASRegister(Model):
+    """A compare-and-set register (reference model.clj:21-40).
+
+    - ``write v``     — always succeeds, value becomes v
+    - ``cas [cur, new]`` — succeeds iff value == cur, becomes new
+    - ``read v``      — succeeds iff v is None (unknown) or v == value
+    """
+
+    value: Any = None
+
+    def step(self, op):
+        f = op.f
+        if f == "write":
+            return CASRegister(op.value)
+        if f == "cas":
+            cur, new = op.value
+            if cur == self.value:
+                return CASRegister(new)
+            return inconsistent(f"can't CAS {self.value} from {cur} to {new}")
+        if f == "read":
+            if op.value is None or op.value == self.value:
+                return self
+            return inconsistent(
+                f"can't read {op.value} from register {self.value}")
+        return inconsistent(f"unknown op f={f}")
+
+
+def cas_register(value=None) -> CASRegister:
+    return CASRegister(value)
+
+
+@dataclass(frozen=True)
+class Register(Model):
+    """A read/write register without CAS (knossos.model/register; the
+    reference's BASELINE config #1 shape)."""
+
+    value: Any = None
+
+    def step(self, op):
+        f = op.f
+        if f == "write":
+            return Register(op.value)
+        if f == "read":
+            if op.value is None or op.value == self.value:
+                return self
+            return inconsistent(
+                f"can't read {op.value} from register {self.value}")
+        return inconsistent(f"unknown op f={f}")
+
+
+def register(value=None) -> Register:
+    return Register(value)
+
+
+@dataclass(frozen=True)
+class Mutex(Model):
+    """A single mutex responding to acquire/release
+    (reference model.clj:42-56)."""
+
+    locked: bool = False
+
+    def step(self, op):
+        f = op.f
+        if f == "acquire":
+            if self.locked:
+                return inconsistent("already held")
+            return Mutex(True)
+        if f == "release":
+            if not self.locked:
+                return inconsistent("not held")
+            return Mutex(False)
+        return inconsistent(f"unknown op f={f}")
+
+
+def mutex() -> Mutex:
+    return Mutex(False)
+
+
+@dataclass(frozen=True)
+class SetModel(Model):
+    """A set responding to add/read (reference model.clj:58-71)."""
+
+    s: frozenset = field(default_factory=frozenset)
+
+    def step(self, op):
+        f = op.f
+        if f == "add":
+            return SetModel(self.s | {op.value})
+        if f == "read":
+            if op.value is not None and set(op.value) == set(self.s):
+                return self
+            return inconsistent(f"can't read {op.value!r} from {set(self.s)!r}")
+        return inconsistent(f"unknown op f={f}")
+
+
+def set_model() -> SetModel:
+    return SetModel()
+
+
+@dataclass(frozen=True)
+class UnorderedQueue(Model):
+    """A queue which does not order its pending elements — a multiset
+    (reference model.clj:73-85)."""
+
+    pending: tuple = ()  # sorted multiset rep, kept canonical for equality
+
+    def step(self, op):
+        f = op.f
+        if f == "enqueue":
+            return UnorderedQueue(_multiset_add(self.pending, op.value))
+        if f == "dequeue":
+            if op.value in self.pending:
+                return UnorderedQueue(_multiset_remove(self.pending, op.value))
+            return inconsistent(f"can't dequeue {op.value}")
+        return inconsistent(f"unknown op f={f}")
+
+
+def _multiset_add(t: tuple, v) -> tuple:
+    return tuple(sorted(list(t) + [v], key=repr))
+
+
+def _multiset_remove(t: tuple, v) -> tuple:
+    out = list(t)
+    out.remove(v)
+    return tuple(out)
+
+
+def unordered_queue() -> UnorderedQueue:
+    return UnorderedQueue()
+
+
+@dataclass(frozen=True)
+class FIFOQueue(Model):
+    """A FIFO queue (reference model.clj:87-105)."""
+
+    pending: tuple = ()
+
+    def step(self, op):
+        f = op.f
+        if f == "enqueue":
+            return FIFOQueue(self.pending + (op.value,))
+        if f == "dequeue":
+            if not self.pending:
+                return inconsistent(
+                    f"can't dequeue {op.value} from empty queue")
+            if self.pending[0] == op.value:
+                return FIFOQueue(self.pending[1:])
+            return inconsistent(f"can't dequeue {op.value}")
+        return inconsistent(f"unknown op f={f}")
+
+
+def fifo_queue() -> FIFOQueue:
+    return FIFOQueue()
+
+
+def multiset(xs=()) -> Counter:
+    """Multiset helper mirroring the reference's multiset.core dependency
+    (project.clj:15), used by the total-queue checker."""
+    return Counter(xs)

@@ -1,0 +1,124 @@
+"""Vmap-able JAX step kernels for the abstract models.
+
+These are the device twins of the Python models in
+:mod:`jepsen_tpu.models` (reference model.clj semantics), written as pure
+branchless int ops so the TPU linearizability search
+(:mod:`jepsen_tpu.lin.bfs`) can evaluate *millions of candidate transitions
+per step* via vmap over an HBM-resident frontier: frontier-config x pending-op
+legality masks are exactly `ok` bits from these kernels.
+
+Conventions:
+
+- ``f`` is an interned function id (:data:`F_READ` ...).
+- Values are interned int32 ids (interning in :mod:`jepsen_tpu.lin.prepare`);
+  :data:`NIL` is the sentinel for nil/unknown (a read invoked with value nil
+  matches any state — reference model.clj:31-32).
+- Model state is an int32 vector of fixed width ``state_width``.
+- ``step(state, f, v) -> (ok, new_state)`` with no Python control flow, so a
+  single compiled kernel evaluates the cross product (configs x candidate ops)
+  on the MXU-adjacent vector units without retracing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+# Interned function ids, shared host<->device.
+F_READ = 0
+F_WRITE = 1
+F_CAS = 2
+F_ACQUIRE = 3
+F_RELEASE = 4
+
+F_IDS = {"read": F_READ, "write": F_WRITE, "cas": F_CAS,
+         "acquire": F_ACQUIRE, "release": F_RELEASE}
+
+# Sentinel for nil/unknown values. Never produced by interning.
+NIL = np.int32(-(2 ** 31))
+
+# Max value words per op: cas carries [cur, new]; everything else uses v[0].
+VALUE_WIDTH = 2
+
+
+@dataclass(frozen=True)
+class KernelModel:
+    """A model compiled for the device frontier search."""
+
+    name: str
+    state_width: int
+    init_state: Callable[[], np.ndarray]  # initial packed state (host)
+    step: Callable  # (i32[S], i32, i32[2]) -> (bool_, i32[S])
+
+
+# --- cas-register (reference model.clj:21-40) -------------------------------
+
+def _cas_register_step(state, f, v):
+    cur = state[0]
+    is_read = f == F_READ
+    is_write = f == F_WRITE
+    is_cas = f == F_CAS
+    ok = ((is_read & ((v[0] == NIL) | (v[0] == cur)))
+          | is_write
+          | (is_cas & (v[0] == cur)))
+    new = jnp.where(is_write, v[0], jnp.where(is_cas, v[1], cur))
+    return ok, state.at[0].set(new)
+
+
+def _register_step(state, f, v):
+    # write/read only (knossos.model/register); cas is never legal.
+    cur = state[0]
+    is_read = f == F_READ
+    is_write = f == F_WRITE
+    ok = (is_read & ((v[0] == NIL) | (v[0] == cur))) | is_write
+    new = jnp.where(is_write, v[0], cur)
+    return ok, state.at[0].set(new)
+
+
+def _mutex_step(state, f, v):
+    # reference model.clj:42-56: acquire fails when held, release when not.
+    locked = state[0]
+    is_acq = f == F_ACQUIRE
+    is_rel = f == F_RELEASE
+    ok = (is_acq & (locked == 0)) | (is_rel & (locked == 1))
+    new = jnp.where(is_acq, jnp.int32(1), jnp.int32(0))
+    return ok, state.at[0].set(new)
+
+
+def cas_register_kernel(initial: int = int(NIL)) -> KernelModel:
+    return KernelModel("cas-register", 1,
+                       lambda: np.array([initial], np.int32),
+                       _cas_register_step)
+
+
+def register_kernel(initial: int = int(NIL)) -> KernelModel:
+    return KernelModel("register", 1,
+                       lambda: np.array([initial], np.int32),
+                       _register_step)
+
+
+def mutex_kernel() -> KernelModel:
+    return KernelModel("mutex", 1,
+                       lambda: np.array([0], np.int32),
+                       _mutex_step)
+
+
+def kernel_for(model) -> KernelModel:
+    """Map a Python model instance (jepsen_tpu.models) to its device kernel.
+    The model's current value becomes the interned initial state in
+    :mod:`jepsen_tpu.lin.prepare` (which owns value interning)."""
+    from jepsen_tpu import models as m
+
+    if isinstance(model, m.CASRegister):
+        return cas_register_kernel()
+    if isinstance(model, m.Register):
+        return register_kernel()
+    if isinstance(model, m.Mutex):
+        return mutex_kernel()
+    raise ValueError(
+        f"no device kernel for model {type(model).__name__}; "
+        "device linearizability supports register/cas-register/mutex "
+        "(use the CPU checker for other models)")

@@ -1,0 +1,201 @@
+"""Kitchen-sink utilities.
+
+TPU-native re-design of the reference's ``jepsen/src/jepsen/util.clj`` (686
+LoC): parallel map over unbounded workers (util.clj:44-50), majority
+(util.clj:57-60), relative-time clock (util.clj:235-252), high-resolution
+sleep (util.clj:254-260), timeout (util.clj:275-286), retry
+(util.clj:288-327), compact integer-set rendering (util.clj:487-512),
+latency extraction (util.clj:557-591) and nemesis intervals
+(util.clj:593-610).
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from concurrent.futures import ThreadPoolExecutor
+from fractions import Fraction
+from typing import Any, Callable, Iterable, Sequence
+
+
+def real_pmap(f: Callable, xs: Iterable) -> list:
+    """Like map, but with one thread per element (reference util.clj:44-50:
+    unbounded futures, used for SSH fan-out to all nodes at once)."""
+    xs = list(xs)
+    if not xs:
+        return []
+    with ThreadPoolExecutor(max_workers=len(xs)) as pool:
+        return list(pool.map(f, xs))
+
+
+def majority(n: int) -> int:
+    """Given a cluster size, return the smallest majority: 1 for 0 or 1 nodes,
+    2 for 3, 3 for 4 or 5 (reference util.clj:57-60)."""
+    return max(1, n // 2 + 1)
+
+
+def fraction(a: int, b: int):
+    """a/b, but 1 when b is zero (reference util.clj `fraction`). Returns an
+    exact :class:`fractions.Fraction` to mirror Clojure ratios."""
+    if b == 0:
+        return 1
+    f = Fraction(a, b)
+    return int(f) if f.denominator == 1 else f
+
+
+def integer_interval_set_str(xs: Iterable[int]) -> str:
+    """Render a set of integers as compact sorted intervals, e.g.
+    ``#{1..3 5 7..9}`` (reference util.clj:487-512)."""
+    xs = sorted(set(xs))
+    parts: list[str] = []
+    i = 0
+    while i < len(xs):
+        j = i
+        while j + 1 < len(xs) and xs[j + 1] == xs[j] + 1:
+            j += 1
+        if j == i:
+            parts.append(str(xs[i]))
+        elif j == i + 1:
+            parts.append(str(xs[i]))
+            parts.append(str(xs[j]))
+        else:
+            parts.append(f"{xs[i]}..{xs[j]}")
+        i = j + 1
+    return "#{" + " ".join(parts) + "}"
+
+
+# ---------------------------------------------------------------------------
+# Relative time (reference util.clj:235-252). All op :time stamps are
+# nanoseconds relative to an anchor established once per test run, so clock
+# nemeses that scramble the wall clock cannot corrupt the history's
+# timestamps (SURVEY.md §5 last bullet of fault-injection).
+# ---------------------------------------------------------------------------
+
+_relative_time_origin: float | None = None
+_relative_time_lock = threading.Lock()
+
+
+class relative_time_context:
+    """Context manager anchoring the relative-time clock at entry
+    (reference ``with-relative-time``, util.clj:243-247)."""
+
+    def __enter__(self):
+        global _relative_time_origin
+        with _relative_time_lock:
+            _relative_time_origin = _time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def relative_time_nanos() -> int:
+    """Nanoseconds since the relative-time origin (util.clj:249-252). If no
+    origin was anchored, anchors one now."""
+    global _relative_time_origin
+    if _relative_time_origin is None:
+        with _relative_time_lock:
+            if _relative_time_origin is None:
+                _relative_time_origin = _time.monotonic()
+    return int((_time.monotonic() - _relative_time_origin) * 1e9)
+
+
+def sleep_nanos(ns: float) -> None:
+    """Sleep for a number of nanoseconds (reference's high-res `sleep`,
+    util.clj:254-260 — ops granularity is often sub-millisecond)."""
+    if ns > 0:
+        _time.sleep(ns / 1e9)
+
+
+class TimeoutError_(Exception):
+    pass
+
+
+def timeout(seconds: float, f: Callable[[], Any], on_timeout: Any = TimeoutError_):
+    """Run f in a worker thread; if it exceeds the deadline return
+    ``on_timeout`` (or raise if it is an exception class). The worker is
+    abandoned, mirroring the reference's interrupt-based `timeout`
+    (util.clj:275-286) as closely as Python threading allows."""
+    result: list = []
+    err: list = []
+
+    def run():
+        try:
+            result.append(f())
+        except BaseException as e:  # noqa: BLE001 - report through the channel
+            err.append(e)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(seconds)
+    if t.is_alive():
+        if isinstance(on_timeout, type) and issubclass(on_timeout, BaseException):
+            raise on_timeout(f"timed out after {seconds}s")
+        return on_timeout
+    if err:
+        raise err[0]
+    return result[0]
+
+
+def with_retry(f: Callable[[], Any], retries: int = 5, backoff: float = 0.2,
+               exceptions: tuple = (Exception,)):
+    """Call f, retrying on failure with linear backoff (reference
+    `with-retry`/`retry`, util.clj:288-327)."""
+    attempt = 0
+    while True:
+        try:
+            return f()
+        except exceptions:
+            attempt += 1
+            if attempt > retries:
+                raise
+            _time.sleep(backoff * attempt)
+
+
+def longest_common_prefix(seqs: Sequence[Sequence]) -> list:
+    """Longest prefix shared by all sequences (reference util.clj:612-625)."""
+    if not seqs:
+        return []
+    out = []
+    for vals in zip(*seqs):
+        if all(v == vals[0] for v in vals[1:]):
+            out.append(vals[0])
+        else:
+            break
+    return out
+
+
+def history_latencies(history) -> list:
+    """Annotate invoke ops with :latency (ns between invoke and completion)
+    and completion type, like reference util.clj:557-591. Returns a list of
+    ``(invoke_op, latency_ns_or_None, completion_type_or_None)``."""
+    pending: dict[Any, Any] = {}
+    out = []
+    for op in history:
+        if op.type == "invoke":
+            pending[op.process] = op
+        elif op.process in pending:
+            inv = pending.pop(op.process)
+            out.append((inv, (op.time or 0) - (inv.time or 0), op.type))
+    for inv in pending.values():
+        out.append((inv, None, None))
+    return out
+
+
+def nemesis_intervals(history) -> list[tuple]:
+    """Pair up nemesis start/stop ops into [start, stop] op intervals
+    (reference util.clj:593-610)."""
+    starts = []
+    intervals = []
+    for op in history:
+        if op.process != "nemesis":
+            continue
+        if op.f in ("start", "info") and op.type == "info" and op.f == "start":
+            starts.append(op)
+        elif op.f == "start":
+            starts.append(op)
+        elif op.f == "stop" and starts:
+            intervals.append((starts.pop(), op))
+    for s in starts:
+        intervals.append((s, None))
+    return intervals

@@ -1,0 +1,21 @@
+"""Test config: force an 8-device virtual CPU mesh BEFORE any backend init.
+
+Multi-chip hardware is not available in CI; sharding tests run on XLA's
+forced host platform device count (the same mechanism the driver's
+multichip dryrun uses). The TPU plugin in this image force-selects its own
+platform via jax config at interpreter start, so the env var alone is not
+enough — we must override the config after importing jax, before any
+jax.devices()/jit call initializes a backend. conftest is imported by pytest
+before all test modules, which guarantees that ordering.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

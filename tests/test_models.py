@@ -1,0 +1,150 @@
+"""Model semantics tests (reference model.clj) + device-kernel parity.
+
+The Python models are the semantic reference; the JAX kernels in
+jepsen_tpu.models.kernels must agree with them on randomized op sequences.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu.history import Op, invoke_op
+from jepsen_tpu.models import kernels as k
+
+
+def step(model, f, value):
+    return model.step(Op("invoke", f, value, 0))
+
+
+class TestCASRegister:
+    def test_write(self):
+        assert step(m.cas_register(), "write", 3) == m.CASRegister(3)
+
+    def test_read_nil_matches_anything(self):
+        r = m.cas_register(5)
+        assert step(r, "read", None) == r
+
+    def test_read_match(self):
+        r = m.cas_register(5)
+        assert step(r, "read", 5) == r
+
+    def test_read_mismatch(self):
+        assert m.is_inconsistent(step(m.cas_register(5), "read", 4))
+
+    def test_cas_ok(self):
+        assert step(m.cas_register(5), "cas", [5, 7]) == m.CASRegister(7)
+
+    def test_cas_fail(self):
+        assert m.is_inconsistent(step(m.cas_register(5), "cas", [4, 7]))
+
+    def test_initial_nil(self):
+        assert m.cas_register().value is None
+        assert m.is_inconsistent(step(m.cas_register(), "cas", [0, 1]))
+
+
+class TestMutex:
+    def test_acquire(self):
+        assert step(m.mutex(), "acquire", None) == m.Mutex(True)
+
+    def test_double_acquire(self):
+        assert m.is_inconsistent(step(m.Mutex(True), "acquire", None))
+
+    def test_release_unheld(self):
+        assert m.is_inconsistent(step(m.mutex(), "release", None))
+
+    def test_release(self):
+        assert step(m.Mutex(True), "release", None) == m.Mutex(False)
+
+
+class TestSet:
+    def test_add_read(self):
+        s = step(step(m.set_model(), "add", 1), "add", 2)
+        assert s.step(Op("invoke", "read", [1, 2], 0)) == s
+
+    def test_bad_read(self):
+        s = step(m.set_model(), "add", 1)
+        assert m.is_inconsistent(s.step(Op("invoke", "read", [1, 2], 0)))
+
+
+class TestQueues:
+    def test_unordered(self):
+        q = step(step(m.unordered_queue(), "enqueue", 1), "enqueue", 2)
+        q = step(q, "dequeue", 2)  # out of order is fine
+        q = step(q, "dequeue", 1)
+        assert q == m.unordered_queue()
+        assert m.is_inconsistent(step(q, "dequeue", 1))
+
+    def test_fifo(self):
+        q = step(step(m.fifo_queue(), "enqueue", 1), "enqueue", 2)
+        assert m.is_inconsistent(step(q, "dequeue", 2))
+        q = step(q, "dequeue", 1)
+        q = step(q, "dequeue", 2)
+        assert m.is_inconsistent(step(q, "dequeue", 9))
+
+
+class TestNoOp:
+    def test_noop(self):
+        assert step(m.noop, "anything", 42) is m.noop
+
+
+# ---------------------------------------------------------------------------
+# Kernel parity: python model vs JAX kernel on randomized traces.
+# Values are small non-negative ints so interning is the identity; NIL maps
+# to None.
+# ---------------------------------------------------------------------------
+
+def _to_py_value(f, v):
+    if f == "cas":
+        return [None if x == int(k.NIL) else int(x) for x in v]
+    return None if v[0] == int(k.NIL) else int(v[0])
+
+
+@pytest.mark.parametrize("model_name", ["cas-register", "register", "mutex"])
+def test_kernel_parity(model_name):
+    rng = random.Random(42)
+    if model_name == "cas-register":
+        kern, py0 = k.cas_register_kernel(), m.cas_register()
+        fs = ["read", "write", "cas"]
+    elif model_name == "register":
+        kern, py0 = k.register_kernel(), m.register()
+        fs = ["read", "write"]
+    else:
+        kern, py0 = k.mutex_kernel(), m.mutex()
+        fs = ["acquire", "release"]
+
+    import jax
+
+    jit_step = jax.jit(kern.step)
+    for _trial in range(50):
+        py = py0
+        state = np.asarray(kern.init_state())
+        for _step_i in range(8):
+            f = rng.choice(fs)
+            if f == "cas":
+                v = np.array([rng.randint(0, 3), rng.randint(0, 3)], np.int32)
+            elif f in ("read",):
+                v = np.array(
+                    [rng.choice([int(k.NIL), 0, 1, 2, 3]), 0], np.int32)
+            elif f == "write":
+                v = np.array([rng.randint(0, 3), 0], np.int32)
+            else:
+                v = np.array([0, 0], np.int32)
+
+            ok_dev, new_state = jit_step(state, np.int32(k.F_IDS[f]), v)
+            res_py = py.step(Op("invoke", f, _to_py_value(f, v), 0))
+            ok_py = not m.is_inconsistent(res_py)
+
+            assert bool(ok_dev) == ok_py, (
+                f"{model_name}: step {f} {v} from {state}: "
+                f"device ok={bool(ok_dev)} python ok={ok_py}")
+            if ok_py:
+                py = res_py
+                state = np.asarray(new_state)
+                # cross-check state agreement for registers
+                if model_name in ("cas-register", "register"):
+                    expect = int(k.NIL) if py.value is None else py.value
+                    assert int(state[0]) == expect
+                else:
+                    assert bool(state[0]) == py.locked
