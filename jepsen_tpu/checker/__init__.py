@@ -1,0 +1,323 @@
+"""History validators.
+
+TPU-native re-design of the reference's `jepsen/src/jepsen/checker.clj`
+(411 LoC). A checker validates a recorded history against a model and
+returns a result map with a ``"valid?"`` key — ``True``, ``False`` or
+``"unknown"`` (checker.clj:46-61). ``linearizable`` is the expensive one:
+in the reference it delegates to the external knossos solver
+(checker.clj:82-107); here it dispatches to :mod:`jepsen_tpu.lin` — the
+device BFS kernel (``algorithm="tpu"``) or the CPU reference
+(``algorithm="cpu"``), with ``"competition"`` racing both like
+knossos.competition (checker.clj:90-93). The rest are O(n) scans.
+"""
+
+from __future__ import annotations
+
+import traceback
+from collections import Counter
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable
+
+from jepsen_tpu import models as model_ns
+from jepsen_tpu.history import Op
+from jepsen_tpu.util import fraction, integer_interval_set_str
+
+VALID = "valid?"
+
+# Larger numbers dominate when checkers are composed (checker.clj:23-28).
+_VALID_PRIORITIES = {True: 0, False: 1, "unknown": 0.5}
+
+
+def merge_valid(valids) -> Any:
+    """Merge valid? values, yielding the highest-priority one
+    (checker.clj:30-44). Raises on unknown values, like the reference."""
+    out = True
+    for v in valids:
+        for x in (out, v):
+            if x not in _VALID_PRIORITIES:
+                raise ValueError(f"{x!r} is not a known valid? value")
+        if _VALID_PRIORITIES[v] > _VALID_PRIORITIES[out]:
+            out = v
+    return out
+
+
+class Checker:
+    """Verify a history is correct (checker.clj:46-61). Returns a map like
+    ``{"valid?": True}`` or ``{"valid?": False, ...details}``. ``opts`` may
+    carry ``subdirectory`` for file-emitting checkers."""
+
+    def check(self, test, model, history, opts=None) -> dict:
+        raise NotImplementedError
+
+
+class FnChecker(Checker):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def check(self, test, model, history, opts=None):
+        return self.fn(test, model, history, opts or {})
+
+
+def check_safe(checker: Checker, test, model, history, opts=None) -> dict:
+    """Like check, but wraps exceptions into
+    ``{"valid?": "unknown", "error": ...}`` (checker.clj:63-74)."""
+    try:
+        return checker.check(test, model, history, opts or {})
+    except Exception:
+        return {VALID: "unknown", "error": traceback.format_exc()}
+
+
+def unbridled_optimism() -> Checker:
+    """Everything is awesoooommmmme! (checker.clj:76-80)"""
+    return FnChecker(lambda test, model, history, opts: {VALID: True})
+
+
+def linearizable(algorithm: str = "competition", **kw) -> Checker:
+    """Validates linearizability (checker.clj:82-107).
+
+    ``algorithm`` is one of:
+
+    - ``"tpu"``  — the device BFS frontier kernel (:mod:`jepsen_tpu.lin.bfs`)
+    - ``"cpu"``  — the host reference search (:mod:`jepsen_tpu.lin.cpu`)
+    - ``"competition"`` — race both, first verdict wins (knossos.competition)
+
+    Like the reference, the analysis result is truncated (writing full
+    configs "can take *hours*", checker.clj:104-107).
+    """
+
+    def check(test, model, history, opts):
+        from jepsen_tpu import lin
+
+        a = lin.analysis(model, history, algorithm=algorithm, **kw)
+        a = dict(a)
+        if not a.get(VALID, False):
+            try:
+                from jepsen_tpu.lin import report as lin_report
+                from jepsen_tpu import store
+
+                if test is not None and isinstance(test, dict) \
+                        and test.get("name"):
+                    path = store.path(test, (opts or {}).get("subdirectory"),
+                                      "linear.svg", make=True)
+                    lin_report.render_analysis(history, a, path)
+            except Exception:
+                pass  # rendering is best-effort, like checker.clj:96-103
+        a["final-paths"] = list(a.get("final-paths", []))[:10]
+        a["configs"] = list(a.get("configs", []))[:10]
+        return a
+
+    return FnChecker(check)
+
+
+def queue() -> Checker:
+    """Every dequeue must come from somewhere: assume every non-failing
+    enqueue succeeded and only OK dequeues succeeded, then fold the model
+    over that history (checker.clj:109-129). O(n)."""
+
+    def check(test, model, history, opts):
+        final = model
+        for op in history:
+            take = (op.is_invoke if op.f == "enqueue"
+                    else op.is_ok if op.f == "dequeue" else False)
+            if take:
+                final = final.step(op)
+                if model_ns.is_inconsistent(final):
+                    return {VALID: False, "error": final.msg}
+        return {VALID: True, "final-queue": final}
+
+    return FnChecker(check)
+
+
+def set_checker() -> Checker:
+    """Adds followed by a final read: every successful add must be present,
+    and nothing never-attempted may appear (checker.clj:131-178)."""
+
+    def check(test, model, history, opts):
+        attempts = {op.value for op in history
+                    if op.is_invoke and op.f == "add"}
+        adds = {op.value for op in history if op.is_ok and op.f == "add"}
+        final_read = None
+        for op in history:
+            if op.is_ok and op.f == "read":
+                final_read = op.value
+        if final_read is None:
+            return {VALID: "unknown", "error": "Set was never read"}
+
+        final_read = set(final_read)
+        ok = final_read & attempts             # read values we tried to add
+        unexpected = final_read - attempts     # never-attempted records
+        lost = adds - final_read               # definitely added, not read
+        recovered = ok - adds                  # indeterminate adds that won
+
+        return {VALID: not lost and not unexpected,
+                "ok": integer_interval_set_str(ok),
+                "lost": integer_interval_set_str(lost),
+                "unexpected": integer_interval_set_str(unexpected),
+                "recovered": integer_interval_set_str(recovered),
+                "ok-frac": fraction(len(ok), len(attempts)),
+                "unexpected-frac": fraction(len(unexpected), len(attempts)),
+                "lost-frac": fraction(len(lost), len(attempts)),
+                "recovered-frac": fraction(len(recovered), len(attempts))}
+
+    return FnChecker(check)
+
+
+def expand_queue_drain_ops(history) -> list[Op]:
+    """Expand successful :drain ops (value = collection of elements) into
+    :dequeue invoke/ok pairs (checker.clj:180-212)."""
+    out: list[Op] = []
+    for op in history:
+        if op.f != "drain":
+            out.append(op)
+        elif op.is_invoke or op.is_fail:
+            continue
+        elif op.is_ok:
+            for element in op.value or []:
+                out.append(op.replace(type="invoke", f="dequeue", value=None))
+                out.append(op.replace(type="ok", f="dequeue", value=element))
+        else:
+            raise ValueError(
+                f"Not sure how to handle a crashed drain operation: {op}")
+    return out
+
+
+def total_queue() -> Checker:
+    """What goes in *must* come out; requires the history to drain the queue
+    (checker.clj:214-271). O(n)."""
+
+    def check(test, model, history, opts):
+        history = expand_queue_drain_ops(history)
+        attempts = Counter(op.value for op in history
+                           if op.is_invoke and op.f == "enqueue")
+        enqueues = Counter(op.value for op in history
+                           if op.is_ok and op.f == "enqueue")
+        dequeues = Counter(op.value for op in history
+                           if op.is_ok and op.f == "dequeue")
+
+        ok = dequeues & attempts
+        # Dequeues of values never even attempted (checker.clj:243-246).
+        unexpected = Counter({v: n for v, n in dequeues.items()
+                              if v not in attempts})
+        # Dequeued more times than attempted, but attempted at least once.
+        duplicated = dequeues - attempts - unexpected
+        lost = enqueues - dequeues
+        # Dequeues whose enqueue was indeterminate but present.
+        recovered = ok - enqueues
+
+        def total(ms: Counter) -> int:
+            return sum(ms.values())
+
+        n = total(attempts)
+        return {VALID: not lost and not unexpected,
+                "lost": lost, "unexpected": unexpected,
+                "duplicated": duplicated, "recovered": recovered,
+                "ok-frac": fraction(total(ok), n),
+                "unexpected-frac": fraction(total(unexpected), n),
+                "duplicated-frac": fraction(total(duplicated), n),
+                "lost-frac": fraction(total(lost), n),
+                "recovered-frac": fraction(total(recovered), n)}
+
+    return FnChecker(check)
+
+
+def unique_ids() -> Checker:
+    """A unique-id generator must emit unique IDs: :generate invocations
+    matched by :ok responses with distinct values (checker.clj:273-318)."""
+
+    def check(test, model, history, opts):
+        attempted = sum(1 for op in history
+                        if op.is_invoke and op.f == "generate")
+        acks = [op.value for op in history
+                if op.is_ok and op.f == "generate"]
+        counts = Counter(acks)
+        dups = {k: v for k, v in counts.items() if v > 1}
+        rng = [min(acks), max(acks)] if acks else [None, None]
+        top_dups = dict(sorted(dups.items(), key=lambda kv: -kv[1])[:48])
+        return {VALID: not dups,
+                "attempted-count": attempted,
+                "acknowledged-count": len(acks),
+                "duplicated-count": len(dups),
+                "duplicated": top_dups,
+                "range": rng}
+
+    return FnChecker(check)
+
+
+def counter() -> Checker:
+    """A monotonically-increasing counter: each read must fall between the
+    sum of :ok increments and the sum of attempted increments at that point
+    (checker.clj:321-374)."""
+
+    def check(test, model, history, opts):
+        from jepsen_tpu.history import complete
+
+        lower = 0            # sum of definite (ok) increments
+        upper = 0            # sum of attempted increments
+        pending_reads: dict[Any, list] = {}   # process -> [lower, read-val]
+        reads: list[list] = []                # completed [lower val upper]
+        for op in complete(list(history)):
+            key = (op.type, op.f)
+            if key == ("invoke", "read"):
+                pending_reads[op.process] = [lower, op.value]
+            elif key == ("ok", "read"):
+                r = pending_reads.pop(op.process, None)
+                if r is not None:
+                    reads.append(r + [upper])
+            elif key == ("invoke", "add"):
+                upper += op.value
+            elif key == ("ok", "add"):
+                lower += op.value
+        errors = [r for r in reads if not (r[0] <= r[1] <= r[2])]
+        return {VALID: not errors, "reads": reads, "errors": errors}
+
+    return FnChecker(check)
+
+
+def compose(checker_map: dict) -> Checker:
+    """Run each named checker (in parallel, like the reference's pmap at
+    checker.clj:382-388) and merge their valid? verdicts."""
+
+    def check(test, model, history, opts):
+        items = list(checker_map.items())
+        with ThreadPoolExecutor(max_workers=max(1, len(items))) as pool:
+            rs = list(pool.map(
+                lambda kv: (kv[0], check_safe(kv[1], test, model, history,
+                                              opts)),
+                items))
+        results = dict(rs)
+        results[VALID] = merge_valid([r[VALID] for _, r in rs])
+        return results
+
+    return FnChecker(check)
+
+
+def latency_graph() -> Checker:
+    """Latency point + quantile graphs (checker.clj:390-397); matplotlib
+    replaces the reference's gnuplot subprocess."""
+
+    def check(test, model, history, opts):
+        from jepsen_tpu.checker import perf as perf_mod
+
+        perf_mod.point_graph(test, history, opts)
+        perf_mod.quantiles_graph(test, history, opts)
+        return {VALID: True}
+
+    return FnChecker(check)
+
+
+def rate_graph() -> Checker:
+    """Throughput-over-time graph (checker.clj:399-405)."""
+
+    def check(test, model, history, opts):
+        from jepsen_tpu.checker import perf as perf_mod
+
+        perf_mod.rate_graph(test, history, opts)
+        return {VALID: True}
+
+    return FnChecker(check)
+
+
+def perf() -> Checker:
+    """Assorted performance statistics (checker.clj:407-411)."""
+    return compose({"latency-graph": latency_graph(),
+                    "rate-graph": rate_graph()})

@@ -1,0 +1,49 @@
+"""Client protocol: applies operations to the system under test.
+
+Re-design of `jepsen/src/jepsen/client.clj` (65 LoC). The open/close vs
+setup/teardown split (client.clj:7-22): ``open`` acquires a connection for
+one process; ``setup`` performs one-time data installation; ``invoke``
+applies one op and returns its completion; workers re-open clients when a
+process crashes (core.clj:168-217).
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu.history import Op
+
+
+class Client:
+    def open(self, test, node) -> "Client":
+        """Return a client bound to a connection to node. Called once per
+        process (client.clj:9-12)."""
+        return self
+
+    def setup(self, test) -> None:
+        """One-time database setup (client.clj:13-14)."""
+
+    def invoke(self, test, op: Op) -> Op:
+        """Apply op, returning its completion: type ok/fail/info
+        (client.clj:15-18)."""
+        raise NotImplementedError
+
+    def teardown(self, test) -> None:
+        """One-time cleanup (client.clj:19-20)."""
+
+    def close(self, test) -> None:
+        """Release this client's connection (client.clj:21-22)."""
+
+
+class NoopClient(Client):
+    """Does nothing (client.clj:24-31)."""
+
+    def invoke(self, test, op):
+        return op.replace(type="ok")
+
+
+noop = NoopClient()
+
+
+def closable(client) -> bool:
+    """Whether the client supports close (client.clj:48-55). All
+    jepsen_tpu clients do; kept for protocol parity."""
+    return isinstance(client, Client)

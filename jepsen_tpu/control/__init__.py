@@ -1,0 +1,403 @@
+"""Remote execution / communication backend — the control plane.
+
+Re-design of the reference's `jepsen/src/jepsen/control.clj` (361 LoC): THE
+distributed communication layer of the harness half. A dynamically-scoped
+session per node (control.clj:15-26), shell escaping (:53-96), sudo wrapping
+(:98-106), exec with retry on transient transport failures (:140-160), scp
+up/download (:190-217), and parallel fan-out over nodes (:314-353).
+
+Transports are pluggable:
+
+- :class:`SshTransport`   — drives the system ``ssh``/``scp`` binaries (the
+  reference uses clj-ssh/JSch; an external-process transport is the
+  TPU-image-friendly equivalent since no SSH library is vendored).
+- :class:`LocalTransport` — runs commands in a local shell, for single-host
+  dev clusters (docker-compose style) and tests.
+- :class:`DummyTransport` — records commands and returns canned results;
+  the analogue of the reference's ``*dummy*`` no-SSH stub (control.clj:15,
+  274-281) used by the no-cluster tests.
+
+The session is scoped with context variables rather than Clojure dynamic
+vars; ``with_session(node)`` / ``on(node, f)`` bind it.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import shlex
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+from jepsen_tpu.util import real_pmap
+
+
+class RemoteError(Exception):
+    """Command failed or transport broke."""
+
+    def __init__(self, msg, exit_code=None, out="", err=""):
+        super().__init__(msg)
+        self.exit_code = exit_code
+        self.out = out
+        self.err = err
+
+
+@dataclass
+class Result:
+    exit: int
+    out: str
+    err: str
+
+
+class Lit:
+    """A literal string that bypasses shell escaping (the reference's
+    `jepsen.control/lit`)."""
+
+    def __init__(self, s: str):
+        self.s = s
+
+    def __str__(self):
+        return self.s
+
+
+def escape(arg) -> str:
+    """Escape one command token (control.clj:53-96): literals pass through,
+    sequences join with spaces, everything else is shell-quoted when
+    needed."""
+    if isinstance(arg, Lit):
+        return arg.s
+    if isinstance(arg, (list, tuple)):
+        return " ".join(escape(a) for a in arg)
+    s = str(arg)
+    if s == "":
+        return "''"
+    if all(c.isalnum() or c in "-_./=:@%+," for c in s):
+        return s
+    return shlex.quote(s)
+
+
+def build_cmd(*args) -> str:
+    return " ".join(escape(a) for a in args)
+
+
+# --- dynamic scope ----------------------------------------------------------
+
+_session_var: contextvars.ContextVar = contextvars.ContextVar(
+    "control_session", default=None)
+_sudo_var: contextvars.ContextVar = contextvars.ContextVar(
+    "control_sudo", default=None)
+_dir_var: contextvars.ContextVar = contextvars.ContextVar(
+    "control_dir", default=None)
+_trace_var: contextvars.ContextVar = contextvars.ContextVar(
+    "control_trace", default=False)
+
+
+def current_session():
+    s = _session_var.get()
+    if s is None:
+        raise RemoteError("no control session bound; use on()/with_session()")
+    return s
+
+
+def current_node():
+    return current_session().node
+
+
+class _Binding:
+    def __init__(self, var, value):
+        self.var, self.value = var, value
+
+    def __enter__(self):
+        self.token = self.var.set(self.value)
+        return self.value
+
+    def __exit__(self, *exc):
+        self.var.reset(self.token)
+        return False
+
+
+def su():
+    """Within this scope, commands run as root via sudo
+    (control.clj:98-106 `wrap-sudo` + `su` macro)."""
+    return _Binding(_sudo_var, "root")
+
+
+def sudo(user: str):
+    return _Binding(_sudo_var, user)
+
+
+def cd(directory: str):
+    return _Binding(_dir_var, directory)
+
+
+def trace():
+    """Log commands before running them (control.clj:18,248-252)."""
+    return _Binding(_trace_var, True)
+
+
+def wrap_sudo(cmd: str) -> str:
+    user = _sudo_var.get()
+    if user:
+        return f"sudo -S -u {user} bash -c {shlex.quote(cmd)}"
+    return cmd
+
+
+def wrap_cd(cmd: str) -> str:
+    d = _dir_var.get()
+    if d:
+        return f"cd {shlex.quote(d)} && {cmd}"
+    return cmd
+
+
+# --- transports -------------------------------------------------------------
+
+class Session:
+    """One connection to one node."""
+
+    node: str
+
+    def execute(self, cmd: str, stdin: str | None = None) -> Result:
+        raise NotImplementedError
+
+    def upload(self, local: str, remote: str) -> None:
+        raise NotImplementedError
+
+    def download(self, remote: str, local: str) -> None:
+        raise NotImplementedError
+
+    def disconnect(self) -> None:
+        pass
+
+
+class Transport:
+    def connect(self, node: str, ssh: dict) -> Session:
+        raise NotImplementedError
+
+
+class DummySession(Session):
+    def __init__(self, node, log, results):
+        self.node = node
+        self.log = log
+        self.results = results
+
+    def execute(self, cmd, stdin=None):
+        self.log.append((self.node, cmd))
+        canned = self.results.get(cmd)
+        if canned is None:
+            return Result(0, "", "")
+        if isinstance(canned, Result):
+            return canned
+        return Result(0, str(canned), "")
+
+    def upload(self, local, remote):
+        self.log.append((self.node, f"UPLOAD {local} -> {remote}"))
+
+    def download(self, remote, local):
+        self.log.append((self.node, f"DOWNLOAD {remote} -> {local}"))
+
+
+class DummyTransport(Transport):
+    """Records commands; returns canned results (the `*dummy*` affordance,
+    control.clj:15,274-281)."""
+
+    def __init__(self, results: dict | None = None):
+        self.log: list = []
+        self.results = results or {}
+
+    def connect(self, node, ssh):
+        return DummySession(node, self.log, self.results)
+
+
+class LocalSession(Session):
+    def __init__(self, node):
+        self.node = node
+
+    def execute(self, cmd, stdin=None):
+        p = subprocess.run(["bash", "-c", cmd], capture_output=True,
+                           text=True, input=stdin)
+        return Result(p.returncode, p.stdout, p.stderr)
+
+    def upload(self, local, remote):
+        subprocess.run(["cp", "-r", local, remote], check=True)
+
+    def download(self, remote, local):
+        subprocess.run(["cp", "-r", remote, local], check=True)
+
+
+class LocalTransport(Transport):
+    """Run everything on localhost — single-host dev clusters."""
+
+    def connect(self, node, ssh):
+        return LocalSession(node)
+
+
+class SshSession(Session):
+    """Drives the system ssh/scp binaries. Equivalent role to the
+    reference's clj-ssh/JSch sessions (control.clj:254-281), including the
+    retry-on-transient-corruption loop (control.clj:140-160)."""
+
+    RETRIES = 5
+
+    def __init__(self, node, ssh: dict):
+        self.node = node
+        self.ssh = ssh or {}
+        self.base = ["ssh"]
+        port = self.ssh.get("port")
+        if port:
+            self.base += ["-p", str(port)]
+        key = self.ssh.get("private-key-path")
+        if key:
+            self.base += ["-i", key]
+        if not self.ssh.get("strict-host-key-checking", False):
+            self.base += ["-o", "StrictHostKeyChecking=no",
+                          "-o", "UserKnownHostsFile=/dev/null",
+                          "-o", "LogLevel=ERROR"]
+        self.user = self.ssh.get("username", "root")
+
+    @property
+    def dest(self):
+        return f"{self.user}@{self.node}"
+
+    def execute(self, cmd, stdin=None):
+        last: Exception | None = None
+        for attempt in range(self.RETRIES):
+            try:
+                p = subprocess.run(self.base + [self.dest, cmd],
+                                   capture_output=True, text=True,
+                                   input=stdin, timeout=600)
+                if p.returncode == 255:  # ssh transport failure: retry
+                    raise RemoteError(f"ssh transport error: {p.stderr}",
+                                      255, p.stdout, p.stderr)
+                return Result(p.returncode, p.stdout, p.stderr)
+            except (RemoteError, subprocess.TimeoutExpired) as e:
+                last = e
+                time.sleep(0.2 * (attempt + 1))
+        raise RemoteError(f"ssh to {self.node} failed after retries: {last}")
+
+    def _scp_base(self):
+        base = ["scp", "-r"]
+        port = self.ssh.get("port")
+        if port:
+            base += ["-P", str(port)]
+        key = self.ssh.get("private-key-path")
+        if key:
+            base += ["-i", key]
+        if not self.ssh.get("strict-host-key-checking", False):
+            base += ["-o", "StrictHostKeyChecking=no",
+                     "-o", "UserKnownHostsFile=/dev/null",
+                     "-o", "LogLevel=ERROR"]
+        return base
+
+    def upload(self, local, remote):
+        subprocess.run(self._scp_base() + [local, f"{self.dest}:{remote}"],
+                       check=True, capture_output=True)
+
+    def download(self, remote, local):
+        subprocess.run(self._scp_base() + [f"{self.dest}:{remote}", local],
+                       check=True, capture_output=True)
+
+
+class SshTransport(Transport):
+    def connect(self, node, ssh):
+        return SshSession(node, ssh)
+
+
+def transport_for(test: dict) -> Transport:
+    t = test.get("transport")
+    if t is None or t == "ssh":
+        return SshTransport()
+    if t == "local":
+        return LocalTransport()
+    if t == "dummy":
+        return DummyTransport()
+    if isinstance(t, Transport):
+        return t
+    raise ValueError(f"unknown transport {t!r}")
+
+
+# --- session management -----------------------------------------------------
+
+def session(test: dict, node: str) -> Session:
+    """Open a session to a node (control.clj:270-281)."""
+    return transport_for(test).connect(node, test.get("ssh") or {})
+
+
+def disconnect(sess: Session) -> None:
+    sess.disconnect()
+
+
+class with_session:
+    """Bind the current session (control.clj `with-session`)."""
+
+    def __init__(self, sess: Session):
+        self.sess = sess
+
+    def __enter__(self):
+        self._token = _session_var.set(self.sess)
+        return self.sess
+
+    def __exit__(self, *exc):
+        _session_var.reset(self._token)
+        return False
+
+
+def exec_(*args, stdin: str | None = None, may_fail: bool = False) -> str:
+    """Run an escaped command on the currently-bound node, returning trimmed
+    stdout; raises on non-zero exit (control.clj:175-181)."""
+    cmd = wrap_cd(wrap_sudo(build_cmd(*args)))
+    sess = current_session()
+    if _trace_var.get():
+        import logging
+
+        logging.getLogger("jepsen.control").info(
+            "[%s] %s", sess.node, cmd)
+    res = sess.execute(cmd, stdin=stdin)
+    if res.exit != 0 and not may_fail:
+        raise RemoteError(
+            f"command failed on {sess.node} (exit {res.exit}): {cmd}\n"
+            f"stdout: {res.out}\nstderr: {res.err}",
+            res.exit, res.out, res.err)
+    return res.out.strip()
+
+
+def upload(local: str, remote: str) -> None:
+    current_session().upload(local, remote)
+
+
+def download(remote: str, local: str) -> None:
+    current_session().download(remote, local)
+
+
+def on(test: dict, node: str, f: Callable[[], Any]) -> Any:
+    """Run f with a session to node bound (control.clj:314-323). Uses the
+    test's cached session when available."""
+    sessions = test.get("sessions") or {}
+    sess = sessions.get(node)
+    if sess is None:
+        sess = session(test, node)
+        try:
+            with with_session(sess):
+                return f()
+        finally:
+            sess.disconnect()
+    with with_session(sess):
+        return f()
+
+
+def on_nodes(test: dict, f: Callable[[dict, str], Any],
+             nodes: Iterable[str] | None = None) -> dict:
+    """Run (f test node) in parallel on each node with its session bound;
+    returns {node: result} (control.clj:337-353)."""
+    nodes = list(nodes if nodes is not None else test.get("nodes", []))
+
+    def run(node):
+        return node, on(test, node, lambda: f(test, node))
+
+    return dict(real_pmap(run, nodes))
+
+
+def on_many(test: dict, nodes: Iterable[str], f: Callable[[], Any]) -> dict:
+    """Run f in parallel on each of nodes (control.clj:325-335)."""
+    return dict(real_pmap(lambda n: (n, on(test, n, f)), list(nodes)))

@@ -1,0 +1,359 @@
+"""The test runner: coordinates setup, workload, fault injection, history
+collection, persistence, and checking.
+
+Re-design of `jepsen/src/jepsen/core.clj` (491 LoC). A test is a plain dict
+(schema documented at core.clj:382-403): nodes, concurrency, ssh, os, db,
+client, nemesis, generator, model, checker, name...
+
+Lifecycle (core.clj:404-430):
+
+1. OS setup on all nodes; 2. DB cycle (teardown+setup, plus Primary setup);
+3. nemesis setup + nemesis thread; 4. one worker thread per logical process,
+each driving a client with ops from the generator; 5. log capture;
+6. teardown; 7. index the history and run the checker.
+
+Key invariants preserved from the reference:
+
+- Each process is logically single-threaded; an op with indeterminate
+  outcome hangs its process forever, so the worker re-incarnates as
+  ``process + concurrency`` with a fresh client (core.clj:168-217).
+- Op timestamps come from the monotonic relative-time clock
+  (util.clj:235-252), so clock nemeses can't corrupt the history.
+- The nemesis is a dedicated thread writing to all active histories
+  (core.clj:267-309).
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import threading
+import traceback
+from typing import Any
+
+from jepsen_tpu import checker as checker_ns
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import control
+from jepsen_tpu import db as db_ns
+from jepsen_tpu import generator
+from jepsen_tpu import history as history_mod
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu import os_ as os_ns
+from jepsen_tpu import store
+from jepsen_tpu.history import Op
+from jepsen_tpu.util import (real_pmap, relative_time_nanos,
+                             relative_time_context)
+
+log = logging.getLogger("jepsen.core")
+
+
+def synchronize(test: dict) -> None:
+    """Block until all nodes arrive at the same point (core.clj:36-41)."""
+    barrier = test.get("barrier")
+    if barrier is not None and barrier != "no-barrier":
+        barrier.wait()
+
+
+def conj_op(test: dict, op: Op) -> Op:
+    """Append an op to the test's history (core.clj:43-47)."""
+    with test["history-lock"]:
+        test["history"].append(op)
+    return op
+
+
+def primary(test: dict):
+    """The primary node = first node (core.clj:49-52)."""
+    return test["nodes"][0] if test.get("nodes") else None
+
+
+def _log_op(op: Op) -> None:
+    log.info("%s\t%s\t%s\t%s", op.process, op.type, op.f, op.value)
+
+
+def setup_primary(test: dict) -> None:
+    """Primary-specific DB setup on the first node (core.clj:86-92)."""
+    db = test.get("db")
+    if isinstance(db, db_ns.Primary) and test.get("nodes"):
+        node = primary(test)
+        control.on(test, node, lambda: db.setup_primary(test, node))
+
+
+def snarf_logs(test: dict) -> None:
+    """Download DB log files from every node into the store directory
+    (core.clj:94-125)."""
+    db = test.get("db")
+    if not isinstance(db, db_ns.LogFiles):
+        return
+
+    def snarf(t, node):
+        for remote in db.log_files(t, node) or []:
+            local = store.path(t, str(node), remote.lstrip("/"), make=True)
+            try:
+                control.download(remote, str(local))
+            except Exception as e:  # noqa: BLE001 - logs are best-effort
+                log.info("couldn't download %s from %s: %s", remote, node, e)
+
+    control.on_nodes(test, snarf)
+
+
+def invoke_and_complete(node, process, client, test, op):
+    """Apply op via the client; append its completion; return the (possibly
+    re-incarnated) process and client (core.clj:143-217)."""
+    try:
+        completion = client.invoke(test, op)
+        assert completion is not None and completion.type in \
+            ("ok", "fail", "info"), \
+            f"Expected invoke to return ok/fail/info, got {completion!r}"
+        assert completion.process == op.process
+        assert completion.f == op.f
+        completion = completion.replace(time=relative_time_nanos())
+        _log_op(completion)
+        conj_op(test, completion)
+
+        if completion.type in ("ok", "fail"):
+            return process, client
+        # Indeterminate: this process is done; re-incarnate.
+        return _reincarnate(node, process, client, test)
+    except Exception as e:  # noqa: BLE001 - synthetic :info completion
+        # The op may or may not have been applied: record an :info
+        # completion and hang this process (core.clj:185-217).
+        info = op.replace(type="info", time=relative_time_nanos(),
+                          error=f"indeterminate: {e}")
+        conj_op(test, info)
+        log.warning("invocation on process %s indeterminate: %s", process, e)
+        return _reincarnate(node, process, client, test)
+
+
+def _reincarnate(node, process, client, test):
+    new_process = process + test["concurrency"]
+    try:
+        client.close(test)
+    except Exception:  # noqa: BLE001
+        pass
+    new_client = test["client"].open(test, node)
+    return new_process, new_client
+
+
+def worker(test: dict, setup_barrier: threading.Barrier, process: int,
+           node) -> threading.Thread:
+    """One worker thread per initial process (core.clj:219-265)."""
+
+    def run():
+        threading.current_thread().name = f"jepsen-worker-{process}"
+        ctx_threads = tuple(range(test["concurrency"])) + ("nemesis",)
+        with generator.with_threads(ctx_threads):
+            _worker_loop(test, setup_barrier, process, node)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def _worker_loop(test, setup_barrier, process, node):
+    gen = test.get("generator")
+    client = test["client"].open(test, node)
+    exception = None
+    setup_barrier.wait()
+    try:
+        while True:
+            op = generator.op_and_validate(gen, test, process)
+            if op is None:
+                break
+            op = history_mod.op(op).replace(process=process,
+                                            time=relative_time_nanos())
+            _log_op(op)
+            conj_op(test, op)
+            process, client = invoke_and_complete(
+                node, process, client, test, op)
+    except Exception as e:  # noqa: BLE001
+        exception = e
+        log.warning("worker for process %s threw:\n%s", process,
+                    traceback.format_exc())
+    finally:
+        # All ops complete before any worker tears down (core.clj:258-261).
+        setup_barrier.wait()
+        try:
+            client.close(test)
+        except Exception:  # noqa: BLE001
+            pass
+    if exception is not None:
+        test.setdefault("worker-errors", []).append(exception)
+
+
+def nemesis_worker(test: dict, nemesis) -> threading.Thread:
+    """The nemesis thread: draws fault ops from the generator, applies
+    them, and logs invocation+completion into every active history
+    (core.clj:267-309)."""
+
+    def run():
+        threading.current_thread().name = "jepsen-nemesis"
+        ctx_threads = tuple(range(test["concurrency"])) + ("nemesis",)
+        with generator.with_threads(ctx_threads):
+            while True:
+                op = generator.op_and_validate(test.get("generator"), test,
+                                               "nemesis")
+                if op is None:
+                    break
+                op = history_mod.op(op).replace(process="nemesis",
+                                                time=relative_time_nanos())
+                for hist, lock in list(test["active-histories"]):
+                    with lock:
+                        hist.append(op)
+                try:
+                    _log_op(op)
+                    completion = nemesis.invoke(test, op)
+                    completion = completion.replace(
+                        time=relative_time_nanos())
+                    assert op.type == "info"
+                    assert completion.f == op.f
+                    assert completion.process == op.process
+                    _log_op(completion)
+                    for hist, lock in list(test["active-histories"]):
+                        with lock:
+                            hist.append(completion)
+                except Exception as e:  # noqa: BLE001
+                    crashed = op.replace(time=relative_time_nanos(),
+                                         error=f"crashed: {e!r}")
+                    for hist, lock in list(test["active-histories"]):
+                        with lock:
+                            hist.append(crashed)
+                    log.warning("nemesis crashed evaluating %s:\n%s", op,
+                                traceback.format_exc())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def run_case(test: dict) -> list[Op]:
+    """Spawn nemesis + workers, run the workload, snarf logs, return the
+    history (core.clj:331-365)."""
+    history: list[Op] = []
+    lock = threading.Lock()
+    test = dict(test)
+    test["history"] = history
+    test["history-lock"] = lock
+    test["active-histories"].append((history, lock))
+
+    nemesis = (test.get("nemesis") or nemesis_ns.noop).setup(test) \
+        or test.get("nemesis") or nemesis_ns.noop
+    try:
+        nem_thread = nemesis_worker(test, nemesis)
+        concurrency = test["concurrency"]
+        setup_barrier = threading.Barrier(concurrency)
+        nodes = test.get("nodes") or []
+        client_nodes = ([None] * concurrency if not nodes else
+                        [nodes[i % len(nodes)] for i in range(concurrency)])
+        workers = [worker(test, setup_barrier, process, node)
+                   for process, node in enumerate(client_nodes)]
+        for w in workers:
+            w.join()
+        log.info("waiting for nemesis to complete")
+        nem_thread.join()
+    finally:
+        nemesis.teardown(test)
+
+    snarf_logs(test)
+    test["active-histories"].remove((history, lock))
+    if test.get("worker-errors"):
+        raise test["worker-errors"][0]
+    return history
+
+
+def _open_sessions(test: dict) -> dict:
+    """Open all node sessions in parallel; on any failure, close the ones
+    that opened and raise (`with-resources`, core.clj:54-75)."""
+    nodes = list(test.get("nodes") or [])
+
+    def open_one(node):
+        try:
+            return node, control.session(test, node)
+        except Exception as e:  # noqa: BLE001
+            return node, e
+
+    sessions = dict(real_pmap(open_one, nodes))
+    errors = {n: s for n, s in sessions.items() if isinstance(s, Exception)}
+    if errors:
+        for s in sessions.values():
+            if not isinstance(s, Exception):
+                s.disconnect()
+        raise RemoteSetupError(f"couldn't open sessions: {errors}")
+    return sessions
+
+
+class RemoteSetupError(Exception):
+    pass
+
+
+def run(test: dict) -> dict:
+    """Run a test (core.clj:381-491). Returns the test dict with :history
+    and :results."""
+    test = dict(test)
+    test.setdefault("start-time", datetime.datetime.now())
+    test["concurrency"] = test.get("concurrency") or len(test["nodes"])
+    n_nodes = len(test.get("nodes") or [])
+    test["barrier"] = threading.Barrier(n_nodes) if n_nodes else "no-barrier"
+    test["active-histories"] = []
+    test.setdefault("os", os_ns.noop)
+    test.setdefault("db", db_ns.noop)
+    test.setdefault("client", client_ns.noop)
+    test.setdefault("nemesis", nemesis_ns.noop)
+    test.setdefault("checker", checker_ns.unbridled_optimism())
+
+    if test.get("name"):
+        store.start_logging(test)
+    try:
+        log.info("Running test: %s", store.serializable_test(test))
+        sessions = _open_sessions(test)
+        test["sessions"] = sessions
+        try:
+            # OS setup (core.clj:77-84)
+            control.on_nodes(test,
+                             lambda t, n: t["os"].setup(t, n))
+            try:
+                # DB cycle + primary (core.clj:127-141)
+                try:
+                    control.on_nodes(
+                        test, lambda t, n: db_ns.cycle(t["db"], t, n))
+                    setup_primary(test)
+
+                    with relative_time_context():
+                        test["history"] = run_case(test)
+                except Exception:
+                    snarf_logs(test)  # emergency log dump
+                    if test.get("name"):
+                        store.update_symlinks(test)
+                    raise
+                finally:
+                    control.on_nodes(
+                        test, lambda t, n: t["db"].teardown(t, n))
+            finally:
+                control.on_nodes(test,
+                                 lambda t, n: t["os"].teardown(t, n))
+        finally:
+            for s in sessions.values():
+                s.disconnect()
+
+        log.info("Run complete, writing")
+        if test.get("name"):
+            store.save_1(test)
+
+        log.info("Analyzing")
+        test["history"] = history_mod.index(test["history"])
+        test["results"] = checker_ns.check_safe(
+            test["checker"], test, test.get("model"), test["history"])
+        log.info("Analysis complete")
+        if test.get("name"):
+            store.save_2(test)
+        _log_results(test)
+        return test
+    finally:
+        store.stop_logging()
+
+
+def _log_results(test: dict) -> None:
+    results = test.get("results", {})
+    if results.get(checker_ns.VALID) is True:
+        log.info("Everything looks good! (valid)")
+    else:
+        log.info("Analysis invalid! %s", results)

@@ -1,0 +1,43 @@
+"""DB lifecycle protocol: install/teardown the system under test.
+
+Re-design of `jepsen/src/jepsen/db.clj` (25 LoC): DB/Primary/LogFiles
+protocols (db.clj:4-12) and ``cycle`` = teardown then setup (db.clj:20-25).
+"""
+
+from __future__ import annotations
+
+
+class DB:
+    def setup(self, test, node) -> None:
+        """Install and start the database on node (db.clj:5-6)."""
+
+    def teardown(self, test, node) -> None:
+        """Remove the database from node (db.clj:7-8)."""
+
+
+class Primary:
+    """Optional mixin: databases with a distinguished primary
+    (db.clj:9-10)."""
+
+    def setup_primary(self, test, node) -> None:
+        """Perform primary-specific setup on the first node."""
+
+
+class LogFiles:
+    """Optional mixin: log file enumeration for download (db.clj:11-12)."""
+
+    def log_files(self, test, node) -> list[str]:
+        return []
+
+
+class NoopDB(DB):
+    """Does nothing (db.clj:14-18)."""
+
+
+noop = NoopDB()
+
+
+def cycle(db: DB, test, node) -> None:
+    """Tear down, then set up (db.clj:20-25)."""
+    db.teardown(test, node)
+    db.setup(test, node)

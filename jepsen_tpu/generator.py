@@ -1,0 +1,545 @@
+"""Operation generator DSL.
+
+Composable, stateful op sources — a re-design of the reference's
+`jepsen/src/jepsen/generator.clj` (457 LoC): "Generates operations for a
+test... Every object may act as a generator, and constantly yields itself.
+Big ol box of monads, really."
+
+The protocol is a single function ``op(gen, test, process)``
+(generator.clj:22-23) where ``gen`` may be:
+
+- ``None``        — terminates (yields None forever)
+- an :class:`Op` or dict — constantly yields itself
+- a callable      — called as ``f(test, process)`` or ``f()``
+- a :class:`Generator` — dispatches to its ``op`` method
+
+Thread routing (``on``/``reserve``/``nemesis``/``clients``) rebinds the
+dynamically-scoped thread set exactly like the reference's ``*threads*``
+var (generator.clj:40-55), here a context variable bound per worker thread.
+Synchronization combinators (``synchronize``/``phases``/``barrier``) block
+threads on a shared barrier (generator.clj:402-424).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import random as _random
+import threading
+import time as _time
+from typing import Any, Callable, Iterable
+
+from jepsen_tpu.history import Op, op as _as_op
+
+_threads_var: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+    "jepsen_threads", default=())
+
+
+def current_threads() -> tuple:
+    """The ordered collection of threads executing the current generator:
+    'nemesis' plus 0..concurrency-1 (generator.clj:40-46)."""
+    return _threads_var.get()
+
+
+def _sort_threads(threads: Iterable) -> tuple:
+    """Integers first in order, then named threads — knossos
+    sort-processes order."""
+    ts = list(threads)
+    ints = sorted(t for t in ts if isinstance(t, int))
+    others = [t for t in ts if not isinstance(t, int)]
+    return tuple(ints + others)
+
+
+class with_threads:
+    """Context manager binding the thread set (generator.clj:48-55).
+    Asserts the threads are sorted, like the reference."""
+
+    def __init__(self, threads: Iterable):
+        self.threads = tuple(threads)
+        assert self.threads == _sort_threads(self.threads), \
+            f"threads not sorted: {self.threads}"
+
+    def __enter__(self):
+        self._token = _threads_var.set(self.threads)
+        return self.threads
+
+    def __exit__(self, *exc):
+        _threads_var.reset(self._token)
+        return False
+
+
+def process_to_thread(test, process):
+    """process mod concurrency, or the process itself for named threads like
+    'nemesis' (generator.clj:57-62)."""
+    if isinstance(process, int):
+        return process % test["concurrency"]
+    return process
+
+
+def process_to_node(test, process):
+    """The node this process is likely talking to (generator.clj:64-71)."""
+    thread = process_to_thread(test, process)
+    if isinstance(thread, int):
+        nodes = test["nodes"]
+        return nodes[thread % len(nodes)]
+    return None
+
+
+class Generator:
+    def op(self, test, process):
+        raise NotImplementedError
+
+
+def op(gen, test, process):
+    """Yield an operation from any generator-like object (the open protocol
+    of generator.clj:25-38)."""
+    if gen is None:
+        return None
+    if isinstance(gen, Generator):
+        return gen.op(test, process)
+    if isinstance(gen, (Op, dict)):
+        return gen
+    if callable(gen):
+        try:
+            return gen(test, process)
+        except TypeError:
+            return gen()
+    return gen
+
+
+def op_and_validate(gen, test, process):
+    """Ensure the generator produced an op map or None
+    (generator.clj:446-457)."""
+    o = op(gen, test, process)
+    if o is not None and not isinstance(o, (Op, dict)):
+        raise AssertionError(
+            f"Expected an operation map from {gen!r}, got {o!r} instead.")
+    return o
+
+
+class _Fn(Generator):
+    def __init__(self, fn):
+        self.fn = fn
+
+    def op(self, test, process):
+        return self.fn(test, process)
+
+
+def gen(fn) -> Generator:
+    """Wrap a 2-arg function as a generator."""
+    return _Fn(fn)
+
+
+void = gen(lambda test, process: None)
+"""A generator which terminates immediately (generator.clj:73-76)."""
+
+
+def sleep_til_nanos(t: int) -> None:
+    """High-resolution sleep until monotonic nanos t (generator.clj:78-82)."""
+    while _time.monotonic_ns() + 10_000 < t:
+        _time.sleep(max(0.0, (t - _time.monotonic_ns()) / 1e9))
+
+
+def delay_fn(f: Callable[[], float], source) -> Generator:
+    """Every op from the underlying generator takes f() seconds longer
+    (generator.clj:89-95)."""
+
+    def go(test, process):
+        _time.sleep(f())
+        return op(source, test, process)
+
+    return gen(go)
+
+
+def delay(dt: float, source) -> Generator:
+    """Every op takes dt seconds to return (generator.clj:97-100)."""
+    return delay_fn(lambda: dt, source)
+
+
+def next_tick_nanos(anchor: int, dt: int, now: int | None = None) -> int:
+    """Next tick after `now` separated from anchor by a multiple of dt
+    (generator.clj:102-110)."""
+    if now is None:
+        now = _time.monotonic_ns()
+    return now + (dt - (now - anchor) % dt)
+
+
+def delay_til(dt: float, source, precache: bool = True) -> Generator:
+    """Emit ops as close as possible to multiples of dt seconds from an
+    epoch — useful for triggering race conditions (generator.clj:112-135)."""
+    anchor = _time.monotonic_ns()
+    dtn = int(dt * 1e9)
+
+    if precache:
+        def go(test, process):
+            o = op(source, test, process)
+            sleep_til_nanos(next_tick_nanos(anchor, dtn))
+            return o
+    else:
+        def go(test, process):
+            sleep_til_nanos(next_tick_nanos(anchor, dtn))
+            return op(source, test, process)
+
+    return gen(go)
+
+
+def stagger(dt: float, source) -> Generator:
+    """Uniform random delay, mean dt, range [0, 2dt)
+    (generator.clj:137-141)."""
+    return delay_fn(lambda: _random.uniform(0, 2 * dt), source)
+
+
+def sleep(dt: float) -> Generator:
+    """Takes dt seconds, always produces None (generator.clj:143-146)."""
+    return delay(dt, void)
+
+
+def once(source) -> Generator:
+    """Invoke the underlying generator only once (generator.clj:148-156)."""
+    state = {"emitted": False}
+    lock = threading.Lock()
+
+    def go(test, process):
+        with lock:
+            if state["emitted"]:
+                return None
+            state["emitted"] = True
+        return op(source, test, process)
+
+    return gen(go)
+
+
+def log_every(msg: str) -> Generator:
+    """Log a message every time invoked, yield None
+    (generator.clj:158-164)."""
+    import logging
+
+    def go(test, process):
+        logging.getLogger("jepsen").info(msg)
+        return None
+
+    return gen(go)
+
+
+def log(msg: str) -> Generator:
+    """Log a message once, yield None (generator.clj:166-169)."""
+    return once(log_every(msg))
+
+
+def each(gen_fn: Callable[[], Any]) -> Generator:
+    """A fresh copy of the underlying generator per process
+    (generator.clj:171-193). Takes a zero-arg factory."""
+    gens: dict = {}
+    lock = threading.Lock()
+
+    def go(test, process):
+        with lock:
+            if process not in gens:
+                gens[process] = gen_fn()
+            g = gens[process]
+        return op(g, test, process)
+
+    return gen(go)
+
+
+def seq(coll: Iterable) -> Generator:
+    """One op from the first element, then one from the second, etc.; an
+    element yielding None advances immediately; None once the collection is
+    exhausted (generator.clj:195-206 — the reference pops one element per
+    call, so each element emits at most one op)."""
+    it = iter(coll)
+    lock = threading.Lock()
+
+    def go(test, process):
+        while True:
+            with lock:
+                try:
+                    g_ = next(it)
+                except StopIteration:
+                    return None
+            o = op(g_, test, process)
+            if o is not None:
+                return o
+
+    return gen(go)
+
+
+def _cycle(xs):
+    while True:
+        yield from xs
+
+
+def start_stop(t1: float, t2: float) -> Generator:
+    """Emit {:info :start} after t1 seconds, {:info :stop} after t2, repeat
+    (generator.clj:208-215). The canonical nemesis schedule."""
+    return seq(_start_stop_iter(t1, t2))
+
+
+def _start_stop_iter(t1, t2):
+    while True:
+        yield sleep(t1)
+        yield Op("info", "start")
+        yield sleep(t2)
+        yield Op("info", "stop")
+
+
+def mix(gens: Iterable) -> Generator:
+    """Uniform random mixture of generators (generator.clj:217-224)."""
+    gens = list(gens)
+
+    def go(test, process):
+        return op(_random.choice(gens), test, process)
+
+    return gen(go)
+
+
+def cas(value_range: int = 5) -> Generator:
+    """Random read/write/cas ops over a small int field
+    (generator.clj:226-239)."""
+
+    def go(test, process):
+        r = _random.random()
+        if r > 0.66:
+            return Op("invoke", "read", None)
+        if r > 0.33:
+            return Op("invoke", "write", _random.randrange(value_range))
+        return Op("invoke", "cas", [_random.randrange(value_range),
+                                    _random.randrange(value_range)])
+
+    return gen(go)
+
+
+def queue_gen() -> Generator:
+    """Random enqueue (consecutive ints) / dequeue mix
+    (generator.clj:241-252)."""
+    counter = {"i": -1}
+    lock = threading.Lock()
+
+    def go(test, process):
+        if _random.random() > 0.5:
+            with lock:
+                counter["i"] += 1
+                return Op("invoke", "enqueue", counter["i"])
+        return Op("invoke", "dequeue", None)
+
+    return gen(go)
+
+
+def drain_queue(source) -> Generator:
+    """Track enqueue/dequeue balance; when the source is exhausted, emit
+    enough dequeues to drain every attempted enqueue
+    (generator.clj:254-269)."""
+    state = {"outstanding": 0}
+    lock = threading.Lock()
+
+    def go(test, process):
+        o = op(source, test, process)
+        if o is not None:
+            if o.get("f") == "enqueue":
+                with lock:
+                    state["outstanding"] += 1
+            return o
+        with lock:
+            state["outstanding"] -= 1
+            remaining = state["outstanding"]
+        if remaining >= 0:
+            return Op("invoke", "dequeue", None)
+        return None
+
+    return gen(go)
+
+
+def limit(n: int, source) -> Generator:
+    """Only produce n operations (generator.clj:271-278)."""
+    state = {"life": n + 1}
+    lock = threading.Lock()
+
+    def go(test, process):
+        with lock:
+            state["life"] -= 1
+            alive = state["life"] > 0
+        if alive:
+            return op(source, test, process)
+        return None
+
+    return gen(go)
+
+
+def time_limit(dt: float, source) -> Generator:
+    """Yield ops until dt seconds have elapsed (generator.clj:280-291)."""
+    state = {"deadline": None}
+    lock = threading.Lock()
+
+    def go(test, process):
+        with lock:
+            if state["deadline"] is None:
+                state["deadline"] = _time.monotonic() + dt
+        if _time.monotonic() <= state["deadline"]:
+            return op(source, test, process)
+        return None
+
+    return gen(go)
+
+
+def filter_gen(f: Callable, source) -> Generator:
+    """Only ops satisfying f(op) (generator.clj:293-303)."""
+
+    def go(test, process):
+        while True:
+            o = op(source, test, process)
+            if o is None:
+                return None
+            if f(o):
+                return o
+
+    return gen(go)
+
+
+def on(f: Callable, source) -> Generator:
+    """Forward ops iff f(thread) is truthy; rebinds the thread set
+    (generator.clj:305-313)."""
+
+    def go(test, process):
+        thread = process_to_thread(test, process)
+        if not f(thread):
+            return None
+        sub = tuple(t for t in current_threads() if f(t))
+        with with_threads(sub):
+            return op(source, test, process)
+
+    return gen(go)
+
+
+def reserve(*args) -> Generator:
+    """(reserve(5, write_gen, 10, cas_gen, read_gen)): first 5 threads use
+    write_gen, next 10 cas_gen, the rest the default
+    (generator.clj:315-358). Rebinds the thread set per range."""
+    if len(args) % 2 != 1:
+        raise ValueError("reserve takes count/gen pairs + a default gen")
+    pairs = list(zip(args[:-1:2], args[1:-1:2]))
+    default = args[-1]
+    ranges = []
+    n = 0
+    for count, g in pairs:
+        ranges.append((n, n + count, g))
+        n += count
+
+    def go(test, process):
+        threads = list(current_threads())
+        thread = process_to_thread(test, process)
+        chosen = None
+        for lower, upper, g in ranges:
+            if upper <= len(threads) and \
+                    threads.index(thread) < upper:
+                chosen = (lower, upper, g)
+                break
+        if chosen is None:
+            lower = ranges[-1][1] if ranges else 0
+            chosen = (lower, len(threads), default)
+        lo, hi, g = chosen
+        with with_threads(tuple(threads[lo:hi])):
+            return op(g, test, process)
+
+    return gen(go)
+
+
+def concat(*sources) -> Generator:
+    """First non-None op from the sources, in order
+    (generator.clj:360-370)."""
+
+    def go(test, process):
+        for source in sources:
+            o = op(source, test, process)
+            if o is not None:
+                return o
+        return None
+
+    return gen(go)
+
+
+def nemesis(nemesis_gen, client_gen=None) -> Generator:
+    """Route the 'nemesis' process to nemesis_gen, others to client_gen
+    (generator.clj:372-380)."""
+    if client_gen is None:
+        return on(lambda t: t == "nemesis", nemesis_gen)
+    return concat(on(lambda t: t == "nemesis", nemesis_gen),
+                  on(lambda t: t != "nemesis", client_gen))
+
+
+def clients(client_gen) -> Generator:
+    """Execute only on client threads (generator.clj:382-385)."""
+    return on(lambda t: t != "nemesis", client_gen)
+
+
+def await_fn(f: Callable, source=None) -> Generator:
+    """Block until f() returns (invoked once), then proceed with source
+    (generator.clj:387-400)."""
+    state = {"waiting": True}
+    lock = threading.Lock()
+
+    def go(test, process):
+        if state["waiting"]:
+            with lock:
+                if state["waiting"]:
+                    f()
+                    state["waiting"] = False
+        return op(source, test, process)
+
+    return gen(go)
+
+
+def synchronize(source) -> Generator:
+    """Block until every thread in the current thread set is awaiting an op
+    from this generator, then proceed; synchronizes once
+    (generator.clj:402-418)."""
+    state: dict = {"barrier": None, "clear": False}
+    lock = threading.Lock()
+
+    def go(test, process):
+        if not state["clear"]:
+            with lock:
+                if state["barrier"] is None and not state["clear"]:
+                    n = len(current_threads())
+
+                    def clear():
+                        state["clear"] = True
+
+                    state["barrier"] = threading.Barrier(n, action=clear)
+                barrier = state["barrier"]
+            if barrier is not None and not state["clear"]:
+                try:
+                    barrier.wait()
+                except threading.BrokenBarrierError:
+                    pass
+        return op(source, test, process)
+
+    return gen(go)
+
+
+def phases(*generators) -> Generator:
+    """Like concat, but all threads must finish each generator before moving
+    on (generator.clj:420-424)."""
+    return concat(*[synchronize(g) for g in generators])
+
+
+def then(a, b) -> Generator:
+    """Generator b, synchronize, then generator a — backwards so it reads
+    well in pipelines (generator.clj:426-430)."""
+    return concat(b, synchronize(a))
+
+
+def singlethreaded(source) -> Generator:
+    """Exclusive lock around the underlying generator
+    (generator.clj:432-439)."""
+    lock = threading.Lock()
+
+    def go(test, process):
+        with lock:
+            return op(source, test, process)
+
+    return gen(go)
+
+
+def barrier(source) -> Generator:
+    """When the generator completes, synchronize, then yield None
+    (generator.clj:441-444)."""
+    return then(void, source)

@@ -201,8 +201,18 @@ def dumps_op(o: Op) -> str:
     return json.dumps(o.to_dict(), default=_default)
 
 
+def _decode(v):
+    if isinstance(v, dict):
+        if set(v) == {"__set__"}:
+            return frozenset(_decode(x) for x in v["__set__"])
+        return {k: _decode(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_decode(x) for x in v]
+    return v
+
+
 def loads_op(s: str) -> Op:
-    return Op.from_dict(json.loads(s))
+    return Op.from_dict(_decode(json.loads(s)))
 
 
 def write_history(path, history: Iterable[Op]) -> None:
@@ -234,7 +244,4 @@ class History(list):
 
 
 def index_history(h: "History") -> "History":
-    out = History()
-    for i, o in enumerate(h):
-        out.append(o.replace(index=i) if o.index != i else o)
-    return out
+    return History(index(h))

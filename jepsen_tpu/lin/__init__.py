@@ -1,0 +1,91 @@
+"""Linearizability checking — the compute kernel of the framework.
+
+This package replaces the external knossos solver the reference delegates to
+(`jepsen/src/jepsen/checker.clj:82-107`, knossos 0.3.1 per
+`jepsen/project.clj:9`). The search is reframed TPU-first: instead of
+knossos's JVM graph search (`knossos.linear` / `knossos.wgl`), linearizability
+is decided by a breadth-first frontier over
+``(linearized-op-bitset x model-state)`` configurations:
+
+- :mod:`jepsen_tpu.lin.prepare` — host-side packing: invoke/completion
+  pairing, concurrency-window slot assignment, value interning, the
+  return-event table both backends consume.
+- :mod:`jepsen_tpu.lin.cpu`     — host reference implementation of the
+  just-in-time linearization closure (semantic spec + fallback for models
+  without device kernels; analogue of knossos.linear).
+- :mod:`jepsen_tpu.lin.bfs`     — the device kernel: frontier in HBM as
+  packed uint32 bitsets + model-state ints, expansion vmapped over
+  (config x candidate op), dedup via lexicographic sort, `lax.scan` over
+  return events (analogue of knossos.wgl, but data-parallel).
+- :mod:`jepsen_tpu.lin.sharded` — pjit/shard_map multi-chip frontier with
+  collective dedup over ICI.
+- :mod:`jepsen_tpu.lin.brute`   — tiny exhaustive search used to test the
+  testers.
+
+``analysis(model, history)`` mirrors the shape of
+``knossos.competition/analysis`` results consumed at checker.clj:104-107:
+``{"valid?": bool, "op": ..., "configs": [...], "final-paths": [...]}``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from jepsen_tpu.lin import prepare as _prepare_mod
+from jepsen_tpu.lin.prepare import PackedHistory, UnsupportedHistory
+
+
+def analysis(model, history, algorithm: str = "competition", **kw) -> dict:
+    """Decide linearizability of ``history`` against ``model``.
+
+    algorithm: ``"tpu"`` (device BFS), ``"cpu"`` (host reference), or
+    ``"competition"`` — race both like knossos.competition (the reference
+    selects among these at checker.clj:90-93).
+    """
+    try:
+        packed = _prepare_mod.prepare(model, history)
+    except UnsupportedHistory as e:
+        return {"valid?": "unknown", "error": str(e), "analyzer": "prepare"}
+
+    if algorithm == "cpu":
+        from jepsen_tpu.lin import cpu
+
+        return cpu.check_packed(packed, **kw)
+    if algorithm == "tpu":
+        from jepsen_tpu.lin import bfs
+
+        return bfs.check_packed(packed, **kw)
+    if algorithm == "competition":
+        return _competition(packed, **kw)
+    raise ValueError(f"unknown linearizability algorithm {algorithm!r}")
+
+
+def _competition(packed: PackedHistory, **kw) -> dict:
+    """Race the device and host searches; first definite verdict wins
+    (knossos.competition/analysis semantics: both algorithms race, winner's
+    analysis is returned)."""
+    from jepsen_tpu.lin import bfs, cpu
+
+    result: dict = {}
+    done = threading.Event()
+
+    def run(fn, name):
+        try:
+            r = fn(packed, **kw)
+        except Exception as e:  # noqa: BLE001 - loser may die, race decides
+            r = {"valid?": "unknown", "error": f"{name}: {e!r}"}
+        if r.get("valid?") in (True, False) or not done.is_set():
+            if not result or r.get("valid?") in (True, False):
+                if not done.is_set():
+                    result.update(r)
+                    done.set()
+
+    threads = [threading.Thread(target=run, args=(cpu.check_packed, "cpu"),
+                                daemon=True),
+               threading.Thread(target=run, args=(bfs.check_packed, "tpu"),
+                                daemon=True)]
+    for t in threads:
+        t.start()
+    done.wait()
+    return dict(result)

@@ -1,0 +1,223 @@
+"""The device linearizability kernel: BFS frontier over
+(linearized-op-bitset x model-state) configurations.
+
+This replaces the reference's exponential JVM search (knossos.linear /
+knossos.wgl, selected at checker.clj:90-93) with a data-parallel formulation
+designed for the TPU's compilation model:
+
+- The frontier lives in fixed-capacity device arrays: ``bits: u32[CAP]``
+  (which pending ops each config has linearized — slot-compressed by
+  :mod:`jepsen_tpu.lin.prepare` so 32 bits cover the concurrency window,
+  not the history length) and ``state: i32[CAP, S]`` (packed model state).
+- One outer `lax.while_loop` walks the R return events. Each step runs the
+  just-in-time closure as an inner `lax.while_loop`: candidate transitions
+  are the full cross product (config x pending slot), evaluated in one shot
+  by the branchless model step kernels (vmap x vmap) — this is the op that
+  fills the vector units; there is no per-config control flow anywhere.
+- Dedup is a lexicographic `lax.sort` over (invalid, bits, state) followed
+  by adjacent-duplicate masking and a cumsum scatter compaction. Fixpoint
+  is detected by the unique-config count not growing (the old frontier is
+  part of the candidate pool, so the set is monotone).
+- Static shapes throughout: frontier capacity CAP is a compile-time
+  constant. Searches run on an escalating CAP schedule — almost all real
+  histories need a tiny frontier, so the common case compiles small and
+  fast, and only pathological histories pay for big buffers. Overflow is
+  detected exactly (a lost config could flip the verdict) and escalates.
+
+The same jitted function is the unit that :mod:`jepsen_tpu.lin.sharded`
+shards over a device mesh and that the independent-keys checker vmaps over
+batched per-key histories.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from jepsen_tpu.lin.prepare import PackedHistory
+
+DEFAULT_CAP_SCHEDULE = (64, 1024, 16384)
+MAX_DEVICE_WINDOW = 32
+
+
+def _dedup(bits, state, valid, cap):
+    """Sort-dedup-compact. Returns (bits[cap], state[cap,S], count, overflow).
+
+    Invalid rows sort last; duplicates are adjacent after the lexicographic
+    sort and masked; survivors are scatter-compacted to the front.
+    """
+    n = bits.shape[0]
+    s_width = state.shape[1]
+    inv = (~valid).astype(jnp.uint32)
+    operands = (inv, bits) + tuple(state[:, k] for k in range(s_width))
+    sorted_ops = lax.sort(operands, num_keys=len(operands))
+    inv_s, bits_s = sorted_ops[0], sorted_ops[1]
+    state_s = jnp.stack(sorted_ops[2:], axis=1)
+
+    prev_differs = (bits_s != jnp.roll(bits_s, 1)) | \
+        jnp.any(state_s != jnp.roll(state_s, 1, axis=0), axis=1)
+    first = jnp.arange(n) == 0
+    mask = (inv_s == 0) & (first | prev_differs)
+
+    total = jnp.sum(mask.astype(jnp.int32))
+    overflow = total > cap
+    pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    idx = jnp.where(mask & (pos < cap), pos, n)
+
+    out_n = max(n, cap) + 1
+    out_bits = jnp.zeros(out_n, jnp.uint32).at[idx].set(bits_s)[:cap]
+    out_state = jnp.zeros((out_n, s_width), jnp.int32) \
+        .at[idx].set(state_s)[:cap]
+    count = jnp.minimum(total, cap)
+    return out_bits, out_state, count, overflow
+
+
+@partial(jax.jit, static_argnames=("cap", "step_fn"))
+def _search(ret_slot, active, slot_f, slot_v, init_state, *, cap, step_fn):
+    """Run the full search. Returns (ok, dead_row, overflow, final_count).
+
+    ret_slot: i32[R]; active: bool[R,W]; slot_f: i32[R,W];
+    slot_v: i32[R,W,VW]; init_state: i32[S].
+    """
+    R, W = active.shape
+    S = init_state.shape[0]
+
+    bits0 = jnp.zeros(cap, jnp.uint32)
+    state0 = jnp.zeros((cap, S), jnp.int32) \
+        .at[0].set(init_state)
+    count0 = jnp.int32(1)
+
+    step_cfg_slot = jax.vmap(                 # over configs
+        jax.vmap(step_fn, in_axes=(None, 0, 0)),   # over slots
+        in_axes=(0, None, None))
+
+    slot_bit = (jnp.uint32(1) << jnp.arange(W, dtype=jnp.uint32))
+
+    def closure_cond(c):
+        _, _, count, prev, ovf = c
+        return (count != prev) & ~ovf
+
+    def row_body(carry):
+        r, bits, state, count, dead, ovf = carry
+        act = active[r]
+        f_row = slot_f[r]
+        v_row = slot_v[r]
+        s = ret_slot[r]
+
+        def closure_body(c):
+            bits, state, count, prev, ovf = c
+            cfg_valid = jnp.arange(cap) < count
+
+            # the hot op: every (config x pending-slot) transition at once
+            ok, new_state = step_cfg_slot(state, f_row, v_row)
+            already = (bits[:, None] & slot_bit[None, :]) != 0
+            legal = ok & act[None, :] & ~already & cfg_valid[:, None]
+            new_bits = bits[:, None] | slot_bit[None, :]
+
+            cand_bits = jnp.concatenate([bits, new_bits.reshape(-1)])
+            cand_state = jnp.concatenate(
+                [state, new_state.reshape(-1, S)], axis=0)
+            cand_valid = jnp.concatenate([cfg_valid, legal.reshape(-1)])
+
+            b2, s2, n2, o2 = _dedup(cand_bits, cand_state, cand_valid, cap)
+            return (b2, s2, n2, count, ovf | o2)
+
+        init = (bits, state, count, jnp.int32(-1), ovf)
+        bits, state, count, _, ovf = lax.while_loop(
+            closure_cond, closure_body, init)
+
+        # Filter: the returning op's linearization point must precede its
+        # return; then recycle its slot bit.
+        s_bit = jnp.uint32(1) << s.astype(jnp.uint32)
+        cfg_valid = jnp.arange(cap) < count
+        keep = cfg_valid & ((bits & s_bit) != 0)
+        bits = bits & ~s_bit
+        bits, state, count, o2 = _dedup(bits, state, keep, cap)
+        dead = count == 0
+        return (r + 1, bits, state, count, dead, ovf | o2)
+
+    def row_cond(carry):
+        r, _, _, _, dead, ovf = carry
+        return (r < R) & ~dead & ~ovf
+
+    r, bits, state, count, dead, ovf = lax.while_loop(
+        row_cond, row_body,
+        (jnp.int32(0), bits0, state0, count0, False, False))
+    # dead_row is the row at which the frontier died (r was incremented)
+    return ~dead & ~ovf, r - 1, ovf, count
+
+
+def _pad_rows(p: PackedHistory):
+    """Bucket R up to a power of two with identity rows so XLA compiles one
+    kernel per bucket instead of one per history length.
+
+    An identity row uses a dedicated pad slot (column W) carrying the
+    universal no-op f: every config linearizes it (state unchanged), the
+    filter keeps everyone, and the recycle clears the bit — frontier exactly
+    preserved. Requires one spare bit, so only applied when window < 32.
+    """
+    from jepsen_tpu.models.kernels import F_NOOP
+
+    R, W = p.active.shape
+    R_pad = 1 << max(4, (R - 1).bit_length())
+    if R_pad == R or W >= MAX_DEVICE_WINDOW:
+        return (np.asarray(p.ret_slot), np.asarray(p.active),
+                np.asarray(p.slot_f), np.asarray(p.slot_v))
+
+    pad = R_pad - R
+    ret_slot = np.concatenate([p.ret_slot, np.full(pad, W, np.int32)])
+    active = np.zeros((R_pad, W + 1), bool)
+    active[:R, :W] = p.active
+    active[R:, W] = True
+    slot_f = np.zeros((R_pad, W + 1), np.int32)
+    slot_f[:R, :W] = p.slot_f
+    slot_f[R:, W] = F_NOOP
+    slot_v = np.zeros((R_pad, W + 1, p.slot_v.shape[2]), np.int32)
+    slot_v[:R, :W] = p.slot_v
+    return ret_slot, active, slot_f, slot_v
+
+
+def check_packed(p: PackedHistory,
+                 cap_schedule=DEFAULT_CAP_SCHEDULE) -> dict:
+    """Decide linearizability of a packed history on device."""
+    if p.kernel is None:
+        return {"valid?": "unknown", "analyzer": "tpu-bfs",
+                "error": f"no device kernel for {type(p.model).__name__}"}
+    if p.window > MAX_DEVICE_WINDOW:
+        return {"valid?": "unknown", "analyzer": "tpu-bfs",
+                "error": f"concurrency window {p.window} exceeds device "
+                         f"bitset width {MAX_DEVICE_WINDOW}"}
+    if p.R == 0:
+        return {"valid?": True, "analyzer": "tpu-bfs", "configs": []}
+
+    ret_slot_h, active_h, slot_f_h, slot_v_h = _pad_rows(p)
+    ret_slot = jnp.asarray(ret_slot_h)
+    active = jnp.asarray(active_h)
+    slot_f = jnp.asarray(slot_f_h)
+    slot_v = jnp.asarray(slot_v_h)
+    init_state = jnp.asarray(p.init_state)
+
+    for cap in cap_schedule:
+        ok, dead_row, overflow, count = _search(
+            ret_slot, active, slot_f, slot_v, init_state,
+            cap=cap, step_fn=p.kernel.step)
+        overflow = bool(overflow)
+        if not overflow:
+            break
+    if overflow:
+        return {"valid?": "unknown", "analyzer": "tpu-bfs",
+                "error": f"frontier exceeded capacity {cap_schedule[-1]}"}
+
+    if bool(ok):
+        return {"valid?": True, "analyzer": "tpu-bfs",
+                "configs": [], "final-frontier-size": int(count)}
+    r = int(dead_row)
+    ret = p.ops[int(p.ret_op[r])]
+    return {"valid?": False, "analyzer": "tpu-bfs",
+            "op": {"process": ret.process, "f": ret.f, "value": ret.value,
+                   "index": ret.op_index, "ok": ret.ok},
+            "configs": [], "final-paths": []}

@@ -1,0 +1,57 @@
+"""Exhaustive linearizability search for tiny histories.
+
+An independent implementation used only to test the testers: enumerates
+every real-time-consistent linearization order directly over the original
+history with the Python models (no packing, no slots, no interning), so a
+bug shared by prepare/cpu/bfs cannot hide. Exponential; keep histories
+under ~12 ops.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from jepsen_tpu.history import Op
+from jepsen_tpu.lin.prepare import pair_ops
+from jepsen_tpu.models import is_inconsistent
+
+INF = float("inf")
+
+
+def check(model, history) -> bool:
+    """True iff the history is linearizable against the model."""
+    ops = pair_ops(list(history))
+    n = len(ops)
+    if n > 20:
+        raise ValueError(f"brute force limited to tiny histories, got {n}")
+
+    returns = [o.return_pos if o.return_pos is not None else INF for o in ops]
+    invokes = [o.invoke_pos for o in ops]
+    must = frozenset(i for i, o in enumerate(ops) if o.ok)
+
+    def shim(i) -> Op:
+        o = ops[i]
+        return Op("invoke", o.f, o.value, o.process)
+
+    seen = set()
+
+    def dfs(remaining: frozenset, state) -> bool:
+        if not (remaining & must):
+            return True  # all ok ops linearized; leftover info ops may not happen
+        key = (remaining, state)
+        if key in seen:
+            return False
+        seen.add(key)
+        # earliest return among remaining: nothing invoked after it may go first
+        horizon = min(returns[i] for i in remaining)
+        for i in remaining:
+            if invokes[i] > horizon:
+                continue
+            st2 = state.step(shim(i))
+            if is_inconsistent(st2):
+                continue
+            if dfs(remaining - {i}, st2):
+                return True
+        return False
+
+    return dfs(frozenset(range(n)), model)

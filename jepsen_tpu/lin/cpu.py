@@ -1,0 +1,205 @@
+"""Host reference implementation of the linearizability search.
+
+The semantic spec for the device kernel (:mod:`jepsen_tpu.lin.bfs`): the same
+just-in-time linearization closure (the algorithm family of knossos.linear /
+knossos.wgl, which the reference races at checker.clj:90-93), expressed as
+Python set operations over ``(bitset, state)`` configs. The frontier only
+changes at completion events:
+
+    at return of op s:
+        closure: repeatedly linearize any pending op legal in some config
+        filter:  keep configs with s linearized (its linearization point
+                 must precede its return)
+        recycle: clear s's bit (constant across survivors) so the slot can
+                 be reused by a later op
+
+    valid iff the frontier never empties.
+
+Also provides the generic-model fallback (models with no device kernel:
+sets, queues) and witness linearization reconstruction via shared-structure
+cons cells.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from jepsen_tpu.history import Op
+from jepsen_tpu.lin.prepare import PackedHistory, py_step_fn
+from jepsen_tpu.models import is_inconsistent
+from jepsen_tpu.models.kernels import NIL
+
+MAX_REPORT_CONFIGS = 32
+
+
+def decode_state(p: PackedHistory, state: tuple) -> Any:
+    """Decode a packed model state back to its observable value."""
+    if p.kernel is None:
+        return state
+    if p.kernel.name in ("cas-register", "register"):
+        return None if state[0] == int(NIL) else p.unintern[state[0]]
+    if p.kernel.name == "mutex":
+        return bool(state[0])
+    return state
+
+
+def _op_dict(o) -> dict:
+    return {"process": o.process, "f": o.f, "value": o.value,
+            "index": o.op_index, "ok": o.ok}
+
+
+def _decode_configs(p: PackedHistory, configs, row: int | None) -> list:
+    out = []
+    for bits, st in list(configs)[:MAX_REPORT_CONFIGS]:
+        pending = []
+        if row is not None:
+            for j in range(p.window):
+                if p.active[row, j] and not (bits >> j) & 1:
+                    pending.append(_op_dict(p.ops[int(p.slot_op[row, j])]))
+        out.append({"model": decode_state(p, st), "pending": pending})
+    return out
+
+
+def _witness_path(p: PackedHistory, cons) -> list:
+    path = []
+    while cons is not None:
+        op_id, cons = cons
+        path.append(_op_dict(p.ops[op_id]))
+    path.reverse()
+    return path
+
+
+def check_packed(p: PackedHistory, witness: bool = False) -> dict:
+    """Decide linearizability on a packed history. ``witness=True`` tracks a
+    representative linearization order (cheap cons-cell sharing; first
+    discovery of a config wins)."""
+    if p.kernel is None:
+        return check_generic(p, witness=witness)
+
+    step = py_step_fn(p.kernel.name)
+    init = (0, tuple(int(x) for x in p.init_state))
+    configs = {init}
+    order: dict | None = {init: None} if witness else None
+
+    for r in range(p.R):
+        act = p.active[r]
+        f_row = p.slot_f[r]
+        v_row = p.slot_v[r]
+        window = p.window
+        seen = set(configs)
+        frontier = list(configs)
+        while frontier:
+            new = []
+            for cfg in frontier:
+                bits, st = cfg
+                for j in range(window):
+                    if act[j] and not (bits >> j) & 1:
+                        ok, st2 = step(st, int(f_row[j]),
+                                       (int(v_row[j, 0]), int(v_row[j, 1])))
+                        if ok:
+                            c2 = (bits | (1 << j), st2)
+                            if c2 not in seen:
+                                seen.add(c2)
+                                new.append(c2)
+                                if order is not None:
+                                    order[c2] = (int(p.slot_op[r, j]),
+                                                 order[cfg])
+            frontier = new
+        s = int(p.ret_slot[r])
+        mask = 1 << s
+        survivors = set()
+        # Rebuilt from scratch: after clearing the returned bit a survivor's
+        # key can collide with a closure config that never linearized the
+        # returner, whose path would be a wrong witness.
+        new_order: dict | None = {} if order is not None else None
+        for cfg in seen:
+            bits, st = cfg
+            if bits & mask:
+                c2 = (bits & ~mask, st)
+                if c2 not in survivors:
+                    survivors.add(c2)
+                    if new_order is not None:
+                        new_order[c2] = order[cfg]
+        if not survivors:
+            ret = p.ops[int(p.ret_op[r])]
+            return {"valid?": False,
+                    "analyzer": "cpu-jit",
+                    "op": _op_dict(ret),
+                    "configs": _decode_configs(p, seen, r),
+                    "final-paths": []}
+        order = new_order
+        configs = survivors
+
+    out = {"valid?": True, "analyzer": "cpu-jit",
+           "configs": _decode_configs(p, configs, None)}
+    if order is not None and configs:
+        some = next(iter(configs))
+        out["witness"] = _witness_path(p, order[some])
+    return out
+
+
+def check_generic(p: PackedHistory, witness: bool = False) -> dict:
+    """Same search with arbitrary (hashable) Python model objects as state —
+    covers models with no device kernel, the analogue of running knossos on
+    an arbitrary Model record."""
+    init = (0, p.model)
+    configs = {init}
+    order: dict | None = {init: None} if witness else None
+
+    def shim(o) -> Op:
+        return Op("invoke", o.f, o.value, o.process)
+
+    for r in range(p.R):
+        act = p.active[r]
+        seen = set(configs)
+        frontier = list(configs)
+        while frontier:
+            new = []
+            for cfg in frontier:
+                bits, st = cfg
+                for j in range(p.window):
+                    if act[j] and not (bits >> j) & 1:
+                        o = p.ops[int(p.slot_op[r, j])]
+                        st2 = st.step(shim(o))
+                        if not is_inconsistent(st2):
+                            c2 = (bits | (1 << j), st2)
+                            if c2 not in seen:
+                                seen.add(c2)
+                                new.append(c2)
+                                if order is not None:
+                                    order[c2] = (int(p.slot_op[r, j]),
+                                                 order[cfg])
+            frontier = new
+        s = int(p.ret_slot[r])
+        mask = 1 << s
+        survivors = set()
+        # Rebuilt from scratch: after clearing the returned bit a survivor's
+        # key can collide with a closure config that never linearized the
+        # returner, whose path would be a wrong witness.
+        new_order: dict | None = {} if order is not None else None
+        for cfg in seen:
+            bits, st = cfg
+            if bits & mask:
+                c2 = (bits & ~mask, st)
+                if c2 not in survivors:
+                    survivors.add(c2)
+                    if new_order is not None:
+                        new_order[c2] = order[cfg]
+        if not survivors:
+            ret = p.ops[int(p.ret_op[r])]
+            return {"valid?": False,
+                    "analyzer": "cpu-generic",
+                    "op": _op_dict(ret),
+                    "configs": [{"model": st, "pending": []}
+                                for _, st in list(seen)[:MAX_REPORT_CONFIGS]],
+                    "final-paths": []}
+        order = new_order
+        configs = survivors
+
+    out = {"valid?": True, "analyzer": "cpu-generic",
+           "configs": [{"model": st, "pending": []}
+                       for _, st in list(configs)[:MAX_REPORT_CONFIGS]]}
+    if order is not None and configs:
+        some = next(iter(configs))
+        out["witness"] = _witness_path(p, order[some])
+    return out

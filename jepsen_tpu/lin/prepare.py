@@ -1,0 +1,291 @@
+"""Host-side history packing for the linearizability search.
+
+Converts a Jepsen-style history (vector of invoke/ok/fail/info op maps,
+reference core.clj:143-217) into the dense int-array form both the CPU
+reference checker and the TPU BFS kernel consume:
+
+1. **Pairing** — each invocation is matched with the next completion by the
+   same process. ``fail`` ops are removed entirely (a failed op definitely
+   did not happen); ``info`` ops (crashed/indeterminate, produced by the
+   runner at core.clj:185-217) stay concurrent with everything after them
+   and may be linearized at any later point, or never.
+2. **Crashed-read elision** — an unobserved read with no return can always
+   be linearized (it never changes state), so crashed reads are dropped.
+3. **Slot assignment** — the linearized-op bitset only needs bits for ops
+   whose linearized-status varies across frontier configs: exactly the
+   *pending* ops. Slots are recycled when an op returns (its bit is then 1
+   in every surviving config and is cleared for reuse), so the bitset width
+   is the max concurrency window, not the history length. This is the key
+   compression that keeps 100k-op histories in a 32/64-bit bitset.
+4. **Value interning** — op values (arbitrary hashables) become dense int32
+   ids shared with model states, so the device kernel only ever compares
+   ints. ``None`` maps to the NIL sentinel (a read invoked with nil matches
+   any state, model.clj:31-32).
+5. **Return-event table** — the frontier only changes at completion events,
+   so the search iterates over R = #ok-ops rows, each carrying the
+   returning slot plus the snapshot of active slots with their (f, value).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+from jepsen_tpu import models as model_ns
+from jepsen_tpu.history import Op
+from jepsen_tpu.models.kernels import (F_IDS, NIL, VALUE_WIDTH, KernelModel,
+                                       kernel_for)
+
+
+class UnsupportedHistory(Exception):
+    """Raised when a history cannot be packed (unknown f, window overflow
+    beyond the configured maximum, un-internable values)."""
+
+
+@dataclass
+class LinOp:
+    """One logical operation (invocation + optional completion)."""
+
+    op_index: int           # index of the invocation in the history
+    process: Any
+    f: str
+    value: Any              # semantic value: completion value for ok reads
+    ok: bool                # True if completed ok; False if crashed (info)
+    invoke_pos: int         # position of invocation event
+    return_pos: int | None  # position of ok completion event, None if crashed
+
+
+@dataclass
+class PackedHistory:
+    """Dense arrays driving the frontier search; see module docstring."""
+
+    model: Any                   # the Python model (semantic reference)
+    kernel: KernelModel | None   # device kernel, None if model unsupported
+    ops: list[LinOp]             # logical ops (reporting / witnesses)
+    window: int                  # W = bitset width in use
+    R: int                       # number of return events
+    ret_slot: np.ndarray         # i32[R]   slot of the returning op
+    ret_op: np.ndarray           # i32[R]   index into ops of the returner
+    active: np.ndarray           # bool[R,W] slots invoked & unreturned
+    slot_f: np.ndarray           # i32[R,W] function id per active slot
+    slot_v: np.ndarray           # i32[R,W,VALUE_WIDTH] interned values
+    slot_op: np.ndarray          # i32[R,W] index into ops per active slot
+    init_state: np.ndarray       # i32[S]
+    intern: dict                 # value -> id
+    unintern: list               # id -> value
+    crashed_ops: list[LinOp]     # info ops pending at end (never linearized)
+
+    @property
+    def state_width(self) -> int:
+        return len(self.init_state)
+
+
+MAX_WINDOW = 64
+
+
+def _semantic_value(f: str, invoke: Op, completion: Op | None) -> Any:
+    """The value the model checks: reads are checked against what they
+    *observed* (the completion's value, knossos.history/complete semantics);
+    mutations against what they *requested* (the invocation's value)."""
+    if f == "read":
+        return completion.value if (completion is not None
+                                    and completion.is_ok) else None
+    return invoke.value
+
+
+def pair_ops(history: list[Op]) -> list[LinOp]:
+    """Match invocations with completions; drop failed ops and crashed
+    reads. Dangling invocations at the end of history count as crashed
+    (the runner emits :info for those, core.clj:185-217)."""
+    ops: list[LinOp] = []
+    pending: dict[Any, tuple[int, Op]] = {}
+    for pos, op in enumerate(history):
+        if op.process == "nemesis" or op.f in ("start", "stop"):
+            continue
+        if op.is_invoke:
+            if op.process in pending:
+                raise UnsupportedHistory(
+                    f"process {op.process} invoked twice without completing "
+                    f"(positions {pending[op.process][0]} and {pos})")
+            pending[op.process] = (pos, op)
+        elif op.process in pending:
+            ipos, inv = pending.pop(op.process)
+            if op.is_fail:
+                continue  # failed ops definitely did not happen
+            ok = op.is_ok
+            ops.append(LinOp(
+                op_index=inv.index if inv.index is not None else ipos,
+                process=op.process, f=inv.f,
+                value=_semantic_value(inv.f, inv, op),
+                ok=ok, invoke_pos=ipos,
+                return_pos=pos if ok else None))
+    # Dangling invokes = crashed.
+    for proc, (ipos, inv) in pending.items():
+        ops.append(LinOp(
+            op_index=inv.index if inv.index is not None else ipos,
+            process=proc, f=inv.f,
+            value=_semantic_value(inv.f, inv, None),
+            ok=False, invoke_pos=ipos, return_pos=None))
+    # Crashed reads never constrain anything: elide.
+    ops = [o for o in ops if o.ok or o.f != "read"]
+    ops.sort(key=lambda o: o.invoke_pos)
+    return ops
+
+
+class _Interner:
+    def __init__(self):
+        self.ids: dict = {}
+        self.values: list = []
+
+    def __call__(self, v) -> int:
+        if v is None:
+            return int(NIL)
+        try:
+            key = v
+            hash(key)
+        except TypeError:
+            key = repr(v)
+        if key not in self.ids:
+            self.ids[key] = len(self.values)
+            self.values.append(v)
+        return self.ids[key]
+
+
+def _op_f_and_values(o: LinOp, intern: _Interner) -> tuple[int, list[int]]:
+    if o.f not in F_IDS:
+        raise UnsupportedHistory(f"unknown op f={o.f!r} for device packing")
+    f_id = F_IDS[o.f]
+    v = [int(NIL)] * VALUE_WIDTH
+    if o.f == "cas":
+        if not isinstance(o.value, (list, tuple)) or len(o.value) != 2:
+            raise UnsupportedHistory(f"cas value must be a pair: {o.value!r}")
+        v[0] = intern(o.value[0])
+        v[1] = intern(o.value[1])
+    elif o.f in ("read", "write"):
+        v[0] = intern(o.value)
+    return f_id, v
+
+
+def prepare(model, history, max_window: int = MAX_WINDOW) -> PackedHistory:
+    """Pack a history for the frontier search. See module docstring."""
+    history = list(history)
+    ops = pair_ops(history)
+    intern = _Interner()
+
+    try:
+        kernel = kernel_for(model)
+    except ValueError:
+        kernel = None
+
+    # Initial state: intern the model's observable value.
+    if isinstance(model, (model_ns.CASRegister, model_ns.Register)):
+        init_state = np.array([intern(model.value)], np.int32)
+    elif isinstance(model, model_ns.Mutex):
+        init_state = np.array([1 if model.locked else 0], np.int32)
+    else:
+        init_state = np.array([0], np.int32)
+
+    # Event stream over op endpoints: (pos, kind, op_id); invokes before
+    # returns at equal positions can't happen (distinct history positions).
+    events: list[tuple[int, int, int]] = []
+    for i, o in enumerate(ops):
+        events.append((o.invoke_pos, 0, i))
+        if o.return_pos is not None:
+            events.append((o.return_pos, 1, i))
+    events.sort()
+
+    R = sum(1 for o in ops if o.ok)
+    W_alloc = max_window
+    ret_slot = np.zeros(R, np.int32)
+    ret_op = np.zeros(R, np.int32)
+    active = np.zeros((R, W_alloc), bool)
+    slot_f = np.zeros((R, W_alloc), np.int32)
+    slot_v = np.full((R, W_alloc, VALUE_WIDTH), int(NIL), np.int32)
+    slot_op = np.full((R, W_alloc), -1, np.int32)
+
+    free = list(range(W_alloc))[::-1]
+    slot_of: dict[int, int] = {}
+    cur_active: dict[int, int] = {}   # slot -> op id
+    max_used = 0
+    r = 0
+    for pos, kind, i in events:
+        if kind == 0:  # invoke
+            if not free:
+                raise UnsupportedHistory(
+                    f"concurrency window exceeds {max_window} pending ops "
+                    f"at history position {pos}")
+            s = free.pop()
+            slot_of[i] = s
+            cur_active[s] = i
+            max_used = max(max_used, s + 1)
+        else:  # ok return
+            s = slot_of[i]
+            ret_slot[r] = s
+            ret_op[r] = i
+            for slot, op_id in cur_active.items():
+                o = ops[op_id]
+                active[r, slot] = True
+                slot_op[r, slot] = op_id
+                if kernel is not None:
+                    f_id, v = _op_f_and_values(o, intern)
+                    slot_f[r, slot] = f_id
+                    slot_v[r, slot] = v
+            r += 1
+            del cur_active[s]
+            del slot_of[i]
+            free.append(s)
+
+    crashed = [ops[i] for i in slot_of]
+
+    W = max(1, max_used)
+    return PackedHistory(
+        model=model, kernel=kernel, ops=ops, window=W, R=R,
+        ret_slot=ret_slot, ret_op=ret_op,
+        active=active[:, :W], slot_f=slot_f[:, :W],
+        slot_v=slot_v[:, :W], slot_op=slot_op[:, :W],
+        init_state=init_state, intern=intern.ids, unintern=intern.values,
+        crashed_ops=crashed)
+
+
+# --- pure-python packed step (mirror of models.kernels, for the CPU
+# reference checker's inner loop and witness replay) -------------------------
+
+def py_step_fn(kernel_name: str) -> Callable:
+    """Python twin of the device step kernels, operating on
+    (state tuple, f id, value ids) — must agree exactly with
+    jepsen_tpu.models.kernels (parity-tested)."""
+    from jepsen_tpu.models import kernels as K
+
+    nil = int(K.NIL)
+
+    if kernel_name in ("cas-register", "register"):
+        allow_cas = kernel_name == "cas-register"
+
+        def step(state, f, v):
+            cur = state[0]
+            if f == K.F_READ:
+                return (v[0] == nil or v[0] == cur), state
+            if f == K.F_WRITE:
+                return True, (v[0],)
+            if f == K.F_CAS and allow_cas:
+                if v[0] == cur:
+                    return True, (v[1],)
+                return False, state
+            return False, state
+
+        return step
+
+    if kernel_name == "mutex":
+        def step(state, f, v):
+            locked = state[0]
+            if f == K.F_ACQUIRE:
+                return locked == 0, (1,)
+            if f == K.F_RELEASE:
+                return locked == 1, (0,)
+            return False, state
+
+        return step
+
+    raise ValueError(f"no python step for kernel {kernel_name!r}")

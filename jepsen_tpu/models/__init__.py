@@ -156,7 +156,11 @@ class SetModel(Model):
         if f == "add":
             return SetModel(self.s | {op.value})
         if f == "read":
-            if op.value is not None and set(op.value) == set(self.s):
+            try:
+                observed = set(op.value) if op.value is not None else None
+            except TypeError:
+                observed = None
+            if observed is not None and observed == set(self.s):
                 return self
             return inconsistent(f"can't read {op.value!r} from {set(self.s)!r}")
         return inconsistent(f"unknown op f={f}")

@@ -33,6 +33,10 @@ F_WRITE = 1
 F_CAS = 2
 F_ACQUIRE = 3
 F_RELEASE = 4
+# Universal no-op: legal in every model, state unchanged. Used by the BFS
+# kernel's identity padding rows (bucketing history length to a few static
+# shapes so XLA compiles once per bucket, not once per history).
+F_NOOP = 5
 
 F_IDS = {"read": F_READ, "write": F_WRITE, "cas": F_CAS,
          "acquire": F_ACQUIRE, "release": F_RELEASE}
@@ -63,7 +67,8 @@ def _cas_register_step(state, f, v):
     is_cas = f == F_CAS
     ok = ((is_read & ((v[0] == NIL) | (v[0] == cur)))
           | is_write
-          | (is_cas & (v[0] == cur)))
+          | (is_cas & (v[0] == cur))
+          | (f == F_NOOP))
     new = jnp.where(is_write, v[0], jnp.where(is_cas, v[1], cur))
     return ok, state.at[0].set(new)
 
@@ -73,7 +78,8 @@ def _register_step(state, f, v):
     cur = state[0]
     is_read = f == F_READ
     is_write = f == F_WRITE
-    ok = (is_read & ((v[0] == NIL) | (v[0] == cur))) | is_write
+    ok = (is_read & ((v[0] == NIL) | (v[0] == cur))) | is_write \
+        | (f == F_NOOP)
     new = jnp.where(is_write, v[0], cur)
     return ok, state.at[0].set(new)
 
@@ -83,8 +89,9 @@ def _mutex_step(state, f, v):
     locked = state[0]
     is_acq = f == F_ACQUIRE
     is_rel = f == F_RELEASE
-    ok = (is_acq & (locked == 0)) | (is_rel & (locked == 1))
-    new = jnp.where(is_acq, jnp.int32(1), jnp.int32(0))
+    ok = (is_acq & (locked == 0)) | (is_rel & (locked == 1)) | (f == F_NOOP)
+    new = jnp.where(is_acq, jnp.int32(1),
+                    jnp.where(is_rel, jnp.int32(0), locked))
     return ok, state.at[0].set(new)
 
 
@@ -107,9 +114,10 @@ def mutex_kernel() -> KernelModel:
 
 
 def kernel_for(model) -> KernelModel:
-    """Map a Python model instance (jepsen_tpu.models) to its device kernel.
-    The model's current value becomes the interned initial state in
-    :mod:`jepsen_tpu.lin.prepare` (which owns value interning)."""
+    """Map a Python model instance (jepsen_tpu.models) to its device kernel,
+    carrying the instance's current state. Register values still pass
+    through value interning in :mod:`jepsen_tpu.lin.prepare` (which owns
+    the intern table and overrides init_state with the interned id)."""
     from jepsen_tpu import models as m
 
     if isinstance(model, m.CASRegister):
@@ -117,7 +125,11 @@ def kernel_for(model) -> KernelModel:
     if isinstance(model, m.Register):
         return register_kernel()
     if isinstance(model, m.Mutex):
-        return mutex_kernel()
+        kern = mutex_kernel()
+        if model.locked:
+            return KernelModel(kern.name, kern.state_width,
+                               lambda: np.array([1], np.int32), kern.step)
+        return kern
     raise ValueError(
         f"no device kernel for model {type(model).__name__}; "
         "device linearizability supports register/cas-register/mutex "

@@ -1,0 +1,327 @@
+"""Fault injection: the nemesis.
+
+Re-design of `jepsen/src/jepsen/nemesis.clj` (325 LoC): the Nemesis
+protocol (nemesis.clj:9-12), partition grudge topology math
+(nemesis.clj:60-157 — pure functions, property-tested), the partitioner
+family, composition (nemesis.clj:159-197), clock scrambling
+(nemesis.clj:199-219), SIGSTOP pauses (nemesis.clj:258-272), node
+start/stop (nemesis.clj:221-256), and file truncation
+(nemesis.clj:274-300).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Callable, Iterable
+
+from jepsen_tpu import control as c
+from jepsen_tpu import net as net_ns
+from jepsen_tpu.history import Op
+from jepsen_tpu.util import majority, real_pmap
+
+
+class Nemesis:
+    def setup(self, test) -> "Nemesis":
+        """Prepare to work with the cluster (nemesis.clj:10)."""
+        return self
+
+    def invoke(self, test, op: Op) -> Op:
+        """Apply an op which alters the cluster (nemesis.clj:11)."""
+        return op
+
+    def teardown(self, test) -> None:
+        """Clean up when work is complete (nemesis.clj:12)."""
+
+
+class NoopNemesis(Nemesis):
+    """Does nothing (nemesis.clj:14-19)."""
+
+
+noop = NoopNemesis()
+
+
+# --- grudge topology math (pure; property-tested like the reference's
+# nemesis_test.clj:18-88) ----------------------------------------------------
+
+def bisect(coll: Iterable) -> tuple[list, list]:
+    """Cut a sequence in half; smaller half first (nemesis.clj:60-63)."""
+    coll = list(coll)
+    k = len(coll) // 2
+    return coll[:k], coll[k:]
+
+
+def split_one(coll: Iterable, loner=None) -> tuple[list, list]:
+    """Split one node off from the rest (nemesis.clj:65-70)."""
+    coll = list(coll)
+    if loner is None:
+        loner = random.choice(coll)
+    return [loner], [x for x in coll if x != loner]
+
+
+def complete_grudge(components: Iterable[Iterable]) -> dict:
+    """Components (collections of nodes) -> grudge where no node can talk
+    outside its component (nemesis.clj:72-84)."""
+    components = [set(comp) for comp in components]
+    universe = set().union(*components) if components else set()
+    grudge = {}
+    for comp in components:
+        for node in comp:
+            grudge[node] = universe - comp
+    return grudge
+
+
+def bridge(nodes: Iterable) -> dict:
+    """Cut the network in half, but preserve one node in the middle with
+    uninterrupted bidirectional connectivity to both halves
+    (nemesis.clj:86-97)."""
+    comps = bisect(nodes)
+    bridge_node = comps[1][0]
+    grudge = complete_grudge(comps)
+    grudge.pop(bridge_node, None)
+    return {node: others - {bridge_node}
+            for node, others in grudge.items()}
+
+
+def majorities_ring(nodes: Iterable) -> dict:
+    """Every node sees a majority, but no node sees the *same* majority as
+    any other (nemesis.clj:136-151): nodes form a random ring; each takes a
+    contiguous majority window, and the window's middle node drops everyone
+    outside it."""
+    nodes = list(nodes)
+    universe = set(nodes)
+    n = len(nodes)
+    m = majority(n)
+    ring = nodes[:]
+    random.shuffle(ring)
+    grudge = {}
+    for i in range(n):
+        maj = [ring[(i + j) % n] for j in range(m)]
+        middle = maj[len(maj) // 2]
+        grudge[middle] = universe - set(maj)
+    return grudge
+
+
+# --- partitions -------------------------------------------------------------
+
+def snub_nodes(test, dest, sources) -> None:
+    """Drop all packets from the given nodes to dest (nemesis.clj:47-50)."""
+    net = test.get("net", net_ns.noop)
+    real_pmap(lambda src: net.drop(test, src, dest), list(sources or ()))
+
+
+def partition(test, grudge: dict) -> None:
+    """Apply a grudge: each node rejects messages from its grudge set.
+    Cumulative until healed (nemesis.clj:52-58)."""
+    c.on_nodes(test, lambda t, node: snub_nodes(t, node, grudge.get(node)))
+
+
+class Partitioner(Nemesis):
+    """:start cuts links per (grudge_fn nodes); :stop heals
+    (nemesis.clj:99-117)."""
+
+    def __init__(self, grudge_fn: Callable[[list], dict]):
+        self.grudge_fn = grudge_fn
+
+    def setup(self, test):
+        test.get("net", net_ns.noop).heal(test)
+        return self
+
+    def invoke(self, test, op):
+        if op.f == "start":
+            grudge = self.grudge_fn(list(test["nodes"]))
+            partition(test, grudge)
+            return op.replace(value=f"Cut off {grudge!r}")
+        if op.f == "stop":
+            test.get("net", net_ns.noop).heal(test)
+            return op.replace(value="fully connected")
+        raise ValueError(f"partitioner can't handle f={op.f!r}")
+
+    def teardown(self, test):
+        test.get("net", net_ns.noop).heal(test)
+
+
+def partitioner(grudge_fn) -> Nemesis:
+    return Partitioner(grudge_fn)
+
+
+def partition_halves() -> Nemesis:
+    """First half vs second half (nemesis.clj:119-124)."""
+    return Partitioner(lambda nodes: complete_grudge(bisect(nodes)))
+
+
+def partition_random_halves() -> Nemesis:
+    """Random halves (nemesis.clj:126-129)."""
+
+    def grudge(nodes):
+        nodes = nodes[:]
+        random.shuffle(nodes)
+        return complete_grudge(bisect(nodes))
+
+    return Partitioner(grudge)
+
+
+def partition_random_node() -> Nemesis:
+    """Isolate a single random node (nemesis.clj:131-134)."""
+    return Partitioner(lambda nodes: complete_grudge(split_one(nodes)))
+
+
+def partition_majorities_ring() -> Nemesis:
+    """Intersecting-majorities ring partition (nemesis.clj:153-157)."""
+    return Partitioner(majorities_ring)
+
+
+# --- composition ------------------------------------------------------------
+
+class Compose(Nemesis):
+    """Route ops to child nemeses by f (nemesis.clj:159-197). Keys are
+    either sets of fs (routed unchanged) or dicts mapping outer f -> inner f
+    (rewritten, so two partitioners can coexist under distinct op names)."""
+
+    def __init__(self, nemeses: dict):
+        self.nemeses = dict(nemeses)
+
+    def setup(self, test):
+        self.nemeses = {fs: n.setup(test) or n
+                        for fs, n in self.nemeses.items()}
+        return self
+
+    def invoke(self, test, op):
+        for fs, nem in self.nemeses.items():
+            if isinstance(fs, dict):
+                inner = fs.get(op.f)
+            else:
+                inner = op.f if op.f in fs else None
+            if inner is not None:
+                res = nem.invoke(test, op.replace(f=inner))
+                return res.replace(f=op.f)
+        raise ValueError(f"no nemesis can handle {op.f!r}")
+
+    def teardown(self, test):
+        for nem in self.nemeses.values():
+            nem.teardown(test)
+
+
+def compose(nemeses: dict) -> Nemesis:
+    return Compose(nemeses)
+
+
+# --- clock faults (see also jepsen_tpu.nemesis_time for the precise C
+# bump/strobe programs) ------------------------------------------------------
+
+def set_time(t: float) -> None:
+    """Set the bound node's time in POSIX seconds (nemesis.clj:199-202)."""
+    with c.su():
+        c.exec_("date", "+%s", "-s", f"@{int(t)}")
+
+
+class ClockScrambler(Nemesis):
+    """Randomizes node clocks within a dt-second window
+    (nemesis.clj:204-219)."""
+
+    def __init__(self, dt: float):
+        self.dt = dt
+
+    def invoke(self, test, op):
+        import time as _time
+
+        def scramble(t, node):
+            set_time(_time.time() + random.randint(-self.dt, self.dt))
+
+        return op.replace(value=c.on_nodes(test, scramble))
+
+    def teardown(self, test):
+        import time as _time
+
+        c.on_nodes(test, lambda t, node: set_time(_time.time()))
+
+
+def clock_scrambler(dt: float) -> Nemesis:
+    return ClockScrambler(dt)
+
+
+# --- node start/stop, pauses, truncation ------------------------------------
+
+class NodeStartStopper(Nemesis):
+    """:start runs start_fn on targeted nodes; :stop undoes it
+    (nemesis.clj:221-256)."""
+
+    def __init__(self, targeter, start_fn, stop_fn):
+        self.targeter = targeter
+        self.start_fn = start_fn
+        self.stop_fn = stop_fn
+        self.nodes: list | None = None
+        self.lock = threading.Lock()
+
+    def invoke(self, test, op):
+        with self.lock:
+            if op.f == "start":
+                targets = self.targeter(list(test["nodes"]))
+                if targets is None:
+                    return op.replace(type="info", value="no-target")
+                if not isinstance(targets, (list, tuple, set)):
+                    targets = [targets]
+                if self.nodes is not None:
+                    return op.replace(
+                        type="info",
+                        value=f"nemesis already disrupting {self.nodes!r}")
+                self.nodes = list(targets)
+                value = c.on_many(
+                    test, self.nodes,
+                    lambda: self.start_fn(test, c.current_node()))
+                return op.replace(type="info", value=value)
+            if op.f == "stop":
+                if self.nodes is None:
+                    return op.replace(type="info", value="not-started")
+                value = c.on_many(
+                    test, self.nodes,
+                    lambda: self.stop_fn(test, c.current_node()))
+                self.nodes = None
+                return op.replace(type="info", value=value)
+            raise ValueError(f"node-start-stopper can't handle {op.f!r}")
+
+
+def node_start_stopper(targeter, start_fn, stop_fn) -> Nemesis:
+    return NodeStartStopper(targeter, start_fn, stop_fn)
+
+
+def hammer_time(process: str, targeter=None) -> Nemesis:
+    """Pause a process with SIGSTOP on :start, resume with SIGCONT on :stop
+    (nemesis.clj:258-272)."""
+    targeter = targeter or (lambda nodes: random.choice(nodes))
+
+    def start(test, node):
+        with c.su():
+            c.exec_("killall", "-s", "STOP", process)
+        return ["paused", process]
+
+    def stop(test, node):
+        with c.su():
+            c.exec_("killall", "-s", "CONT", process)
+        return ["resumed", process]
+
+    return NodeStartStopper(targeter, start, stop)
+
+
+class TruncateFile(Nemesis):
+    """Drop the last :drop bytes from files:
+    value = {node: {"file": path, "drop": bytes}} (nemesis.clj:274-300)."""
+
+    def invoke(self, test, op):
+        assert op.f == "truncate"
+        plan = op.value
+
+        def go(t, node):
+            spec = plan[node]
+            assert isinstance(spec["file"], str)
+            assert isinstance(spec["drop"], int)
+            with c.su():
+                c.exec_("truncate", "-c", "-s", f"-{spec['drop']}",
+                        spec["file"])
+
+        c.on_nodes(test, go, nodes=list(plan))
+        return op
+
+
+def truncate_file() -> Nemesis:
+    return TruncateFile()

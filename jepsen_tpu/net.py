@@ -1,0 +1,103 @@
+"""Network manipulation: partitions, delay, loss.
+
+Re-design of `jepsen/src/jepsen/net.clj` (109 LoC): the Net protocol
+(net.clj:9-20) with the iptables implementation (net.clj:34-75) driving
+``iptables`` + ``tc qdisc netem`` over the control plane. The ipfilter
+variant for SmartOS-style nodes mirrors net.clj:77-109.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import control as c
+
+
+class Net:
+    def drop(self, test, src, dest) -> None:
+        """Drop traffic from src to dest (net.clj:10-11)."""
+
+    def heal(self, test) -> None:
+        """End all traffic drops and restore network (net.clj:12-13)."""
+
+    def slow(self, test) -> None:
+        """Delay all packets (net.clj:14-15)."""
+
+    def flaky(self, test) -> None:
+        """Introduce packet loss (net.clj:16-17)."""
+
+    def fast(self, test) -> None:
+        """Remove packet loss and delays (net.clj:18-19)."""
+
+
+class NoopNet(Net):
+    """Does nothing (net.clj:24-32)."""
+
+
+noop = NoopNet()
+
+
+class IptablesNet(Net):
+    """Default implementation: iptables droprules + tc netem delay/loss
+    (net.clj:34-75)."""
+
+    def drop(self, test, src, dest):
+        def go():
+            with c.su():
+                c.exec_("iptables", "-A", "INPUT", "-s", _ip(src),
+                        "-j", "DROP", "-w")
+        c.on(test, dest, go)
+
+    def heal(self, test):
+        def go(test_, node):
+            with c.su():
+                c.exec_("iptables", "-F", "-w")
+                c.exec_("iptables", "-X", "-w")
+        c.on_nodes(test, go)
+
+    def slow(self, test):
+        def go(test_, node):
+            with c.su():
+                c.exec_("tc", "qdisc", "add", "dev", "eth0", "root",
+                        "netem", "delay", "50ms", "10ms",
+                        "distribution", "normal")
+        c.on_nodes(test, go)
+
+    def flaky(self, test):
+        def go(test_, node):
+            with c.su():
+                c.exec_("tc", "qdisc", "add", "dev", "eth0", "root",
+                        "netem", "loss", "20%", "75%")
+        c.on_nodes(test, go)
+
+    def fast(self, test):
+        def go(test_, node):
+            with c.su():
+                c.exec_("tc", "qdisc", "del", "dev", "eth0", "root",
+                        may_fail=True)
+        c.on_nodes(test, go)
+
+
+iptables = IptablesNet()
+
+
+class IpfilterNet(Net):
+    """ipfilter-based variant (net.clj:77-109)."""
+
+    def drop(self, test, src, dest):
+        def go():
+            with c.su():
+                c.exec_("echo", f"block in from {_ip(src)} to any",
+                        c.Lit("| ipf -f -"))
+        c.on(test, dest, go)
+
+    def heal(self, test):
+        def go(test_, node):
+            with c.su():
+                c.exec_("ipf", "-Fa")
+        c.on_nodes(test, go)
+
+
+ipfilter = IpfilterNet()
+
+
+def _ip(node: str) -> str:
+    return node

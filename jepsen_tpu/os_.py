@@ -1,0 +1,23 @@
+"""OS provisioning protocol (reference `jepsen/src/jepsen/os.clj`, 14 LoC).
+
+Concrete implementations: :mod:`jepsen_tpu.os_debian` (apt-based, the
+reference's os/debian.clj) — others can be added per suite like the
+reference's smartos/ubuntu variants.
+"""
+
+from __future__ import annotations
+
+
+class OS:
+    def setup(self, test, node) -> None:
+        """Prepare the node's operating system (os.clj:5-6)."""
+
+    def teardown(self, test, node) -> None:
+        """Remove any changes made (os.clj:7-8)."""
+
+
+class NoopOS(OS):
+    """Does nothing (os.clj:10-14)."""
+
+
+noop = NoopOS()

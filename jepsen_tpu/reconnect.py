@@ -1,0 +1,68 @@
+"""Self-healing connection wrappers.
+
+Re-design of `jepsen/src/jepsen/reconnect.clj` (129 LoC): a wrapper around
+a connection with an RW-lock-guarded slot, auto close/reopen on error —
+failure recovery for both SSH and DB client connections.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+
+class Wrapper:
+    """Holds a connection built by ``open_fn``; ``with_conn`` runs a
+    function against it, reopening on failure (reconnect.clj:17-33,
+    98-129)."""
+
+    def __init__(self, open_fn: Callable[[], Any],
+                 close_fn: Callable[[Any], None] | None = None,
+                 log: str | None = None):
+        self.open_fn = open_fn
+        self.close_fn = close_fn or (lambda conn: None)
+        self.log = log
+        self._conn: Any = None
+        self._lock = threading.RLock()
+
+    def open(self) -> "Wrapper":
+        with self._lock:
+            if self._conn is None:
+                self._conn = self.open_fn()
+        return self
+
+    def conn(self):
+        with self._lock:
+            if self._conn is None:
+                self.open()
+            return self._conn
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                try:
+                    self.close_fn(self._conn)
+                finally:
+                    self._conn = None
+
+    def reopen(self) -> None:
+        """Close and reopen (reconnect.clj:85-95)."""
+        with self._lock:
+            self.close()
+            self.open()
+
+    def with_conn(self, f: Callable[[Any], Any]):
+        """Run f(conn); on error, close the connection (so the next call
+        reopens) and re-raise (reconnect.clj:98-129)."""
+        try:
+            return f(self.conn())
+        except Exception:
+            try:
+                self.close()
+            except Exception:
+                pass
+            raise
+
+
+def wrapper(open_fn, close_fn=None, log=None) -> Wrapper:
+    return Wrapper(open_fn, close_fn, log)

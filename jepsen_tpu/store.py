@@ -1,0 +1,196 @@
+"""Persistence: test run directories, histories, results, symlinks, logs.
+
+Re-design of `jepsen/src/jepsen/store.clj` (345 LoC). Layout matches the
+reference: ``store/<test-name>/<timestamp>/`` holding history + test +
+results, with ``latest`` symlinks (store.clj:235-247) and two-phase saves
+(`save_1` after the run, store.clj:279-290; `save_2` after analysis,
+store.clj:292-302). JSON/JSONL replaces Fressian/EDN as the portable
+serialization; runtime objects are excluded via nonserializable keys
+(store.clj:155-163).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import os
+import shutil
+from pathlib import Path
+from typing import Any
+
+from jepsen_tpu import history as history_mod
+
+BASE = Path("store")
+
+NONSERIALIZABLE_KEYS = (
+    # Runtime objects (store.clj:155-163): barriers, sessions, live handles
+    "db", "os", "net", "client", "checker", "nemesis", "generator", "model",
+    "sessions", "barrier", "active-histories", "transport", "remote",
+)
+
+
+def _sanitize(v: Any):
+    try:
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError):
+        if isinstance(v, dict):
+            return {str(k): _sanitize(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple, set, frozenset)):
+            return [_sanitize(x) for x in v]
+        if isinstance(v, history_mod.Op):
+            return _sanitize(v.to_dict())
+        return repr(v)
+
+
+def serializable_test(test: dict) -> dict:
+    return {k: _sanitize(v) for k, v in test.items()
+            if k not in NONSERIALIZABLE_KEYS and k != "history"}
+
+
+def dir_name(test: dict) -> str:
+    t = test.get("start-time") or _dt.datetime.now()
+    if isinstance(t, _dt.datetime):
+        return t.strftime("%Y%m%dT%H%M%S.%f")[:-3]
+    return str(t)
+
+
+def path(test: dict, *components, make: bool = False) -> Path:
+    """Path within a test's store directory (store.clj:113-142); with
+    make=True, creates parent directories (`path!`)."""
+    components = [c for c in components if c is not None]
+    base = Path(test.get("store-base", BASE))
+    p = base / str(test.get("name", "noname")) / dir_name(test)
+    for comp in components:
+        p = p / str(comp)
+    if make:
+        target_dir = p if not components else p.parent
+        target_dir.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+def update_symlinks(test: dict) -> None:
+    """Point store/<name>/latest and store/latest at this run
+    (store.clj:235-247)."""
+    run_dir = path(test, make=True)
+    base = Path(test.get("store-base", BASE))
+    for link, target in ((base / str(test.get("name", "noname")) / "latest",
+                          run_dir),
+                         (base / "latest", run_dir)):
+        try:
+            if link.is_symlink() or link.exists():
+                link.unlink()
+            link.symlink_to(target.resolve())
+        except OSError:
+            pass
+
+
+def write_history(test: dict) -> None:
+    """history.txt (human-readable) + history.jsonl (machine)
+    (store.clj:265-277); parallel chunked writing in the reference
+    (util.clj:149-170) is replaced by buffered streaming."""
+    hist = test.get("history") or []
+    p = path(test, "history.jsonl", make=True)
+    history_mod.write_history(p, hist)
+    with open(path(test, "history.txt"), "w") as fh:
+        for op in hist:
+            fh.write(f"{op.process!r:<12} {op.type:<8} {op.f!r:<16} "
+                     f"{op.value!r}\n")
+
+
+def write_results(test: dict) -> None:
+    with open(path(test, "results.json", make=True), "w") as fh:
+        json.dump(_sanitize(test.get("results", {})), fh, indent=2)
+
+
+def write_test(test: dict) -> None:
+    with open(path(test, "test.json", make=True), "w") as fh:
+        json.dump(serializable_test(test), fh, indent=2)
+
+
+def save_1(test: dict) -> dict:
+    """Phase 1: after the run, before analysis — history + test
+    (store.clj:279-290)."""
+    write_history(test)
+    write_test(test)
+    update_symlinks(test)
+    return test
+
+
+def save_2(test: dict) -> dict:
+    """Phase 2: after analysis — results (store.clj:292-302)."""
+    write_results(test)
+    write_test(test)
+    update_symlinks(test)
+    return test
+
+
+def load(name: str, ts: str, base=BASE) -> dict:
+    """Reload a saved test for re-analysis (store.clj:165-171)."""
+    d = Path(base) / name / ts
+    test = json.loads((d / "test.json").read_text())
+    hist_path = d / "history.jsonl"
+    if hist_path.exists():
+        test["history"] = history_mod.read_history(hist_path)
+    results = d / "results.json"
+    if results.exists():
+        test["results"] = json.loads(results.read_text())
+    return test
+
+
+def tests(name: str, base=BASE) -> dict:
+    """{timestamp: loader} for each saved run of a test
+    (store.clj:214-233)."""
+    d = Path(base) / name
+    out = {}
+    if d.is_dir():
+        for ts in sorted(os.listdir(d)):
+            if ts != "latest" and (d / ts).is_dir():
+                out[ts] = (lambda t=ts: load(name, t, base))
+    return out
+
+
+def all_tests(base=BASE) -> dict:
+    base = Path(base)
+    out = {}
+    if base.is_dir():
+        for name in sorted(os.listdir(base)):
+            if name != "latest" and (base / name).is_dir():
+                out[name] = tests(name, base)
+    return out
+
+
+def delete(name: str, ts: str | None = None, base=BASE) -> None:
+    """Delete a run, or every run of a test (store.clj:337-345)."""
+    d = Path(base) / name
+    if ts:
+        d = d / ts
+    if d.exists():
+        shutil.rmtree(d)
+
+
+# --- logging (store.clj:304-326: unilog console + per-test jepsen.log) ------
+
+_handler: logging.Handler | None = None
+
+
+def start_logging(test: dict) -> None:
+    global _handler
+    stop_logging()
+    p = path(test, "jepsen.log", make=True)
+    _handler = logging.FileHandler(p)
+    _handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s %(threadName)s %(name)s - %(message)s"))
+    root = logging.getLogger()
+    root.addHandler(_handler)
+    if root.level > logging.INFO or root.level == logging.NOTSET:
+        root.setLevel(logging.INFO)
+
+
+def stop_logging() -> None:
+    global _handler
+    if _handler is not None:
+        logging.getLogger().removeHandler(_handler)
+        _handler.close()
+        _handler = None

@@ -1,0 +1,93 @@
+"""No-cluster test fixtures.
+
+The analogue of the reference's `jepsen/src/jepsen/tests.clj` (12-56):
+``noop_test`` — a base test map that does nothing, and an in-memory
+atom-backed DB + client (used by the reference's `core_test.clj`
+basic-cas-test :18-28) so the full runner can execute with zero
+infrastructure: the dummy control transport records commands instead of
+SSHing, and the client applies ops against a lock-guarded in-process
+register.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from jepsen_tpu import checker as checker_ns
+from jepsen_tpu import client as client_ns
+from jepsen_tpu.history import Op
+
+
+def noop_test(**overrides) -> dict:
+    """A test map that does nothing (tests.clj:12-24)."""
+    test = {
+        "name": None,               # no persistence by default
+        "nodes": ["n1", "n2", "n3", "n4", "n5"],
+        "transport": "dummy",
+        "concurrency": 5,
+        "generator": None,
+        "checker": checker_ns.unbridled_optimism(),
+    }
+    test.update(overrides)
+    return test
+
+
+class AtomRegister:
+    """A lock-guarded in-memory register standing in for a real database
+    (the reference's atom-db, core_test.clj)."""
+
+    def __init__(self, value=None):
+        self.value = value
+        self.lock = threading.Lock()
+
+    def read(self):
+        with self.lock:
+            return self.value
+
+    def write(self, v):
+        with self.lock:
+            self.value = v
+            return True
+
+    def cas(self, cur, new) -> bool:
+        with self.lock:
+            if self.value == cur:
+                self.value = new
+                return True
+            return False
+
+
+class AtomClient(client_ns.Client):
+    """Client applying read/write/cas against a shared AtomRegister
+    (the reference's atom-client, core_test.clj basic-cas-test)."""
+
+    def __init__(self, register: AtomRegister, latency: float = 0.0):
+        self.register = register
+        self.latency = latency
+
+    def open(self, test, node):
+        return AtomClient(self.register, self.latency)
+
+    def invoke(self, test, op: Op) -> Op:
+        if self.latency:
+            time.sleep(random.uniform(0, self.latency))
+        if op.f == "read":
+            return op.replace(type="ok", value=self.register.read())
+        if op.f == "write":
+            self.register.write(op.value)
+            return op.replace(type="ok")
+        if op.f == "cas":
+            cur, new = op.value
+            ok = self.register.cas(cur, new)
+            return op.replace(type="ok" if ok else "fail")
+        raise ValueError(f"unknown op {op.f!r}")
+
+
+class CrashyClient(client_ns.Client):
+    """Always raises from invoke — exercises worker re-incarnation
+    (the reference's worker-recovery-test, core_test.clj:86-101)."""
+
+    def invoke(self, test, op):
+        raise RuntimeError("kaboom")

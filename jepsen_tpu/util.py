@@ -183,19 +183,18 @@ def history_latencies(history) -> list:
 
 
 def nemesis_intervals(history) -> list[tuple]:
-    """Pair up nemesis start/stop ops into [start, stop] op intervals
-    (reference util.clj:593-610)."""
-    starts = []
+    """Pair up nemesis start/stop ops into [start, stop] op intervals,
+    FIFO — first start pairs with first stop, like the reference's
+    queue-based pairing (util.clj:593-610)."""
+    starts: list = []
     intervals = []
     for op in history:
         if op.process != "nemesis":
             continue
-        if op.f in ("start", "info") and op.type == "info" and op.f == "start":
-            starts.append(op)
-        elif op.f == "start":
+        if op.f == "start":
             starts.append(op)
         elif op.f == "stop" and starts:
-            intervals.append((starts.pop(), op))
+            intervals.append((starts.pop(0), op))
     for s in starts:
         intervals.append((s, None))
     return intervals

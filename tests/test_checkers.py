@@ -1,0 +1,223 @@
+"""O(n) checker golden tests, ported from the reference's
+jepsen/test/jepsen/checker_test.clj (queue :11-30, total-queue pathological
+case :58-82, counter interleavings :84-150, compose :152-157)."""
+
+from collections import Counter
+from fractions import Fraction
+
+from jepsen_tpu import checker as c
+from jepsen_tpu import models as m
+from jepsen_tpu.history import invoke_op, ok_op
+
+V = c.VALID
+
+
+def check(ck, model, history):
+    return ck.check(None, model,
+                    list(history) if history is not None else None, {})
+
+
+class TestQueue:
+    def test_empty(self):
+        assert check(c.queue(), None, [])[V]
+
+    def test_possible_enqueue_no_dequeue(self):
+        assert check(c.queue(), m.unordered_queue(),
+                     [invoke_op(1, "enqueue", 1)])[V]
+
+    def test_definite_enqueue_no_dequeue(self):
+        assert check(c.queue(), m.unordered_queue(),
+                     [ok_op(1, "enqueue", 1)])[V]
+
+    def test_concurrent_enqueue_dequeue(self):
+        assert check(c.queue(), m.unordered_queue(),
+                     [invoke_op(2, "dequeue", None),
+                      invoke_op(1, "enqueue", 1),
+                      ok_op(2, "dequeue", 1)])[V]
+
+    def test_dequeue_no_enqueue(self):
+        assert not check(c.queue(), m.unordered_queue(),
+                         [ok_op(1, "dequeue", 1)])[V]
+
+
+class TestTotalQueue:
+    def test_empty(self):
+        assert check(c.total_queue(), None, [])[V]
+
+    def test_sane(self):
+        r = check(c.total_queue(), None,
+                  [invoke_op(1, "enqueue", 1),
+                   invoke_op(2, "enqueue", 2),
+                   ok_op(2, "enqueue", 2),
+                   invoke_op(3, "dequeue", 1),
+                   ok_op(3, "dequeue", 1),
+                   invoke_op(3, "dequeue", 2),
+                   ok_op(3, "dequeue", 2)])
+        assert r == {V: True,
+                     "duplicated": Counter(),
+                     "lost": Counter(),
+                     "unexpected": Counter(),
+                     "recovered": Counter({1: 1}),
+                     "ok-frac": 1,
+                     "unexpected-frac": 0,
+                     "lost-frac": 0,
+                     "duplicated-frac": 0,
+                     "recovered-frac": Fraction(1, 2)}
+
+    def test_pathological(self):
+        r = check(c.total_queue(), None,
+                  [invoke_op(1, "enqueue", "hung"),
+                   invoke_op(2, "enqueue", "enqueued"),
+                   ok_op(2, "enqueue", "enqueued"),
+                   invoke_op(3, "enqueue", "dup"),
+                   ok_op(3, "enqueue", "dup"),
+                   invoke_op(4, "dequeue", None),  # nope
+                   invoke_op(5, "dequeue", None),
+                   ok_op(5, "dequeue", "wtf"),
+                   invoke_op(6, "dequeue", None),
+                   ok_op(6, "dequeue", "dup"),
+                   invoke_op(7, "dequeue", None),
+                   ok_op(7, "dequeue", "dup")])
+        assert r == {V: False,
+                     "lost": Counter({"enqueued": 1}),
+                     "unexpected": Counter({"wtf": 1}),
+                     "recovered": Counter(),
+                     "duplicated": Counter({"dup": 1}),
+                     "ok-frac": Fraction(1, 3),
+                     "lost-frac": Fraction(1, 3),
+                     "unexpected-frac": Fraction(1, 3),
+                     "duplicated-frac": Fraction(1, 3),
+                     "recovered-frac": 0}
+
+    def test_drain_expansion(self):
+        r = check(c.total_queue(), None,
+                  [invoke_op(1, "enqueue", 1),
+                   ok_op(1, "enqueue", 1),
+                   invoke_op(2, "drain", None),
+                   ok_op(2, "drain", [1])])
+        assert r[V]
+
+
+class TestCounter:
+    def test_empty(self):
+        assert check(c.counter(), None, []) == \
+            {V: True, "reads": [], "errors": []}
+
+    def test_initial_read(self):
+        assert check(c.counter(), None,
+                     [invoke_op(0, "read", None),
+                      ok_op(0, "read", 0)]) == \
+            {V: True, "reads": [[0, 0, 0]], "errors": []}
+
+    def test_initial_invalid_read(self):
+        assert check(c.counter(), None,
+                     [invoke_op(0, "read", None),
+                      ok_op(0, "read", 1)]) == \
+            {V: False, "reads": [[0, 1, 0]], "errors": [[0, 1, 0]]}
+
+    def test_interleaved_concurrent_reads_writes(self):
+        h = [invoke_op(0, "read", None),
+             invoke_op(1, "add", 1),
+             invoke_op(2, "read", None),
+             invoke_op(3, "add", 2),
+             invoke_op(4, "read", None),
+             invoke_op(5, "add", 4),
+             invoke_op(6, "read", None),
+             invoke_op(7, "add", 8),
+             invoke_op(8, "read", None),
+             ok_op(0, "read", 6),
+             ok_op(1, "add", 1),
+             ok_op(2, "read", 0),
+             ok_op(3, "add", 2),
+             ok_op(4, "read", 3),
+             ok_op(5, "add", 4),
+             ok_op(6, "read", 100),
+             ok_op(7, "add", 8),
+             ok_op(8, "read", 15)]
+        assert check(c.counter(), None, h) == \
+            {V: False,
+             "reads": [[0, 6, 15], [0, 0, 15], [0, 3, 15],
+                       [0, 100, 15], [0, 15, 15]],
+             "errors": [[0, 100, 15]]}
+
+    def test_rolling_reads_and_writes(self):
+        h = [invoke_op(0, "read", None),
+             invoke_op(1, "add", 1),
+             ok_op(0, "read", 0),
+             invoke_op(0, "read", None),
+             ok_op(1, "add", 1),
+             invoke_op(1, "add", 2),
+             ok_op(0, "read", 3),
+             invoke_op(0, "read", None),
+             ok_op(1, "add", 2),
+             ok_op(0, "read", 5)]
+        assert check(c.counter(), None, h) == \
+            {V: False,
+             "reads": [[0, 0, 1], [0, 3, 3], [1, 5, 3]],
+             "errors": [[1, 5, 3]]}
+
+
+class TestSetChecker:
+    def test_never_read(self):
+        r = check(c.set_checker(), None, [invoke_op(0, "add", 0)])
+        assert r[V] == "unknown"
+
+    def test_ok(self):
+        r = check(c.set_checker(), None,
+                  [invoke_op(0, "add", 0), ok_op(0, "add", 0),
+                   invoke_op(1, "add", 1),  # indeterminate, recovered
+                   invoke_op(2, "read", None), ok_op(2, "read", [0, 1])])
+        assert r[V]
+        assert r["recovered"] == "#{1}"
+        assert r["ok-frac"] == 1
+
+    def test_lost_and_unexpected(self):
+        r = check(c.set_checker(), None,
+                  [invoke_op(0, "add", 0), ok_op(0, "add", 0),
+                   invoke_op(2, "read", None), ok_op(2, "read", [5])])
+        assert not r[V]
+        assert r["lost"] == "#{0}"
+        assert r["unexpected"] == "#{5}"
+
+
+class TestUniqueIds:
+    def test_unique(self):
+        r = check(c.unique_ids(), None,
+                  [invoke_op(0, "generate", None), ok_op(0, "generate", 0),
+                   invoke_op(1, "generate", None), ok_op(1, "generate", 1)])
+        assert r[V] and r["range"] == [0, 1]
+        assert r["attempted-count"] == 2 and r["acknowledged-count"] == 2
+
+    def test_dups(self):
+        r = check(c.unique_ids(), None,
+                  [invoke_op(0, "generate", None), ok_op(0, "generate", 7),
+                   invoke_op(1, "generate", None), ok_op(1, "generate", 7)])
+        assert not r[V]
+        assert r["duplicated"] == {7: 2}
+
+
+class TestCompose:
+    def test_compose(self):
+        r = check(c.compose({"a": c.unbridled_optimism(),
+                             "b": c.unbridled_optimism()}), None, None)
+        assert r == {"a": {V: True}, "b": {V: True}, V: True}
+
+    def test_compose_dominates(self):
+        bad = c.FnChecker(lambda t, m_, h, o: {V: False})
+        unk = c.FnChecker(lambda t, m_, h, o: {V: "unknown"})
+        r = check(c.compose({"a": c.unbridled_optimism(), "b": unk}),
+                  None, None)
+        assert r[V] == "unknown"
+        r = check(c.compose({"a": bad, "b": unk}), None, None)
+        assert r[V] is False
+
+    def test_check_safe_wraps_errors(self):
+        boom = c.FnChecker(lambda t, m_, h, o: 1 / 0)
+        r = c.check_safe(boom, None, None, [], {})
+        assert r[V] == "unknown" and "ZeroDivisionError" in r["error"]
+
+    def test_merge_valid_rejects_garbage(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            c.merge_valid([True, "nope"])

@@ -1,0 +1,130 @@
+"""Runner integration tests with the in-memory fake DB — parity with the
+reference's core_test.clj basic-cas-test (:18-28, real CAS checking against
+an atom register through the full run lifecycle) and worker-recovery-test
+(:86-101, crashing clients consume exactly n ops)."""
+
+from jepsen_tpu import checker as c
+from jepsen_tpu import core
+from jepsen_tpu import generator as g
+from jepsen_tpu import models as m
+from jepsen_tpu import tests_support as ts
+from jepsen_tpu.history import Op
+
+
+def test_basic_cas():
+    reg = ts.AtomRegister()
+    test = ts.noop_test(
+        client=ts.AtomClient(reg, latency=0.001),
+        generator=g.clients(g.limit(60, g.stagger(0.001, g.cas(5)))),
+        model=m.cas_register(),
+        checker=c.linearizable("cpu"),
+    )
+    result = core.run(test)
+    assert result["results"][c.VALID] is True
+    hist = result["history"]
+    assert len(hist) >= 120  # invoke + completion per op
+    invokes = [o for o in hist if o.is_invoke]
+    completions = [o for o in hist if not o.is_invoke]
+    assert len(invokes) == 60
+    assert len(invokes) == len(completions)
+    # indices are assigned
+    assert [o.index for o in hist] == list(range(len(hist)))
+    # every op carries a relative timestamp
+    assert all(isinstance(o.time, int) for o in hist)
+
+
+def test_basic_cas_device_checker():
+    reg = ts.AtomRegister()
+    test = ts.noop_test(
+        client=ts.AtomClient(reg),
+        generator=g.clients(g.limit(40, g.cas(5))),
+        model=m.cas_register(),
+        checker=c.linearizable("tpu"),
+    )
+    result = core.run(test)
+    assert result["results"][c.VALID] is True
+    assert result["results"]["analyzer"] == "tpu-bfs"
+
+
+def test_lying_client_detected():
+    """A client that acks writes but drops them must produce an invalid
+    history."""
+
+    class LyingClient(ts.AtomClient):
+        def invoke(self, test, op):
+            if op.f == "write":
+                return op.replace(type="ok")  # ack without applying
+            return super().invoke(test, op)
+
+        def open(self, test, node):
+            return LyingClient(self.register)
+
+    reg = ts.AtomRegister()
+    reg.write(99)  # writes can never change this value: reads must see 99
+    test = ts.noop_test(
+        client=LyingClient(reg),
+        generator=g.clients(g.limit(40, g.mix(
+            [Op("invoke", "read", None), lambda:
+             Op("invoke", "write", 1)]))),
+        model=m.cas_register(99),
+        checker=c.linearizable("cpu"),
+    )
+    result = core.run(test)
+    assert result["results"][c.VALID] is False
+
+
+def test_worker_recovery():
+    """Crashing clients must re-incarnate processes and consume exactly n
+    generator ops (core_test.clj:86-101)."""
+    test = ts.noop_test(
+        client=ts.CrashyClient(),
+        generator=g.clients(g.limit(20, Op("invoke", "read", None))),
+        checker=c.unbridled_optimism(),
+    )
+    result = core.run(test)
+    hist = result["history"]
+    invokes = [o for o in hist if o.is_invoke]
+    infos = [o for o in hist if o.is_info]
+    assert len(invokes) == 20
+    assert len(infos) == 20
+    # every process id appears at most once among invokes (re-incarnation)
+    procs = [o.process for o in invokes]
+    assert len(set(procs)) == len(procs)
+
+
+def test_nemesis_ops_reach_history():
+    from jepsen_tpu import nemesis as n
+
+    class MarkerNemesis(n.Nemesis):
+        def invoke(self, test, op):
+            return op.replace(value="marked")
+
+    test = ts.noop_test(
+        client=ts.AtomClient(ts.AtomRegister()),
+        nemesis=MarkerNemesis(),
+        generator=g.nemesis(
+            g.limit(2, Op("info", "start", None)),
+            g.limit(10, g.cas(5))),
+    )
+    result = core.run(test)
+    nem_ops = [o for o in result["history"] if o.process == "nemesis"]
+    assert len(nem_ops) == 4  # 2 invocations + 2 completions
+    assert [o.value for o in nem_ops].count("marked") == 2
+
+
+def test_generator_sees_test_and_process():
+    seen = []
+
+    def source(test, process):
+        if len(seen) >= 5:
+            return None
+        seen.append(process)
+        return Op("invoke", "read", None)
+
+    test = ts.noop_test(
+        client=ts.AtomClient(ts.AtomRegister()),
+        concurrency=2,
+        generator=g.clients(source),
+    )
+    core.run(test)
+    assert len(seen) == 5

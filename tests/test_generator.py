@@ -1,0 +1,195 @@
+"""Generator DSL tests, modeled on the reference's
+jepsen/test/jepsen/generator_test.clj: a real multithreaded harness drains
+the generator from one thread per logical process (generator_test.clj:9-25),
+plus combinator semantics."""
+
+import threading
+import time
+
+from jepsen_tpu import generator as g
+from jepsen_tpu.history import Op
+
+TEST = {"concurrency": 3, "nodes": ["n1", "n2", "n3"]}
+
+
+def drain(source, threads=(0, 1, 2), test=TEST, max_ops=10000):
+    """Spin one thread per logical thread id; each drains the generator
+    until it yields None. Returns ops in completion order."""
+    ops = []
+    lock = threading.Lock()
+
+    def worker(thread_id):
+        with g.with_threads(tuple(sorted([t for t in threads
+                                          if isinstance(t, int)])) +
+                            tuple(t for t in threads
+                                  if not isinstance(t, int))):
+            n = 0
+            while n < max_ops:
+                o = g.op_and_validate(source, test, thread_id)
+                if o is None:
+                    return
+                with lock:
+                    ops.append((thread_id, o))
+                n += 1
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in threads]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    return ops
+
+
+class TestBasicProtocol:
+    def test_none_terminates(self):
+        assert g.op(None, TEST, 0) is None
+
+    def test_op_yields_itself(self):
+        o = Op("invoke", "read", None)
+        assert g.op(o, TEST, 0) is o
+
+    def test_fn_as_generator(self):
+        assert g.op(lambda: Op("invoke", "read", None), TEST, 0).f == "read"
+        assert g.op(lambda test, process: Op("invoke", "w", process),
+                    TEST, 5).value == 5
+
+    def test_validate_rejects_garbage(self):
+        import pytest
+
+        with pytest.raises(AssertionError):
+            g.op_and_validate(lambda: 42, TEST, 0)
+
+    def test_process_to_thread(self):
+        assert g.process_to_thread(TEST, 7) == 1
+        assert g.process_to_thread(TEST, "nemesis") == "nemesis"
+
+    def test_process_to_node(self):
+        assert g.process_to_node(TEST, 0) == "n1"
+        assert g.process_to_node(TEST, 4) == "n2"
+        assert g.process_to_node(TEST, "nemesis") is None
+
+
+class TestCombinators:
+    def test_once(self):
+        ops = drain(g.once(Op("invoke", "read", None)))
+        assert len(ops) == 1
+
+    def test_limit(self):
+        ops = drain(g.limit(5, Op("invoke", "read", None)))
+        assert len(ops) == 5
+
+    def test_seq_advances_on_nil(self):
+        source = g.seq([g.once(Op("invoke", "a", None)),
+                        g.once(Op("invoke", "b", None)),
+                        g.once(Op("invoke", "c", None))])
+        ops = drain(source, threads=(0,))
+        assert [o.f for _, o in ops] == ["a", "b", "c"]
+
+    def test_concat(self):
+        source = g.concat(g.once(Op("invoke", "a", None)),
+                          g.once(Op("invoke", "b", None)))
+        ops = drain(source, threads=(0,))
+        assert [o.f for _, o in ops] == ["a", "b"]
+
+    def test_mix_produces_all(self):
+        source = g.limit(200, g.mix([Op("invoke", "a", None),
+                                     Op("invoke", "b", None)]))
+        fs = {o.f for _, o in drain(source, threads=(0,))}
+        assert fs == {"a", "b"}
+
+    def test_filter(self):
+        source = g.limit(10, g.filter_gen(lambda o: o.f == "read",
+                                          g.cas(5)))
+        assert all(o.f == "read" for _, o in drain(source, threads=(0,)))
+
+    def test_time_limit(self):
+        source = g.time_limit(0.2, Op("invoke", "read", None))
+        t0 = time.monotonic()
+        ops = drain(source, threads=(0,), max_ops=10 ** 6)
+        assert time.monotonic() - t0 < 5
+        assert len(ops) > 0
+
+    def test_stagger_delays(self):
+        source = g.limit(5, g.stagger(0.01, Op("invoke", "read", None)))
+        t0 = time.monotonic()
+        drain(source, threads=(0,))
+        assert time.monotonic() - t0 > 0.005
+
+    def test_drain_queue(self):
+        source = g.drain_queue(g.limit(10, g.queue_gen()))
+        ops = [o for _, o in drain(source, threads=(0,))]
+        enq = sum(1 for o in ops if o.f == "enqueue")
+        deq = sum(1 for o in ops if o.f == "dequeue")
+        assert deq >= enq
+
+    def test_each_per_process(self):
+        source = g.each(lambda: g.once(Op("invoke", "read", None)))
+        ops = drain(source)
+        assert len(ops) == 3  # one per thread
+
+    def test_start_stop(self):
+        source = g.start_stop(0.0, 0.0)
+        seen = []
+        with g.with_threads((0,)):
+            for _ in range(4):
+                seen.append(g.op(source, TEST, 0))
+        # ops interleaved with None sleeps
+        fs = [o.f for o in seen if o is not None]
+        assert fs[:2] == ["start", "stop"]
+
+
+class TestRouting:
+    def test_nemesis_routing(self):
+        source = g.limit(20, g.nemesis(Op("info", "n", None),
+                                       Op("invoke", "c", None)))
+        ops = drain(source, threads=(0, 1, "nemesis"))
+        for tid, o in ops:
+            if tid == "nemesis":
+                assert o.f == "n"
+            else:
+                assert o.f == "c"
+
+    def test_clients_blocks_nemesis(self):
+        source = g.limit(5, g.clients(Op("invoke", "c", None)))
+        ops = drain(source, threads=(0, "nemesis"))
+        assert all(tid != "nemesis" for tid, _ in ops)
+
+    def test_reserve(self):
+        source = g.reserve(1, Op("invoke", "w", None),
+                           1, Op("invoke", "c", None),
+                           Op("invoke", "r", None))
+        with g.with_threads((0, 1, 2)):
+            assert g.op(source, TEST, 0).f == "w"
+            assert g.op(source, TEST, 1).f == "c"
+            assert g.op(source, TEST, 2).f == "r"
+
+
+class TestSynchronization:
+    def test_phases(self):
+        source = g.phases(g.limit(3, Op("invoke", "a", None)),
+                          g.limit(3, Op("invoke", "b", None)))
+        ops = drain(source)
+        fs = [o.f for _, o in ops]
+        # all a's must precede all b's
+        last_a = max(i for i, f in enumerate(fs) if f == "a")
+        first_b = min(i for i, f in enumerate(fs) if f == "b")
+        assert last_a < first_b
+
+    def test_then(self):
+        source = g.then(g.limit(2, Op("invoke", "b", None)),
+                        g.limit(2, Op("invoke", "a", None)))
+        ops = drain(source, threads=(0,))
+        assert [o.f for _, o in ops] == ["a", "a", "b", "b"]
+
+    def test_barrier(self):
+        source = g.barrier(g.limit(3, Op("invoke", "a", None)))
+        ops = drain(source)
+        assert len(ops) == 3
+
+    def test_await(self):
+        called = []
+        source = g.await_fn(lambda: called.append(1),
+                            g.limit(2, Op("invoke", "a", None)))
+        ops = drain(source, threads=(0,))
+        assert called == [1]
+        assert len(ops) == 2

@@ -1,0 +1,98 @@
+"""Device BFS kernel parity tests (on the virtual CPU mesh backend).
+
+The CPU JIT checker (itself brute-force-verified in test_lin_cpu.py) is the
+oracle; the device kernel must agree on every history, including crashed-op
+and corrupted cases, and across frontier-capacity escalation boundaries.
+"""
+
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu.history import History, invoke_op, ok_op, info_op
+from jepsen_tpu.lin import analysis, prepare
+from jepsen_tpu.lin import bfs, cpu, synth
+
+
+def both(model, history, cap_schedule=bfs.DEFAULT_CAP_SCHEDULE):
+    p = prepare.prepare(model, history)
+    want = cpu.check_packed(p)["valid?"]
+    got = bfs.check_packed(p, cap_schedule=cap_schedule)["valid?"]
+    assert got == want, f"device={got} cpu={want}"
+    return got
+
+
+class TestBasics:
+    def test_empty(self):
+        assert both(m.cas_register(), History.of())
+
+    def test_sequential(self):
+        assert both(m.cas_register(), History.of(
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(0, "read", None), ok_op(0, "read", 1)))
+
+    def test_stale_read_invalid(self):
+        p = prepare.prepare(m.cas_register(), History.of(
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(0, "read", None), ok_op(0, "read", 0)))
+        r = bfs.check_packed(p)
+        assert r["valid?"] is False
+        assert r["op"]["f"] == "read" and r["op"]["value"] == 0
+
+    def test_crashed_write_observed(self):
+        assert both(m.cas_register(), History.of(
+            invoke_op(0, "write", 3), info_op(0, "write", 3),
+            invoke_op(1, "read", None), ok_op(1, "read", 3)))
+
+    def test_mutex(self):
+        assert not both(m.mutex(), History.of(
+            invoke_op(0, "acquire", None), ok_op(0, "acquire", None),
+            invoke_op(1, "acquire", None), ok_op(1, "acquire", None)))
+
+    def test_unsupported_model_unknown(self):
+        p = prepare.prepare(m.set_model(), History.of(
+            invoke_op(0, "add", 1), ok_op(0, "add", 1)))
+        assert bfs.check_packed(p)["valid?"] == "unknown"
+
+    def test_tiny_cap_escalates(self):
+        # capacity-1 schedule forces overflow then escalation
+        h = synth.generate_register_history(30, concurrency=5, seed=1,
+                                            crash_prob=0.3)
+        assert both(m.cas_register(), h, cap_schedule=(1, 4096))
+
+    def test_overflow_returns_unknown(self):
+        h = synth.generate_register_history(30, concurrency=5, seed=1,
+                                            crash_prob=0.3)
+        p = prepare.prepare(m.cas_register(), h)
+        r = bfs.check_packed(p, cap_schedule=(1,))
+        assert r["valid?"] == "unknown"
+
+
+@pytest.mark.parametrize("seed", range(15))
+def test_register_parity_valid(seed):
+    h = synth.generate_register_history(40, concurrency=4, seed=seed,
+                                        value_range=3, crash_prob=0.15)
+    assert both(m.cas_register(), h) is True
+
+
+@pytest.mark.parametrize("seed", range(15))
+def test_register_parity_corrupted(seed):
+    h = synth.generate_register_history(40, concurrency=4, seed=seed,
+                                        value_range=3, crash_prob=0.1)
+    h = synth.corrupt_history(h, seed=seed)
+    both(m.cas_register(), h)
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_mutex_parity(seed):
+    h = synth.generate_mutex_history(40, concurrency=4, seed=seed,
+                                     crash_prob=0.15)
+    assert both(m.mutex(), h) is True
+
+
+def test_analysis_tpu_and_competition():
+    h = synth.generate_register_history(30, concurrency=4, seed=3)
+    assert analysis(m.cas_register(), h, algorithm="tpu")["valid?"]
+    assert analysis(m.cas_register(), h, algorithm="competition")["valid?"]
+    bad = synth.corrupt_history(h, seed=3)
+    assert analysis(m.cas_register(), bad,
+                    algorithm="competition")["valid?"] is False

@@ -1,0 +1,197 @@
+"""CPU linearizability checker tests: hand-built histories + randomized
+parity against an independent brute-force search (testing the testers)."""
+
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu.history import History, invoke_op, ok_op, info_op, fail_op
+from jepsen_tpu.lin import analysis, prepare
+from jepsen_tpu.lin import brute, cpu, synth
+
+
+def H(*ops):
+    return History.of(*ops)
+
+
+def cpu_check(model, history, **kw):
+    return cpu.check_packed(prepare.prepare(model, history), **kw)
+
+
+class TestRegisterHistories:
+    def test_empty(self):
+        assert cpu_check(m.cas_register(), H())["valid?"]
+
+    def test_sequential_ok(self):
+        h = H(invoke_op(0, "write", 1), ok_op(0, "write", 1),
+              invoke_op(0, "read", None), ok_op(0, "read", 1))
+        assert cpu_check(m.cas_register(), h)["valid?"]
+
+    def test_stale_read(self):
+        h = H(invoke_op(0, "write", 1), ok_op(0, "write", 1),
+              invoke_op(0, "read", None), ok_op(0, "read", 0))
+        r = cpu_check(m.cas_register(), h)
+        assert r["valid?"] is False
+        assert r["op"]["f"] == "read" and r["op"]["value"] == 0
+
+    def test_concurrent_read_either_value(self):
+        # read overlaps the write: may see old or new
+        for seen in (None, 7):
+            h = H(invoke_op(0, "write", 7),
+                  invoke_op(1, "read", None),
+                  ok_op(1, "read", seen),
+                  ok_op(0, "write", 7))
+            assert cpu_check(m.cas_register(), h)["valid?"], seen
+
+    def test_cas_chain(self):
+        h = H(invoke_op(0, "write", 1), ok_op(0, "write", 1),
+              invoke_op(0, "cas", [1, 2]), ok_op(0, "cas", [1, 2]),
+              invoke_op(0, "read", None), ok_op(0, "read", 2))
+        assert cpu_check(m.cas_register(), h)["valid?"]
+
+    def test_impossible_cas(self):
+        h = H(invoke_op(0, "write", 1), ok_op(0, "write", 1),
+              invoke_op(0, "cas", [5, 2]), ok_op(0, "cas", [5, 2]))
+        assert cpu_check(m.cas_register(), h)["valid?"] is False
+
+    def test_crashed_write_observed(self):
+        # write crashes (indeterminate) but its value is later read: legal
+        h = H(invoke_op(0, "write", 3), info_op(0, "write", 3),
+              invoke_op(1, "read", None), ok_op(1, "read", 3))
+        assert cpu_check(m.cas_register(), h)["valid?"]
+
+    def test_crashed_write_unobserved(self):
+        # write crashes and is never seen: also legal (never linearized)
+        h = H(invoke_op(0, "write", 3), info_op(0, "write", 3),
+              invoke_op(1, "read", None), ok_op(1, "read", None))
+        assert cpu_check(m.cas_register(), h)["valid?"]
+
+    def test_failed_write_observed_is_invalid(self):
+        # a :fail op definitely did not happen; reading its value is a bug
+        h = H(invoke_op(0, "write", 3), fail_op(0, "write", 3),
+              invoke_op(1, "read", None), ok_op(1, "read", 3))
+        assert cpu_check(m.cas_register(), h)["valid?"] is False
+
+    def test_crashed_op_stays_concurrent_forever(self):
+        # crashed write may linearize arbitrarily late — even after
+        # intervening completed ops (core.clj:185-217 semantics)
+        h = H(invoke_op(0, "write", 3), info_op(0, "write", 3),
+              invoke_op(1, "write", 5), ok_op(1, "write", 5),
+              invoke_op(2, "read", None), ok_op(2, "read", 5),
+              invoke_op(3, "read", None), ok_op(3, "read", 3))
+        assert cpu_check(m.cas_register(), h)["valid?"]
+
+    def test_witness(self):
+        h = H(invoke_op(0, "write", 1),
+              invoke_op(1, "read", None),
+              ok_op(1, "read", 1),
+              ok_op(0, "write", 1))
+        r = cpu_check(m.cas_register(), h, witness=True)
+        assert r["valid?"]
+        fs = [(o["f"], o["value"]) for o in r["witness"]]
+        assert fs == [("write", 1), ("read", 1)]
+
+
+class TestMutexHistories:
+    def test_ok(self):
+        h = H(invoke_op(0, "acquire", None), ok_op(0, "acquire", None),
+              invoke_op(0, "release", None), ok_op(0, "release", None),
+              invoke_op(1, "acquire", None), ok_op(1, "acquire", None))
+        assert cpu_check(m.mutex(), h)["valid?"]
+
+    def test_double_acquire(self):
+        h = H(invoke_op(0, "acquire", None), ok_op(0, "acquire", None),
+              invoke_op(1, "acquire", None), ok_op(1, "acquire", None))
+        assert cpu_check(m.mutex(), h)["valid?"] is False
+
+    def test_concurrent_handoff(self):
+        h = H(invoke_op(0, "release", None),
+              invoke_op(1, "acquire", None),
+              ok_op(1, "acquire", None),
+              ok_op(0, "release", None))
+        assert cpu_check(m.Mutex(True), h)["valid?"]
+
+
+class TestGenericModels:
+    def test_set_model_generic_path(self):
+        h = H(invoke_op(0, "add", 1), ok_op(0, "add", 1),
+              invoke_op(1, "read", [1]), ok_op(1, "read", [1]))
+        p = prepare.prepare(m.set_model(), h)
+        assert p.kernel is None
+        assert cpu.check_packed(p)["valid?"]
+
+    def test_fifo_generic(self):
+        h = H(invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+              invoke_op(0, "enqueue", 2), ok_op(0, "enqueue", 2),
+              invoke_op(1, "dequeue", None), ok_op(1, "dequeue", 2))
+        assert cpu.check_packed(
+            prepare.prepare(m.fifo_queue(), h))["valid?"] is False
+
+
+class TestAnalysisFrontend:
+    def test_cpu_algorithm(self):
+        h = H(invoke_op(0, "write", 1), ok_op(0, "write", 1))
+        r = analysis(m.cas_register(), h, algorithm="cpu")
+        assert r["valid?"] and r["analyzer"] == "cpu-jit"
+
+
+# ---------------------------------------------------------------------------
+# Randomized parity: cpu JIT search vs independent brute force.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(40))
+def test_register_parity_valid(seed):
+    h = synth.generate_register_history(
+        8, concurrency=3, seed=seed, value_range=3, crash_prob=0.2)
+    expect = brute.check(m.cas_register(), h)
+    got = cpu_check(m.cas_register(), h)["valid?"]
+    assert got == expect
+    assert expect is True  # valid by construction
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_register_parity_corrupted(seed):
+    h = synth.generate_register_history(
+        8, concurrency=3, seed=seed, value_range=3, crash_prob=0.1)
+    h = synth.corrupt_history(h, seed=seed)
+    expect = brute.check(m.cas_register(), h)
+    got = cpu_check(m.cas_register(), h)["valid?"]
+    assert got == expect
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_mutex_parity(seed):
+    h = synth.generate_mutex_history(8, concurrency=3, seed=seed,
+                                     crash_prob=0.2)
+    expect = brute.check(m.mutex(), h)
+    got = cpu_check(m.mutex(), h)["valid?"]
+    assert got == expect
+    assert expect is True
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_random_garbage_histories(seed):
+    """Fully random op soup — exercises invalid shapes the simulator never
+    produces."""
+    import random
+
+    rng = random.Random(seed + 999)
+    h = []
+    procs = {}
+    for _ in range(10):
+        proc = rng.randrange(3)
+        if proc not in procs:
+            f = rng.choice(["read", "write", "cas"])
+            v = {"read": None, "write": rng.randrange(2),
+                 "cas": [rng.randrange(2), rng.randrange(2)]}[f]
+            procs[proc] = (f, v)
+            h.append(invoke_op(proc, f, v))
+        else:
+            f, v = procs.pop(proc)
+            typ = rng.choice(["ok", "ok", "fail", "info"])
+            if f == "read" and typ == "ok":
+                v = rng.choice([None, 0, 1])
+            h.append({"type": typ, "f": f, "value": v, "process": proc})
+    h = History.of(*h)
+    expect = brute.check(m.cas_register(), h)
+    got = cpu_check(m.cas_register(), h)["valid?"]
+    assert got == expect

@@ -101,6 +101,27 @@ def _to_py_value(f, v):
     return None if v[0] == int(k.NIL) else int(v[0])
 
 
+@pytest.mark.parametrize("kern", [k.cas_register_kernel(),
+                                  k.register_kernel(), k.mutex_kernel()])
+def test_kernel_noop_preserves_state(kern):
+    """F_NOOP (identity padding rows in the BFS) must be legal in every
+    kernel and leave state untouched."""
+    import jax
+
+    for s in ([0], [1], [3]):
+        state = np.array(s, np.int32)
+        ok, new = jax.jit(kern.step)(state, np.int32(k.F_NOOP),
+                                     np.array([7, 7], np.int32))
+        assert bool(ok) and np.array_equal(np.asarray(new), state)
+
+
+def test_kernel_for_carries_mutex_state():
+    held = k.kernel_for(m.Mutex(True))
+    assert list(held.init_state()) == [1]
+    free = k.kernel_for(m.mutex())
+    assert list(free.init_state()) == [0]
+
+
 @pytest.mark.parametrize("model_name", ["cas-register", "register", "mutex"])
 def test_kernel_parity(model_name):
     rng = random.Random(42)
