@@ -1,0 +1,67 @@
+"""Headline benchmark: linearizability checking throughput on device.
+
+North star (BASELINE.md): decide a 100k-op CAS-register history in <60 s
+where CPU knossos DNFs. Prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+with vs_baseline = achieved ops/s over the 100k-in-60s target rate.
+
+Runs on whatever jax.devices() provides (the real TPU chip under the
+driver). The history carries crashed ops (the frontier-inflating case that
+makes CPU checkers struggle) but stays within one device's bitset window.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+N_OPS = 100_000
+TARGET_SECONDS = 60.0
+
+
+def main() -> None:
+    from jepsen_tpu import models as m
+    from jepsen_tpu.lin import bfs, prepare, synth
+
+    h = synth.generate_register_history(
+        N_OPS, concurrency=5, seed=42, value_range=5,
+        crash_prob=0.001, max_crashes=10)
+
+    t0 = time.time()
+    p = prepare.prepare(m.cas_register(), h)
+    prep_s = time.time() - t0
+
+    # Warm the compile cache on a small same-shaped-bucket history so the
+    # measured run is the steady-state check (first TPU compile is slow).
+    warm = prepare.prepare(m.cas_register(), synth.generate_register_history(
+        256, concurrency=5, seed=7, crash_prob=0.01, max_crashes=4))
+    bfs.check_packed(warm, cap_schedule=(1024,))
+
+    t0 = time.time()
+    result = bfs.check_packed(p, cap_schedule=(1024, 16384))
+    check_s = time.time() - t0
+
+    if result["valid?"] is not True:
+        print(json.dumps({"metric": "lin_check_ops_per_sec", "value": 0,
+                          "unit": "ops/s", "vs_baseline": 0,
+                          "error": f"unexpected verdict {result}"}))
+        sys.exit(1)
+
+    ops_per_sec = N_OPS / check_s
+    target_rate = N_OPS / TARGET_SECONDS
+    print(json.dumps({
+        "metric": "lin_check_ops_per_sec",
+        "value": round(ops_per_sec, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(ops_per_sec / target_rate, 3),
+        "detail": {"n_ops": N_OPS, "check_seconds": round(check_s, 2),
+                   "prepare_seconds": round(prep_s, 2),
+                   "window": p.window, "return_events": int(p.R),
+                   "verdict": result["valid?"],
+                   "analyzer": result.get("analyzer")},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -106,7 +106,12 @@ def linearizable(algorithm: str = "competition", **kw) -> Checker:
         a["configs"] = list(a.get("configs", []))[:10]
         return a
 
-    return FnChecker(check)
+    ck = FnChecker(check)
+    # Marker consumed by jepsen_tpu.independent: only a pure linearizable
+    # checker may be replaced by the batched device search.
+    ck.is_linearizable = True
+    ck.algorithm = algorithm
+    return ck
 
 
 def queue() -> Checker:
@@ -296,7 +301,7 @@ def latency_graph() -> Checker:
     replaces the reference's gnuplot subprocess."""
 
     def check(test, model, history, opts):
-        from jepsen_tpu.checker import perf as perf_mod
+        from jepsen_tpu.checker import perf_graphs as perf_mod
 
         perf_mod.point_graph(test, history, opts)
         perf_mod.quantiles_graph(test, history, opts)
@@ -309,7 +314,7 @@ def rate_graph() -> Checker:
     """Throughput-over-time graph (checker.clj:399-405)."""
 
     def check(test, model, history, opts):
-        from jepsen_tpu.checker import perf as perf_mod
+        from jepsen_tpu.checker import perf_graphs as perf_mod
 
         perf_mod.rate_graph(test, history, opts)
         return {VALID: True}

@@ -1,0 +1,201 @@
+"""Performance graphs from histories.
+
+Re-design of `jepsen/src/jepsen/checker/perf.clj` (343 LoC): latency
+point/quantile graphs and throughput rate graphs, with nemesis-active
+regions shaded. matplotlib replaces the reference's gnuplot subprocess
+(perf.clj:231-247 shells out to gnuplot; this keeps everything in-process).
+
+Pure helpers (bucketing perf.clj:16-44, quantiles :46-56,
+latencies->quantiles :58-80, rate :114-128) are exposed for tests.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable
+
+from jepsen_tpu.util import history_latencies, nemesis_intervals
+
+log = logging.getLogger("jepsen.perf")
+
+TYPE_COLORS = {"ok": "#81BFFC", "info": "#FFA400", "fail": "#FF1E90"}
+
+
+def bucket_scale(dt: float, b: float) -> float:
+    """The center point of bucket b with width dt (perf.clj:16-24)."""
+    return b * dt + dt / 2
+
+
+def bucket_time(dt: float, t: float) -> float:
+    """Map a time to its bucket's center (perf.clj:26-31)."""
+    return bucket_scale(dt, t // dt)
+
+
+def buckets(dt: float, t_max: float) -> list[float]:
+    """Bucket centers covering [0, t_max] (perf.clj:33-37)."""
+    out = []
+    t = dt / 2
+    while t <= t_max + dt / 2:
+        out.append(t)
+        t += dt
+    return out
+
+
+def bucket_points(dt: float, points: Iterable[tuple]) -> dict:
+    """Group [t, x] points into buckets of width dt keyed by bucket center
+    (perf.clj:39-44)."""
+    out: dict = {}
+    for t, x in points:
+        out.setdefault(bucket_time(dt, t), []).append((t, x))
+    return out
+
+
+def quantiles(qs: Iterable[float], points: list) -> dict:
+    """Exact quantiles of a sample by sorted-rank (perf.clj:46-56)."""
+    points = sorted(points)
+    out = {}
+    for q in qs:
+        if not points:
+            continue
+        if not 0 <= q <= 1:
+            raise ValueError(f"quantile must be in [0,1]: {q}")
+        k = min(len(points) - 1, int(q * len(points)))
+        out[q] = points[k]
+    return out
+
+
+def latencies_to_quantiles(dt: float, qs: Iterable[float],
+                           points: Iterable[tuple]) -> dict:
+    """{quantile: [[bucket-time, latency] ...]} (perf.clj:58-80)."""
+    qs = list(qs)
+    by_bucket = bucket_points(dt, points)
+    centers = sorted(by_bucket)
+    out: dict = {q: [] for q in qs}
+    for center in centers:
+        lats = sorted(x for _, x in by_bucket[center])
+        qmap = quantiles(qs, lats)
+        for q in qs:
+            if q in qmap:
+                out[q].append([center, qmap[q]])
+    return out
+
+
+def rate(dt: float, history) -> dict:
+    """{(f, type): [[bucket-time, ops/sec] ...]} from completion events
+    (perf.clj:114-128)."""
+    counts: dict = {}
+    t_max = 0.0
+    for op in history:
+        if op.is_invoke or op.time is None:
+            continue
+        t = op.time / 1e9
+        t_max = max(t_max, t)
+        key = (op.f, op.type)
+        counts.setdefault(key, {})
+        b = bucket_time(dt, t)
+        counts[key][b] = counts[key].get(b, 0) + 1
+    return {key: [[b, c / dt] for b, c in sorted(m.items())]
+            for key, m in counts.items()}
+
+
+def _nemesis_spans(history) -> list[tuple[float, float]]:
+    spans = []
+    t_max = max((op.time or 0) for op in history) / 1e9 if history else 0
+    for start, stop in nemesis_intervals(history):
+        t0 = (start.time or 0) / 1e9
+        t1 = (stop.time or 0) / 1e9 if stop is not None else t_max
+        spans.append((t0, t1))
+    return spans
+
+
+def _setup_plot(title, ylabel):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(10, 5))
+    ax.set_title(title)
+    ax.set_xlabel("time (s)")
+    ax.set_ylabel(ylabel)
+    return fig, ax
+
+
+def _shade_nemesis(ax, history):
+    for t0, t1 in _nemesis_spans(history):
+        ax.axvspan(t0, t1, color="#F3F3F3", zorder=0)
+
+
+def _save(fig, test, opts, filename):
+    from jepsen_tpu import store
+
+    path = store.path(test or {"name": "noname"},
+                      (opts or {}).get("subdirectory"), filename, make=True)
+    fig.savefig(path, dpi=110, bbox_inches="tight")
+    import matplotlib.pyplot as plt
+
+    plt.close(fig)
+    return path
+
+
+def point_graph(test, history, opts=None):
+    """Latency scatter colored by completion type (perf.clj:221-249)."""
+    fig, ax = _setup_plot(f"{(test or {}).get('name', '')} latency (raw)",
+                          "latency (ms)")
+    _shade_nemesis(ax, history)
+    series: dict = {}
+    for inv, latency, ctype in history_latencies(history):
+        if latency is None or inv.time is None:
+            continue
+        series.setdefault(ctype, []).append(
+            (inv.time / 1e9, latency / 1e6))
+    for ctype, pts in sorted(series.items(), key=lambda kv: str(kv[0])):
+        xs, ys = zip(*pts)
+        ax.scatter(xs, ys, s=4, label=str(ctype),
+                   color=TYPE_COLORS.get(ctype, "#888888"))
+    ax.set_yscale("log")
+    if series:
+        ax.legend(loc="upper right", fontsize=7)
+    return _save(fig, test, opts, "latency-raw.png")
+
+
+def quantiles_graph(test, history, opts=None,
+                    qs=(0.5, 0.95, 0.99, 1.0), dt=10.0):
+    """Latency quantiles over time (perf.clj:251-291)."""
+    pts = [(inv.time / 1e9, latency / 1e6)
+           for inv, latency, _ in history_latencies(history)
+           if latency is not None and inv.time is not None]
+    by_q = latencies_to_quantiles(dt, qs, pts)
+    fig, ax = _setup_plot(
+        f"{(test or {}).get('name', '')} latency (quantiles)",
+        "latency (ms)")
+    _shade_nemesis(ax, history)
+    for q, series in sorted(by_q.items()):
+        if series:
+            xs, ys = zip(*series)
+            ax.plot(xs, ys, marker="o", markersize=3, label=f"q={q}")
+    ax.set_yscale("log")
+    if any(by_q.values()):
+        ax.legend(loc="upper right", fontsize=7)
+    return _save(fig, test, opts, "latency-quantiles.png")
+
+
+def rate_graph(test, history, opts=None, dt=10.0):
+    """Throughput by (f, completion-type) over time (perf.clj:300-342)."""
+    series = rate(dt, [op for op in history if op.process != "nemesis"])
+    fig, ax = _setup_plot(f"{(test or {}).get('name', '')} rate",
+                          "throughput (hz)")
+    _shade_nemesis(ax, history)
+    for (f, ctype), pts in sorted(series.items(),
+                                  key=lambda kv: str(kv[0])):
+        if pts:
+            xs, ys = zip(*pts)
+            ax.plot(xs, ys, marker="o", markersize=3,
+                    label=f"{f} {ctype}",
+                    color=None if ctype not in TYPE_COLORS
+                    else TYPE_COLORS[ctype],
+                    linestyle={"ok": "-", "info": "--",
+                               "fail": ":"}.get(ctype, "-"))
+    if series:
+        ax.legend(loc="upper right", fontsize=7)
+    return _save(fig, test, opts, "rate.png")

@@ -1,0 +1,103 @@
+"""HTML timeline of a history: one swimlane per process.
+
+Re-design of `jepsen/src/jepsen/checker/timeline.clj` (179 LoC): pairs
+invocations with completions (:33-53), renders each op as a positioned div
+colored by completion type (:97-121), emits timeline.html through the
+store (:159-179). No external templating — plain string HTML.
+"""
+
+from __future__ import annotations
+
+import html as _html
+
+from jepsen_tpu import checker as checker_ns
+from jepsen_tpu.history import Op
+
+TYPE_COLORS = {"ok": "#B3F3B5", "info": "#FFE0B3", "fail": "#F3B3B3",
+               None: "#DDDDDD"}
+
+NS_PER_PX = 1e6  # 1 ms per pixel vertically
+
+
+def pairs(history) -> list[tuple[Op, Op | None]]:
+    """Match invocations with their completions; unmatched invocations pair
+    with None (timeline.clj:33-53)."""
+    out = []
+    pending: dict = {}
+    for op in history:
+        if op.is_invoke:
+            pending[op.process] = op
+        elif op.process in pending:
+            out.append((pending.pop(op.process), op))
+    for inv in pending.values():
+        out.append((inv, None))
+    out.sort(key=lambda p: p[0].time or 0)
+    return out
+
+
+def _op_div(inv: Op, completion: Op | None, lane: int) -> str:
+    t0 = inv.time or 0
+    t1 = completion.time if completion is not None and \
+        completion.time is not None else t0 + int(5e6)
+    ctype = completion.type if completion is not None else None
+    color = TYPE_COLORS.get(ctype, "#DDDDDD")
+    top = t0 / NS_PER_PX
+    height = max(1.0, (t1 - t0) / NS_PER_PX)
+    completed_value = repr(completion.value) if completion is not None else ""
+    title = _html.escape(
+        f"process {inv.process} | {inv.f} {inv.value!r} -> "
+        f"{ctype or 'never returned'} {completed_value} | "
+        f"{t0 / 1e6:.2f}ms +{(t1 - t0) / 1e6:.2f}ms")
+    label = _html.escape(f"{inv.f} {inv.value!r}"[:28])
+    return (f'<div class="op" title="{title}" style="top:{top:.1f}px;'
+            f'height:{height:.1f}px;left:{lane * 110}px;'
+            f'background:{color}">{label}</div>')
+
+
+def html(test, history, opts=None) -> str:
+    """Render the timeline document (timeline.clj:159-179)."""
+    ps = pairs(op for op in history if op.process != "nemesis")
+    lanes: dict = {}
+    for inv, _ in ps:
+        thread = inv.process if not isinstance(inv.process, int) else \
+            inv.process % max(1, (test or {}).get("concurrency", 1) or 1)
+        lanes.setdefault(thread, len(lanes))
+    divs = [_op_div(inv, comp, lanes[
+        inv.process if not isinstance(inv.process, int)
+        else inv.process % max(1, (test or {}).get("concurrency", 1) or 1)])
+        for inv, comp in ps]
+    headers = "".join(
+        f'<div class="lane-h" style="left:{i * 110}px">thread {t}</div>'
+        for t, i in lanes.items())
+    name = (test or {}).get("name", "")
+    return f"""<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>{_html.escape(str(name))} timeline</title>
+<style>
+body {{ font-family: monospace; margin: 0; }}
+.lanes {{ position: relative; margin-top: 30px; }}
+.lane-h {{ position: fixed; top: 0; width: 105px; background: #eee;
+           padding: 4px; font-weight: bold; z-index: 2; }}
+.op {{ position: absolute; width: 105px; overflow: hidden;
+       font-size: 9px; border: 1px solid #999; box-sizing: border-box; }}
+</style></head>
+<body>{headers}<div class="lanes">{"".join(divs)}</div></body></html>"""
+
+
+def checker() -> checker_ns.Checker:
+    """A checker that writes timeline.html and always passes
+    (timeline.clj:159-179)."""
+
+    def check(test, model, history, opts):
+        doc = html(test, history, opts)
+        try:
+            from jepsen_tpu import store
+
+            if test is not None and test.get("name"):
+                path = store.path(test, (opts or {}).get("subdirectory"),
+                                  "timeline.html", make=True)
+                path.write_text(doc)
+        except Exception:  # noqa: BLE001 - artifact is best-effort
+            pass
+        return {checker_ns.VALID: True}
+
+    return checker_ns.FnChecker(check)

@@ -1,0 +1,259 @@
+"""Command-line runner.
+
+Re-design of `jepsen/src/jepsen/cli.clj` (334 LoC): a subcommand
+dispatcher with the standard test option set (cli.clj:52-87 — --node,
+--nodes-file, --username, --password, --concurrency with the "3n"
+multiplier :123-138, --time-limit, --test-count, --ssh-private-key), the
+exit-code contract (cli.clj:103-112: 0 = valid, 1 = invalid, 2 = unknown,
+254 = error, 255 = usage), `single_test_cmd` for suites (:295-329) and
+`serve_cmd` for the results web server (:278-293).
+
+A suite module plugs in exactly like the reference's `-main`s::
+
+    from jepsen_tpu import cli
+    cli.run(cli.single_test_cmd(my_test_fn, opt_spec=[...]), argv)
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import traceback
+from typing import Callable
+
+from jepsen_tpu import checker as checker_ns
+
+EXIT_OK = 0
+EXIT_INVALID = 1
+EXIT_UNKNOWN = 2
+EXIT_ERROR = 254
+EXIT_USAGE = 255
+
+
+def add_test_opts(p: argparse.ArgumentParser) -> None:
+    """The standard test option set (cli.clj:52-87)."""
+    p.add_argument("--node", action="append", dest="nodes", metavar="NODE",
+                   help="node to test; repeatable (default n1..n5)")
+    p.add_argument("--nodes-file", help="file with one node per line")
+    p.add_argument("--username", default="root", help="ssh username")
+    p.add_argument("--password", help="ssh password")
+    p.add_argument("--ssh-private-key", dest="private_key_path",
+                   help="path to an SSH identity file")
+    p.add_argument("--strict-host-key-checking", action="store_true",
+                   help="verify host keys")
+    p.add_argument("--concurrency", default="1n",
+                   help='number of workers, e.g. "10" or "3n" '
+                        "(3 x node count)")
+    p.add_argument("--time-limit", type=float, default=60.0,
+                   help="how long to run the workload, seconds")
+    p.add_argument("--test-count", type=int, default=1,
+                   help="how many times to run the test")
+    p.add_argument("--transport", default="ssh",
+                   choices=["ssh", "local", "dummy"],
+                   help="control-plane transport")
+    p.add_argument("--store", default="store", help="results directory")
+
+
+def parse_concurrency(spec: str, n_nodes: int) -> int:
+    """'10' -> 10 workers; '3n' -> 3 x node count (cli.clj:123-138)."""
+    spec = str(spec).strip()
+    try:
+        if spec.endswith("n"):
+            return int(spec[:-1] or 1) * n_nodes
+        return int(spec)
+    except ValueError:
+        raise UsageError(
+            f"--concurrency must be an integer optionally followed by 'n', "
+            f"got {spec!r}")
+
+
+class UsageError(Exception):
+    pass
+
+
+def options_to_test(opts: argparse.Namespace) -> dict:
+    """Build the base test map from parsed options (the reference's
+    test-opt-fn pipeline, cli.clj:156-197)."""
+    nodes = opts.nodes
+    if opts.nodes_file:
+        with open(opts.nodes_file) as fh:
+            nodes = [line.strip() for line in fh if line.strip()]
+    if not nodes:
+        nodes = ["n1", "n2", "n3", "n4", "n5"]
+    ssh = {"username": opts.username,
+           "password": opts.password,
+           "private-key-path": opts.private_key_path,
+           "strict-host-key-checking": opts.strict_host_key_checking}
+    return {"nodes": nodes,
+            "ssh": ssh,
+            "transport": opts.transport,
+            "concurrency": parse_concurrency(opts.concurrency, len(nodes)),
+            "time-limit": opts.time_limit,
+            "store-base": opts.store}
+
+
+def single_test_cmd(test_fn: Callable[[dict], dict],
+                    name: str = "test",
+                    opt_spec: Callable[[argparse.ArgumentParser], None]
+                    | None = None) -> dict:
+    """A subcommand spec running `test_fn(options)` through the core runner
+    --test-count times (cli.clj:295-329)."""
+
+    def build_parser(p: argparse.ArgumentParser):
+        add_test_opts(p)
+        if opt_spec:
+            opt_spec(p)
+
+    def run_cmd(opts: argparse.Namespace) -> int:
+        from jepsen_tpu import core
+
+        # invalid (definite violation) dominates unknown dominates ok —
+        # same priority order as merge_valid, not numeric exit-code order.
+        severity = {EXIT_OK: 0, EXIT_UNKNOWN: 1, EXIT_INVALID: 2}
+        worst = EXIT_OK
+        for _ in range(opts.test_count):
+            test = test_fn({**vars(opts), **options_to_test(opts)})
+            result = core.run(test)
+            valid = result.get("results", {}).get(checker_ns.VALID)
+            code = (EXIT_OK if valid is True else
+                    EXIT_INVALID if valid is False else EXIT_UNKNOWN)
+            if severity[code] > severity[worst]:
+                worst = code
+        return worst
+
+    return {"name": name, "parser": build_parser, "run": run_cmd,
+            "help": f"run the {name} test"}
+
+
+def serve_cmd() -> dict:
+    """Run the results web server (cli.clj:278-293)."""
+
+    def build_parser(p: argparse.ArgumentParser):
+        p.add_argument("--port", "-p", type=int, default=8080)
+        p.add_argument("--host", "-b", default="0.0.0.0")
+        p.add_argument("--store", default="store")
+
+    def run_cmd(opts: argparse.Namespace) -> int:
+        from jepsen_tpu import web
+
+        web.serve(host=opts.host, port=opts.port, base=opts.store)
+        return EXIT_OK
+
+    return {"name": "serve", "parser": build_parser, "run": run_cmd,
+            "help": "serve the results browser"}
+
+
+def analyze_cmd() -> dict:
+    """Re-run a checker offline on a saved history — the TPU build's
+    first-class path: record once, re-check on device (the seam noted in
+    SURVEY.md §5 checkpoint/resume)."""
+
+    def build_parser(p: argparse.ArgumentParser):
+        p.add_argument("test_name")
+        p.add_argument("timestamp", nargs="?",
+                       help="defaults to the latest run")
+        p.add_argument("--store", default="store")
+        p.add_argument("--model", default="cas-register",
+                       choices=["cas-register", "register", "mutex"])
+        p.add_argument("--algorithm", default="competition",
+                       choices=["tpu", "cpu", "competition"])
+
+    def run_cmd(opts: argparse.Namespace) -> int:
+        import json
+
+        from jepsen_tpu import models as m
+        from jepsen_tpu import store
+        from jepsen_tpu.lin import analysis
+
+        runs = store.tests(opts.test_name, base=opts.store)
+        if not runs:
+            print(f"no runs found for {opts.test_name!r} in {opts.store}",
+                  file=sys.stderr)
+            return EXIT_ERROR
+        ts = opts.timestamp or sorted(runs)[-1]
+        test = runs[ts]() if ts in runs else None
+        if test is None:
+            print(f"no run {ts!r}", file=sys.stderr)
+            return EXIT_ERROR
+        model = {"cas-register": m.cas_register, "register": m.register,
+                 "mutex": m.mutex}[opts.model]()
+        result = analysis(model, test["history"],
+                          algorithm=opts.algorithm)
+        print(json.dumps({k: v for k, v in result.items()
+                          if k in ("valid?", "analyzer", "op", "error")},
+                         default=repr, indent=2))
+        valid = result.get("valid?")
+        return (EXIT_OK if valid is True else
+                EXIT_INVALID if valid is False else EXIT_UNKNOWN)
+
+    return {"name": "analyze", "parser": build_parser, "run": run_cmd,
+            "help": "re-check a saved history (optionally on device)"}
+
+
+def run(commands, argv=None) -> int:
+    """Dispatch subcommands (cli.clj:201-276). Returns the exit code; the
+    `main` wrapper calls sys.exit with it."""
+    if isinstance(commands, dict) and "name" in commands:
+        commands = [commands]
+    parser = argparse.ArgumentParser(prog="jepsen-tpu")
+    subs = parser.add_subparsers(dest="subcommand")
+    for cmd in commands:
+        sp = subs.add_parser(cmd["name"], help=cmd.get("help"))
+        cmd["parser"](sp)
+        sp.set_defaults(_run=cmd["run"])
+
+    try:
+        opts = parser.parse_args(argv)
+    except SystemExit as e:
+        return EXIT_USAGE if e.code not in (0,) else EXIT_OK
+    if not getattr(opts, "_run", None):
+        parser.print_help()
+        return EXIT_USAGE
+    try:
+        return opts._run(opts)
+    except UsageError as e:
+        print(f"usage error: {e}", file=sys.stderr)
+        return EXIT_USAGE
+    except Exception:
+        traceback.print_exc()
+        return EXIT_ERROR
+
+
+def main(commands, argv=None) -> None:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s - %(message)s")
+    sys.exit(run(commands, argv))
+
+
+def _demo_test_fn(options: dict) -> dict:
+    """The built-in demo test: in-memory CAS register through the full
+    runner (what `python -m jepsen_tpu.cli test` runs with no suite)."""
+    from jepsen_tpu import generator as g
+    from jepsen_tpu import models
+    from jepsen_tpu import tests_support as ts
+    from jepsen_tpu.checker import timeline
+
+    reg = ts.AtomRegister()
+    return {
+        "name": "demo-cas",
+        "nodes": options["nodes"],
+        "transport": "dummy",
+        "concurrency": options["concurrency"],
+        "store-base": options["store-base"],
+        "client": ts.AtomClient(reg, latency=0.002),
+        "generator": g.clients(
+            g.time_limit(min(options.get("time-limit", 10), 10),
+                         g.stagger(0.005, g.cas(5)))),
+        "model": models.cas_register(),
+        # cpu engine: the demo shouldn't contend for the TPU chip
+        "checker": checker_ns.compose({
+            "linear": checker_ns.linearizable("cpu"),
+            "timeline": timeline.checker(),
+            "perf": checker_ns.perf()}),
+    }
+
+
+if __name__ == "__main__":
+    main([single_test_cmd(_demo_test_fn), serve_cmd(), analyze_cmd()])

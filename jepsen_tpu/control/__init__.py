@@ -137,11 +137,26 @@ def trace():
     return _Binding(_trace_var, True)
 
 
-def wrap_sudo(cmd: str) -> str:
+def wrap_sudo(cmd: str, local_user: str | None = None) -> str:
+    """Wrap a command in sudo when a sudo scope is active
+    (control.clj:98-106). Skipped when the session already runs as the
+    target user — minimal nodes (and the local/dummy transports) often
+    have no sudo binary, and root needs no escalation."""
     user = _sudo_var.get()
-    if user:
-        return f"sudo -S -u {user} bash -c {shlex.quote(cmd)}"
-    return cmd
+    if not user:
+        return cmd
+    session = _session_var.get()
+    runs_as = getattr(session, "user", None) or local_user
+    if runs_as is None and isinstance(session, (LocalSession, DummySession)):
+        import getpass
+
+        try:
+            runs_as = getpass.getuser()
+        except (OSError, KeyError):  # stripped env / uid without passwd
+            runs_as = None
+    if runs_as == user:
+        return cmd
+    return f"sudo -S -u {user} bash -c {shlex.quote(cmd)}"
 
 
 def wrap_cd(cmd: str) -> str:

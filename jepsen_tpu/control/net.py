@@ -1,0 +1,31 @@
+"""Node network helpers (reference `jepsen/src/jepsen/control/net.clj`,
+30 LoC): reachability and IP lookup over the control plane."""
+
+from __future__ import annotations
+
+from jepsen_tpu import control as c
+
+
+def reachable(host: str) -> bool:
+    """Can the current node ping host? (control/net.clj:8-11)"""
+    try:
+        c.exec_("ping", "-w", "1", host)
+        return True
+    except c.RemoteError:
+        return False
+
+
+def local_ip() -> str:
+    """The bound node's first global IP (control/net.clj:12-18)."""
+    return c.exec_(c.Lit(
+        "hostname -I | awk '{print $1}'"))
+
+
+def ip(host: str) -> str:
+    """Resolve a hostname to an IP via getent (control/net.clj:20-30)."""
+    out = c.exec_("getent", "ahosts", host)
+    for line in out.splitlines():
+        parts = line.split()
+        if parts and "STREAM" in line:
+            return parts[0]
+    return out.split()[0] if out.split() else host

@@ -1,0 +1,118 @@
+"""Remote installation / daemon-management helpers.
+
+Re-design of `jepsen/src/jepsen/control/util.clj` (219 LoC): wget with
+retries (:51-70), tarball/zip install with corruption retry (:72-140),
+user management (:147-154), grepkill (:156-173), daemon start/stop via
+start-stop-daemon (:176-205+), tmp dirs (:40-49).
+All functions run against the currently-bound control session.
+"""
+
+from __future__ import annotations
+
+import os.path
+import secrets
+
+from jepsen_tpu import control as c
+
+
+def exists(path: str) -> bool:
+    """Does a file exist on the node? (control/util.clj:17-22)"""
+    try:
+        c.exec_("stat", path)
+        return True
+    except c.RemoteError:
+        return False
+
+
+def tmp_dir() -> str:
+    """Create and return a fresh temp directory (control/util.clj:40-49)."""
+    d = f"/tmp/jepsen/{secrets.token_hex(8)}"
+    c.exec_("mkdir", "-p", d)
+    return d
+
+
+def wget(url: str, force: bool = False, retries: int = 3) -> str:
+    """Download a file to the current directory if not already present;
+    returns its filename (control/util.clj:51-70)."""
+    filename = os.path.basename(url)
+    if force:
+        c.exec_("rm", "-f", filename, may_fail=True)
+    if not exists(filename):
+        def fetch():
+            return c.exec_("wget", "--tries", "20", "--waitretry", "60",
+                           "--retry-connrefused", "--no-dns-cache",
+                           "--no-cache", url)
+        from jepsen_tpu.util import with_retry
+
+        with_retry(fetch, retries=retries, exceptions=(c.RemoteError,))
+    return filename
+
+
+def install_archive(url: str, dest: str, force: bool = False) -> str:
+    """Download a tar/zip archive and extract it to dest, retrying once on
+    a corrupt archive (control/util.clj:72-140)."""
+    with c.cd("/tmp"):
+        name = wget(url, force=force)
+        c.exec_("rm", "-rf", dest, may_fail=True)
+        c.exec_("mkdir", "-p", dest)
+        for attempt in (0, 1):
+            try:
+                if name.endswith(".zip"):
+                    c.exec_("unzip", "-o", name, "-d", dest)
+                else:
+                    c.exec_("tar", "--extract", "--file", name,
+                            "--directory", dest,
+                            "--strip-components", "1")
+                return dest
+            except c.RemoteError:
+                if attempt == 1:
+                    raise
+                # corrupt download: refetch once
+                name = wget(url, force=True)
+    return dest
+
+
+def ensure_user(username: str) -> str:
+    """Create a user if absent (control/util.clj:147-154)."""
+    try:
+        c.exec_("id", username)
+    except c.RemoteError:
+        c.exec_("useradd", "--create-home", username)
+    return username
+
+
+def grepkill(pattern: str, signal: str = "KILL") -> None:
+    """Kill processes matching a pattern (control/util.clj:156-173)."""
+    c.exec_(c.Lit(
+        f"ps aux | grep {pattern!r} | grep -v grep | awk '{{print $2}}' "
+        f"| xargs -r kill -{signal}"), may_fail=True)
+
+
+def start_daemon(binary: str, *args, logfile: str, pidfile: str,
+                 chdir: str | None = None, make_pidfile: bool = True,
+                 background: bool = True, env: dict | None = None) -> None:
+    """Start a daemon via start-stop-daemon (control/util.clj:176-205)."""
+    cmd = ["start-stop-daemon", "--start"]
+    if background:
+        cmd += ["--background", "--no-close"]
+    if make_pidfile:
+        cmd += ["--make-pidfile"]
+    cmd += ["--pidfile", pidfile]
+    if chdir:
+        cmd += ["--chdir", chdir]
+    cmd += ["--oknodo", "--exec", binary, "--"]
+    cmd += list(args)
+    prefix = ""
+    if env:
+        prefix = " ".join(f"{k}={v}" for k, v in env.items()) + " "
+    c.exec_(c.Lit(prefix + c.build_cmd(*cmd) + f" >> {logfile} 2>&1"))
+
+
+def stop_daemon(pidfile: str, binary: str | None = None) -> None:
+    """Stop a daemon by pidfile (control/util.clj:206+)."""
+    if exists(pidfile):
+        c.exec_("start-stop-daemon", "--stop", "--oknodo",
+                "--pidfile", pidfile, "--retry", "15", may_fail=True)
+        c.exec_("rm", "-f", pidfile, may_fail=True)
+    elif binary:
+        grepkill(binary)

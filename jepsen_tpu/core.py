@@ -151,31 +151,47 @@ def worker(test: dict, setup_barrier: threading.Barrier, process: int,
 
 def _worker_loop(test, setup_barrier, process, node):
     gen = test.get("generator")
-    client = test["client"].open(test, node)
+    client = None
     exception = None
-    setup_barrier.wait()
     try:
-        while True:
-            op = generator.op_and_validate(gen, test, process)
-            if op is None:
-                break
-            op = history_mod.op(op).replace(process=process,
-                                            time=relative_time_nanos())
-            _log_op(op)
-            conj_op(test, op)
-            process, client = invoke_and_complete(
-                node, process, client, test, op)
+        client = test["client"].open(test, node)
     except Exception as e:  # noqa: BLE001
+        # A failed open must not leave the other workers parked on the
+        # setup barrier forever: poison it so everyone unblocks.
         exception = e
-        log.warning("worker for process %s threw:\n%s", process,
-                    traceback.format_exc())
-    finally:
-        # All ops complete before any worker tears down (core.clj:258-261).
-        setup_barrier.wait()
+        log.warning("client open for process %s on %s failed:\n%s",
+                    process, node, traceback.format_exc())
+        setup_barrier.abort()
+    if client is not None:
         try:
-            client.close(test)
-        except Exception:  # noqa: BLE001
-            pass
+            setup_barrier.wait()
+            while True:
+                op = generator.op_and_validate(gen, test, process)
+                if op is None:
+                    break
+                op = history_mod.op(op).replace(process=process,
+                                                time=relative_time_nanos())
+                _log_op(op)
+                conj_op(test, op)
+                process, client = invoke_and_complete(
+                    node, process, client, test, op)
+        except threading.BrokenBarrierError as e:
+            exception = exception or e
+        except Exception as e:  # noqa: BLE001
+            exception = e
+            log.warning("worker for process %s threw:\n%s", process,
+                        traceback.format_exc())
+        finally:
+            # All ops complete before any worker tears down
+            # (core.clj:258-261).
+            try:
+                setup_barrier.wait()
+            except threading.BrokenBarrierError:
+                pass
+            try:
+                client.close(test)
+            except Exception:  # noqa: BLE001
+                pass
     if exception is not None:
         test.setdefault("worker-errors", []).append(exception)
 
@@ -238,18 +254,25 @@ def run_case(test: dict) -> list[Op]:
     nemesis = (test.get("nemesis") or nemesis_ns.noop).setup(test) \
         or test.get("nemesis") or nemesis_ns.noop
     try:
-        nem_thread = nemesis_worker(test, nemesis)
-        concurrency = test["concurrency"]
-        setup_barrier = threading.Barrier(concurrency)
-        nodes = test.get("nodes") or []
-        client_nodes = ([None] * concurrency if not nodes else
-                        [nodes[i % len(nodes)] for i in range(concurrency)])
-        workers = [worker(test, setup_barrier, process, node)
-                   for process, node in enumerate(client_nodes)]
-        for w in workers:
-            w.join()
-        log.info("waiting for nemesis to complete")
-        nem_thread.join()
+        # One-time client data setup (client.clj:13-14), before any worker
+        # opens per-process connections; torn down after the workload.
+        test["client"].setup(test)
+        try:
+            nem_thread = nemesis_worker(test, nemesis)
+            concurrency = test["concurrency"]
+            setup_barrier = threading.Barrier(concurrency)
+            nodes = test.get("nodes") or []
+            client_nodes = ([None] * concurrency if not nodes else
+                            [nodes[i % len(nodes)]
+                             for i in range(concurrency)])
+            workers = [worker(test, setup_barrier, process, node)
+                       for process, node in enumerate(client_nodes)]
+            for w in workers:
+                w.join()
+            log.info("waiting for nemesis to complete")
+            nem_thread.join()
+        finally:
+            test["client"].teardown(test)
     finally:
         nemesis.teardown(test)
 

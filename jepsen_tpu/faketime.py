@@ -1,0 +1,40 @@
+"""Per-process clock-rate skew via libfaketime wrappers.
+
+Re-design of `jepsen/src/jepsen/faketime.clj` (31 LoC): replaces a DB
+binary with a shell wrapper that launches it under ``faketime`` with a
+random rate, so one node's process experiences accelerated/dilated time.
+"""
+
+from __future__ import annotations
+
+import random
+
+from jepsen_tpu import control as c
+
+
+def script(binary: str, rate: float) -> str:
+    """A wrapper script running binary under faketime at a given rate
+    (faketime.clj:8-19)."""
+    return ("#!/bin/bash\n"
+            f"exec faketime -m -f \"+0s x{rate:.4f}\" "
+            f"{binary}.real \"$@\"\n")
+
+
+def wrap(binary: str, rate: float | None = None) -> None:
+    """Move binary to binary.real and install the faketime wrapper in its
+    place; idempotent (faketime.clj:21-31)."""
+    rate = rate if rate is not None else random.uniform(0.5, 1.5)
+    real = f"{binary}.real"
+    with c.su():
+        c.exec_(c.Lit(
+            f"test -f {real} || mv {binary} {real}"))
+        c.exec_("tee", binary, stdin=script(binary, rate))
+        c.exec_("chmod", "a+x", binary)
+
+
+def unwrap(binary: str) -> None:
+    """Restore the original binary."""
+    real = f"{binary}.real"
+    with c.su():
+        c.exec_(c.Lit(
+            f"test -f {real} && mv {real} {binary} || true"))

@@ -1,0 +1,254 @@
+"""Lifting single-key tests to maps of independent keys.
+
+Re-design of `jepsen/src/jepsen/independent.clj` (296 LoC): expensive
+checkers (linearizability) need short histories, so a test of one register
+is lifted to a *map* of keys to registers (independent.clj:2-7). The
+generator side shards worker threads into per-key groups
+(independent.clj:65-219); the checker side partitions the history into
+per-key subhistories and checks each (independent.clj:246-296).
+
+The TPU twist: per-key subhistories are a *batch axis*. `checker` runs the
+device path by packing every key's subhistory into one stacked array set
+and vmapping the BFS frontier search over keys
+(:mod:`jepsen_tpu.lin.batched`) — thousands of independent searches in one
+device program — falling back to per-key host checking for models without
+kernels.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, NamedTuple
+
+from jepsen_tpu import checker as checker_ns
+from jepsen_tpu import generator as gen
+from jepsen_tpu.history import Op
+
+DIR = "independent"
+
+
+class KV(NamedTuple):
+    """A key-value tuple marking an op as belonging to an independent key
+    (independent.clj:20-28)."""
+
+    key: object
+    value: object
+
+
+def tuple_(k, v) -> KV:
+    return KV(k, v)
+
+
+def is_tuple(v) -> bool:
+    return isinstance(v, KV) or (isinstance(v, (list, tuple))
+                                 and len(v) == 2
+                                 and getattr(v, "_is_kv", False))
+
+
+def sequential_generator(keys: Iterable, fgen: Callable) -> gen.Generator:
+    """Work through keys one at a time: build (fgen k), drain it (wrapping
+    each op value in a [k v] tuple), move to the next key
+    (independent.clj:30-63)."""
+    it = iter(keys)
+    state: dict = {"key": None, "gen": None, "done": False}
+    lock = threading.Lock()
+
+    def advance():
+        try:
+            k = next(it)
+            state["key"], state["gen"] = k, fgen(k)
+        except StopIteration:
+            state["done"] = True
+
+    def go(test, process):
+        while True:
+            with lock:
+                if state["done"]:
+                    return None
+                if state["gen"] is None:
+                    advance()
+                    continue
+                k, g = state["key"], state["gen"]
+            o = gen.op(g, test, process)
+            if o is not None:
+                return o.replace(value=KV(k, o.value))
+            with lock:
+                if state["gen"] is g:
+                    advance()
+
+    return gen.gen(go)
+
+
+def concurrent_generator(n: int, keys: Iterable,
+                         fgen: Callable) -> gen.Generator:
+    """Run independent keys concurrently with n threads per key
+    (independent.clj:65-219): worker threads split into contiguous groups
+    of n; each group drives one key's generator (with the thread set
+    rebound so barriers work per-key); exhausted groups pull the next key.
+    Nemesis ops never enter subgenerators."""
+    if not (isinstance(n, int) and n > 0):
+        raise ValueError("threads-per-key must be a positive integer")
+    key_iter = iter(keys)
+    state: dict = {"init": False, "active": [], "group_threads": []}
+    lock = threading.Lock()
+
+    def next_key():
+        try:
+            k = next(key_iter)
+            return [k, fgen(k)]
+        except StopIteration:
+            return None
+
+    def initialize(test):
+        threads = [t for t in gen.current_threads() if isinstance(t, int)]
+        thread_count = len(threads)
+        if sorted(threads) != list(range(thread_count)):
+            raise AssertionError(
+                f"expected integer threads 0..{thread_count - 1}, "
+                f"got {threads}")
+        if test["concurrency"] != thread_count:
+            raise AssertionError(
+                f"Expected test concurrency ({test['concurrency']}) to be "
+                f"equal to number of integer threads ({thread_count})")
+        group_count = thread_count // n
+        if n > thread_count:
+            raise AssertionError(
+                f"With {thread_count} worker threads, this "
+                f"concurrent-generator cannot run a key with {n} threads "
+                f"concurrently. Consider raising your test's concurrency "
+                f"to at least {n}.")
+        if thread_count != n * group_count:
+            raise AssertionError(
+                f"This concurrent-generator has {thread_count} threads to "
+                f"work with, but can only use {n * group_count} of those "
+                f"threads to run {group_count} concurrent keys with {n} "
+                f"threads apiece. Consider raising or lowering the test's "
+                f"concurrency to a multiple of {n}.")
+        state["active"] = [next_key() for _ in range(group_count)]
+        state["group_threads"] = [
+            tuple(sorted(threads)[i * n:(i + 1) * n])
+            for i in range(group_count)]
+        state["init"] = True
+
+    def go(test, process):
+        with lock:
+            if not state["init"]:
+                initialize(test)
+        thread = gen.process_to_thread(test, process)
+        if not isinstance(thread, int):
+            raise AssertionError(
+                "Only worker threads with numeric ids can ask for "
+                f"operations from concurrent-generator, but we received a "
+                f"request from {thread!r}.")
+        group = thread // n
+        while True:
+            with lock:
+                pair = state["active"][group]
+            if pair is None:
+                return None
+            k, g = pair
+            with gen.with_threads(state["group_threads"][group]):
+                o = gen.op(g, test, process)
+            if o is not None:
+                return o.replace(value=KV(k, o.value))
+            with lock:
+                if state["active"][group] is pair:
+                    state["active"][group] = next_key()
+
+    return gen.gen(go)
+
+
+def history_keys(history) -> set:
+    """The set of independent keys in a history (independent.clj:221-231)."""
+    return {op.value.key for op in history if isinstance(op.value, KV)}
+
+
+def subhistory(k, history) -> list[Op]:
+    """Ops for key k (tuples unwrapped) plus every un-keyed op — nemesis
+    ops appear in every subhistory (independent.clj:233-244)."""
+    out = []
+    for op in history:
+        v = op.value
+        if not isinstance(v, KV):
+            out.append(op)
+        elif v.key == k:
+            out.append(op.replace(value=v.value))
+    return out
+
+
+def checker(inner: checker_ns.Checker,
+            batch_device: bool = True) -> checker_ns.Checker:
+    """Lift a checker over values to a checker over [k v] histories
+    (independent.clj:246-296): valid iff the inner checker is valid for
+    every key's subhistory. Results per key under "results"; invalid keys
+    under "failures".
+
+    When the inner checker is device linearizability and every subhistory
+    packs onto the device, all keys are checked in ONE vmapped search
+    (jepsen_tpu.lin.batched) instead of key-at-a-time.
+    """
+
+    def check(test, model, history, opts):
+        ks = sorted(history_keys(history), key=repr)
+        subs = {k: subhistory(k, history) for k in ks}
+        opts = opts or {}
+
+        results: dict = {}
+        batched = None
+        # The batched device search may only stand in for a checker that IS
+        # device linearizability — substituting it for an arbitrary lifted
+        # checker would silently skip that checker's semantics.
+        inner_is_lin = getattr(inner, "is_linearizable", False) and \
+            getattr(inner, "algorithm", None) in ("tpu", "competition")
+        if batch_device and inner_is_lin and model is not None:
+            from jepsen_tpu.lin import batched as batched_mod
+
+            batched = batched_mod.try_check_batch(model, subs)
+        if batched is not None:
+            results = batched
+        else:
+            for k in ks:
+                sub_opts = {**opts,
+                            "subdirectory": _subdir(opts, k),
+                            "history-key": k}
+                results[k] = checker_ns.check_safe(
+                    inner, test, model, subs[k], sub_opts)
+
+        _write_artifacts(test, opts, subs, results)
+        failures = [k for k in ks
+                    if results[k].get(checker_ns.VALID) is not True]
+        return {checker_ns.VALID:
+                checker_ns.merge_valid(
+                    [results[k].get(checker_ns.VALID) for k in ks])
+                if ks else True,
+                "results": results,
+                "failures": failures}
+
+    return checker_ns.FnChecker(check)
+
+
+def _subdir(opts, k):
+    sub = opts.get("subdirectory")
+    parts = [sub] if isinstance(sub, str) else list(sub or [])
+    return parts + [DIR, str(k)]
+
+
+def _write_artifacts(test, opts, subs, results):
+    """Per-key results + history files (independent.clj:274-282)."""
+    if not (isinstance(test, dict) and test.get("name")):
+        return
+    try:
+        import json
+
+        from jepsen_tpu import history as history_mod
+        from jepsen_tpu import store
+
+        for k, sub in subs.items():
+            subdir = _subdir(opts or {}, k)
+            rpath = store.path(test, *subdir, "results.json", make=True)
+            with open(rpath, "w") as fh:
+                json.dump(results.get(k), fh, default=repr, indent=2)
+            history_mod.write_history(
+                store.path(test, *subdir, "history.jsonl", make=True), sub)
+    except Exception:  # noqa: BLE001 - artifacts are best-effort
+        pass

@@ -62,12 +62,14 @@ def analysis(model, history, algorithm: str = "competition", **kw) -> dict:
 
 
 def _competition(packed: PackedHistory, **kw) -> dict:
-    """Race the device and host searches; first definite verdict wins
-    (knossos.competition/analysis semantics: both algorithms race, winner's
-    analysis is returned)."""
+    """Race the device and host searches; the first *definite* verdict wins
+    (knossos.competition/analysis semantics). A racer returning "unknown"
+    (e.g. no device kernel for this model) does not end the race — only
+    when both racers fail to decide is "unknown" returned."""
     from jepsen_tpu.lin import bfs, cpu
 
-    result: dict = {}
+    lock = threading.Lock()
+    state: dict = {"result": None, "finished": 0}
     done = threading.Event()
 
     def run(fn, name):
@@ -75,10 +77,16 @@ def _competition(packed: PackedHistory, **kw) -> dict:
             r = fn(packed, **kw)
         except Exception as e:  # noqa: BLE001 - loser may die, race decides
             r = {"valid?": "unknown", "error": f"{name}: {e!r}"}
-        if r.get("valid?") in (True, False) or not done.is_set():
-            if not result or r.get("valid?") in (True, False):
+        with lock:
+            state["finished"] += 1
+            if r.get("valid?") in (True, False):
                 if not done.is_set():
-                    result.update(r)
+                    state["result"] = r
+                    done.set()
+            else:
+                if state["result"] is None:
+                    state["result"] = r  # fallback if nobody decides
+                if state["finished"] == 2:
                     done.set()
 
     threads = [threading.Thread(target=run, args=(cpu.check_packed, "cpu"),
@@ -88,4 +96,5 @@ def _competition(packed: PackedHistory, **kw) -> dict:
     for t in threads:
         t.start()
     done.wait()
-    return dict(result)
+    with lock:
+        return dict(state["result"])

@@ -1,0 +1,212 @@
+"""Multi-chip frontier search: the BFS sharded over a device mesh.
+
+The distributed half of :mod:`jepsen_tpu.lin.bfs` — the capability the
+reference gets from a 32GB JVM heap on one control node
+(jepsen/project.clj:22-25), re-designed as SPMD over a
+``jax.sharding.Mesh``:
+
+- The frontier's capacity axis is sharded: each device owns
+  ``cap_local = cap/D`` configs in its HBM, so total frontier capacity
+  scales linearly with chip count.
+- Expansion (config x pending-op step kernels) is embarrassingly parallel
+  and stays local.
+- Dedup is the collective: candidate (bits, state) keys are
+  ``all_gather``-ed over the mesh axis (ICI within a slice), every device
+  runs the identical lexicographic sort + unique-mask + cumsum compaction,
+  and keeps the slice of the packed result it owns: a deterministic
+  balanced re-shard with no host round-trips. All control decisions
+  (fixpoint, death, overflow) derive from replicated reductions, so every
+  device takes the same `lax.while_loop` branches.
+
+The whole search — outer return-event loop included — is one
+``shard_map``-ped program: a single XLA computation per (R-bucket, W, cap)
+with collectives inlined where the dedup needs them.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from jepsen_tpu.lin.bfs import MAX_DEVICE_WINDOW, _pad_rows
+from jepsen_tpu.lin.prepare import PackedHistory
+
+
+def _global_dedup(bits, state, valid, cap_local, axis):
+    """All-gather candidates, globally sort-dedup, keep this device's
+    slice. Returns (bits[cap_local], state[cap_local,S], count_local,
+    total, overflow) — total/overflow are replicated."""
+    d = lax.axis_index(axis)
+    n_dev = lax.axis_size(axis)
+    s_width = state.shape[1]
+
+    bits_all = lax.all_gather(bits, axis, tiled=True)
+    state_all = lax.all_gather(state, axis, tiled=True)
+    valid_all = lax.all_gather(valid, axis, tiled=True)
+    n = bits_all.shape[0]
+
+    inv = (~valid_all).astype(jnp.uint32)
+    operands = (inv, bits_all) + tuple(state_all[:, k]
+                                       for k in range(s_width))
+    sorted_ops = lax.sort(operands, num_keys=len(operands))
+    inv_s, bits_s = sorted_ops[0], sorted_ops[1]
+    state_s = jnp.stack(sorted_ops[2:], axis=1)
+
+    prev_differs = (bits_s != jnp.roll(bits_s, 1)) | \
+        jnp.any(state_s != jnp.roll(state_s, 1, axis=0), axis=1)
+    first = jnp.arange(n) == 0
+    mask = (inv_s == 0) & (first | prev_differs)
+
+    total = jnp.sum(mask.astype(jnp.int32))
+    cap_global = cap_local * n_dev
+    overflow = total > cap_global
+
+    # Global packed position; this device keeps [d*cap_local, (d+1)*cap).
+    pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    lo = d * cap_local
+    mine = mask & (pos >= lo) & (pos < lo + cap_local)
+    idx = jnp.where(mine, pos - lo, n)
+
+    out_n = max(n, cap_local) + 1
+    out_bits = jnp.zeros(out_n, jnp.uint32).at[idx].set(bits_s)[:cap_local]
+    out_state = jnp.zeros((out_n, s_width), jnp.int32) \
+        .at[idx].set(state_s)[:cap_local]
+    count_local = jnp.clip(total - lo, 0, cap_local)
+    return out_bits, out_state, count_local, total, overflow
+
+
+@partial(jax.jit, static_argnames=("cap_local", "step_fn", "mesh",
+                                   "axis"))
+def _search_sharded(ret_slot, active, slot_f, slot_v, init_state, *,
+                    cap_local, step_fn, mesh, axis="d"):
+    """shard_map-ped full search. Frontier sharded over `axis`; row tables
+    replicated. Returns replicated (ok, dead_row, overflow, total)."""
+    R, W = active.shape
+    S = init_state.shape[0]
+
+    def shard_body(ret_slot, active, slot_f, slot_v, init_state):
+        d = lax.axis_index(axis)
+        slot_bit = (jnp.uint32(1) << jnp.arange(W, dtype=jnp.uint32))
+
+        bits0 = jnp.zeros(cap_local, jnp.uint32)
+        state0 = jnp.zeros((cap_local, S), jnp.int32).at[0].set(init_state)
+        # Only device 0 starts with the initial config.
+        count0 = jnp.where(d == 0, jnp.int32(1), jnp.int32(0))
+
+        step_cfg_slot = jax.vmap(
+            jax.vmap(step_fn, in_axes=(None, 0, 0)),
+            in_axes=(0, None, None))
+
+        def closure_cond(c):
+            _, _, _, total, prev, ovf = c
+            return (total != prev) & ~ovf
+
+        def row_body(carry):
+            r, bits, state, count, total, dead, ovf = carry
+            act = active[r]
+            f_row = slot_f[r]
+            v_row = slot_v[r]
+            s = ret_slot[r]
+
+            def closure_body(c):
+                bits, state, count, total, prev, ovf = c
+                cfg_valid = jnp.arange(cap_local) < count
+                ok, new_state = step_cfg_slot(state, f_row, v_row)
+                already = (bits[:, None] & slot_bit[None, :]) != 0
+                legal = ok & act[None, :] & ~already & cfg_valid[:, None]
+                new_bits = bits[:, None] | slot_bit[None, :]
+
+                cand_bits = jnp.concatenate([bits, new_bits.reshape(-1)])
+                cand_state = jnp.concatenate(
+                    [state, new_state.reshape(-1, S)], axis=0)
+                cand_valid = jnp.concatenate([cfg_valid, legal.reshape(-1)])
+
+                b2, s2, n2, tot2, o2 = _global_dedup(
+                    cand_bits, cand_state, cand_valid, cap_local, axis)
+                return (b2, s2, n2, tot2, total, ovf | o2)
+
+            init = (bits, state, count, total, jnp.int32(-1), ovf)
+            bits, state, count, total, _, ovf = lax.while_loop(
+                closure_cond, closure_body, init)
+
+            s_bit = jnp.uint32(1) << s.astype(jnp.uint32)
+            cfg_valid = jnp.arange(cap_local) < count
+            keep = cfg_valid & ((bits & s_bit) != 0)
+            bits = bits & ~s_bit
+            bits, state, count, total, o2 = _global_dedup(
+                bits, state, keep, cap_local, axis)
+            dead = total == 0
+            return (r + 1, bits, state, count, total, dead, ovf | o2)
+
+        def row_cond(carry):
+            r, _, _, _, _, dead, ovf = carry
+            return (r < R) & ~dead & ~ovf
+
+        r, bits, state, count, total, dead, ovf = lax.while_loop(
+            row_cond, row_body,
+            (jnp.int32(0), bits0, state0, count0, jnp.int32(1),
+             False, False))
+        return (~dead & ~ovf)[None], (r - 1)[None], ovf[None], total[None]
+
+    shard_map = jax.shard_map
+
+    # check_vma off: the carry deliberately mixes axis-varying values (the
+    # frontier shard, via axis_index) with replicated control scalars
+    # (total/dead/overflow from all_gather'ed reductions).
+    fn = shard_map(shard_body, mesh=mesh,
+                   in_specs=(P(), P(), P(), P(), P()),
+                   out_specs=(P(axis), P(axis), P(axis), P(axis)),
+                   check_vma=False)
+    ok, dead_row, ovf, total = fn(ret_slot, active, slot_f, slot_v,
+                                  init_state)
+    return ok[0], dead_row[0], ovf[0], total[0]
+
+
+DEFAULT_CAP_PER_DEVICE = (64, 1024, 16384)
+
+
+def check_packed(p: PackedHistory, mesh: Mesh | None = None,
+                 cap_schedule=DEFAULT_CAP_PER_DEVICE) -> dict:
+    """Decide linearizability with the frontier sharded over a mesh. With
+    no mesh, shards over all visible devices on axis 'd'."""
+    if p.kernel is None:
+        return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
+                "error": f"no device kernel for {type(p.model).__name__}"}
+    if p.window > MAX_DEVICE_WINDOW:
+        return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
+                "error": f"window {p.window} exceeds device bitset"}
+    if p.R == 0:
+        return {"valid?": True, "analyzer": "tpu-bfs-sharded"}
+
+    if mesh is None:
+        mesh = Mesh(np.array(jax.devices()), ("d",))
+    axis = mesh.axis_names[0]
+
+    ret_slot_h, active_h, slot_f_h, slot_v_h = _pad_rows(p)
+    args = (jnp.asarray(ret_slot_h), jnp.asarray(active_h),
+            jnp.asarray(slot_f_h), jnp.asarray(slot_v_h),
+            jnp.asarray(p.init_state))
+
+    for cap in cap_schedule:
+        ok, dead_row, overflow, total = _search_sharded(
+            *args, cap_local=cap, step_fn=p.kernel.step, mesh=mesh,
+            axis=axis)
+        if not bool(overflow):
+            break
+    if bool(overflow):
+        return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
+                "error": f"frontier exceeded {cap_schedule[-1]} per device"}
+    if bool(ok):
+        return {"valid?": True, "analyzer": "tpu-bfs-sharded",
+                "final-frontier-size": int(total)}
+    r = int(dead_row)
+    ret = p.ops[int(p.ret_op[r])]
+    return {"valid?": False, "analyzer": "tpu-bfs-sharded",
+            "op": {"process": ret.process, "f": ret.f, "value": ret.value,
+                   "index": ret.op_index, "ok": ret.ok},
+            "configs": [], "final-paths": []}

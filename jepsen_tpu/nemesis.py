@@ -174,22 +174,26 @@ def partition_majorities_ring() -> Nemesis:
 # --- composition ------------------------------------------------------------
 
 class Compose(Nemesis):
-    """Route ops to child nemeses by f (nemesis.clj:159-197). Keys are
-    either sets of fs (routed unchanged) or dicts mapping outer f -> inner f
-    (rewritten, so two partitioners can coexist under distinct op names)."""
+    """Route ops to child nemeses by f (nemesis.clj:159-197). Routers are
+    either collections of fs (routed unchanged) or dicts mapping outer
+    f -> inner f (rewritten, so two partitioners can coexist under
+    distinct op names). Accepts a dict or an iterable of (router, nemesis)
+    pairs — dict routers aren't hashable, so pairs are the general form."""
 
-    def __init__(self, nemeses: dict):
-        self.nemeses = dict(nemeses)
+    def __init__(self, nemeses):
+        self.nemeses = list(nemeses.items()) if isinstance(nemeses, dict) \
+            else list(nemeses)
 
     def setup(self, test):
-        self.nemeses = {fs: n.setup(test) or n
-                        for fs, n in self.nemeses.items()}
+        self.nemeses = [(fs, n.setup(test) or n) for fs, n in self.nemeses]
         return self
 
     def invoke(self, test, op):
-        for fs, nem in self.nemeses.items():
+        for fs, nem in self.nemeses:
             if isinstance(fs, dict):
                 inner = fs.get(op.f)
+            elif callable(fs) and not isinstance(fs, (set, frozenset)):
+                inner = fs(op.f)
             else:
                 inner = op.f if op.f in fs else None
             if inner is not None:
@@ -198,11 +202,11 @@ class Compose(Nemesis):
         raise ValueError(f"no nemesis can handle {op.f!r}")
 
     def teardown(self, test):
-        for nem in self.nemeses.values():
+        for _, nem in self.nemeses:
             nem.teardown(test)
 
 
-def compose(nemeses: dict) -> Nemesis:
+def compose(nemeses) -> Nemesis:
     return Compose(nemeses)
 
 
@@ -226,7 +230,7 @@ class ClockScrambler(Nemesis):
         import time as _time
 
         def scramble(t, node):
-            set_time(_time.time() + random.randint(-self.dt, self.dt))
+            set_time(_time.time() + random.uniform(-self.dt, self.dt))
 
         return op.replace(value=c.on_nodes(test, scramble))
 

@@ -1,0 +1,142 @@
+"""Clock fault nemesis: precise bump/strobe via native programs compiled on
+the DB nodes.
+
+Re-design of `jepsen/src/jepsen/nemesis/time.clj` (~125 LoC): uploads the
+C++ sources from ``native/`` and compiles them with the node's g++/gcc
+(time.clj:12-27 does exactly this with gcc), then drives clock resets,
+signed millisecond bumps, and strobe oscillations, plus randomized
+generators for each (time.clj:92-125).
+"""
+
+from __future__ import annotations
+
+import os.path
+import random
+
+from jepsen_tpu import control as c
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu.history import Op
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                          "native")
+REMOTE_DIR = "/opt/jepsen"
+
+
+def compile_tool(src_name: str, bin_name: str) -> None:
+    """Upload a C++ source and build it on the node (time.clj:12-27)."""
+    with c.su():
+        c.exec_("mkdir", "-p", REMOTE_DIR)
+    local = os.path.join(NATIVE_DIR, src_name)
+    remote_src = f"{REMOTE_DIR}/{src_name}"
+    c.upload(local, remote_src)
+    with c.su():
+        compiler = "g++"
+        try:
+            c.exec_("which", "g++")
+        except c.RemoteError:
+            compiler = "gcc"
+        c.exec_(compiler, "-O2", "-o", f"{REMOTE_DIR}/{bin_name}",
+                remote_src)
+
+
+def install() -> None:
+    """Build both clock tools on the bound node (time.clj:34-42)."""
+    compile_tool("bump_time.cc", "bump-time")
+    compile_tool("strobe_time.cc", "strobe-time")
+
+
+def reset_time() -> None:
+    """Resynchronize with NTP (time.clj:44-47)."""
+    with c.su():
+        c.exec_("ntpdate", "-p", "1", "-b", "pool.ntp.org", may_fail=True)
+
+
+def bump_time(delta_ms: float) -> None:
+    """Jump the bound node's clock by delta ms (time.clj:49-52)."""
+    with c.su():
+        c.exec_(f"{REMOTE_DIR}/bump-time", int(delta_ms))
+
+
+def strobe_time(delta_ms: float, period_ms: float, duration_s: float):
+    """Oscillate the clock by delta every period for duration
+    (time.clj:54-58)."""
+    with c.su():
+        c.exec_(f"{REMOTE_DIR}/strobe-time", int(delta_ms),
+                int(period_ms), int(duration_s))
+
+
+class ClockNemesis(nemesis_ns.Nemesis):
+    """Responds to :reset / :bump / :strobe ops (time.clj:60-90).
+
+    - ``{:f :reset,  :value [nodes...]}``
+    - ``{:f :bump,   :value {node: delta-ms}}``
+    - ``{:f :strobe, :value {node: {delta, period, duration}}}``
+    """
+
+    def setup(self, test):
+        c.on_nodes(test, lambda t, n: install())
+        # Stop ntp daemons so they don't fight the nemesis (time.clj:63-69).
+        def stop_ntp(t, n):
+            with c.su():
+                c.exec_("service", "ntp", "stop", may_fail=True)
+        c.on_nodes(test, stop_ntp)
+        return self
+
+    def invoke(self, test, op):
+        if op.f == "reset":
+            nodes = op.value or test["nodes"]
+            c.on_nodes(test, lambda t, n: reset_time(), nodes=nodes)
+            return op
+        if op.f == "bump":
+            plan = op.value
+            c.on_nodes(test, lambda t, n: bump_time(plan[n]),
+                       nodes=list(plan))
+            return op
+        if op.f == "strobe":
+            plan = op.value
+            c.on_nodes(
+                test,
+                lambda t, n: strobe_time(plan[n]["delta"],
+                                         plan[n]["period"],
+                                         plan[n]["duration"]),
+                nodes=list(plan))
+            return op
+        raise ValueError(f"clock nemesis can't handle {op.f!r}")
+
+    def teardown(self, test):
+        c.on_nodes(test, lambda t, n: reset_time())
+
+
+def clock_nemesis() -> ClockNemesis:
+    return ClockNemesis()
+
+
+# --- randomized generators (time.clj:92-125) --------------------------------
+
+def reset_gen(test, process):
+    return Op("info", "reset", None)
+
+
+def bump_gen(test, process):
+    nodes = test["nodes"]
+    k = random.randint(1, len(nodes))
+    targets = random.sample(nodes, k)
+    return Op("info", "bump",
+              {n: (random.random() - 0.5) * 2e5 for n in targets})
+
+
+def strobe_gen(test, process):
+    nodes = test["nodes"]
+    k = random.randint(1, len(nodes))
+    targets = random.sample(nodes, k)
+    return Op("info", "strobe",
+              {n: {"delta": random.randint(0, 2 ** 8) * 4,
+                   "period": random.randint(0, 2 ** 10) + 1,
+                   "duration": random.randint(0, 32)}
+               for n in targets})
+
+
+def clock_gen():
+    """Mix of reset/bump/strobe faults (time.clj:117-125)."""
+    return gen.mix([reset_gen, bump_gen, strobe_gen])

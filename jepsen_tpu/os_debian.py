@@ -1,0 +1,97 @@
+"""Debian OS provisioning.
+
+Re-design of `jepsen/src/jepsen/os/debian.clj` (167 LoC): apt package
+management with idempotent install (:77-95), repo management (:103-117),
+JDK install (:119-137), hostfile normalization and base packages in the OS
+setup (:139-167).
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import control as c
+from jepsen_tpu import os_ as os_ns
+
+BASE_PACKAGES = ["wget", "curl", "vim", "man-db", "faketime", "ntpdate",
+                 "unzip", "iptables", "psmisc", "tar", "bzip2",
+                 "iputils-ping", "iproute2", "rsyslog", "logrotate"]
+
+
+def installed(packages) -> set:
+    """Which of the given packages are installed? (debian.clj:38-48)"""
+    out = c.exec_("dpkg", "--get-selections", may_fail=True)
+    have = {line.split()[0].split(":")[0]
+            for line in out.splitlines()
+            if line.strip().endswith("install")}
+    return {p for p in packages if p in have}
+
+
+def uninstall(packages) -> None:
+    """Remove packages (debian.clj:56-64)."""
+    packages = list(packages)
+    if packages:
+        with c.su():
+            c.exec_("apt-get", "remove", "--purge", "-y", *packages)
+
+
+def update() -> None:
+    """apt-get update (debian.clj:66-69)."""
+    with c.su():
+        c.exec_("apt-get", "update")
+
+
+def upgrade() -> None:
+    """apt-get upgrade (debian.clj:71-75)."""
+    with c.su():
+        c.exec_("apt-get", "upgrade", "-y")
+
+
+def install(packages, force: bool = False) -> None:
+    """Install missing packages, idempotently (debian.clj:77-95)."""
+    packages = list(packages)
+    missing = packages if force else \
+        [p for p in packages if p not in installed(packages)]
+    if missing:
+        with c.su():
+            c.exec_("env", "DEBIAN_FRONTEND=noninteractive",
+                    "apt-get", "install", "-y", *missing)
+
+
+def add_repo(name: str, line: str, keyserver: str | None = None,
+             key: str | None = None) -> None:
+    """Add an apt repo + optional key (debian.clj:103-117)."""
+    with c.su():
+        c.exec_("tee", f"/etc/apt/sources.list.d/{name}.list", stdin=line)
+        if keyserver and key:
+            c.exec_("apt-key", "adv", "--keyserver", keyserver,
+                    "--recv", key)
+        c.exec_("apt-get", "update")
+
+
+def install_jdk(version: str = "17") -> None:
+    """Install a JDK (the reference pins jdk8 via backports,
+    debian.clj:119-137; modern debians carry openjdk directly)."""
+    install([f"openjdk-{version}-jdk-headless"])
+
+
+def setup_hostfile(test, node) -> None:
+    """Make the node refer to itself by its test name (debian.clj:145-155
+    equivalent): hostname + /etc/hosts entry."""
+    with c.su():
+        c.exec_("hostname", node, may_fail=True)
+        hosts = ["127.0.0.1 localhost", f"127.0.1.1 {node}"]
+        c.exec_("tee", "/etc/hosts", stdin="\n".join(hosts) + "\n")
+
+
+class DebianOS(os_ns.OS):
+    """Debian setup: hostfile, apt update, base packages
+    (debian.clj:139-167)."""
+
+    def setup(self, test, node):
+        setup_hostfile(test, node)
+        install(BASE_PACKAGES)
+
+    def teardown(self, test, node):
+        pass
+
+
+os = DebianOS()

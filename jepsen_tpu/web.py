@@ -1,0 +1,190 @@
+"""Results web browser.
+
+Re-design of `jepsen/src/jepsen/web.clj` (320 LoC): an http server over
+the ``store/`` directory — a home table of runs with valid?-colored rows
+(web.clj:116-128), a directory browser with text/image previews
+(web.clj:194-229), and zip downloads of whole runs (web.clj:250-271).
+Python's http.server replaces http-kit/ring/hiccup.
+"""
+
+from __future__ import annotations
+
+import html as _html
+import io
+import json
+import logging
+import os
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from urllib.parse import quote, unquote
+
+log = logging.getLogger("jepsen.web")
+
+VALID_COLORS = {True: "#ADF6B0", False: "#F6AEAD", "unknown": "#F3F6AD"}
+
+
+def _run_rows(base: Path) -> list[dict]:
+    """All runs, newest first, with their validity (web.clj:47-67
+    fast-tests reads each run's results)."""
+    rows = []
+    if not base.is_dir():
+        return rows
+    for name in sorted(os.listdir(base)):
+        d = base / name
+        if name == "latest" or not d.is_dir():
+            continue
+        for ts in sorted(os.listdir(d), reverse=True):
+            run = d / ts
+            if ts == "latest" or not run.is_dir():
+                continue
+            valid = None
+            results = run / "results.json"
+            if results.exists():
+                try:
+                    valid = json.loads(results.read_text()).get("valid?")
+                except (ValueError, OSError):
+                    valid = "unknown"
+            rows.append({"name": name, "ts": ts, "valid": valid,
+                         "path": f"{name}/{ts}"})
+    rows.sort(key=lambda r: r["ts"], reverse=True)
+    return rows
+
+
+def home_html(base: Path) -> str:
+    rows = []
+    for r in _run_rows(base):
+        color = VALID_COLORS.get(r["valid"], "#FFFFFF")
+        rows.append(
+            f'<tr style="background:{color}">'
+            f'<td><a href="/files/{quote(r["path"])}/">'
+            f'{_html.escape(r["name"])}</a></td>'
+            f'<td><a href="/files/{quote(r["path"])}/">'
+            f'{_html.escape(r["ts"])}</a></td>'
+            f'<td>{_html.escape(str(r["valid"]))}</td>'
+            f'<td><a href="/zip/{quote(r["path"])}">zip</a></td></tr>')
+    return ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            "<title>jepsen-tpu</title><style>"
+            "body{font-family:sans-serif} table{border-collapse:collapse}"
+            "td,th{padding:4px 12px;border:1px solid #ccc}"
+            "</style></head><body><h1>jepsen-tpu results</h1>"
+            "<table><tr><th>test</th><th>run</th><th>valid?</th>"
+            "<th>download</th></tr>" + "".join(rows) +
+            "</table></body></html>")
+
+
+def dir_html(base: Path, rel: str) -> str:
+    d = base / rel
+    entries = []
+    for name in sorted(os.listdir(d)):
+        p = d / name
+        href = f"/files/{quote(rel)}/{quote(name)}" + \
+            ("/" if p.is_dir() else "")
+        preview = ""
+        if p.suffix in (".png", ".svg", ".jpg"):
+            preview = (f'<br><a href="{href}">'
+                       f'<img src="{href}" style="max-width:600px"></a>')
+        entries.append(f'<li><a href="{href}">{_html.escape(name)}</a>'
+                       f"{preview}</li>")
+    return ("<!DOCTYPE html><html><head><meta charset='utf-8'></head>"
+            f"<body><h2>{_html.escape(rel)}</h2>"
+            '<p><a href="/">home</a></p><ul>' + "".join(entries) +
+            "</ul></body></html>")
+
+
+def zip_run(base: Path, rel: str) -> bytes:
+    """Zip a run directory in memory (web.clj:250-271 streams; runs are
+    small enough to buffer)."""
+    buf = io.BytesIO()
+    root = base / rel
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for dirpath, _dirs, files in os.walk(root):
+            for f in files:
+                full = Path(dirpath) / f
+                z.write(full, arcname=str(full.relative_to(base)))
+    return buf.getvalue()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    base: Path = Path("store")
+
+    def log_message(self, fmt, *args):  # route through logging
+        log.debug(fmt, *args)
+
+    def _send(self, code: int, body: bytes, ctype="text/html"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _safe_rel(self, rel: str) -> str | None:
+        """Reject path traversal out of the store dir (resolved-path
+        containment, not a string prefix — /srv/store-secrets must not
+        pass for base /srv/store)."""
+        target = (self.base / rel).resolve()
+        if not target.is_relative_to(self.base.resolve()):
+            return None
+        return rel
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        path = unquote(self.path)
+        try:
+            if path == "/" or path == "":
+                self._send(200, home_html(self.base).encode())
+            elif path.startswith("/zip/"):
+                rel = self._safe_rel(path[len("/zip/"):].strip("/"))
+                if rel is None:
+                    return self._send(403, b"forbidden")
+                data = zip_run(self.base, rel)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/zip")
+                self.send_header(
+                    "Content-Disposition",
+                    f'attachment; filename="{rel.replace("/", "_")}.zip"')
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            elif path.startswith("/files/"):
+                rel = self._safe_rel(path[len("/files/"):].strip("/"))
+                if rel is None:
+                    return self._send(403, b"forbidden")
+                target = self.base / rel
+                if target.is_dir():
+                    self._send(200, dir_html(self.base, rel).encode())
+                elif target.is_file():
+                    ctype = {"": "text/plain", ".txt": "text/plain",
+                             ".log": "text/plain", ".json": "application/json",
+                             ".jsonl": "text/plain", ".html": "text/html",
+                             ".png": "image/png", ".svg": "image/svg+xml",
+                             }.get(target.suffix, "application/octet-stream")
+                    self._send(200, target.read_bytes(), ctype)
+                else:
+                    self._send(404, b"not found")
+            else:
+                self._send(404, b"not found")
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001
+            log.warning("web error on %s: %s", path, e)
+            try:
+                self._send(500, str(e).encode())
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def make_server(host="0.0.0.0", port=8080, base="store") \
+        -> ThreadingHTTPServer:
+    handler = type("Handler", (_Handler,), {"base": Path(base)})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve(host="0.0.0.0", port=8080, base="store") -> None:
+    """Run the server until interrupted (web.clj:315-320)."""
+    srv = make_server(host, port, base)
+    log.info("serving %s on http://%s:%d/", base, host, port)
+    print(f"serving {base} on http://{host}:{port}/")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        srv.shutdown()

@@ -1,0 +1,50 @@
+// Jump the system wall clock by a signed delta in milliseconds.
+//
+// TPU-framework equivalent of the reference's clock-bump fault program
+// (jepsen resources/bump-time.c, uploaded and compiled on DB nodes by the
+// clock nemesis): reads the current CLOCK_REALTIME, adds the delta, and
+// sets it back, so a database under test experiences a step change in
+// wall-clock time. Requires CAP_SYS_TIME (run as root).
+//
+// Usage: bump-time <delta-ms>
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+
+int main(int argc, char **argv) {
+  if (argc != 2) {
+    std::fprintf(stderr, "usage: %s <delta-ms>\n", argv[0]);
+    return 2;
+  }
+  char *end = nullptr;
+  long long delta_ms = std::strtoll(argv[1], &end, 10);
+  if (end == argv[1] || *end != '\0') {
+    std::fprintf(stderr, "invalid delta: %s\n", argv[1]);
+    return 2;
+  }
+
+  timespec now{};
+  if (clock_gettime(CLOCK_REALTIME, &now) != 0) {
+    std::perror("clock_gettime");
+    return 1;
+  }
+
+  long long ns = now.tv_nsec + (delta_ms % 1000) * 1000000LL;
+  now.tv_sec += delta_ms / 1000 + ns / 1000000000LL;
+  now.tv_nsec = ns % 1000000000LL;
+  if (now.tv_nsec < 0) {
+    now.tv_nsec += 1000000000LL;
+    now.tv_sec -= 1;
+  }
+
+  if (clock_settime(CLOCK_REALTIME, &now) != 0) {
+    std::perror("clock_settime");
+    return 1;
+  }
+  std::printf("%lld.%09ld\n", static_cast<long long>(now.tv_sec),
+              now.tv_nsec);
+  return 0;
+}

@@ -1,0 +1,80 @@
+// Oscillate the system wall clock between "true" time and true+delta,
+// flipping every <period> ms for <duration> seconds.
+//
+// TPU-framework equivalent of the reference's clock-strobe fault program
+// (jepsen resources/strobe-time.c): true time is tracked against
+// CLOCK_MONOTONIC so our own writes to CLOCK_REALTIME don't compound — we
+// record the (realtime - monotonic) offset once at startup and reconstruct
+// true realtime from the monotonic clock on every flip. Requires
+// CAP_SYS_TIME (run as root).
+//
+// Usage: strobe-time <delta-ms> <period-ms> <duration-s>
+
+#include <cstdio>
+#include <cstdlib>
+#include <ctime>
+
+namespace {
+
+constexpr long long kBillion = 1000000000LL;
+
+long long to_ns(const timespec &t) {
+  return t.tv_sec * kBillion + t.tv_nsec;
+}
+
+timespec from_ns(long long ns) {
+  timespec t;
+  t.tv_sec = ns / kBillion;
+  t.tv_nsec = ns % kBillion;
+  if (t.tv_nsec < 0) {
+    t.tv_nsec += kBillion;
+    t.tv_sec -= 1;
+  }
+  return t;
+}
+
+long long now(clockid_t clock) {
+  timespec t{};
+  clock_gettime(clock, &t);
+  return to_ns(t);
+}
+
+void sleep_ms(long long ms) {
+  timespec t = from_ns(ms * 1000000LL);
+  nanosleep(&t, nullptr);
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    std::fprintf(stderr, "usage: %s <delta-ms> <period-ms> <duration-s>\n",
+                 argv[0]);
+    return 2;
+  }
+  const long long delta_ns = std::atoll(argv[1]) * 1000000LL;
+  const long long period_ms = std::atoll(argv[2]);
+  const long long duration_ns = std::atoll(argv[3]) * kBillion;
+
+  // True realtime = monotonic + base_offset, immune to our own writes.
+  const long long base_offset =
+      now(CLOCK_REALTIME) - now(CLOCK_MONOTONIC);
+  const long long t_end = now(CLOCK_MONOTONIC) + duration_ns;
+
+  bool skewed = false;
+  while (now(CLOCK_MONOTONIC) < t_end) {
+    skewed = !skewed;
+    long long true_rt = now(CLOCK_MONOTONIC) + base_offset;
+    timespec t = from_ns(true_rt + (skewed ? delta_ns : 0));
+    if (clock_settime(CLOCK_REALTIME, &t) != 0) {
+      std::perror("clock_settime");
+      return 1;
+    }
+    sleep_ms(period_ms);
+  }
+
+  // Restore true time.
+  timespec t = from_ns(now(CLOCK_MONOTONIC) + base_offset);
+  clock_settime(CLOCK_REALTIME, &t);
+  return 0;
+}

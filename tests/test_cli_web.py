@@ -1,0 +1,123 @@
+"""CLI contract tests (exit codes, concurrency parsing, full demo run —
+cli.clj:103-138) and web results-browser tests over a real HTTP socket."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import cli
+
+
+class TestConcurrency:
+    def test_plain(self):
+        assert cli.parse_concurrency("10", 5) == 10
+
+    def test_multiplier(self):
+        assert cli.parse_concurrency("3n", 5) == 15
+
+    def test_bare_n(self):
+        assert cli.parse_concurrency("n", 5) == 5
+
+    def test_garbage(self):
+        with pytest.raises(cli.UsageError):
+            cli.parse_concurrency("lots", 5)
+
+
+class TestCliDispatch:
+    def commands(self):
+        return [cli.single_test_cmd(cli._demo_test_fn),
+                cli.serve_cmd(), cli.analyze_cmd()]
+
+    def test_no_subcommand_is_usage_error(self):
+        assert cli.run(self.commands(), []) == cli.EXIT_USAGE
+
+    def test_unknown_flag_is_usage_error(self):
+        assert cli.run(self.commands(),
+                       ["test", "--frobnicate"]) == cli.EXIT_USAGE
+
+    def test_demo_run_and_analyze(self, tmp_path, monkeypatch):
+        store = str(tmp_path / "store")
+        code = cli.run(self.commands(),
+                       ["test", "--transport", "dummy",
+                        "--concurrency", "1n",
+                        "--time-limit", "2", "--store", store])
+        assert code == cli.EXIT_OK
+        # artifacts exist
+        runs = list((tmp_path / "store" / "demo-cas").iterdir())
+        run_dir = [d for d in runs if d.name != "latest"][0]
+        names = {p.name for p in run_dir.iterdir()}
+        assert {"history.jsonl", "results.json", "test.json",
+                "timeline.html", "latency-raw.png",
+                "rate.png"} <= names
+        # offline re-analysis of the saved history on the cpu engine
+        code = cli.run(self.commands(),
+                       ["analyze", "demo-cas", "--store", store,
+                        "--algorithm", "cpu"])
+        assert code == cli.EXIT_OK
+
+    def test_analyze_missing_test(self, tmp_path):
+        code = cli.run(self.commands(),
+                       ["analyze", "nope", "--store", str(tmp_path)])
+        assert code == cli.EXIT_ERROR
+
+
+class TestWeb:
+    @pytest.fixture()
+    def server(self, tmp_path):
+        from jepsen_tpu import web
+
+        run = tmp_path / "t" / "20260101T000000.000"
+        run.mkdir(parents=True)
+        (run / "results.json").write_text(json.dumps({"valid?": True}))
+        (run / "history.txt").write_text("0 invoke read None\n")
+        srv = web.make_server(host="127.0.0.1", port=0, base=str(tmp_path))
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        yield f"http://127.0.0.1:{srv.server_address[1]}"
+        srv.shutdown()
+
+    def get(self, url):
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.status, r.read()
+
+    def test_home_lists_runs(self, server):
+        status, body = self.get(server + "/")
+        assert status == 200
+        assert b"20260101T000000.000" in body
+        assert b"True" in body
+
+    def test_file_preview(self, server):
+        status, body = self.get(
+            server + "/files/t/20260101T000000.000/history.txt")
+        assert status == 200 and b"invoke" in body
+
+    def test_dir_listing(self, server):
+        status, body = self.get(server + "/files/t/20260101T000000.000/")
+        assert status == 200 and b"results.json" in body
+
+    def test_zip_download(self, server):
+        import io
+        import zipfile
+
+        status, body = self.get(server + "/zip/t/20260101T000000.000")
+        assert status == 200
+        z = zipfile.ZipFile(io.BytesIO(body))
+        assert any("results.json" in n for n in z.namelist())
+
+    def test_traversal_blocked(self, server):
+        import urllib.error
+
+        try:
+            status, _ = self.get(server + "/files/../../../etc/passwd")
+            assert status in (403, 404)
+        except urllib.error.HTTPError as e:
+            assert e.code in (403, 404)
+
+    def test_missing_file_404(self, server):
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self.get(server + "/files/t/nope.txt")
+        assert ei.value.code == 404

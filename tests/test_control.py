@@ -1,0 +1,163 @@
+"""Control plane tests: escaping, sudo/cd scoping, the local transport
+(real subprocesses — the analogue of the reference's control_test.clj
+whoami check over real SSH), clock-tool compilation, and store round-trip.
+"""
+
+import getpass
+import os
+import subprocess
+
+import pytest
+
+from jepsen_tpu import control as c
+from jepsen_tpu.control import util as cu
+
+
+class TestEscape:
+    def test_plain(self):
+        assert c.escape("ls") == "ls"
+
+    def test_spaces(self):
+        assert c.escape("hello world") == "'hello world'"
+
+    def test_quotes(self):
+        assert c.escape("it's") == '\'it\'"\'"\'s\''
+
+    def test_empty(self):
+        assert c.escape("") == "''"
+
+    def test_sequence_joins(self):
+        assert c.escape(["a", "b c"]) == "a 'b c'"
+
+    def test_lit_passthrough(self):
+        assert c.escape(c.Lit("a | b")) == "a | b"
+
+    def test_numbers(self):
+        assert c.build_cmd("sleep", 5) == "sleep 5"
+
+
+class TestDummyTransport:
+    def test_records_and_cans(self):
+        t = c.DummyTransport(results={"whoami": "root"})
+        sess = t.connect("n1", {})
+        with c.with_session(sess):
+            assert c.exec_("whoami") == "root"
+            assert c.exec_("other") == ""
+        assert t.log == [("n1", "whoami"), ("n1", "other")]
+
+    def test_no_session_raises(self):
+        with pytest.raises(c.RemoteError):
+            c.exec_("ls")
+
+
+class TestLocalTransport:
+    """Real command execution on localhost — control_test.clj:5-8 runs
+    `(c/on "n1" (c/exec :whoami))` over real SSH; the local transport is
+    the no-SSH equivalent surface."""
+
+    def session(self):
+        return c.LocalTransport().connect("local", {})
+
+    def test_whoami(self):
+        with c.with_session(self.session()):
+            assert c.exec_("whoami") == getpass.getuser()
+
+    def test_exit_code_raises(self):
+        with c.with_session(self.session()):
+            with pytest.raises(c.RemoteError) as ei:
+                c.exec_("false")
+            assert ei.value.exit_code == 1
+
+    def test_may_fail(self):
+        with c.with_session(self.session()):
+            assert c.exec_("false", may_fail=True) == ""
+
+    def test_cd_scope(self):
+        with c.with_session(self.session()):
+            with c.cd("/tmp"):
+                assert c.exec_("pwd") == "/tmp"
+            assert c.exec_("pwd") != "/tmp"
+
+    def test_stdin(self):
+        with c.with_session(self.session()):
+            out = c.exec_("cat", stdin="hello")
+            assert out == "hello"
+
+    def test_escaping_prevents_injection(self):
+        with c.with_session(self.session()):
+            out = c.exec_("echo", "$(rm -rf /tmp/nope); true")
+            assert "$(rm" in out  # not executed, printed verbatim
+
+    def test_upload_download(self, tmp_path):
+        src = tmp_path / "src.txt"
+        src.write_text("payload")
+        with c.with_session(self.session()):
+            c.upload(str(src), str(tmp_path / "up.txt"))
+            c.download(str(tmp_path / "up.txt"), str(tmp_path / "down.txt"))
+        assert (tmp_path / "down.txt").read_text() == "payload"
+
+    def test_control_util_tmpdir_and_exists(self):
+        with c.with_session(self.session()):
+            d = cu.tmp_dir()
+            try:
+                assert cu.exists(d)
+                assert not cu.exists(d + "/nope")
+            finally:
+                c.exec_("rm", "-rf", d)
+
+    def test_grepkill_noop_on_no_match(self):
+        with c.with_session(self.session()):
+            cu.grepkill("definitely-not-a-process-name-xyz")
+
+
+class TestOnNodes:
+    def test_parallel_fanout(self):
+        t = c.DummyTransport()
+        test = {"nodes": ["n1", "n2", "n3"], "transport": t}
+        out = c.on_nodes(test, lambda tst, node: c.exec_("hostname"))
+        assert set(out) == {"n1", "n2", "n3"}
+        assert len(t.log) == 3
+
+
+def test_native_clock_tools_compile(tmp_path):
+    """The C++ clock fault programs must compile with the node toolchain
+    (the clock nemesis compiles them remotely; here: local g++)."""
+    native = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native")
+    for src, name in (("bump_time.cc", "bump-time"),
+                      ("strobe_time.cc", "strobe-time")):
+        out = tmp_path / name
+        r = subprocess.run(["g++", "-O2", "-Wall", "-o", str(out),
+                            os.path.join(native, src)],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        # usage errors exit 2 without touching the clock
+        u = subprocess.run([str(out)], capture_output=True, text=True)
+        assert u.returncode == 2
+        assert "usage" in u.stderr
+
+
+def test_store_round_trip(tmp_path):
+    import datetime
+
+    from jepsen_tpu import store
+    from jepsen_tpu.history import invoke_op, ok_op
+
+    test = {"name": "rt", "store-base": str(tmp_path),
+            "start-time": datetime.datetime(2026, 7, 29, 12, 0, 0),
+            "nodes": ["n1"], "history":
+            [invoke_op(0, "read", None).replace(index=0, time=1),
+             ok_op(0, "read", 5).replace(index=1, time=2)],
+            "results": {"valid?": True},
+            "client": object()}  # nonserializable, must be dropped
+    store.save_1(test)
+    store.save_2(test)
+    runs = store.tests("rt", base=tmp_path)
+    assert len(runs) == 1
+    loaded = next(iter(runs.values()))()
+    assert loaded["results"]["valid?"] is True
+    assert len(loaded["history"]) == 2
+    assert loaded["history"][1].value == 5
+    assert "client" not in loaded
+    latest = tmp_path / "rt" / "latest"
+    assert latest.is_symlink()

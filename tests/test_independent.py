@@ -1,0 +1,192 @@
+"""Independent-keys tests, mirroring the reference's independent_test.clj
+(sequential/concurrent generator semantics incl. thread-math error
+messages, and the sharded checker) plus the batched device path."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import checker as c
+from jepsen_tpu import generator as g
+from jepsen_tpu import independent as ind
+from jepsen_tpu import models as m
+from jepsen_tpu.history import History, Op, invoke_op, ok_op
+
+TEST = {"concurrency": 4, "nodes": ["n1", "n2"]}
+
+
+class TestSequentialGenerator:
+    def test_wraps_values_and_advances(self):
+        source = ind.sequential_generator(
+            ["a", "b"], lambda k: g.limit(2, Op("invoke", "w", 1)))
+        with g.with_threads((0,)):
+            vals = []
+            while True:
+                o = g.op(source, TEST, 0)
+                if o is None:
+                    break
+                vals.append(o.value)
+        assert vals == [ind.KV("a", 1)] * 2 + [ind.KV("b", 1)] * 2
+
+    def test_empty_keys(self):
+        source = ind.sequential_generator([], lambda k: Op("invoke", "w", 1))
+        with g.with_threads((0,)):
+            assert g.op(source, TEST, 0) is None
+
+
+class TestConcurrentGenerator:
+    def drain(self, source, threads, test):
+        ops = []
+        lock = threading.Lock()
+
+        def worker(tid):
+            with g.with_threads(threads):
+                while True:
+                    o = g.op(source, test, tid)
+                    if o is None:
+                        return
+                    with lock:
+                        ops.append((tid, o))
+
+        ts = [threading.Thread(target=worker, args=(t,))
+              for t in threads if isinstance(t, int)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(20)
+        return ops
+
+    def test_groups_stick_to_keys(self):
+        source = ind.concurrent_generator(
+            2, ["a", "b"], lambda k: g.limit(4, Op("invoke", "w", k)))
+        ops = self.drain(source, (0, 1, 2, 3), TEST)
+        keys_by_thread = {}
+        for tid, o in ops:
+            keys_by_thread.setdefault(tid, set()).add(o.value.key)
+        # threads 0,1 form group 0; 2,3 group 1; each group one key
+        assert keys_by_thread.get(0, set()) | keys_by_thread.get(1, set()) \
+            != keys_by_thread.get(2, set()) | keys_by_thread.get(3, set())
+        assert len(ops) == 8
+
+    def test_concurrency_mismatch_error(self):
+        source = ind.concurrent_generator(
+            3, ["a"], lambda k: Op("invoke", "w", 1))
+        with g.with_threads((0, 1, 2, 3)):
+            with pytest.raises(AssertionError,
+                               match="multiple of 3"):
+                g.op(source, TEST, 0)
+
+    def test_too_few_threads_error(self):
+        source = ind.concurrent_generator(
+            9, ["a"], lambda k: Op("invoke", "w", 1))
+        test = {"concurrency": 4}
+        with g.with_threads((0, 1, 2, 3)):
+            with pytest.raises(AssertionError, match="at least 9"):
+                g.op(source, test, 0)
+
+    def test_nemesis_rejected(self):
+        source = ind.concurrent_generator(
+            2, ["a"], lambda k: Op("invoke", "w", 1))
+        with g.with_threads((0, 1, 2, 3)):
+            g.op(source, TEST, 0)  # initialize
+            with pytest.raises(AssertionError, match="numeric"):
+                g.op(source, TEST, "nemesis")
+
+
+class TestSubhistories:
+    def history(self):
+        return History.of(
+            invoke_op(0, "w", ind.KV("a", 1)),
+            invoke_op(1, "w", ind.KV("b", 2)),
+            Op("info", "start", None, "nemesis"),
+            ok_op(0, "w", ind.KV("a", 1)),
+            ok_op(1, "w", ind.KV("b", 2)))
+
+    def test_history_keys(self):
+        assert ind.history_keys(self.history()) == {"a", "b"}
+
+    def test_subhistory_unwraps_and_keeps_unkeyed(self):
+        sub = ind.subhistory("a", self.history())
+        assert [o.value for o in sub if o.process != "nemesis"] == [1, 1]
+        assert any(o.process == "nemesis" for o in sub)
+
+
+class TestIndependentChecker:
+    def kv_register_history(self, corrupt_key=None):
+        # like the reference generators, invocations carry (k, nil) tuples
+        h = []
+        for k in ("a", "b", "c"):
+            h += [invoke_op(0, "write", ind.KV(k, 7)),
+                  ok_op(0, "write", ind.KV(k, 7)),
+                  invoke_op(0, "read", ind.KV(k, None)),
+                  ok_op(0, "read",
+                        ind.KV(k, 8 if k == corrupt_key else 7))]
+        return History.of(*h)
+
+    def test_all_valid_device_batch(self):
+        ck = ind.checker(c.linearizable("tpu"))
+        r = ck.check(None, m.cas_register(), self.kv_register_history(), {})
+        assert r[c.VALID] is True
+        assert set(r["results"]) == {"a", "b", "c"}
+        assert all(v["analyzer"] == "tpu-bfs-batch"
+                   for v in r["results"].values())
+        assert r["failures"] == []
+
+    def test_invalid_key_flagged(self):
+        ck = ind.checker(c.linearizable("tpu"))
+        r = ck.check(None, m.cas_register(),
+                     self.kv_register_history(corrupt_key="b"), {})
+        assert r[c.VALID] is False
+        assert r["failures"] == ["b"]
+        assert r["results"]["b"]["valid?"] is False
+        assert r["results"]["a"]["valid?"] is True
+
+    def test_host_fallback_for_generic_model(self):
+        h = History.of(
+            invoke_op(0, "add", ind.KV("k", 1)),
+            ok_op(0, "add", ind.KV("k", 1)),
+            invoke_op(0, "read", ind.KV("k", [1])),
+            ok_op(0, "read", ind.KV("k", [1])))
+        ck = ind.checker(c.linearizable("cpu"))
+        r = ck.check(None, m.set_model(), h, {})
+        assert r[c.VALID] is True
+        assert r["results"]["k"]["analyzer"] == "cpu-generic"
+
+    def test_empty_history(self):
+        ck = ind.checker(c.linearizable("tpu"))
+        r = ck.check(None, m.cas_register(), [], {})
+        assert r[c.VALID] is True
+
+
+class TestAdya:
+    def test_g2_checker(self):
+        from jepsen_tpu import adya
+
+        ck = adya.g2_checker()
+        ok1 = [invoke_op(0, "insert", {"key": 1, "id": 0}),
+               ok_op(0, "insert", {"key": 1, "id": 0}),
+               invoke_op(1, "insert", {"key": 1, "id": 1}),
+               Op("fail", "insert", {"key": 1, "id": 1}, 1)]
+        assert ck.check(None, None, ok1, {})[c.VALID] is True
+        both = [invoke_op(0, "insert", {"key": 1, "id": 0}),
+                ok_op(0, "insert", {"key": 1, "id": 0}),
+                invoke_op(1, "insert", {"key": 1, "id": 1}),
+                ok_op(1, "insert", {"key": 1, "id": 1})]
+        r = ck.check(None, None, both, {})
+        assert r[c.VALID] is False
+
+    def test_g2_gen_pairs(self):
+        from jepsen_tpu import adya
+
+        source = adya.g2_gen(keys=iter(["k1", "k2"]))
+        with g.with_threads((0, 1)):
+            ops = []
+            while True:
+                o = g.op(source, TEST, len(ops) % 2)
+                if o is None:
+                    break
+                ops.append(o)
+        assert len(ops) == 4
+        assert {o.value.key for o in ops} == {"k1", "k2"}
+        ids = [(o.value.key, o.value.value["id"]) for o in ops]
+        assert len(set(ids)) == 4

@@ -1,0 +1,153 @@
+"""Nemesis grudge topology property tests, mirroring the reference's
+nemesis_test.clj:18-88 (bisect/complete-grudge/bridge/majorities-ring ring
+walk), plus partitioner/compose behavior over the dummy transport."""
+
+import pytest
+
+from jepsen_tpu import control as c
+from jepsen_tpu import nemesis as n
+from jepsen_tpu import net
+from jepsen_tpu import tests_support as ts
+from jepsen_tpu.history import Op
+from jepsen_tpu.util import majority
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+class TestGrudgeMath:
+    def test_bisect(self):
+        assert n.bisect([]) == ([], [])
+        assert n.bisect([1, 2, 3]) == ([1], [2, 3])
+        assert n.bisect([1, 2, 3, 4]) == ([1, 2], [3, 4])
+
+    def test_split_one(self):
+        loner, rest = n.split_one(NODES, loner="n3")
+        assert loner == ["n3"]
+        assert rest == ["n1", "n2", "n4", "n5"]
+
+    def test_complete_grudge(self):
+        g = n.complete_grudge(n.bisect(NODES))
+        assert g["n1"] == {"n3", "n4", "n5"}
+        assert g["n3"] == {"n1", "n2"}
+        # symmetric: a grudges b iff b grudges a
+        for a, enemies in g.items():
+            for b in enemies:
+                assert a in g[b]
+
+    def test_bridge(self):
+        g = n.bridge(NODES)
+        # bridge node (n3) snubs nobody and is snubbed by nobody
+        assert "n3" not in g
+        for a, enemies in g.items():
+            assert "n3" not in enemies
+        # halves can't talk: n1/n2 vs n4/n5
+        assert g["n1"] == {"n4", "n5"}
+        assert g["n5"] == {"n1", "n2"}
+
+    @pytest.mark.parametrize("size", [3, 5, 7, 9])
+    def test_majorities_ring_properties(self, size):
+        """nemesis_test.clj:39-48: one grudge entry per node; nobody snubs
+        themselves; every node sees (= doesn't snub) exactly a majority."""
+        nodes = [f"n{i}" for i in range(size)]
+        g = n.majorities_ring(nodes)
+        m = majority(size)
+        assert set(g) == set(nodes)
+        for node, snubbed in g.items():
+            assert node not in snubbed
+            assert len(snubbed) == size - m
+
+    def test_majorities_ring_five_node_palindrome(self):
+        """nemesis_test.clj:50-87: with 5 nodes every node talks to its two
+        ring neighbors symmetrically — walking the ring one way then back
+        yields a palindromic path covering all nodes."""
+        g = n.majorities_ring(NODES)
+        universe = set(g)
+        start = next(iter(g))
+        frm, node, returning, path = None, start, False, []
+        for _ in range(2 * len(NODES) + 2):
+            vis = universe - g[node]
+            assert len(vis) == 3
+            assert node in vis
+            if frm is not None and node == start:
+                if returning:
+                    path.append(node)
+                    break
+                frm, node, returning = node, frm, True
+                path.append(start)
+                continue
+            nxt = next(iter(vis - {node, frm}))
+            frm, node = node, nxt
+            path.append(frm)
+        assert set(path) == universe
+        assert path == path[::-1]
+        assert len(path) == 2 * len(universe) + 1
+
+
+class TestPartitioner:
+    def make_test(self):
+        transport = c.DummyTransport()
+        return ts.noop_test(transport=transport, net=net.iptables), transport
+
+    def test_start_stop(self):
+        test, transport = self.make_test()
+        nem = n.partition_halves().setup(test)
+        res = nem.invoke(test, Op("info", "start", None))
+        assert "Cut off" in res.value
+        drops = [cmd for _, cmd in transport.log if "-j DROP" in cmd]
+        # complete grudge over 2|3 split: 2*3*2 = 12 directed drops
+        assert len(drops) == 12
+        res = nem.invoke(test, Op("info", "stop", None))
+        assert res.value == "fully connected"
+        assert any("-F" in cmd for _, cmd in transport.log)
+
+    def test_unknown_f_raises(self):
+        test, _ = self.make_test()
+        with pytest.raises(ValueError):
+            n.partition_halves().invoke(test, Op("info", "frob", None))
+
+
+class TestCompose:
+    def test_routing_with_rewrite(self):
+        test = ts.noop_test(transport=c.DummyTransport(), net=net.noop)
+        seen = []
+
+        class Recorder(n.Nemesis):
+            def __init__(self, name):
+                self.name = name
+
+            def invoke(self, t, op):
+                seen.append((self.name, op.f))
+                return op
+
+        nem = n.compose([
+            (frozenset(["kill"]), Recorder("killer")),
+            ({"split-start": "start", "split-stop": "stop"},
+             Recorder("splitter")),
+        ]).setup(test)
+
+        out = nem.invoke(test, Op("info", "kill", None))
+        assert out.f == "kill" and seen[-1] == ("killer", "kill")
+        out = nem.invoke(test, Op("info", "split-start", None))
+        # inner nemesis saw the rewritten f; outer op keeps its name
+        assert seen[-1] == ("splitter", "start") and out.f == "split-start"
+        with pytest.raises(ValueError):
+            nem.invoke(test, Op("info", "mystery", None))
+
+
+class TestNodeStartStopper:
+    def test_lifecycle(self):
+        test = ts.noop_test(transport=c.DummyTransport())
+        events = []
+        nem = n.node_start_stopper(
+            lambda nodes: nodes[0],
+            lambda t, node: events.append(("start", node)) or "started",
+            lambda t, node: events.append(("stop", node)) or "stopped")
+        r = nem.invoke(test, Op("info", "stop", None))
+        assert r.value == "not-started"
+        r = nem.invoke(test, Op("info", "start", None))
+        assert r.value == {"n1": "started"}
+        r = nem.invoke(test, Op("info", "start", None))
+        assert "already disrupting" in r.value
+        r = nem.invoke(test, Op("info", "stop", None))
+        assert r.value == {"n1": "stopped"}
+        assert events == [("start", "n1"), ("stop", "n1")]

@@ -1,0 +1,90 @@
+"""Perf-math golden tests (ported from checker_test.clj:156-205), plus
+timeline/graph artifact generation on a synthetic 10k-op history."""
+
+import random
+
+from jepsen_tpu import checker as c
+from jepsen_tpu.checker import perf_graphs as perf
+from jepsen_tpu.checker import timeline
+from jepsen_tpu.history import Op, invoke_op, ok_op
+
+
+def test_bucket_points():
+    # checker_test.clj:156-171
+    got = perf.bucket_points(2, [(1, "a"), (7, "g"), (5, "e"), (2, "b"),
+                                 (3, "c"), (4, "d"), (6, "f")])
+    assert got == {1: [(1, "a")],
+                   3: [(2, "b"), (3, "c")],
+                   5: [(5, "e"), (4, "d")],
+                   7: [(7, "g"), (6, "f")]}
+
+
+def test_latencies_to_quantiles():
+    # checker_test.clj:173-186
+    pts = list(zip(range(11), [0, 10, 1, 1, 1, 20, 21, 22, 25, 25, 25]))
+    got = perf.latencies_to_quantiles(5, [0, 1], pts)
+    assert got == {0: [[2.5, 0], [7.5, 20], [12.5, 25]],
+                   1: [[2.5, 10], [7.5, 25], [12.5, 25]]}
+
+
+def _random_history(n=10000, seed=0):
+    # the shape of checker_test.clj:188-205's perf-test history
+    rng = random.Random(seed)
+    h = []
+    for _ in range(n // 2):
+        latency = 1e9 / (1 + rng.randrange(1000))
+        f = rng.choice(["write", "read"])
+        proc = rng.randrange(100)
+        t = 1e9 * rng.randrange(100)
+        typ = rng.choice(["ok"] * 5 + ["fail"] + ["info"] * 2)
+        h.append(Op("invoke", f, None, proc, time=int(t)))
+        h.append(Op(typ, f, None, proc, time=int(t + latency)))
+    h.append(Op("info", "start", None, "nemesis", time=int(10e9)))
+    h.append(Op("info", "stop", None, "nemesis", time=int(30e9)))
+    return h
+
+
+def test_perf_checker_writes_graphs(tmp_path):
+    test = {"name": "perf-test", "store-base": str(tmp_path),
+            "start-time": "t0"}
+    r = c.perf().check(test, None, _random_history(), {})
+    assert r[c.VALID] is True
+    d = tmp_path / "perf-test" / "t0"
+    assert (d / "latency-raw.png").stat().st_size > 1000
+    assert (d / "latency-quantiles.png").stat().st_size > 1000
+    assert (d / "rate.png").stat().st_size > 1000
+
+
+def test_rate_math():
+    h = [invoke_op(0, "read", None).replace(time=0),
+         ok_op(0, "read", 1).replace(time=int(1e9)),
+         invoke_op(0, "read", None).replace(time=int(2e9)),
+         ok_op(0, "read", 1).replace(time=int(3e9))]
+    r = perf.rate(10.0, h)
+    assert r[("read", "ok")] == [[5.0, 0.2]]
+
+
+def test_timeline_html(tmp_path):
+    test = {"name": "tl", "store-base": str(tmp_path),
+            "start-time": "t0", "concurrency": 2}
+    h = [invoke_op(0, "read", None).replace(time=0),
+         ok_op(0, "read", 5).replace(time=int(3e6)),
+         invoke_op(1, "write", 7).replace(time=int(1e6)),
+         # process 1's op never returns
+         ]
+    r = timeline.checker().check(test, None, h, {})
+    assert r[c.VALID] is True
+    doc = (tmp_path / "tl" / "t0" / "timeline.html").read_text()
+    assert "read" in doc and "write" in doc
+    assert "never returned" in doc
+    assert doc.count('class="op"') == 2
+
+
+def test_timeline_pairs():
+    h = [invoke_op(0, "read", None).replace(time=0),
+         invoke_op(1, "write", 1).replace(time=1),
+         ok_op(1, "write", 1).replace(time=2),
+         ok_op(0, "read", 9).replace(time=3)]
+    ps = timeline.pairs(h)
+    assert len(ps) == 2
+    assert ps[0][0].process == 0 and ps[0][1].value == 9

@@ -1,0 +1,125 @@
+"""Regression tests for reviewed failure modes: competition on kernel-less
+models, worker open failure, independent batch gating, CLI exit severity,
+client setup lifecycle."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import checker as c
+from jepsen_tpu import cli
+from jepsen_tpu import core
+from jepsen_tpu import generator as g
+from jepsen_tpu import independent as ind
+from jepsen_tpu import models as m
+from jepsen_tpu import tests_support as ts
+from jepsen_tpu.history import History, Op, invoke_op, ok_op
+from jepsen_tpu.lin import analysis
+
+
+def test_competition_decides_generic_models():
+    """The device racer instantly returns 'unknown' for models without a
+    kernel; competition must still wait for the host's definite verdict."""
+    h = History.of(invoke_op(0, "add", 1), ok_op(0, "add", 1),
+                   invoke_op(0, "read", [1]), ok_op(0, "read", [1]))
+    for _ in range(5):
+        r = analysis(m.set_model(), h, algorithm="competition")
+        assert r["valid?"] is True
+        assert r["analyzer"] == "cpu-generic"
+
+
+def test_competition_detects_violation_on_generic_model():
+    h = History.of(invoke_op(0, "add", 1), ok_op(0, "add", 1),
+                   invoke_op(0, "read", [2]), ok_op(0, "read", [2]))
+    r = analysis(m.set_model(), h, algorithm="competition")
+    assert r["valid?"] is False
+
+
+def test_failed_client_open_does_not_deadlock():
+    class BadOpenClient(ts.AtomClient):
+        opens = [0]
+
+        def open(self, test, node):
+            self.opens[0] += 1
+            if self.opens[0] == 2:  # second worker's open explodes
+                raise RuntimeError("connection refused")
+            return super().open(test, node)
+
+    test = ts.noop_test(
+        client=BadOpenClient(ts.AtomRegister()),
+        concurrency=3,
+        generator=g.clients(g.limit(10, g.cas(3))))
+    done = []
+
+    def run():
+        with pytest.raises(RuntimeError):
+            core.run(test)
+        done.append(True)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(20)
+    assert done, "run deadlocked on a failed client open"
+
+
+def test_independent_batch_only_replaces_linearizable():
+    """A lifted non-linearizable checker must actually run, not be swapped
+    for device linearizability verdicts."""
+    ran = []
+
+    def spy(test, model, history, opts):
+        ran.append(opts.get("history-key"))
+        return {c.VALID: False, "spy": True}
+
+    h = History.of(invoke_op(0, "write", ind.KV("k", 1)),
+                   ok_op(0, "write", ind.KV("k", 1)))
+    r = ind.checker(c.FnChecker(spy)).check(None, m.cas_register(), h, {})
+    assert ran == ["k"]
+    assert r[c.VALID] is False
+    assert r["results"]["k"].get("spy") is True
+
+
+def test_independent_batch_runs_for_linearizable():
+    h = History.of(invoke_op(0, "write", ind.KV("k", 1)),
+                   ok_op(0, "write", ind.KV("k", 1)))
+    r = ind.checker(c.linearizable("tpu")).check(
+        None, m.cas_register(), h, {})
+    assert r["results"]["k"]["analyzer"] == "tpu-bfs-batch"
+
+
+def test_cli_exit_severity_invalid_dominates_unknown():
+    calls = []
+
+    def test_fn(options):
+        calls.append(1)
+        verdict = False if len(calls) == 1 else "unknown"
+        return ts.noop_test(
+            client=ts.AtomClient(ts.AtomRegister()),
+            generator=g.clients(g.limit(2, g.cas(3))),
+            checker=c.FnChecker(
+                lambda t, mo, h, o, v=verdict: {c.VALID: v}))
+
+    cmd = cli.single_test_cmd(test_fn)
+    import argparse
+
+    p = argparse.ArgumentParser()
+    cmd["parser"](p)
+    opts = p.parse_args(["--transport", "dummy", "--test-count", "2"])
+    assert cmd["run"](opts) == cli.EXIT_INVALID
+
+
+def test_client_setup_teardown_called_once():
+    events = []
+
+    class LifecycleClient(ts.AtomClient):
+        def setup(self, test):
+            events.append("setup")
+
+        def teardown(self, test):
+            events.append("teardown")
+
+    test = ts.noop_test(
+        client=LifecycleClient(ts.AtomRegister()),
+        generator=g.clients(g.limit(6, g.cas(3))))
+    core.run(test)
+    assert events == ["setup", "teardown"]
