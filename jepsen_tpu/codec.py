@@ -1,0 +1,74 @@
+"""Serialization of client payloads to bytes.
+
+The analogue of `jepsen/src/jepsen/codec.clj` (29 LoC): the reference
+round-trips op values through EDN strings (`encode` :10-16, `decode`
+:18-29) so clients can ship arbitrary structures over DB wire protocols
+that only carry bytes/strings. Here the wire form is JSON with a small
+tagging scheme for the non-JSON types Jepsen values actually use (tuples,
+sets, bytes), chosen because every DB client library in the Python
+ecosystem can carry JSON strings.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any
+
+_TAG = "__jepsen__"
+
+
+def _encode_value(v: Any):
+    if isinstance(v, tuple):
+        return {_TAG: "tuple", "v": [_encode_value(x) for x in v]}
+    if isinstance(v, (set, frozenset)):
+        return {_TAG: "set", "v": sorted((_encode_value(x) for x in v),
+                                         key=repr)}
+    if isinstance(v, bytes):
+        return {_TAG: "bytes", "v": base64.b64encode(v).decode("ascii")}
+    if isinstance(v, dict):
+        if all(isinstance(k, str) for k in v) and _TAG not in v:
+            return {k: _encode_value(x) for k, x in v.items()}
+        # Non-string keys would be coerced by JSON; carry as pairs.
+        return {_TAG: "dict",
+                "v": [[_encode_value(k), _encode_value(x)]
+                      for k, x in v.items()]}
+    if isinstance(v, list):
+        return [_encode_value(x) for x in v]
+    return v
+
+
+def _decode_value(v: Any):
+    if isinstance(v, dict):
+        tag = v.get(_TAG)
+        if tag == "tuple":
+            return tuple(_decode_value(x) for x in v["v"])
+        if tag == "set":
+            return set(_decode_value(x) for x in v["v"])
+        if tag == "bytes":
+            return base64.b64decode(v["v"])
+        if tag == "dict":
+            return {_decode_value(k): _decode_value(x) for k, x in v["v"]}
+        return {k: _decode_value(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_decode_value(x) for x in v]
+    return v
+
+
+def encode(obj: Any) -> bytes:
+    """Serialize an object to bytes (codec.clj:10-16). ``None`` encodes to
+    the empty byte string, mirroring the reference's nil handling."""
+    if obj is None:
+        return b""
+    return json.dumps(_encode_value(obj),
+                      separators=(",", ":")).encode("utf-8")
+
+
+def decode(data: bytes | None) -> Any:
+    """Deserialize bytes produced by :func:`encode` (codec.clj:18-29).
+    Empty/None input decodes to ``None``."""
+    if not data:
+        return None
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    return _decode_value(json.loads(data.decode("utf-8")))

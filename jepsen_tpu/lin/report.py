@@ -1,0 +1,150 @@
+"""SVG rendering of linearizability counterexamples.
+
+The analogue of knossos.linear.report/render-analysis!, which the reference
+invokes when a history is non-linearizable to produce ``linear.svg``
+(`jepsen/src/jepsen/checker.clj:96-103`). Draws per-process swimlanes of the
+operations in the neighbourhood of the failure: one bar per op spanning
+invocation → completion, the inconsistent op highlighted, and the surviving
+configurations' model states printed beneath.
+
+Self-contained XML string assembly — no plotting dependency.
+"""
+
+from __future__ import annotations
+
+from xml.sax.saxutils import escape
+
+from jepsen_tpu.history import Op
+
+BAR_H = 22
+LANE_GAP = 10
+LEFT_MARGIN = 110
+TOP_MARGIN = 34
+PX_PER_COL = 46          # one column per history event in the window
+TYPE_FILL = {"ok": "#a8e6a1", "info": "#ffd9a8", "fail": "#f4a6a6"}
+BAD_FILL = "#ff5555"
+CONTEXT_OPS = 24         # ops on either side of the failure to draw
+
+
+def _op_label(f, value) -> str:
+    if value is None:
+        return str(f)
+    if isinstance(value, (list, tuple)):
+        return f"{f} {' '.join(str(v) for v in value)}"
+    return f"{f} {value}"
+
+
+def _window(history: list[Op], analysis: dict) -> list[tuple[Op, Op | None]]:
+    """Invoke/completion pairs near the failing op, in invocation order."""
+    pairs: list[tuple[Op, Op | None]] = []
+    pending: dict = {}
+    for op in history:
+        if op.process == "nemesis":
+            continue
+        if op.is_invoke:
+            pending[op.process] = len(pairs)
+            pairs.append((op, None))
+        elif op.process in pending:
+            i = pending.pop(op.process)
+            pairs[i] = (pairs[i][0], op)
+
+    bad = (analysis or {}).get("op") or {}
+    bad_index = bad.get("index")
+    center = len(pairs) - 1
+    if bad_index is not None:
+        for i, (inv, _) in enumerate(pairs):
+            if inv.index == bad_index:
+                center = i
+                break
+    lo = max(0, center - CONTEXT_OPS)
+    hi = min(len(pairs), center + CONTEXT_OPS + 1)
+    return pairs[lo:hi]
+
+
+def _event_columns(history: list[Op],
+                   pairs: list[tuple[Op, Op | None]]) \
+        -> tuple[dict[int, int], dict[int, float], int]:
+    """Column per invocation and completion, ordered by history position,
+    so concurrent ops visually overlap: a bar spans its invocation event's
+    column to its completion event's column."""
+    pos = {id(op): i for i, op in enumerate(history)}
+    events = []
+    for inv, comp in pairs:
+        events.append((pos.get(id(inv), 0), 0, id(inv)))
+        if comp is not None:
+            events.append((pos.get(id(comp), len(history)), 1, id(inv)))
+    events.sort()
+    inv_col: dict[int, int] = {}
+    comp_col: dict[int, float] = {}
+    for col, (_, kind, key) in enumerate(events):
+        if kind == 0:
+            inv_col[key] = col
+        else:
+            comp_col[key] = col + 0.8
+    return inv_col, comp_col, max(1, len(events))
+
+
+def render_analysis(history, analysis: dict, path) -> str:
+    """Write an SVG counterexample for an invalid analysis to ``path``;
+    returns the SVG text (knossos.linear.report/render-analysis! parity)."""
+    history = list(history)
+    pairs = _window(history, analysis)
+    bad = (analysis or {}).get("op") or {}
+
+    processes = []
+    for inv, _ in pairs:
+        if inv.process not in processes:
+            processes.append(inv.process)
+    lane_of = {p: i for i, p in enumerate(processes)}
+
+    inv_col, comp_col, n_cols = _event_columns(history, pairs)
+    width = LEFT_MARGIN + (n_cols + 1) * PX_PER_COL + 40
+    height = (TOP_MARGIN + len(processes) * (BAR_H + LANE_GAP)
+              + 30 + 16 * min(6, len((analysis or {}).get("configs", []))))
+
+    out = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+           f'height="{height}" font-family="sans-serif" font-size="11">',
+           f'<text x="8" y="16" font-size="13">Non-linearizable: '
+           f'{escape(_op_label(bad.get("f"), bad.get("value")))} by process '
+           f'{escape(str(bad.get("process")))}</text>']
+
+    for inv, comp in pairs:
+        lane = lane_of[inv.process]
+        y = TOP_MARGIN + lane * (BAR_H + LANE_GAP)
+        x0 = LEFT_MARGIN + inv_col[id(inv)] * PX_PER_COL
+        x1 = (LEFT_MARGIN + comp_col[id(inv)] * PX_PER_COL
+              if comp is not None
+              else LEFT_MARGIN + n_cols * PX_PER_COL)
+        ctype = comp.type if comp is not None else "info"
+        is_bad = (bad.get("index") is not None
+                  and inv.index == bad.get("index"))
+        fill = BAD_FILL if is_bad else TYPE_FILL.get(ctype, "#d0d0d0")
+        out.append(
+            f'<rect x="{x0:.0f}" y="{y}" width="{max(8, x1 - x0):.0f}" '
+            f'height="{BAR_H}" rx="3" fill="{fill}" stroke="#555"/>')
+        label = _op_label(inv.f, comp.value if comp is not None
+                          and inv.f == "read" else inv.value)
+        out.append(f'<text x="{x0 + 4:.0f}" y="{y + 15}">'
+                   f'{escape(label)}</text>')
+
+    for p, lane in lane_of.items():
+        y = TOP_MARGIN + lane * (BAR_H + LANE_GAP) + 15
+        out.append(f'<text x="8" y="{y}">process {escape(str(p))}</text>')
+
+    y = TOP_MARGIN + len(processes) * (BAR_H + LANE_GAP) + 16
+    for cfg in (analysis or {}).get("configs", [])[:6]:
+        model = cfg.get("model") if isinstance(cfg, dict) else cfg
+        pend = cfg.get("pending", []) if isinstance(cfg, dict) else []
+        pend_s = ", ".join(_op_label(o.get("f"), o.get("value"))
+                           for o in pend if isinstance(o, dict))
+        out.append(f'<text x="8" y="{y}" fill="#333">config: model='
+                   f'{escape(repr(model))}'
+                   f'{escape(" pending=[" + pend_s + "]" if pend_s else "")}'
+                   f'</text>')
+        y += 16
+
+    out.append("</svg>")
+    svg = "\n".join(out)
+    with open(path, "w") as fh:
+        fh.write(svg)
+    return svg

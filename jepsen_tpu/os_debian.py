@@ -48,8 +48,8 @@ def upgrade() -> None:
 def install(packages, force: bool = False) -> None:
     """Install missing packages, idempotently (debian.clj:77-95)."""
     packages = list(packages)
-    missing = packages if force else \
-        [p for p in packages if p not in installed(packages)]
+    have = set() if force else installed(packages)
+    missing = [p for p in packages if p not in have]
     if missing:
         with c.su():
             c.exec_("env", "DEBIAN_FRONTEND=noninteractive",

@@ -1,0 +1,71 @@
+"""SmartOS provisioning.
+
+The analogue of `jepsen/src/jepsen/os/smartos.clj` (132 LoC): pkgin-based
+package management mirroring the debian module's shape, used by the
+reference's mongodb-smartos suite. SmartOS ships ipfilter instead of
+iptables, so suites on this OS pair it with
+:class:`jepsen_tpu.net.IpfilterNet` (net.clj:77-109).
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import control as c
+from jepsen_tpu import os_ as os_ns
+
+BASE_PACKAGES = ["curl", "wget", "gnu-tar", "unzip", "psmisc"]
+
+
+def installed(packages) -> set:
+    """Which of the given packages are installed? (pkgin list)"""
+    out = c.exec_("pkgin", "list", may_fail=True)
+    have = set()
+    for line in out.splitlines():
+        name = line.split()[0] if line.split() else ""
+        # pkgin prints name-version; strip the trailing version component.
+        have.add(name.rsplit("-", 1)[0])
+    return {p for p in packages if p in have}
+
+
+def update() -> None:
+    """Refresh the pkgin catalogue (smartos.clj pkgin update)."""
+    with c.su():
+        c.exec_("pkgin", "-y", "update")
+
+
+def install(packages, force: bool = False) -> None:
+    """Install missing packages idempotently via pkgin."""
+    packages = list(packages)
+    have = set() if force else installed(packages)
+    missing = [p for p in packages if p not in have]
+    if missing:
+        with c.su():
+            c.exec_("pkgin", "-y", "install", *missing)
+
+
+def uninstall(packages) -> None:
+    packages = list(packages)
+    if packages:
+        with c.su():
+            c.exec_("pkgin", "-y", "remove", *packages)
+
+
+def setup_hostfile(test, node) -> None:
+    """Make the node refer to itself by its test name."""
+    with c.su():
+        c.exec_("hostname", node, may_fail=True)
+        hosts = ["127.0.0.1 localhost", f"127.0.0.1 {node}"]
+        c.exec_("tee", "/etc/hosts", stdin="\n".join(hosts) + "\n")
+
+
+class SmartOS(os_ns.OS):
+    """SmartOS setup: hostfile + base packages (smartos.clj os reify)."""
+
+    def setup(self, test, node):
+        setup_hostfile(test, node)
+        install(BASE_PACKAGES)
+
+    def teardown(self, test, node):
+        pass
+
+
+os = SmartOS()

@@ -1,0 +1,36 @@
+"""Interactive-analysis conveniences.
+
+The analogue of `jepsen/src/jepsen/repl.clj` (13 LoC): ``last_test``
+loads the most recent run from the store (repl.clj:6-13) so a recorded
+history can be re-checked interactively — e.g. rerun the device
+linearizability search with a different model or algorithm.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import store
+
+
+def last_test(base=store.BASE) -> dict | None:
+    """Load the most recently-run test from the store (repl.clj:6-13)."""
+    newest = None
+    for name, runs in store.all_tests(base=base).items():
+        for ts, loader in runs.items():
+            if newest is None or ts > newest[0]:
+                newest = (ts, loader)
+    return newest[1]() if newest else None
+
+
+def recheck(test: dict, model=None, algorithm: str = "tpu") -> dict:
+    """Re-run the linearizability analysis on a loaded test's history —
+    the record-once / re-check-on-device seam (SURVEY.md §5).
+
+    ``model`` must be supplied for store-loaded tests: models are runtime
+    objects the store never persists (store.serializable_test)."""
+    from jepsen_tpu import lin
+
+    model = model or test.get("model")
+    if model is None:
+        raise ValueError(
+            "no model: store-loaded tests don't carry one; pass model=")
+    return lin.analysis(model, test["history"], algorithm=algorithm)

@@ -1,0 +1,44 @@
+"""Redirect analysis output to a report file.
+
+The analogue of `jepsen/src/jepsen/report.clj` (16 LoC): ``to`` is a
+context manager that tees stdout to a file in the test's store directory
+(report.clj:7-16), so ad-hoc analysis printed at the REPL lands next to
+the run's other artifacts.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import sys
+from pathlib import Path
+
+
+class _Tee(io.TextIOBase):
+    def __init__(self, *streams):
+        self.streams = streams
+
+    def write(self, s):
+        for st in self.streams:
+            st.write(s)
+        return len(s)
+
+    def flush(self):
+        for st in self.streams:
+            st.flush()
+
+
+@contextlib.contextmanager
+def to(path, echo: bool = True):
+    """Within the block, stdout is copied to ``path`` (report.clj:7-16).
+    With ``echo=False`` output goes only to the file."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w") as fh:
+        tee = _Tee(fh, sys.stdout) if echo else fh
+        old = sys.stdout
+        sys.stdout = tee
+        try:
+            yield path
+        finally:
+            sys.stdout = old

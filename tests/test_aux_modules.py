@@ -1,0 +1,148 @@
+"""Tests for the auxiliary parity modules: codec, report, repl,
+lin.report (SVG counterexamples), os_smartos."""
+
+from __future__ import annotations
+
+import jepsen_tpu.history as h
+from jepsen_tpu import codec, models, report, repl, store
+from jepsen_tpu.lin import analysis
+from jepsen_tpu.lin import report as lin_report
+
+
+class TestCodec:
+    def test_roundtrip_scalars(self):
+        for v in (None, 0, 1, -5, 1.5, "x", True, False, [1, 2], {"a": 1}):
+            assert codec.decode(codec.encode(v)) == v
+
+    def test_roundtrip_tagged(self):
+        for v in ((1, 2), {3, 1, 2}, b"\x00\xffbytes",
+                  {"k": (1, {"nested": {2, 3}})}):
+            assert codec.decode(codec.encode(v)) == v
+
+    def test_none_is_empty(self):
+        assert codec.encode(None) == b""
+        assert codec.decode(b"") is None
+        assert codec.decode(None) is None
+
+    def test_accepts_str(self):
+        assert codec.decode(codec.encode([1]).decode()) == [1]
+
+    def test_non_string_dict_keys(self):
+        for v in ({1: "a"}, {1: "a", "b": 2}, {(1, 2): {3}}):
+            assert codec.decode(codec.encode(v)) == v
+
+
+class TestReport:
+    def test_tee_to_file(self, tmp_path, capsys):
+        p = tmp_path / "sub" / "report.txt"
+        with report.to(p):
+            print("hello analysis")
+        assert p.read_text() == "hello analysis\n"
+        assert "hello analysis" in capsys.readouterr().out
+
+    def test_no_echo(self, tmp_path, capsys):
+        p = tmp_path / "quiet.txt"
+        with report.to(p, echo=False):
+            print("silent")
+        assert p.read_text() == "silent\n"
+        assert capsys.readouterr().out == ""
+
+
+def _bad_history():
+    """write 1 acknowledged, then a read of 2: non-linearizable."""
+    ops = [h.invoke_op(0, "write", 1), h.ok_op(0, "write", 1),
+           h.invoke_op(1, "read", None), h.ok_op(1, "read", 2)]
+    return h.index(ops)
+
+
+class TestLinReportSvg:
+    def test_render_invalid(self, tmp_path):
+        hist = _bad_history()
+        a = analysis(models.cas_register(), hist, algorithm="cpu")
+        assert a["valid?"] is False
+        path = tmp_path / "linear.svg"
+        svg = lin_report.render_analysis(hist, a, path)
+        text = path.read_text()
+        assert text == svg
+        assert text.startswith("<svg")
+        assert "Non-linearizable" in text
+        assert "read 2" in text
+        assert "process 0" in text and "process 1" in text
+
+    def test_concurrent_ops_overlap(self, tmp_path):
+        """Bars of genuinely concurrent ops share columns — the overlap is
+        the point of the counterexample rendering."""
+        import re
+
+        hist = h.index([h.invoke_op(0, "write", 1),
+                        h.invoke_op(1, "read", None),
+                        h.ok_op(0, "write", 1),
+                        h.ok_op(1, "read", 2)])
+        a = analysis(models.cas_register(), hist, algorithm="cpu")
+        svg = lin_report.render_analysis(hist, a, tmp_path / "l.svg")
+        rects = [(float(m.group(1)), float(m.group(2)))
+                 for m in re.finditer(
+                     r'<rect x="(\d+)" y="\d+" width="(\d+)"', svg)]
+        assert len(rects) == 2
+        (x0, w0), (x1, w1) = sorted(rects)
+        assert x0 + w0 > x1, "concurrent bars should overlap horizontally"
+
+    def test_render_handles_empty_analysis(self, tmp_path):
+        hist = _bad_history()
+        path = tmp_path / "linear.svg"
+        svg = lin_report.render_analysis(hist, {}, path)
+        assert svg.startswith("<svg")
+
+    def test_checker_writes_svg(self, tmp_path):
+        """checker.linearizable renders linear.svg on invalid histories
+        (checker.clj:96-103)."""
+        from jepsen_tpu import checker as ck
+
+        test = {"name": "svg-test", "store-base": str(tmp_path),
+                "start-time": __import__("datetime").datetime(2026, 1, 1)}
+        r = ck.check_safe(ck.linearizable("cpu"), test,
+                          models.cas_register(), _bad_history())
+        assert r["valid?"] is False
+        svgs = list(tmp_path.rglob("linear.svg"))
+        assert len(svgs) == 1
+        assert "Non-linearizable" in svgs[0].read_text()
+
+
+class TestRepl:
+    def test_last_test_empty(self, tmp_path):
+        assert repl.last_test(base=tmp_path) is None
+
+    def test_last_test_roundtrip(self, tmp_path):
+        import datetime
+
+        test = {"name": "repl-test", "store-base": str(tmp_path),
+                "start-time": datetime.datetime(2026, 1, 2),
+                "history": _bad_history()}
+        store.save_1(test)
+        loaded = repl.last_test(base=tmp_path)
+        assert loaded is not None
+        assert len(loaded["history"]) == 4
+        r = repl.recheck(loaded, model=models.cas_register(),
+                         algorithm="cpu")
+        assert r["valid?"] is False
+
+    def test_recheck_requires_model(self, tmp_path):
+        import pytest
+
+        with pytest.raises(ValueError, match="model"):
+            repl.recheck({"history": []})
+
+
+class TestSmartOS:
+    def test_setup_commands(self):
+        """SmartOS setup drives pkgin over the dummy transport."""
+        from jepsen_tpu import control, os_smartos
+
+        test = {"transport": "dummy", "nodes": ["n1"]}
+        sess = control.session(test, "n1")
+        with control.with_session(sess):
+            os_smartos.os.setup(test, "n1")
+        cmds = [cmd for _, cmd in sess.log]
+        assert any("pkgin" in c and "install" in c for c in cmds)
+        assert any("hostname" in c for c in cmds)
+        os_smartos.os.teardown(test, "n1")
