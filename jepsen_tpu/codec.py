@@ -21,7 +21,12 @@ _TAG = "__jepsen__"
 def _encode_value(v: Any):
     if isinstance(v, tuple):
         return {_TAG: "tuple", "v": [_encode_value(x) for x in v]}
-    if isinstance(v, (set, frozenset)):
+    if isinstance(v, frozenset):
+        # Distinct tag: a frozenset may sit inside another hashable
+        # container (set element, dict key) where a mutable set can't.
+        return {_TAG: "fset", "v": sorted((_encode_value(x) for x in v),
+                                          key=repr)}
+    if isinstance(v, set):
         return {_TAG: "set", "v": sorted((_encode_value(x) for x in v),
                                          key=repr)}
     if isinstance(v, bytes):
@@ -45,6 +50,8 @@ def _decode_value(v: Any):
             return tuple(_decode_value(x) for x in v["v"])
         if tag == "set":
             return set(_decode_value(x) for x in v["v"])
+        if tag == "fset":
+            return frozenset(_decode_value(x) for x in v["v"])
         if tag == "bytes":
             return base64.b64decode(v["v"])
         if tag == "dict":

@@ -71,10 +71,11 @@ def _competition(packed: PackedHistory, **kw) -> dict:
     lock = threading.Lock()
     state: dict = {"result": None, "finished": 0}
     done = threading.Event()
+    cancel = threading.Event()
 
     def run(fn, name):
         try:
-            r = fn(packed, **kw)
+            r = fn(packed, cancel=cancel, **kw)
         except Exception as e:  # noqa: BLE001 - loser may die, race decides
             r = {"valid?": "unknown", "error": f"{name}: {e!r}"}
         with lock:
@@ -84,17 +85,21 @@ def _competition(packed: PackedHistory, **kw) -> dict:
                     state["result"] = r
                     done.set()
             else:
-                if state["result"] is None:
+                if state["result"] is None and \
+                        r.get("error") != "cancelled":
                     state["result"] = r  # fallback if nobody decides
                 if state["finished"] == 2:
                     done.set()
 
-    threads = [threading.Thread(target=run, args=(cpu.check_packed, "cpu"),
-                                daemon=True),
-               threading.Thread(target=run, args=(bfs.check_packed, "tpu"),
-                                daemon=True)]
+    threads = [threading.Thread(target=run, args=(cpu.check_packed, "cpu")),
+               threading.Thread(target=run, args=(bfs.check_packed, "tpu"))]
     for t in threads:
         t.start()
     done.wait()
+    # Stop the loser (it checks `cancel` between rows/chunks) and join it —
+    # an abandoned thread still inside XLA aborts the process at exit.
+    cancel.set()
+    for t in threads:
+        t.join()
     with lock:
         return dict(state["result"])

@@ -40,17 +40,53 @@ from jax import lax
 
 from jepsen_tpu.lin.prepare import PackedHistory
 
-DEFAULT_CAP_SCHEDULE = (64, 1024, 16384)
+DEFAULT_CAP_SCHEDULE = (256, 2048, 16384, 131072)
 MAX_DEVICE_WINDOW = 32
+CHUNK = 512
 
 
-def _dedup(bits, state, valid, cap):
+def _dedup(bits, state, valid, cap, state_bits=None, nil_id=None):
     """Sort-dedup-compact. Returns (bits[cap], state[cap,S], count, overflow).
 
     Invalid rows sort last; duplicates are adjacent after the lexicographic
     sort and masked; survivors are scatter-compacted to the front.
+
+    When ``state_bits`` is set (single-word state whose values fit in that
+    many bits next to the W-bit bitset), the whole config packs into ONE
+    uint32 sort key — invalid flag in bit 31 — so the sort is a single
+    payload-free u32 sort instead of a multi-key lexicographic one. This is
+    the hot op of the whole search; on TPU the single-key sort is several
+    times faster.
     """
     n = bits.shape[0]
+    if state_bits is not None:
+        from jepsen_tpu.models.kernels import NIL
+
+        b = state_bits
+        sv = state[:, 0]
+        packed_state = jnp.where(sv == NIL, nil_id, sv).astype(jnp.uint32)
+        key = ((bits << b) | packed_state) \
+            | ((~valid).astype(jnp.uint32) << 31)
+        key_s = lax.sort(key)
+        inv_s = key_s >> 31
+        cfg_s = key_s & jnp.uint32(0x7FFFFFFF)
+
+        prev_differs = cfg_s != jnp.roll(cfg_s, 1)
+        first = jnp.arange(n) == 0
+        mask = (inv_s == 0) & (first | prev_differs)
+
+        total = jnp.sum(mask.astype(jnp.int32))
+        overflow = total > cap
+        pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+        idx = jnp.where(mask & (pos < cap), pos, n)
+
+        out_n = max(n, cap) + 1
+        out_cfg = jnp.zeros(out_n, jnp.uint32).at[idx].set(cfg_s)[:cap]
+        out_bits = out_cfg >> b
+        sv_out = (out_cfg & jnp.uint32((1 << b) - 1)).astype(jnp.int32)
+        out_state = jnp.where(sv_out == nil_id, NIL, sv_out)[:, None]
+        count = jnp.minimum(total, cap)
+        return out_bits, out_state, count, overflow
     s_width = state.shape[1]
     inv = (~valid).astype(jnp.uint32)
     operands = (inv, bits) + tuple(state[:, k] for k in range(s_width))
@@ -151,6 +187,91 @@ def _search(ret_slot, active, slot_f, slot_v, init_state, *, cap, step_fn):
     return ~dead & ~ovf, r - 1, ovf, count
 
 
+@partial(jax.jit, static_argnames=("cap", "step_fn", "state_bits",
+                                   "nil_id"))
+def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v,
+                  bits, state, count, *, cap, step_fn,
+                  state_bits=None, nil_id=None):
+    """Process up to n_rows return events (tables are CHUNK-row static
+    shapes; rows past n_rows are ignored) starting from a carried frontier.
+
+    The chunk is the unit of device dispatch: every chunk of every history
+    reuses the same compiled program per (cap, step_fn), each program runs
+    for bounded time (no watchdog kills on 100k-row histories), and a
+    transient frontier spike re-runs one chunk at a bigger cap instead of
+    the whole search.
+
+    Returns (bits[cap], state[cap,S], count, rows_done, dead, overflow).
+    """
+    C, W = active.shape
+    S = state.shape[1]
+
+    step_cfg_slot = jax.vmap(
+        jax.vmap(step_fn, in_axes=(None, 0, 0)),
+        in_axes=(0, None, None))
+    slot_bit = (jnp.uint32(1) << jnp.arange(W, dtype=jnp.uint32))
+
+    def closure_cond(c):
+        _, _, count, prev, ovf = c
+        return (count != prev) & ~ovf
+
+    def row_body(carry):
+        r, bits, state, count, dead, ovf = carry
+        act = active[r]
+        f_row = slot_f[r]
+        v_row = slot_v[r]
+        s = ret_slot[r]
+
+        def closure_body(c):
+            bits, state, count, prev, ovf = c
+            cfg_valid = jnp.arange(cap) < count
+            ok, new_state = step_cfg_slot(state, f_row, v_row)
+            already = (bits[:, None] & slot_bit[None, :]) != 0
+            legal = ok & act[None, :] & ~already & cfg_valid[:, None]
+            new_bits = bits[:, None] | slot_bit[None, :]
+
+            cand_bits = jnp.concatenate([bits, new_bits.reshape(-1)])
+            cand_state = jnp.concatenate(
+                [state, new_state.reshape(-1, S)], axis=0)
+            cand_valid = jnp.concatenate([cfg_valid, legal.reshape(-1)])
+
+            b2, s2, n2, o2 = _dedup(cand_bits, cand_state, cand_valid, cap,
+                                    state_bits, nil_id)
+            return (b2, s2, n2, count, ovf | o2)
+
+        init = (bits, state, count, jnp.int32(-1), ovf)
+        bits, state, count, _, ovf = lax.while_loop(
+            closure_cond, closure_body, init)
+
+        s_bit = jnp.uint32(1) << s.astype(jnp.uint32)
+        cfg_valid = jnp.arange(cap) < count
+        keep = cfg_valid & ((bits & s_bit) != 0)
+        bits = bits & ~s_bit
+        bits, state, count, o2 = _dedup(bits, state, keep, cap,
+                                        state_bits, nil_id)
+        dead = count == 0
+        return (r + 1, bits, state, count, dead, ovf | o2)
+
+    def row_cond(carry):
+        r, _, _, _, dead, ovf = carry
+        return (r < n_rows) & ~dead & ~ovf
+
+    r, bits, state, count, dead, ovf = lax.while_loop(
+        row_cond, row_body,
+        (jnp.int32(0), bits, state, count, False, False))
+    return bits, state, count, r, dead, ovf
+
+
+def _chunk_slice(a: np.ndarray, base: int, chunk: int) -> np.ndarray:
+    """Static-shape chunk slice, zero-padded past the end of the table."""
+    end = min(base + chunk, a.shape[0])
+    part = a[base:end]
+    if part.shape[0] == chunk:
+        return part
+    pad = np.zeros((chunk - part.shape[0],) + a.shape[1:], a.dtype)
+    return np.concatenate([part, pad], axis=0)
+
+
 def _pad_rows(p: PackedHistory):
     """Bucket R up to a power of two with identity rows so XLA compiles one
     kernel per bucket instead of one per history length.
@@ -181,9 +302,18 @@ def _pad_rows(p: PackedHistory):
     return ret_slot, active, slot_f, slot_v
 
 
-def check_packed(p: PackedHistory,
-                 cap_schedule=DEFAULT_CAP_SCHEDULE) -> dict:
-    """Decide linearizability of a packed history on device."""
+def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
+                 chunk: int = CHUNK, cancel=None) -> dict:
+    """Decide linearizability of a packed history on device.
+
+    Host loop over CHUNK-row device dispatches; the frontier carries
+    between chunks. Capacity adapts per chunk: overflow re-runs just that
+    chunk at the next cap level (from the pre-chunk frontier snapshot);
+    when the frontier shrinks the cap drops back so the common case keeps
+    running on the small fast program. ``cancel`` (a threading.Event) stops
+    the search between chunks — set by a competition race once the other
+    racer has decided.
+    """
     if p.kernel is None:
         return {"valid?": "unknown", "analyzer": "tpu-bfs",
                 "error": f"no device kernel for {type(p.model).__name__}"}
@@ -194,30 +324,77 @@ def check_packed(p: PackedHistory,
     if p.R == 0:
         return {"valid?": True, "analyzer": "tpu-bfs", "configs": []}
 
-    ret_slot_h, active_h, slot_f_h, slot_v_h = _pad_rows(p)
-    ret_slot = jnp.asarray(ret_slot_h)
-    active = jnp.asarray(active_h)
-    slot_f = jnp.asarray(slot_f_h)
-    slot_v = jnp.asarray(slot_v_h)
-    init_state = jnp.asarray(p.init_state)
+    ret_slot_h = np.asarray(p.ret_slot)
+    active_h = np.asarray(p.active)
+    slot_f_h = np.asarray(p.slot_f)
+    slot_v_h = np.asarray(p.slot_v)
+    S = p.init_state.shape[0]
+    step_fn = p.kernel.step
 
-    for cap in cap_schedule:
-        ok, dead_row, overflow, count = _search(
-            ret_slot, active, slot_f, slot_v, init_state,
-            cap=cap, step_fn=p.kernel.step)
-        overflow = bool(overflow)
-        if not overflow:
-            break
-    if overflow:
-        return {"valid?": "unknown", "analyzer": "tpu-bfs",
-                "error": f"frontier exceeded capacity {cap_schedule[-1]}"}
+    # Single-u32-key dedup packing: possible when the one-word state's
+    # values (interned ids or 0/1 flags; NIL remapped to nil_id) fit next
+    # to the W-bit bitset under the bit-31 invalid flag.
+    state_bits = nil_id = None
+    if S == 1:
+        nid = max(len(p.unintern), 2)
+        b = nid.bit_length()
+        if p.window + b <= 31:
+            state_bits, nil_id = b, nid
 
-    if bool(ok):
-        return {"valid?": True, "analyzer": "tpu-bfs",
-                "configs": [], "final-frontier-size": int(count)}
-    r = int(dead_row)
-    ret = p.ops[int(p.ret_op[r])]
-    return {"valid?": False, "analyzer": "tpu-bfs",
-            "op": {"process": ret.process, "f": ret.f, "value": ret.value,
-                   "index": ret.op_index, "ok": ret.ok},
-            "configs": [], "final-paths": []}
+    level = 0
+    cap = cap_schedule[level]
+    bits = jnp.zeros(cap, jnp.uint32)
+    state = jnp.zeros((cap, S), jnp.int32).at[0].set(
+        jnp.asarray(p.init_state))
+    count = jnp.int32(1)
+    max_cap_used = cap
+
+    base = 0
+    while base < p.R:
+        if cancel is not None and cancel.is_set():
+            return {"valid?": "unknown", "analyzer": "tpu-bfs",
+                    "error": "cancelled"}
+        n = min(chunk, p.R - base)
+        tables = (jnp.asarray(_chunk_slice(ret_slot_h, base, chunk)),
+                  jnp.asarray(_chunk_slice(active_h, base, chunk)),
+                  jnp.asarray(_chunk_slice(slot_f_h, base, chunk)),
+                  jnp.asarray(_chunk_slice(slot_v_h, base, chunk)))
+        while True:
+            b2, s2, c2, r_done, dead, ovf = _search_chunk(
+                jnp.int32(n), *tables, bits, state, count,
+                cap=cap_schedule[level], step_fn=step_fn,
+                state_bits=state_bits, nil_id=nil_id)
+            if not bool(ovf):
+                break
+            if level + 1 >= len(cap_schedule):
+                return {"valid?": "unknown", "analyzer": "tpu-bfs",
+                        "error": ("frontier exceeded capacity "
+                                  f"{cap_schedule[-1]}")}
+            # Retry this chunk from its entry frontier at the next cap.
+            level += 1
+            cap = cap_schedule[level]
+            max_cap_used = max(max_cap_used, cap)
+            grow = cap - bits.shape[0]
+            bits = jnp.pad(bits, (0, grow))
+            state = jnp.pad(state, ((0, grow), (0, 0)))
+        if bool(dead):
+            r = base + int(r_done) - 1
+            ret = p.ops[int(p.ret_op[r])]
+            return {"valid?": False, "analyzer": "tpu-bfs",
+                    "op": {"process": ret.process, "f": ret.f,
+                           "value": ret.value, "index": ret.op_index,
+                           "ok": ret.ok},
+                    "configs": [], "final-paths": []}
+        bits, state, count = b2, s2, c2
+        base += n
+        # Frontier is compacted to the front, so a shrunken frontier can
+        # drop back to a smaller (faster) program by slicing.
+        while level > 0 and int(count) * 4 <= cap_schedule[level - 1]:
+            level -= 1
+            cap = cap_schedule[level]
+            bits = bits[:cap]
+            state = state[:cap]
+
+    return {"valid?": True, "analyzer": "tpu-bfs", "configs": [],
+            "final-frontier-size": int(count),
+            "max-cap": max_cap_used}

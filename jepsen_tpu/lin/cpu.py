@@ -69,10 +69,13 @@ def _witness_path(p: PackedHistory, cons) -> list:
     return path
 
 
-def check_packed(p: PackedHistory, witness: bool = False) -> dict:
+def check_packed(p: PackedHistory, witness: bool = False,
+                 cancel=None) -> dict:
     """Decide linearizability on a packed history. ``witness=True`` tracks a
     representative linearization order (cheap cons-cell sharing; first
-    discovery of a config wins)."""
+    discovery of a config wins). ``cancel`` (a threading.Event) stops the
+    search between rows — set by a competition race once the other racer
+    has decided."""
     if p.kernel is None:
         return check_generic(p, witness=witness)
 
@@ -82,6 +85,9 @@ def check_packed(p: PackedHistory, witness: bool = False) -> dict:
     order: dict | None = {init: None} if witness else None
 
     for r in range(p.R):
+        if cancel is not None and cancel.is_set():
+            return {"valid?": "unknown", "analyzer": "cpu-jit",
+                    "error": "cancelled"}
         act = p.active[r]
         f_row = p.slot_f[r]
         v_row = p.slot_v[r]
@@ -89,8 +95,17 @@ def check_packed(p: PackedHistory, witness: bool = False) -> dict:
         seen = set(configs)
         frontier = list(configs)
         while frontier:
+            # One row's closure can itself be exponential (2^window waves);
+            # poll here too so a competition loser dies promptly.
+            if cancel is not None and cancel.is_set():
+                return {"valid?": "unknown", "analyzer": "cpu-jit",
+                        "error": "cancelled"}
             new = []
-            for cfg in frontier:
+            for ci, cfg in enumerate(frontier):
+                if cancel is not None and ci % 4096 == 4095 \
+                        and cancel.is_set():
+                    return {"valid?": "unknown", "analyzer": "cpu-jit",
+                            "error": "cancelled"}
                 bits, st = cfg
                 for j in range(window):
                     if act[j] and not (bits >> j) & 1:

@@ -31,6 +31,17 @@ class TestCodec:
         for v in ({1: "a"}, {1: "a", "b": 2}, {(1, 2): {3}}):
             assert codec.decode(codec.encode(v)) == v
 
+    def test_nested_frozenset(self):
+        # frozensets survive inside hashable containers (set elements,
+        # dict keys) and keep their type through the round trip.
+        for v in ({frozenset({1, 2})},
+                  {(1, frozenset({2})): "x"},
+                  frozenset({3, 4}),
+                  [frozenset(), {frozenset({5}), frozenset({6})}]):
+            got = codec.decode(codec.encode(v))
+            assert got == v
+            assert type(got) is type(v)
+
 
 class TestReport:
     def test_tee_to_file(self, tmp_path, capsys):

@@ -96,3 +96,21 @@ def test_analysis_tpu_and_competition():
     bad = synth.corrupt_history(h, seed=3)
     assert analysis(m.cas_register(), bad,
                     algorithm="competition")["valid?"] is False
+
+
+def test_cancel_stops_both_racers():
+    # A pre-set cancel event makes either racer bail with an "unknown"
+    # cancelled result instead of running the search (the competition
+    # loser must die promptly so its thread can be joined).
+    import threading
+
+    from jepsen_tpu.lin import bfs, cpu, prepare
+
+    ev = threading.Event()
+    ev.set()
+    h = synth.generate_register_history(200, concurrency=4, seed=5)
+    p = prepare.prepare(m.cas_register(), h)
+    for checker in (cpu.check_packed, bfs.check_packed):
+        r = checker(p, cancel=ev)
+        assert r["valid?"] == "unknown"
+        assert r["error"] == "cancelled"
