@@ -61,8 +61,44 @@ def g2_checker() -> checker_ns.Checker:
     return checker_ns.FnChecker(check)
 
 
-def workload(keys=None) -> dict:
-    """Generator + checker pair for a G2 test over independent keys."""
+class _FakeG2Client:
+    """Serializable fake: each transaction checks the other row's absence
+    before inserting, under one lock — so exactly one insert per key can
+    succeed (faulty="g2" admits both, the anomaly the checker flags)."""
+
+    def __init__(self, faulty=None, _rows=None, _lock=None):
+        self.faulty = faulty
+        self.rows = _rows if _rows is not None else {}
+        self.lock = _lock if _lock is not None else threading.Lock()
+
+    def open(self, test, node):
+        return _FakeG2Client(self.faulty, self.rows, self.lock)
+
+    def setup(self, test):
+        pass
+
+    def invoke(self, test, op):
+        v = op.value
+        k, payload = (v[0], v[1]) if independent.is_tuple(v) else (None, v)
+        with self.lock:
+            taken = self.rows.setdefault(k, set())
+            other = 1 - payload["id"]
+            if other in taken and self.faulty != "g2":
+                return op.replace(type="fail")
+            taken.add(payload["id"])
+            return op.replace(type="ok")
+
+    def teardown(self, test):
+        pass
+
+    def close(self, test):
+        pass
+
+
+def workload(keys=None, faulty=None) -> dict:
+    """Generator + checker + fake client for a G2 test over independent
+    keys (the workload-map shape of jepsen_tpu.suites.workloads)."""
     return {"generator": gen.clients(g2_gen(keys)),
+            "client": _FakeG2Client(faulty=faulty),
             "checker": independent.checker(g2_checker(),
                                            batch_device=False)}

@@ -126,6 +126,24 @@ def single_test_cmd(test_fn: Callable[[dict], dict],
             "help": f"run the {name} test"}
 
 
+def suite_commands(test_fn: Callable[[dict], dict],
+                   opt_spec: Callable[[argparse.ArgumentParser], None]
+                   | None = None) -> list[dict]:
+    """The standard command set of a suite's -main: run the test, serve
+    results, re-analyze saved histories (etcd.clj:182-188 composes
+    single-test-cmd + serve-cmd the same way)."""
+
+    def spec(p: argparse.ArgumentParser):
+        p.add_argument("--fake", action="store_true",
+                       help="run against the in-memory workload fake "
+                            "(no cluster; dummy control transport)")
+        if opt_spec:
+            opt_spec(p)
+
+    return [single_test_cmd(test_fn, opt_spec=spec), serve_cmd(),
+            analyze_cmd()]
+
+
 def serve_cmd() -> dict:
     """Run the results web server (cli.clj:278-293)."""
 

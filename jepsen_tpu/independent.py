@@ -22,6 +22,7 @@ from typing import Callable, Iterable, NamedTuple
 
 from jepsen_tpu import checker as checker_ns
 from jepsen_tpu import generator as gen
+from jepsen_tpu import history as history_mod
 from jepsen_tpu.history import Op
 
 DIR = "independent"
@@ -150,6 +151,9 @@ def concurrent_generator(n: int, keys: Iterable,
             with gen.with_threads(state["group_threads"][group]):
                 o = gen.op(g, test, process)
             if o is not None:
+                # The generator protocol admits plain dicts as ops
+                # (generator.clj:25-38); normalize before tupling.
+                o = history_mod.op(o)
                 return o.replace(value=KV(k, o.value))
             with lock:
                 if state["active"][group] is pair:

@@ -1,0 +1,51 @@
+"""DB test suites — the L13 layer of the reference (SURVEY §2.3-2.8).
+
+One module per reference suite (24 sibling Leiningen projects in the
+reference repo). Each module exposes:
+
+- ``test(opts) -> dict`` — the test-map constructor (etcd.clj:149-179
+  shape), runnable no-cluster with ``opts={"fake": True}`` via the
+  workload fakes;
+- ``main(argv)`` — the CLI entry (cli/single-test-cmd + serve-cmd,
+  etcd.clj:182-188).
+
+``SUITES`` maps suite name → module path for the umbrella CLI
+(``python -m jepsen_tpu.cli suite <name> ...``) and the test matrix.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+SUITES = {
+    "aerospike": "jepsen_tpu.suites.aerospike",
+    "chronos": "jepsen_tpu.suites.chronos",
+    "cockroachdb": "jepsen_tpu.suites.cockroachdb",
+    "consul": "jepsen_tpu.suites.consul",
+    "crate": "jepsen_tpu.suites.crate",
+    "disque": "jepsen_tpu.suites.disque",
+    "elasticsearch": "jepsen_tpu.suites.elasticsearch",
+    "etcd": "jepsen_tpu.suites.etcd",
+    "galera": "jepsen_tpu.suites.galera",
+    "hazelcast": "jepsen_tpu.suites.hazelcast",
+    "logcabin": "jepsen_tpu.suites.logcabin",
+    "mongodb-rocks": "jepsen_tpu.suites.mongodb_rocks",
+    "mongodb-smartos": "jepsen_tpu.suites.mongodb_smartos",
+    "mysql-cluster": "jepsen_tpu.suites.mysql_cluster",
+    "percona": "jepsen_tpu.suites.percona",
+    "postgres-rds": "jepsen_tpu.suites.postgres_rds",
+    "rabbitmq": "jepsen_tpu.suites.rabbitmq",
+    "raftis": "jepsen_tpu.suites.raftis",
+    "rethinkdb": "jepsen_tpu.suites.rethinkdb",
+    "robustirc": "jepsen_tpu.suites.robustirc",
+    "tidb": "jepsen_tpu.suites.tidb",
+    "zookeeper": "jepsen_tpu.suites.zookeeper",
+}
+
+
+def load(name: str):
+    """Import a suite module by registry name."""
+    if name not in SUITES:
+        raise KeyError(
+            f"unknown suite {name!r}; one of {sorted(SUITES)}")
+    return importlib.import_module(SUITES[name])

@@ -1,0 +1,119 @@
+"""Aerospike suite — CAS register + counter
+(aerospike/src/aerospike/core.clj).
+
+Workloads: CAS register checked linearizable (core.clj:530-533) and the
+counter (checker/counter, core.clj:556-557). Nemeses:
+partition-random-halves (core.clj:533) and node kill/restart via
+node-start-stopper (core.clj:488). The reference also ships the repo's
+only formal artifact, a TLA+ model (aerospike/spec/aerospike.tla); its
+counterpart here is ``spec/cas_register.tla`` at the repo root.
+
+Aerospike speaks a proprietary binary protocol (reference uses the Java
+client), so the wire client is gated; no-cluster runs use the fakes.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import control
+from jepsen_tpu import db as db_ns
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu import os_debian
+from jepsen_tpu.suites import common, workloads
+
+
+class AerospikeDB(db_ns.DB, db_ns.LogFiles):
+    """Package install + cluster config (core.clj:60-200)."""
+
+    def setup(self, test, node) -> None:
+        with control.su():
+            os_debian.install(["aerospike-server-community",
+                               "aerospike-tools"])
+            mesh = "\n".join(
+                f"    mesh-seed-address-port {n} 3002"
+                for n in test["nodes"])
+            config = f"""service {{
+  paxos-single-replica-limit 1
+  proto-fd-max 15000
+}}
+network {{
+  service {{ address any; port 3000 }}
+  heartbeat {{
+    mode mesh
+    port 3002
+{mesh}
+    interval 150
+    timeout 10
+  }}
+  fabric {{ port 3001 }}
+  info {{ port 3003 }}
+}}
+namespace jepsen {{
+  replication-factor 3
+  memory-size 512M
+  storage-engine memory
+}}
+"""
+            control.exec_("tee", "/etc/aerospike/aerospike.conf",
+                          stdin=config)
+            control.exec_("service", "aerospike", "restart")
+
+    def teardown(self, test, node) -> None:
+        with control.su():
+            control.exec_("service", "aerospike", "stop", may_fail=True)
+
+    def log_files(self, test, node) -> list[str]:
+        return ["/var/log/aerospike/aerospike.log"]
+
+
+def kill_nemesis() -> nemesis_ns.Nemesis:
+    """Node kill/restart via start-stopper (core.clj:488): the nemesis
+    :start op kills asd on a random node, :stop restarts it."""
+    import random
+
+    def kill(test, node):
+        control.exec_("killall", "-9", "asd", may_fail=True)
+        return ["killed", "asd"]
+
+    def restart(test, node):
+        control.exec_("service", "aerospike", "restart", may_fail=True)
+        return ["restarted", "asd"]
+
+    return nemesis_ns.node_start_stopper(
+        lambda nodes: [random.choice(nodes)], kill, restart)
+
+
+def test(opts: dict | None = None) -> dict:
+    """The aerospike test map (core.clj:500-560). ``workload`` picks
+    cas-register (default) or counter; ``nemesis`` partition or kill."""
+    opts = dict(opts or {})
+    name = opts.pop("workload", None) or "cas-register"
+    nem = opts.pop("nemesis", None) or "partition"
+    wl = workloads.single_register() if name == "cas-register" \
+        else workloads.counter_workload()
+    nemesis = nemesis_ns.partition_random_halves() \
+        if nem == "partition" else kill_nemesis()
+    return common.suite_test(
+        f"aerospike {name}", opts,
+        workload=wl,
+        db=AerospikeDB(),
+        client=common.GatedClient(
+            "aerospike speaks a proprietary binary protocol; "
+            "run with --fake"),
+        nemesis=nemesis,
+        nemesis_gen=common.standard_nemesis_gen(5, 5))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    def opt_spec(p):
+        p.add_argument("--workload", default="cas-register",
+                       choices=["cas-register", "counter"])
+        p.add_argument("--nemesis", default="partition",
+                       choices=["partition", "kill"])
+
+    cli.main(cli.suite_commands(test, opt_spec=opt_spec), argv)
+
+
+if __name__ == "__main__":
+    main()

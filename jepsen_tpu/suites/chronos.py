@@ -1,0 +1,260 @@
+"""Chronos suite — job-scheduler runs-vs-targets checking
+(chronos/src/jepsen/chronos.clj + chronos/checker.clj).
+
+A scheduled job with (start, interval, count, epsilon, duration) induces
+*target* windows in which a run must begin: window i is
+``[start + i*interval, start + i*interval + epsilon + forgiveness]``,
+truncated to targets that must have begun by the final read
+(checker.clj:30-46). The history's runs satisfy the schedule iff every
+target can be assigned a *distinct* run starting inside its window.
+
+The reference solves this with the loco/Choco CSP solver
+(checker.clj:22-23,116-176); the assignment problem is exactly maximum
+bipartite matching, solved here directly with augmenting paths — no
+solver dependency, O(targets × runs) per augment.
+
+The real cluster needs Mesos + Chronos (mesosphere.clj provisions
+both); the wire client posts jobs over Chronos's HTTP API. No-cluster
+runs use a fake scheduler that executes jobs in-process with jitter.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from jepsen_tpu import checker as checker_ns
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu.checker import FnChecker
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import common
+
+EPSILON_FORGIVENESS = 5  # seconds of extra grace (checker.clj:26-28)
+
+
+def job_targets(read_time: float, job: dict) -> list[tuple[float, float]]:
+    """Target windows that must have begun by read_time
+    (checker.clj:30-46): cutoff is epsilon+duration before the read."""
+    finish = read_time - job["epsilon"] - job["duration"]
+    out = []
+    t = job["start"]
+    for _ in range(job["count"]):
+        if t >= finish:
+            break
+        out.append((t, t + job["epsilon"] + EPSILON_FORGIVENESS))
+        t += job["interval"]
+    return out
+
+
+def match_targets(targets: list[tuple[float, float]],
+                  runs: list[float]) -> dict | None:
+    """Assign each target a distinct run starting inside its window —
+    maximum bipartite matching via augmenting paths. Returns
+    {target index: run index} covering all targets, or None."""
+    match_of_run: dict[int, int] = {}
+
+    def augment(ti: int, seen: set[int]) -> bool:
+        lo, hi = targets[ti]
+        for ri, r in enumerate(runs):
+            if ri in seen or not (lo <= r <= hi):
+                continue
+            seen.add(ri)
+            if ri not in match_of_run or \
+                    augment(match_of_run[ri], seen):
+                match_of_run[ri] = ti
+                return True
+        return False
+
+    for ti in range(len(targets)):
+        if not augment(ti, set()):
+            return None
+    return {ti: ri for ri, ti in match_of_run.items()}
+
+
+def job_solution(read_time: float, job: dict, runs: list[float]) -> dict:
+    """The per-job verdict (checker.clj:116-176 job-solution shape)."""
+    targets = job_targets(read_time, job)
+    sol = match_targets(targets, sorted(runs))
+    if sol is None:
+        return {"valid?": False, "job": job, "targets": targets,
+                "runs": sorted(runs), "solution": None}
+    used = set(sol.values())
+    extra = [r for i, r in enumerate(sorted(runs)) if i not in used]
+    return {"valid?": True, "job": job, "solution": sol, "extra": extra}
+
+
+def checker() -> checker_ns.Checker:
+    """History checker: add-job invocations define the schedule; the
+    final read carries {job name: [run start times]}
+    (chronos/checker.clj:179-226)."""
+
+    def check(test, model, history, opts):
+        jobs: dict = {}
+        read = None
+        read_time = None
+        for op in history:
+            if op.f == "add-job" and op.is_ok:
+                jobs[op.value["name"]] = op.value
+            elif op.f == "read" and op.is_ok:
+                read = op.value
+                read_time = op.value.get("time") \
+                    if isinstance(op.value, dict) else None
+        if read is None:
+            return {"valid?": "unknown", "error": "no final read"}
+        runs_by_job = read.get("runs", {}) \
+            if isinstance(read, dict) else {}
+        if read_time is None:
+            read_time = time.time()
+        sols = {name: job_solution(read_time, job,
+                                   runs_by_job.get(name, []))
+                for name, job in jobs.items()}
+        bad = {n: s for n, s in sols.items() if not s["valid?"]}
+        return {"valid?": not bad, "job-count": len(jobs),
+                "bad-jobs": {n: {"targets": s["targets"],
+                                 "runs": s["runs"]}
+                             for n, s in list(bad.items())[:5]}}
+
+    return FnChecker(check)
+
+
+class FakeScheduler:
+    """In-process job scheduler: runs each job's occurrences on time with
+    bounded jitter (within epsilon), recording start times."""
+
+    def __init__(self, drop_prob: float = 0.0):
+        self.jobs: dict = {}
+        self.runs: dict = {}
+        self.lock = threading.Lock()
+        self.threads: list[threading.Thread] = []
+        self.drop_prob = drop_prob
+
+    def add(self, job: dict) -> None:
+        with self.lock:
+            self.jobs[job["name"]] = job
+            self.runs.setdefault(job["name"], [])
+
+        def run_job():
+            t = job["start"]
+            for _ in range(job["count"]):
+                delay = t - time.time()
+                if delay > 0:
+                    time.sleep(delay)
+                jitter = random.uniform(0, max(job["epsilon"] - 1, 0))
+                if jitter:
+                    time.sleep(min(jitter, 2))
+                if random.random() >= self.drop_prob:
+                    with self.lock:
+                        self.runs[job["name"]].append(time.time())
+                t += job["interval"]
+
+        th = threading.Thread(target=run_job, daemon=True)
+        th.start()
+        self.threads.append(th)
+
+    def read(self) -> dict:
+        with self.lock:
+            return {"time": time.time(),
+                    "runs": {k: list(v) for k, v in self.runs.items()}}
+
+
+class FakeChronosClient(client_ns.Client):
+    def __init__(self, sched: FakeScheduler):
+        self.sched = sched
+
+    def open(self, test, node):
+        return FakeChronosClient(self.sched)
+
+    def invoke(self, test, op: Op) -> Op:
+        if op.f == "add-job":
+            self.sched.add(op.value)
+            return op.replace(type="ok")
+        if op.f == "read":
+            return op.replace(type="ok", value=self.sched.read())
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+class ChronosClient(client_ns.Client):
+    """Job submission over Chronos's HTTP API (chronos.clj:120-170);
+    reading runs back requires the reference's remote run-log scrape."""
+
+    def __init__(self, node: str | None = None):
+        self.node = node
+
+    def open(self, test, node):
+        return ChronosClient(node)
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add-job":
+                j = op.value
+                body = {"name": j["name"],
+                        "schedule": (f"R{j['count']}/"
+                                     f"{j['start']}/PT{j['interval']}S"),
+                        "epsilon": f"PT{j['epsilon']}S",
+                        "command": f"echo run >> /tmp/chronos-{j['name']}"}
+                status, _ = common.http_json(
+                    "POST",
+                    f"http://{self.node}:4400/scheduler/iso8601", body)
+                return op.replace(
+                    type="ok" if status in (200, 204) else "info")
+        except OSError as e:
+            return op.replace(type="info", error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+def workload(n_jobs: int = 10, horizon: float = 10.0) -> dict:
+    """Job-submission generator + final read (chronos.clj:180-260):
+    random (interval, count, epsilon, duration) per job starting shortly
+    after submission."""
+    state = {"n": 0}
+    lock = threading.Lock()
+
+    def add_job(test, process):
+        with lock:
+            state["n"] += 1
+            i = state["n"]
+        if i > n_jobs:
+            return None
+        return {"type": "invoke", "f": "add-job",
+                "value": {"name": f"job-{i}",
+                          "start": time.time() + random.uniform(1, 3),
+                          "interval": random.randint(2, 5),
+                          "count": random.randint(1, 3),
+                          "epsilon": random.randint(1, 2),
+                          "duration": 0}}
+
+    sched = FakeScheduler()
+    return {
+        "generator": gen.stagger(0.5, gen.gen(add_job)),
+        # Let scheduled runs play out, then one read collects them.
+        "final_generator": gen.then(
+            gen.singlethreaded(gen.once({"type": "invoke", "f": "read",
+                                         "value": None})),
+            gen.sleep(horizon)),
+        "client": FakeChronosClient(sched),
+        "checker": checker(),
+        "model": None,
+    }
+
+
+def test(opts: dict | None = None) -> dict:
+    """The chronos test map (chronos.clj:240-280)."""
+    return common.suite_test(
+        "chronos", opts,
+        workload=workload(),
+        client=ChronosClient(),
+        nemesis=nemesis_ns.partition_random_halves(),
+        nemesis_gen=common.standard_nemesis_gen(30, 30))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    cli.main(cli.suite_commands(test), argv)
+
+
+if __name__ == "__main__":
+    main()

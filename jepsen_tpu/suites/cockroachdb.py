@@ -1,0 +1,386 @@
+"""CockroachDB suite — the multi-workload, multi-nemesis runner
+(cockroachdb/src/jepsen/cockroach/*.clj, the reference's richest suite).
+
+Registries mirror cockroach/runner.clj:25-57: a **test registry**
+(bank, bank-multitable, comments, register, monotonic, sets, sequential,
+g2) crossed with a **nemesis registry** (none, parts, majring, clock
+skews at five magnitudes, strobe-skews, split, start-stop-2,
+start-kill-2), composable pairwise the way runner.clj:94-110 builds a
+cartesian product of --nemesis × --nemesis2.
+
+The wire client speaks the PostgreSQL protocol
+(:mod:`jepsen_tpu.suites.pgwire`) with cockroach/client.clj's
+serialization-retry semantics; register and bank run real SQL, the rest
+run no-cluster against their workload fakes (the reference's
+``--jdbc-mode pg-local`` seam, cockroach.clj:141-152).
+"""
+
+from __future__ import annotations
+
+import random
+
+from jepsen_tpu import adya
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import control
+from jepsen_tpu import independent
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu import nemesis_time
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import common, workloads
+from jepsen_tpu.suites.pgwire import PgClient, PgError
+
+VERSION = "v1.0"
+PORT = 26257
+
+
+class CockroachDB(common.TarballDB):
+    """Tarball install + cockroach start --join (cockroach/auto.clj)."""
+
+    name = "cockroach"
+    dir = "/opt/cockroach"
+    binary = "cockroach"
+
+    def __init__(self, version: str = VERSION):
+        self.url = (f"https://binaries.cockroachdb.com/"
+                    f"cockroach-{version}.linux-amd64.tgz")
+
+    def start_args(self, test, node) -> list:
+        join = ",".join(f"{n}:26258" for n in test["nodes"])
+        return ["start", "--insecure", "--background",
+                f"--advertise-host={node}",
+                f"--port={PORT}", "--http-port=8081",
+                f"--join={join}",
+                f"--store=path={self.dir}/data"]
+
+
+# --- SQL clients over pgwire -------------------------------------------------
+
+
+class RegisterClient(client_ns.Client):
+    """Per-key register via SQL upserts (cockroach/register.clj:82):
+    read = SELECT, write = UPSERT, cas = conditional UPDATE in a txn."""
+
+    TABLE = "jepsen_registers"
+
+    def __init__(self, conn: PgClient | None = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return RegisterClient(PgClient(node, port=PORT, user="root",
+                                       database="jepsen"))
+
+    def setup(self, test) -> None:
+        conn = PgClient(test["nodes"][0], port=PORT, user="root",
+                        database="system")
+        try:
+            conn.query("CREATE DATABASE IF NOT EXISTS jepsen")
+            conn.query(f"CREATE TABLE IF NOT EXISTS jepsen.{self.TABLE} "
+                       f"(id INT PRIMARY KEY, val INT)")
+        finally:
+            conn.close()
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value if independent.is_tuple(op.value) \
+            else (0, op.value)
+
+        def join(val):
+            return independent.tuple_(k, val) \
+                if independent.is_tuple(op.value) else val
+
+        try:
+            if op.f == "read":
+                rows = self.conn.query(
+                    f"SELECT val FROM {self.TABLE} WHERE id = {int(k)}")
+                val = int(rows[0][0]) if rows and rows[0][0] is not None \
+                    else None
+                return op.replace(type="ok", value=join(val))
+            if op.f == "write":
+                self.conn.query(f"UPSERT INTO {self.TABLE} (id, val) "
+                                f"VALUES ({int(k)}, {int(v)})")
+                return op.replace(type="ok")
+            if op.f == "cas":
+                old, new = v
+                rows = self.conn.txn([
+                    f"UPDATE {self.TABLE} SET val = {int(new)} "
+                    f"WHERE id = {int(k)} AND val = {int(old)} "
+                    f"RETURNING id"])
+                return op.replace(type="ok" if rows[-1] else "fail")
+        except PgError as e:
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=str(e))
+        except (OSError, ConnectionError) as e:
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+class BankClient(client_ns.Client):
+    """Bank transfers in explicit transactions (cockroach/bank.clj)."""
+
+    TABLE = "jepsen_accounts"
+
+    def __init__(self, conn: PgClient | None = None, n: int = 5,
+                 total: int = 50):
+        self.conn = conn
+        self.n = n
+        self.total = total
+
+    def open(self, test, node):
+        return BankClient(PgClient(node, port=PORT, user="root",
+                                   database="jepsen"),
+                          self.n, self.total)
+
+    def setup(self, test) -> None:
+        conn = PgClient(test["nodes"][0], port=PORT, user="root",
+                        database="system")
+        try:
+            conn.query("CREATE DATABASE IF NOT EXISTS jepsen")
+            conn.query(f"CREATE TABLE IF NOT EXISTS jepsen.{self.TABLE} "
+                       f"(id INT PRIMARY KEY, balance INT NOT NULL)")
+            for i in range(self.n):
+                conn.query(f"INSERT INTO jepsen.{self.TABLE} VALUES "
+                           f"({i}, {self.total // self.n}) "
+                           f"ON CONFLICT (id) DO NOTHING")
+        finally:
+            conn.close()
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                rows = self.conn.query(
+                    f"SELECT balance FROM {self.TABLE} ORDER BY id")
+                return op.replace(type="ok",
+                                  value=[int(r[0]) for r in rows])
+            if op.f == "transfer":
+                t = op.value
+                try:
+                    self.conn.txn([
+                        f"UPDATE {self.TABLE} SET balance = balance - "
+                        f"{t['amount']} WHERE id = {t['from']} AND "
+                        f"balance >= {t['amount']}",
+                        f"UPDATE {self.TABLE} SET balance = balance + "
+                        f"{t['amount']} WHERE id = {t['to']}"])
+                    return op.replace(type="ok")
+                except PgError:
+                    return op.replace(type="fail")
+        except (OSError, ConnectionError) as e:
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()
+
+
+# --- nemesis registry (cockroach/nemesis.clj) -------------------------------
+
+
+def _skew(name: str, dt_s: float) -> dict:
+    """Clock-bump nemesis at one magnitude (nemesis.clj:233-272): :start
+    bumps randomly-selected nodes by dt seconds, :stop resets clocks."""
+
+    class Skew(nemesis_ns.Nemesis):
+        def invoke(self, test, op):
+            from jepsen_tpu.control import on_many
+
+            if op.f == "start":
+                def bump():
+                    if random.random() < 0.5:
+                        nemesis_time.bump_time(dt_s * 1000)
+                        return dt_s
+                    return 0
+
+                vals = on_many(test, test["nodes"], bump)
+                return op.replace(type="info", value=vals)
+            if op.f == "stop":
+                on_many(test, test["nodes"],
+                        lambda: nemesis_time.reset_time())
+                return op.replace(type="info", value="clocks-reset")
+            return op.replace(type="info")
+
+    return {"name": name, "nemesis": Skew(), "clocks": True,
+            "gen": common.standard_nemesis_gen(5, 5)}
+
+
+def _strobe() -> dict:
+    """strobe-skews (nemesis.clj:202-230): oscillate the clock 200ms
+    ahead/back every 10ms for 10s on :start."""
+
+    class Strobe(nemesis_ns.Nemesis):
+        def invoke(self, test, op):
+            from jepsen_tpu.control import on_many
+
+            if op.f == "start":
+                on_many(test, test["nodes"],
+                        lambda: nemesis_time.strobe_time(200, 10, 10))
+                return op.replace(type="info", value="strobed")
+            if op.f == "stop":
+                on_many(test, test["nodes"],
+                        lambda: nemesis_time.reset_time())
+                return op.replace(type="info", value="clocks-reset")
+            return op.replace(type="info")
+
+    return {"name": "strobe-skews", "nemesis": Strobe(), "clocks": True,
+            "gen": common.standard_nemesis_gen(0, 0)}
+
+
+def _startstop(n: int) -> dict:
+    """SIGSTOP n random cockroach processes (runner.clj startstop)."""
+    return {"name": f"start-stop-{n}",
+            "nemesis": nemesis_ns.hammer_time(
+                "cockroach",
+                lambda nodes: random.sample(list(nodes),
+                                            min(n, len(nodes)))),
+            "clocks": False,
+            "gen": common.standard_nemesis_gen(5, 5)}
+
+
+def _startkill(n: int) -> dict:
+    """kill -9 + restart n random nodes (runner.clj startkill)."""
+    db = CockroachDB()
+
+    def kill(test, node):
+        control.exec_("killall", "-9", "cockroach", may_fail=True)
+        return ["killed", "cockroach"]
+
+    def restart(test, node):
+        db.start(test, node)
+        return ["restarted", "cockroach"]
+
+    return {"name": f"start-kill-{n}",
+            "nemesis": nemesis_ns.node_start_stopper(
+                lambda nodes: random.sample(list(nodes),
+                                            min(n, len(nodes))),
+                kill, restart),
+            "clocks": False,
+            "gen": common.standard_nemesis_gen(5, 5)}
+
+
+def _split() -> dict:
+    """Range-split nemesis (nemesis.clj:274-317): SPLIT AT below the
+    most recently written register key."""
+
+    class Split(nemesis_ns.Nemesis):
+        def invoke(self, test, op):
+            keyrange = test.get("keyrange")
+            if not keyrange:
+                return op.replace(type="info", value="no-keyrange")
+            k = max(keyrange)
+            try:
+                conn = PgClient(random.choice(test["nodes"]), port=PORT,
+                                user="root", database="jepsen")
+                try:
+                    conn.query(f"ALTER TABLE {RegisterClient.TABLE} "
+                               f"SPLIT AT VALUES ({int(k)})")
+                finally:
+                    conn.close()
+                return op.replace(type="info", value=["split", k])
+            except (PgError, OSError, ConnectionError) as e:
+                return op.replace(type="info", value=repr(e))
+
+    def delay_gen():
+        from jepsen_tpu import generator as gen
+
+        return gen.delay(2, {"type": "info", "f": "split", "value": None})
+
+    return {"name": "splits", "nemesis": Split(), "clocks": False,
+            "gen": delay_gen()}
+
+
+def nemeses() -> dict:
+    """name -> nemesis map (runner.clj:42-57)."""
+    return {
+        "none": {"name": "blank", "nemesis": nemesis_ns.noop,
+                 "clocks": False, "gen": None},
+        "parts": {"name": "parts",
+                  "nemesis": nemesis_ns.partition_random_halves(),
+                  "clocks": False,
+                  "gen": common.standard_nemesis_gen(5, 5)},
+        "majority-ring": {"name": "majring",
+                          "nemesis":
+                          nemesis_ns.partition_majorities_ring(),
+                          "clocks": False,
+                          "gen": common.standard_nemesis_gen(5, 5)},
+        "small-skews": _skew("small-skews", 0.100),
+        "subcritical-skews": _skew("subcritical-skews", 0.200),
+        "critical-skews": _skew("critical-skews", 0.250),
+        "big-skews": _skew("big-skews", 0.5),
+        "huge-skews": _skew("huge-skews", 5),
+        "strobe-skews": _strobe(),
+        "split": _split(),
+        "start-stop-2": _startstop(2),
+        "start-kill-2": _startkill(2),
+    }
+
+
+def combine_nemeses(a: dict, b: dict) -> dict:
+    """Compose two registry entries (runner.clj:94-110 nemesis product):
+    composed client, concatenated schedules, OR'd clock flag."""
+    from jepsen_tpu import generator as gen
+
+    gens = [g for g in (a.get("gen"), b.get("gen")) if g is not None]
+    return {"name": f"{a['name']}+{b['name']}",
+            "nemesis": nemesis_ns.compose([a["nemesis"], b["nemesis"]]),
+            "clocks": a["clocks"] or b["clocks"],
+            "gen": gen.mix(gens) if len(gens) > 1 else
+            (gens[0] if gens else None)}
+
+
+def tests_registry() -> dict:
+    """name -> workload factory (runner.clj:25-34)."""
+    return {
+        "bank": lambda: workloads.bank_workload(),
+        "bank-multitable": lambda: workloads.bank_workload(),
+        "comments": lambda: workloads.comments_workload(),
+        "register": lambda: workloads.register(threads_per_key=5),
+        "monotonic": lambda: workloads.monotonic_workload(),
+        "monotonic-multitable": lambda: workloads.monotonic_workload(),
+        "sets": lambda: workloads.set_workload(),
+        "sequential": lambda: workloads.sequential_workload(),
+        "g2": lambda: adya.workload(),
+    }
+
+
+def test(opts: dict | None = None) -> dict:
+    """The cockroach test map (cockroach.clj:136-164 basic-test +
+    runner.clj test-cmd): ``workload``, ``nemesis``, ``nemesis2``."""
+    opts = dict(opts or {})
+    wname = opts.pop("workload", None) or "register"
+    n1 = opts.pop("nemesis", None) or "none"
+    n2 = opts.pop("nemesis2", None)
+    table = tests_registry()
+    if wname not in table:
+        raise ValueError(f"unknown workload {wname!r}; "
+                         f"one of {sorted(table)}")
+    reg = nemeses()
+    nem = reg[n1] if n2 is None else combine_nemeses(reg[n1], reg[n2])
+    if wname == "register" and opts.get("concurrency", 0) < 5:
+        opts["concurrency"] = 5
+    client = {"register": RegisterClient,
+              "bank": BankClient}.get(wname)
+    return common.suite_test(
+        f"cockroachdb {wname} {nem['name']}", opts,
+        workload=table[wname](),
+        db=CockroachDB(),
+        client=client() if client else None,
+        nemesis=nem["nemesis"],
+        nemesis_gen=nem["gen"])
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    def opt_spec(p):
+        p.add_argument("--workload", default="register",
+                       choices=sorted(tests_registry()))
+        p.add_argument("--nemesis", default="none",
+                       choices=sorted(nemeses()))
+        p.add_argument("--nemesis2", default=None,
+                       choices=sorted(nemeses()))
+
+    cli.main(cli.suite_commands(test, opt_spec=opt_spec), argv)
+
+
+if __name__ == "__main__":
+    main()

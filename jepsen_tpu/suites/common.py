@@ -1,0 +1,204 @@
+"""Shared scaffolding for the DB suites.
+
+Every reference suite repeats the same skeleton (etcd.clj:51-86 is the
+cleanest instance): a DB that installs a tarball / package and runs a
+daemon, a wire client, a test-map constructor merging ``noop_test`` with
+workload + nemesis + checker, and a ``-main`` built from
+``cli/single-test-cmd`` + ``serve-cmd``. This module carries the shared
+parts so each suite is mostly declaration.
+
+Wire clients use real protocols where the Python stdlib can speak them
+(HTTP/JSON, RESP, the PostgreSQL wire protocol); drivers that would need
+external packages are *gated*: the client raises
+:class:`DriverUnavailable` at open time with instructions, and every
+suite can instead run against its in-memory workload fake
+(``fake=True``), the pg-local pattern of cockroach.clj:141-152.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import control
+from jepsen_tpu import db as db_ns
+from jepsen_tpu import os_debian
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.tests_support import noop_test
+
+
+class DriverUnavailable(Exception):
+    """Raised at client open time when a suite's wire protocol needs a
+    driver that is not vendored (e.g. AMQP, the Mongo wire protocol).
+    Runs against real clusters need that driver; no-cluster runs use the
+    workload fake instead (``fake=True``)."""
+
+
+class TarballDB(db_ns.DB, db_ns.LogFiles):
+    """DB installed from a release archive and run as a daemon — the etcd
+    template (etcd.clj:51-86): install tarball, start daemon with
+    per-node flags, teardown = stop + rm -rf.
+
+    Subclasses define :meth:`start_args` (daemon argv) and may override
+    :meth:`post_install` / :meth:`await_ready`.
+    """
+
+    name = "db"
+    url: str | None = None          # release archive URL
+    dir = "/opt/jepsen/db"
+    binary = "db"
+
+    @property
+    def logfile(self):
+        return f"{self.dir}/{self.name}.log"
+
+    @property
+    def pidfile(self):
+        return f"{self.dir}/{self.name}.pid"
+
+    def start_args(self, test, node) -> list:
+        raise NotImplementedError
+
+    def post_install(self, test, node) -> None:
+        pass
+
+    def await_ready(self, test, node) -> None:
+        pass
+
+    def setup(self, test, node) -> None:
+        with control.su():
+            if self.url:
+                cu.install_archive(self.url, self.dir)
+            self.post_install(test, node)
+            cu.start_daemon(f"{self.dir}/{self.binary}",
+                            *self.start_args(test, node),
+                            logfile=self.logfile, pidfile=self.pidfile,
+                            chdir=self.dir)
+        self.await_ready(test, node)
+
+    def teardown(self, test, node) -> None:
+        with control.su():
+            cu.stop_daemon(self.pidfile, binary=self.binary)
+            control.exec_("rm", "-rf", self.dir, may_fail=True)
+
+    def log_files(self, test, node) -> list[str]:
+        return [self.logfile]
+
+    # start/stop used by kill/restart nemeses (node_start_stopper)
+    def start(self, test, node) -> None:
+        with control.su():
+            cu.start_daemon(f"{self.dir}/{self.binary}",
+                            *self.start_args(test, node),
+                            logfile=self.logfile, pidfile=self.pidfile,
+                            chdir=self.dir)
+
+    def stop(self, test, node) -> None:
+        with control.su():
+            cu.stop_daemon(self.pidfile, binary=self.binary)
+
+
+def http_json(method: str, url: str, body=None, timeout: float = 5.0,
+              headers=None) -> tuple[int, dict | list | str | None]:
+    """Tiny HTTP/JSON helper for the suites whose DB speaks HTTP (etcd,
+    consul, elasticsearch, crate, chronos). Returns (status, parsed)."""
+    data = None
+    hdrs = dict(headers or {})
+    if body is not None:
+        if isinstance(body, (dict, list)):
+            data = json.dumps(body).encode()
+            hdrs.setdefault("Content-Type", "application/json")
+        elif isinstance(body, str):
+            data = body.encode()
+            hdrs.setdefault("Content-Type",
+                            "application/x-www-form-urlencoded")
+        else:
+            data = body
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=hdrs)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read().decode()
+            status = resp.status
+    except urllib.error.HTTPError as e:
+        raw = e.read().decode()
+        status = e.code
+    try:
+        return status, json.loads(raw) if raw else None
+    except json.JSONDecodeError:
+        return status, raw
+
+
+class GatedClient(client_ns.Client):
+    """Client for a wire protocol whose driver isn't vendored: fails
+    loudly at open() with the reason, rather than silently faking."""
+
+    def __init__(self, reason: str):
+        self.reason = reason
+
+    def open(self, test, node):
+        raise DriverUnavailable(self.reason)
+
+    def invoke(self, test, op):
+        raise DriverUnavailable(self.reason)
+
+
+def suite_test(name: str, opts: dict | None = None, *,
+               workload: dict, nemesis=None, nemesis_gen=None,
+               db=None, client=None, os=None, extra=None) -> dict:
+    """Assemble a suite test map: noop_test <- suite components <- opts
+    (the merge order of etcd.clj:149-179).
+
+    ``workload`` is a workload map (jepsen_tpu.suites.workloads). With
+    ``opts={"fake": True}`` (or no client given) the workload's fake
+    client is used, making the test runnable with the dummy transport.
+    """
+    from jepsen_tpu import checker as checker_ns
+    from jepsen_tpu.suites import workloads as wl
+
+    opts = dict(opts or {})
+    fake = opts.pop("fake", client is None)
+
+    checker = checker_ns.compose({
+        "perf": checker_ns.perf(),
+        "workload": workload["checker"],
+    })
+
+    test = noop_test(
+        name=name,
+        client=workload["client"] if fake else client,
+        model=workload.get("model"),
+        checker=checker,
+        generator=wl.finalize(workload, opts, nemesis_gen=nemesis_gen),
+    )
+    if not fake:
+        # Real-cluster components; omitted keys fall back to core's noops.
+        for key, v in (("os", os or os_debian.os), ("db", db),
+                       ("nemesis", nemesis)):
+            if v is not None:
+                test[key] = v
+    if extra:
+        test.update(extra)
+    test.update(opts)
+    if fake:
+        # No-cluster run: the dummy transport records control commands
+        # instead of SSHing (control.clj:15 *dummy*), regardless of any
+        # --transport flag that rode in through opts.
+        test["transport"] = "dummy"
+        test["nemesis"] = None
+    return test
+
+
+def standard_nemesis_gen(start_sleep: float = 5.0, stop_sleep: float = 5.0):
+    """The ubiquitous start/stop fault schedule (etcd.clj:173-178)."""
+    from jepsen_tpu import generator as gen
+
+    def cycle():
+        while True:
+            yield gen.sleep(start_sleep)
+            yield {"type": "info", "f": "start", "value": None}
+            yield gen.sleep(stop_sleep)
+            yield {"type": "info", "f": "stop", "value": None}
+
+    return gen.seq(cycle())

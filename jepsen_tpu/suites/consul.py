@@ -1,0 +1,129 @@
+"""Consul suite — CAS register over the HTTP KV API
+(consul/src/jepsen/consul.clj).
+
+Consul's KV store exposes *index-based* CAS: the client reads the key's
+ModifyIndex, compares the current value itself, then PUTs with
+``?cas=<index>`` (consul.clj:101-110). Single shared key, linearizable
+against a nil-initialized CAS register, partition nemesis.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import control
+from jepsen_tpu import db as db_ns
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import common, workloads
+
+VERSION = "0.5.2"
+KEY = "jepsen"
+
+
+class ConsulDB(db_ns.DB, db_ns.LogFiles):
+    """Binary download + agent daemon in server mode (consul.clj:21-66):
+    first node bootstraps, the rest retry-join it."""
+
+    dir = "/opt/consul"
+    binary = "consul"
+    logfile = "/opt/consul/consul.log"
+    pidfile = "/opt/consul/consul.pid"
+
+    def __init__(self, version: str = VERSION):
+        self.url = (f"https://releases.hashicorp.com/consul/{version}/"
+                    f"consul_{version}_linux_amd64.zip")
+
+    def setup(self, test, node) -> None:
+        with control.su():
+            cu.install_archive(self.url, self.dir)
+            args = ["agent", "-server", "-data-dir", f"{self.dir}/data",
+                    "-bind", node, "-client", "0.0.0.0",
+                    "-node", node]
+            if node == test["nodes"][0]:
+                args += ["-bootstrap-expect", "1"]
+            else:
+                args += ["-retry-join", test["nodes"][0]]
+            cu.start_daemon(f"{self.dir}/{self.binary}", *args,
+                            logfile=self.logfile, pidfile=self.pidfile,
+                            chdir=self.dir)
+
+    def teardown(self, test, node) -> None:
+        with control.su():
+            cu.stop_daemon(self.pidfile, binary=self.binary)
+            control.exec_("rm", "-rf", self.dir, may_fail=True)
+
+    def log_files(self, test, node) -> list[str]:
+        return [self.logfile]
+
+
+class ConsulClient(client_ns.Client):
+    """read / write / index-CAS over /v1/kv (consul.clj:95-146). Values
+    are JSON-encoded; reads decode the base64 payload Consul returns."""
+
+    def __init__(self, node: str | None = None):
+        self.node = node
+
+    def open(self, test, node):
+        return ConsulClient(node)
+
+    @property
+    def _url(self) -> str:
+        return f"http://{self.node}:8500/v1/kv/{KEY}"
+
+    def _get(self):
+        """Returns (modify_index, decoded value) or (None, None)."""
+        status, body = common.http_json("GET", self._url)
+        if status != 200 or not body:
+            return None, None
+        entry = body[0]
+        raw = base64.b64decode(entry["Value"]) if entry["Value"] else b""
+        val = json.loads(raw) if raw else None
+        return entry["ModifyIndex"], val
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                _, val = self._get()
+                return op.replace(type="ok", value=val)
+            if op.f == "write":
+                status, _ = common.http_json(
+                    "PUT", self._url, json.dumps(op.value))
+                return op.replace(type="ok" if status == 200 else "info")
+            if op.f == "cas":
+                old, new = op.value
+                index, cur = self._get()
+                if index is None or cur != old:
+                    return op.replace(type="fail")
+                status, body = common.http_json(
+                    "PUT", f"{self._url}?cas={index}", json.dumps(new))
+                ok = status == 200 and body is True
+                return op.replace(type="ok" if ok else "fail")
+        except OSError as e:
+            t = "fail" if op.f == "read" else "info"
+            return op.replace(type=t, error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+def test(opts: dict | None = None) -> dict:
+    """The consul test map (consul.clj:160-181)."""
+    return common.suite_test(
+        "consul", opts,
+        workload=workloads.single_register(),
+        db=ConsulDB(),
+        client=ConsulClient(),
+        nemesis=nemesis_ns.partition_random_halves(),
+        nemesis_gen=common.standard_nemesis_gen(5, 5))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    cli.main(cli.suite_commands(test), argv)
+
+
+if __name__ == "__main__":
+    main()

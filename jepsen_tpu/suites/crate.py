@@ -1,0 +1,205 @@
+"""CrateDB suite — dirty-read / lost-updates / version-divergence / set
+(crate/src/jepsen/crate/{core,dirty_read,lost_updates,version_divergence}.clj).
+
+Crate speaks SQL over HTTP (``/_sql``), so the wire client is a real
+stdlib HTTP client (the reference used the ES transport client).
+Workloads: the independent-keyed set (core.clj:117-121), the
+dirty-read probe (dirty_read.clj), and lost-updates (lost_updates.clj:141
+— concurrent updates to one row must all survive in the final value).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu.checker import FnChecker
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import common, workloads
+
+PORT = 4200
+
+
+class CrateDB(common.TarballDB):
+    """Tarball + unicast discovery (core.clj:40-100)."""
+
+    name = "crate"
+    dir = "/opt/crate"
+    binary = "bin/crate"
+
+    def __init__(self, version: str = "0.57.5"):
+        self.url = (f"https://cdn.crate.io/downloads/releases/"
+                    f"crate-{version}.tar.gz")
+
+    def post_install(self, test, node) -> None:
+        from jepsen_tpu import control, os_debian
+
+        os_debian.install_jdk()
+        hosts = ", ".join(f'"{n}:4300"' for n in test["nodes"])
+        config = (f"cluster.name: jepsen\nnode.name: {node}\n"
+                  f"network.host: {node}\n"
+                  f"discovery.zen.ping.unicast.hosts: [{hosts}]\n")
+        control.exec_("tee", f"{self.dir}/config/crate.yml", stdin=config)
+
+    def start_args(self, test, node) -> list:
+        return ["-d", "-p", self.pidfile]
+
+
+def sql(node: str, stmt: str, args=None, timeout: float = 10.0):
+    """POST /_sql (the HTTP endpoint the reference's transport client
+    wraps). Returns (status, body dict with "rows")."""
+    body: dict = {"stmt": stmt}
+    if args is not None:
+        body["args"] = args
+    return common.http_json("POST", f"http://{node}:{PORT}/_sql", body,
+                            timeout=timeout)
+
+
+class CrateSetClient(client_ns.Client):
+    """add = INSERT, read = SELECT with refresh (core.clj:117-121)."""
+
+    TABLE = "jepsen_set"
+
+    def __init__(self, node: str | None = None):
+        self.node = node
+
+    def open(self, test, node):
+        return CrateSetClient(node)
+
+    def setup(self, test) -> None:
+        sql(test["nodes"][0],
+            f"CREATE TABLE IF NOT EXISTS {self.TABLE} "
+            f"(id integer PRIMARY KEY)")
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add":
+                status, body = sql(self.node,
+                                   f"INSERT INTO {self.TABLE} (id) "
+                                   f"VALUES (?)", [op.value])
+                return op.replace(
+                    type="ok" if status == 200 else "info",
+                    error=None if status == 200 else body)
+            if op.f == "read":
+                sql(self.node, f"REFRESH TABLE {self.TABLE}", timeout=30)
+                status, body = sql(self.node,
+                                   f"SELECT id FROM {self.TABLE} "
+                                   f"LIMIT 1000000", timeout=30)
+                if status != 200:
+                    return op.replace(type="fail", error=body)
+                return op.replace(
+                    type="ok", value=sorted(r[0] for r in body["rows"]))
+        except OSError as e:
+            t = "fail" if op.f == "read" else "info"
+            return op.replace(type=t, error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+def lost_updates_checker() -> FnChecker:
+    """Every acknowledged update must appear in the final value
+    (lost_updates.clj:141): value is a collected list per key."""
+
+    def check(test, model, history, opts):
+        acked = set()
+        final = None
+        for op in history:
+            if op.f == "update" and op.is_ok:
+                acked.add(op.value)
+            elif op.f == "read" and op.is_ok and op.value is not None:
+                final = set(op.value)
+        if final is None:
+            return {"valid?": "unknown", "error": "no final read"}
+        lost = acked - final
+        return {"valid?": not lost, "lost": sorted(lost)[:10],
+                "lost-count": len(lost), "acked-count": len(acked)}
+
+    return FnChecker(check)
+
+
+def lost_updates_workload(n: int = 100, faulty=None) -> dict:
+    """Concurrent list-append updates to one row; the final read must
+    contain every acknowledged update (lost_updates.clj)."""
+    state = {"n": 0}
+    lock = threading.Lock()
+
+    class Store:
+        def __init__(self):
+            self.vals: list = []
+            self.lock = threading.Lock()
+            self._n = 0
+
+        def update(self, v):
+            with self.lock:
+                self._n += 1
+                if faulty == "lost-update" and self._n % 7 == 0:
+                    return True
+                self.vals.append(v)
+                return True
+
+        def read(self):
+            with self.lock:
+                return sorted(self.vals)
+
+    store = Store()
+
+    class Client(client_ns.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op: Op) -> Op:
+            if op.f == "update":
+                store.update(op.value)
+                return op.replace(type="ok")
+            if op.f == "read":
+                return op.replace(type="ok", value=store.read())
+            return op.replace(type="fail")
+
+    def update(test, process):
+        with lock:
+            v = state["n"]
+            state["n"] += 1
+        return {"type": "invoke", "f": "update", "value": v}
+
+    return {
+        "generator": gen.limit(n, gen.stagger(1 / 20, gen.gen(update))),
+        "final_generator": gen.once({"type": "invoke", "f": "read",
+                                     "value": None}),
+        "client": Client(),
+        "checker": lost_updates_checker(),
+        "model": None,
+    }
+
+
+def test(opts: dict | None = None) -> dict:
+    """The crate test map (core.clj:100-140 + runner.clj). ``workload``
+    picks set (default) / dirty-read / lost-updates."""
+    opts = dict(opts or {})
+    name = opts.pop("workload", None) or "set"
+    table = {"set": lambda: workloads.set_workload(),
+             "dirty-read": lambda: workloads.dirty_read_workload(),
+             "lost-updates": lambda: lost_updates_workload()}
+    if name not in table:
+        raise ValueError(f"unknown workload {name!r}")
+    return common.suite_test(
+        f"crate {name}", opts,
+        workload=table[name](),
+        db=CrateDB(),
+        client=CrateSetClient() if name == "set" else None,
+        nemesis=nemesis_ns.partition_random_halves(),
+        nemesis_gen=common.standard_nemesis_gen(10, 10))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    def opt_spec(p):
+        p.add_argument("--workload", default="set",
+                       choices=["set", "dirty-read", "lost-updates"])
+
+    cli.main(cli.suite_commands(test, opt_spec=opt_spec), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+"""Disque suite — distributed job queue (disque/src/jepsen/disque.clj).
+
+Enqueue/dequeue/ack of jobs checked by total-queue against the
+unordered-queue model (disque.clj:305-310): every acknowledged enqueue
+must eventually be dequeued exactly once after the final drain. Faults:
+partition-random-halves (disque.clj:321) and node kill/restart
+(disque.clj:268). The wire client speaks Disque's RESP dialect
+(ADDJOB/GETJOB/ACKJOB) via :mod:`jepsen_tpu.suites.resp` where the
+reference used jedisque.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import common, workloads
+from jepsen_tpu.suites.resp import RespClient, RespError
+
+VERSION = "2b04ba0a61069b4945bad2b16c90b49a30c80f33"
+QUEUE = "jepsen"
+PORT = 7711
+
+
+class DisqueDB(common.TarballDB):
+    """Source build + daemon (disque.clj:40-108): every node joins the
+    cluster via CLUSTER MEET after start."""
+
+    name = "disque"
+    dir = "/opt/disque"
+    binary = "disque-server"
+
+    def __init__(self, version: str = VERSION):
+        self.url = f"https://github.com/antirez/disque/archive/{version}.tar.gz"
+
+    def start_args(self, test, node) -> list:
+        return ["--port", str(PORT), "--appendonly", "yes",
+                "--cluster-enabled", "yes"]
+
+    def await_ready(self, test, node) -> None:
+        # CLUSTER MEET fan-in from the first node (disque.clj:88-99).
+        if node == test["nodes"][0]:
+            try:
+                c = RespClient(node, PORT, timeout=10)
+                for peer in test["nodes"][1:]:
+                    c.call("CLUSTER", "MEET", peer, str(PORT))
+                c.close()
+            except (OSError, RespError):
+                pass
+
+
+class DisqueClient(client_ns.Client):
+    """ADDJOB / GETJOB+ACKJOB over RESP (disque.clj:126-180)."""
+
+    def __init__(self, conn: RespClient | None = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return DisqueClient(RespClient(node, PORT))
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "enqueue":
+                self.conn.call("ADDJOB", QUEUE, str(op.value), "0",
+                               "RETRY", "1")
+                return op.replace(type="ok")
+            if op.f in ("dequeue", "drain"):
+                drained = []
+                while True:
+                    got = self.conn.call("GETJOB", "NOHANG", "FROM", QUEUE)
+                    if not got:
+                        break
+                    _, job_id, body = got[0]
+                    self.conn.call("ACKJOB", job_id)
+                    drained.append(int(body))
+                    if op.f == "dequeue":
+                        return op.replace(type="ok", value=drained[0])
+                if op.f == "drain":
+                    return op.replace(type="ok", value=drained)
+                return op.replace(type="fail")
+        except RespError as e:
+            return op.replace(type="fail", error=str(e))
+        except OSError as e:
+            t = "fail" if op.f in ("dequeue",) else "info"
+            return op.replace(type=t, error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()
+
+
+def test(opts: dict | None = None) -> dict:
+    """The disque test map (disque.clj:290-330)."""
+    return common.suite_test(
+        "disque", opts,
+        workload=workloads.queue_workload(),
+        db=DisqueDB(),
+        client=DisqueClient(),
+        nemesis=nemesis_ns.partition_random_halves(),
+        nemesis_gen=common.standard_nemesis_gen(5, 5))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    cli.main(cli.suite_commands(test), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+"""Elasticsearch suite — set + dirty-read
+(elasticsearch/src/jepsen/elasticsearch/{core,sets,dirty_read}.clj).
+
+Workloads: concurrent document indexing with a final search, validated
+by the set checker (core.clj:190-193), and the dirty-read probe
+(dirty_read.clj:112). Nemeses: hammer-time SIGSTOP pauses (core.clj:219)
+and the bridge partitioner (core.clj:259). The wire client speaks the
+HTTP JSON API directly (the reference used the ES transport client).
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import control
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import common, workloads
+
+VERSION = "5.0.0"
+INDEX = "jepsen"
+PORT = 9200
+
+
+class ElasticsearchDB(common.TarballDB):
+    """Tarball + unicast discovery config (core.clj:60-140)."""
+
+    name = "elasticsearch"
+    dir = "/opt/elasticsearch"
+    binary = "bin/elasticsearch"
+
+    def __init__(self, version: str = VERSION):
+        self.version = version
+        self.url = (f"https://artifacts.elastic.co/downloads/"
+                    f"elasticsearch/elasticsearch-{version}.tar.gz")
+
+    def post_install(self, test, node) -> None:
+        from jepsen_tpu import os_debian
+
+        os_debian.install_jdk()
+        hosts = ", ".join(f'"{n}"' for n in test["nodes"])
+        config = (f"cluster.name: jepsen\n"
+                  f"node.name: {node}\n"
+                  f"network.host: {node}\n"
+                  f"discovery.zen.ping.unicast.hosts: [{hosts}]\n"
+                  f"discovery.zen.minimum_master_nodes: "
+                  f"{len(test['nodes']) // 2 + 1}\n")
+        control.exec_("tee", f"{self.dir}/config/elasticsearch.yml",
+                      stdin=config)
+
+    def start_args(self, test, node) -> list:
+        return ["-d", "-p", self.pidfile]
+
+
+class EsSetClient(client_ns.Client):
+    """add = index a doc (wait_for refresh), read = match_all search
+    (sets.clj operations)."""
+
+    def __init__(self, node: str | None = None):
+        self.node = node
+
+    def open(self, test, node):
+        return EsSetClient(node)
+
+    def _base(self) -> str:
+        return f"http://{self.node}:{PORT}"
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add":
+                status, body = common.http_json(
+                    "PUT",
+                    f"{self._base()}/{INDEX}/doc/{op.value}"
+                    f"?refresh=wait_for",
+                    {"value": op.value}, timeout=10)
+                if status in (200, 201):
+                    return op.replace(type="ok")
+                return op.replace(type="info", error=body)
+            if op.f == "read":
+                common.http_json("POST", f"{self._base()}/{INDEX}/_refresh",
+                                 timeout=30)
+                status, body = common.http_json(
+                    "POST", f"{self._base()}/{INDEX}/_search",
+                    {"size": 10 ** 6,
+                     "query": {"match_all": {}}}, timeout=30)
+                if status != 200:
+                    return op.replace(type="fail", error=body)
+                vals = sorted(h["_source"]["value"]
+                              for h in body["hits"]["hits"])
+                return op.replace(type="ok", value=vals)
+        except OSError as e:
+            t = "fail" if op.f == "read" else "info"
+            return op.replace(type=t, error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+def test(opts: dict | None = None) -> dict:
+    """The elasticsearch set test map (core.clj:170-226). ``nemesis``
+    opt picks "hammer-time" (default) or "bridge" (core.clj:219,259)."""
+    opts = dict(opts or {})
+    nem = opts.pop("nemesis", None) or "hammer-time"
+    nemesis = (nemesis_ns.hammer_time("java") if nem == "hammer-time"
+               else nemesis_ns.partitioner(nemesis_ns.bridge))
+    return common.suite_test(
+        "elasticsearch", opts,
+        workload=workloads.set_workload(),
+        db=ElasticsearchDB(),
+        client=EsSetClient(),
+        nemesis=nemesis,
+        nemesis_gen=common.standard_nemesis_gen(10, 10))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    def opt_spec(p):
+        p.add_argument("--nemesis", default="hammer-time",
+                       choices=["hammer-time", "bridge"])
+
+    cli.main(cli.suite_commands(test, opt_spec=opt_spec), argv)
+
+
+if __name__ == "__main__":
+    main()

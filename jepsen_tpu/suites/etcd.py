@@ -1,0 +1,141 @@
+"""etcd suite — the canonical register test (etcd/src/jepsen/etcd.clj).
+
+Per-key CAS registers over etcd's HTTP KV API, checked linearizable on
+the device kernel via ``independent.checker``: tarball install
+(etcd.clj:51-86), 10 threads/key × 300 ops (etcd.clj:167-173),
+partition-random-halves nemesis on a 5s start/stop cycle
+(etcd.clj:159,173-178).
+
+The wire client speaks etcd's v2 HTTP API directly (the reference goes
+through the Verschlimmbesserung client, etcd.clj:93-143): reads are
+unquorum gets, CAS uses ``prevValue``.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import independent
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import common, workloads
+
+VERSION = "v3.1.5"
+
+
+def client_url(node: str) -> str:
+    return f"http://{node}:2379"
+
+
+def peer_url(node: str) -> str:
+    return f"http://{node}:2380"
+
+
+class EtcdDB(common.TarballDB):
+    """Tarball install + daemon flags (etcd.clj:51-86)."""
+
+    name = "etcd"
+    dir = "/opt/etcd"
+    binary = "etcd"
+
+    def __init__(self, version: str = VERSION):
+        self.version = version
+        self.url = (f"https://storage.googleapis.com/etcd/{version}/"
+                    f"etcd-{version}-linux-amd64.tar.gz")
+
+    def start_args(self, test, node) -> list:
+        initial = ",".join(f"{n}={peer_url(n)}" for n in test["nodes"])
+        return ["--name", node,
+                "--listen-peer-urls", peer_url(node),
+                "--listen-client-urls", client_url(node),
+                "--advertise-client-urls", client_url(node),
+                "--initial-cluster-state", "new",
+                "--initial-advertise-peer-urls", peer_url(node),
+                "--initial-cluster", initial,
+                "--log-output", "stdout"]
+
+
+class EtcdClient(client_ns.Client):
+    """CAS register over the v2 keys API (the operations of
+    etcd.clj:93-143: unquorum read, put, compare-and-swap)."""
+
+    def __init__(self, node: str | None = None):
+        self.node = node
+
+    def open(self, test, node):
+        return EtcdClient(node)
+
+    def _url(self, k) -> str:
+        return f"{client_url(self.node)}/v2/keys/jepsen/{k}"
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value if independent.is_tuple(op.value) else (None, op.value)
+
+        def join(val):
+            return independent.tuple_(k, val) \
+                if independent.is_tuple(op.value) else val
+
+        try:
+            if op.f == "read":
+                status, body = common.http_json(
+                    "GET", self._url(k) + "?quorum=false")
+                if status == 404:
+                    return op.replace(type="ok", value=join(None))
+                val = json.loads(body["node"]["value"]) \
+                    if status == 200 else None
+                if status != 200:
+                    return op.replace(type="fail", error=body)
+                return op.replace(type="ok", value=join(val))
+            if op.f == "write":
+                form = urllib.parse.urlencode({"value": json.dumps(v)})
+                status, body = common.http_json("PUT", self._url(k), form)
+                if status in (200, 201):
+                    return op.replace(type="ok")
+                return op.replace(type="info", error=body)
+            if op.f == "cas":
+                old, new = v
+                form = urllib.parse.urlencode(
+                    {"value": json.dumps(new),
+                     "prevValue": json.dumps(old)})
+                status, body = common.http_json("PUT", self._url(k), form)
+                if status == 200:
+                    return op.replace(type="ok")
+                if status in (404, 412):  # key missing / compare failed
+                    return op.replace(type="fail")
+                return op.replace(type="info", error=body)
+        except OSError as e:
+            # Reads are side-effect free: a timed-out read definitely
+            # didn't happen; writes/cas are indeterminate
+            # (etcd.clj:105-113 crash handling).
+            t = "fail" if op.f == "read" else "info"
+            return op.replace(type=t, error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+def test(opts: dict | None = None) -> dict:
+    """The etcd test map (etcd.clj:149-179). Concurrency is floored at
+    the per-key thread-group size — the reference instead errors out of
+    independent/concurrent-generator when given fewer workers."""
+    opts = dict(opts or {})
+    threads_per_key = 10
+    if opts.get("concurrency", 0) < threads_per_key:
+        opts["concurrency"] = threads_per_key
+    return common.suite_test(
+        "etcd", opts,
+        workload=workloads.register(threads_per_key=threads_per_key),
+        db=EtcdDB(),
+        client=EtcdClient(),
+        nemesis=nemesis_ns.partition_random_halves(),
+        nemesis_gen=common.standard_nemesis_gen(5, 5))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    cli.main(cli.suite_commands(test), argv)
+
+
+if __name__ == "__main__":
+    main()

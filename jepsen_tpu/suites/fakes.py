@@ -1,0 +1,378 @@
+"""In-memory fake clusters for no-cluster suite runs.
+
+The reference keeps a fake seam at every layer so tests run with zero
+infrastructure: `control/*dummy*` skips SSH (control.clj:15,274-281), the
+atom-db/atom-client pair backs core_test.clj's basic-cas-test
+(tests.clj:26-56), and cockroach's ``:jdbc-mode :pg-local`` swaps the
+cluster for localhost (cockroach.clj:141-152). This module is that seam
+for every suite workload: each fake implements one workload vocabulary
+against a lock-guarded in-process structure, so any suite's test map can
+run end-to-end (runner → history → checkers) by swapping its wire client
+for the workload fake.
+
+Each fake also supports *injected consistency bugs* (``faulty=...``) —
+stale reads, lost enqueues, double lock grants, non-atomic transfers,
+dirty reads — used by the test suite to prove the checkers actually catch
+the violations they claim to (the reference proves this with hand-built
+pathological histories, checker_test.clj:58-82).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu.history import Op
+
+
+class FakeKV:
+    """Linearizable per-key register store (read/write/cas).
+
+    faulty="stale-read": reads may return the previous value, which a
+    linearizability checker must eventually flag.
+    """
+
+    def __init__(self, faulty: str | None = None):
+        self.data: dict = {}
+        self.prev: dict = {}
+        self.lock = threading.Lock()
+        self.faulty = faulty
+        self._n = 0
+
+    def read(self, k):
+        with self.lock:
+            self._n += 1
+            if self.faulty == "stale-read" and self._n % 5 == 0 \
+                    and k in self.prev:
+                return self.prev[k]
+            return self.data.get(k)
+
+    def write(self, k, v) -> bool:
+        with self.lock:
+            self.prev[k] = self.data.get(k)
+            self.data[k] = v
+            return True
+
+    def cas(self, k, old, new) -> bool:
+        with self.lock:
+            if self.data.get(k) != old:
+                return False
+            self.prev[k] = self.data.get(k)
+            self.data[k] = new
+            return True
+
+
+class FakeSetStore:
+    """Grow-only set. faulty="lost-add": drops some acknowledged adds."""
+
+    def __init__(self, faulty: str | None = None):
+        self.items: set = set()
+        self.lock = threading.Lock()
+        self.faulty = faulty
+        self._n = 0
+
+    def add(self, v) -> bool:
+        with self.lock:
+            self._n += 1
+            if self.faulty == "lost-add" and self._n % 7 == 0:
+                return True  # acked but dropped
+            self.items.add(v)
+            return True
+
+    def read(self) -> list:
+        with self.lock:
+            return sorted(self.items)
+
+
+class FakeQueue:
+    """FIFO queue. faulty="lost-enqueue": acks then drops some enqueues;
+    faulty="duplicate": delivers some items twice."""
+
+    def __init__(self, faulty: str | None = None):
+        self.items: list = []
+        self.lock = threading.Lock()
+        self.faulty = faulty
+        self._n = 0
+
+    def enqueue(self, v) -> bool:
+        with self.lock:
+            self._n += 1
+            if self.faulty == "lost-enqueue" and self._n % 7 == 0:
+                return True
+            self.items.append(v)
+            return True
+
+    def dequeue(self):
+        with self.lock:
+            if not self.items:
+                return None
+            v = self.items.pop(0)
+            if self.faulty == "duplicate" and self._n % 5 == 0:
+                self.items.insert(0, v)
+            return v
+
+
+class FakeCounter:
+    """Atomic counter. faulty="lost-add": drops some increments."""
+
+    def __init__(self, faulty: str | None = None):
+        self.value = 0
+        self.lock = threading.Lock()
+        self.faulty = faulty
+        self._n = 0
+
+    def add(self, dt) -> bool:
+        with self.lock:
+            self._n += 1
+            if self.faulty == "lost-add" and self._n % 7 == 0:
+                return True
+            self.value += dt
+            return True
+
+    def read(self):
+        with self.lock:
+            return self.value
+
+
+class FakeLock:
+    """Distributed lock. faulty="double-grant": sometimes grants the lock
+    while held (the classic split-brain lock bug, which the Mutex model
+    must flag as non-linearizable)."""
+
+    def __init__(self, faulty: str | None = None):
+        self.owner = None
+        self.lock = threading.Lock()
+        self.faulty = faulty
+        self._n = 0
+
+    def acquire(self, who) -> bool:
+        with self.lock:
+            self._n += 1
+            if self.owner is None:
+                self.owner = who
+                return True
+            if self.faulty == "double-grant" and self._n % 3 == 0:
+                return True  # granted while held!
+            return False
+
+    def release(self, who) -> bool:
+        with self.lock:
+            if self.owner == who:
+                self.owner = None
+                return True
+            return False
+
+
+class FakeIdGen:
+    """Unique id source. faulty="duplicate": repeats some ids."""
+
+    def __init__(self, faulty: str | None = None):
+        self.n = 0
+        self.lock = threading.Lock()
+        self.faulty = faulty
+
+    def generate(self) -> int:
+        with self.lock:
+            self.n += 1
+            if self.faulty == "duplicate" and self.n % 6 == 0:
+                return self.n - 1
+            return self.n
+
+
+class FakeBank:
+    """Account balances with transfer transactions.
+
+    faulty="non-atomic": a reader can observe a transfer's debit without
+    its credit (the snapshot-isolation read-skew anomaly the bank
+    workload exists to catch)."""
+
+    def __init__(self, n: int = 5, total: int = 50,
+                 faulty: str | None = None):
+        self.balances = [total // n] * n
+        self.balances[0] += total - sum(self.balances)
+        self.lock = threading.Lock()
+        self.faulty = faulty
+        self._mid = None  # mid-transfer snapshot for the faulty mode
+        self._n = 0
+
+    def read(self) -> list[int]:
+        with self.lock:
+            self._n += 1
+            if self.faulty == "non-atomic" and self._mid is not None \
+                    and self._n % 4 == 0:
+                return list(self._mid)
+            return list(self.balances)
+
+    def transfer(self, frm: int, to: int, amount: int) -> bool:
+        with self.lock:
+            if self.balances[frm] < amount:
+                return False
+            self.balances[frm] -= amount
+            mid = list(self.balances)  # debit applied, credit not yet
+            self.balances[to] += amount
+            self._mid = mid
+            return True
+
+
+class FakeTable:
+    """Append-only table of (id, committed) rows for the dirty-read /
+    monotonic / sequential / comments workloads.
+
+    faulty="dirty-read": readers can see rows whose transaction later
+    aborted."""
+
+    def __init__(self, faulty: str | None = None):
+        self.rows: list = []          # committed ids, insertion order
+        self.uncommitted: list = []   # ids written but later aborted
+        self.lock = threading.Lock()
+        self.faulty = faulty
+        self._n = 0
+
+    def insert(self, v, commit: bool = True) -> bool:
+        with self.lock:
+            if commit:
+                self.rows.append(v)
+            else:
+                self.uncommitted.append(v)
+            return commit
+
+    def read(self) -> list:
+        with self.lock:
+            self._n += 1
+            if self.faulty == "dirty-read" and self.uncommitted \
+                    and self._n % 3 == 0:
+                return list(self.rows) + [self.uncommitted[-1]]
+            return list(self.rows)
+
+
+# --- clients over the fakes -------------------------------------------------
+
+
+class FakeClient(client_ns.Client):
+    """Base: binds a shared fake store; open() shares the store across
+    processes (one cluster, many connections)."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def open(self, test, node):
+        return type(self)(self.store)
+
+
+class KVClient(FakeClient):
+    """read/write/cas over FakeKV. Values are independent-key tuples
+    ``(k, v)`` or plain values keyed under None."""
+
+    def _split(self, op):
+        from jepsen_tpu import independent
+
+        if independent.is_tuple(op.value):
+            return op.value[0], op.value[1]
+        return None, op.value
+
+    def _join(self, op, k, v):
+        from jepsen_tpu import independent
+
+        if independent.is_tuple(op.value):
+            return independent.tuple_(k, v)
+        return v
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = self._split(op)
+        if op.f == "read":
+            got = self.store.read(k)
+            return op.replace(type="ok", value=self._join(op, k, got))
+        if op.f == "write":
+            self.store.write(k, v)
+            return op.replace(type="ok")
+        if op.f == "cas":
+            old, new = v
+            ok = self.store.cas(k, old, new)
+            return op.replace(type="ok" if ok else "fail")
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+class SetClient(FakeClient):
+    def invoke(self, test, op: Op) -> Op:
+        if op.f == "add":
+            self.store.add(op.value)
+            return op.replace(type="ok")
+        if op.f == "read":
+            return op.replace(type="ok", value=self.store.read())
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+class QueueClient(FakeClient):
+    def invoke(self, test, op: Op) -> Op:
+        if op.f == "enqueue":
+            self.store.enqueue(op.value)
+            return op.replace(type="ok")
+        if op.f == "dequeue":
+            v = self.store.dequeue()
+            if v is None:
+                return op.replace(type="fail")
+            return op.replace(type="ok", value=v)
+        if op.f == "drain":
+            # Emitted by gen.drain_queue: drain everything left.
+            drained = []
+            while True:
+                v = self.store.dequeue()
+                if v is None:
+                    break
+                drained.append(v)
+            return op.replace(type="ok", value=drained)
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+class CounterClient(FakeClient):
+    def invoke(self, test, op: Op) -> Op:
+        if op.f == "add":
+            self.store.add(op.value)
+            return op.replace(type="ok")
+        if op.f == "read":
+            return op.replace(type="ok", value=self.store.read())
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+class LockClient(FakeClient):
+    def __init__(self, store):
+        super().__init__(store)
+        self.me = object()
+
+    def invoke(self, test, op: Op) -> Op:
+        if op.f == "acquire":
+            ok = self.store.acquire(self.me)
+            return op.replace(type="ok" if ok else "fail")
+        if op.f == "release":
+            ok = self.store.release(self.me)
+            return op.replace(type="ok" if ok else "fail")
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+class IdGenClient(FakeClient):
+    def invoke(self, test, op: Op) -> Op:
+        if op.f == "generate":
+            return op.replace(type="ok", value=self.store.generate())
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+class BankClient(FakeClient):
+    def invoke(self, test, op: Op) -> Op:
+        if op.f == "read":
+            return op.replace(type="ok", value=self.store.read())
+        if op.f == "transfer":
+            t = op.value
+            ok = self.store.transfer(t["from"], t["to"], t["amount"])
+            return op.replace(type="ok" if ok else "fail")
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+class TableClient(FakeClient):
+    def invoke(self, test, op: Op) -> Op:
+        if op.f == "insert":
+            commit = not op.get("abort", False)
+            ok = self.store.insert(op.value, commit=commit)
+            return op.replace(type="ok" if ok else "fail")
+        if op.f == "read":
+            return op.replace(type="ok", value=self.store.read())
+        return op.replace(type="fail", error=f"unknown f {op.f}")

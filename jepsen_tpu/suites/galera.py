@@ -1,0 +1,86 @@
+"""Galera (MariaDB cluster) suite — bank set + dirty reads
+(galera/src/jepsen/galera.clj + galera/dirty_reads.clj).
+
+Workloads: the bank-style invariant set (galera.clj:256-258) and the
+dirty-reads probe (dirty_reads.clj:77): readers must never observe rows
+from aborted transactions. Nemesis: partition-random-halves
+(galera.clj:195). DB install provisions mariadb-server with a wsrep
+cluster address over all nodes (galera.clj:40-150).
+
+MySQL's wire protocol needs a driver (the reference uses JDBC); the
+client is gated and no-cluster runs use the workload fakes.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import control
+from jepsen_tpu import db as db_ns
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu import os_debian
+from jepsen_tpu.suites import common, workloads
+
+
+class GaleraDB(db_ns.DB, db_ns.LogFiles):
+    """mariadb + wsrep cluster config (galera.clj:40-150)."""
+
+    def setup(self, test, node) -> None:
+        with control.su():
+            os_debian.install(["mariadb-server", "galera-3", "rsync"])
+            cluster = ",".join(test["nodes"])
+            config = f"""[mysqld]
+bind-address=0.0.0.0
+wsrep_on=ON
+wsrep_provider=/usr/lib/galera/libgalera_smm.so
+wsrep_cluster_address=gcomm://{cluster}
+wsrep_cluster_name=jepsen
+wsrep_node_address={node}
+wsrep_sst_method=rsync
+binlog_format=ROW
+default_storage_engine=InnoDB
+innodb_autoinc_lock_mode=2
+"""
+            control.exec_("tee", "/etc/mysql/conf.d/galera.cnf",
+                          stdin=config)
+            if node == test["nodes"][0]:
+                control.exec_("galera_new_cluster", may_fail=True)
+            else:
+                control.exec_("service", "mysql", "restart")
+
+    def teardown(self, test, node) -> None:
+        with control.su():
+            control.exec_("service", "mysql", "stop", may_fail=True)
+
+    def log_files(self, test, node) -> list[str]:
+        return ["/var/log/mysql/error.log"]
+
+
+def test(opts: dict | None = None) -> dict:
+    """The galera test map (galera.clj:240-270). ``workload`` picks
+    bank (default) or dirty-reads."""
+    opts = dict(opts or {})
+    name = opts.pop("workload", None) or "bank"
+    wl = workloads.bank_workload() if name == "bank" \
+        else workloads.dirty_read_workload()
+    return common.suite_test(
+        f"galera {name}", opts,
+        workload=wl,
+        db=GaleraDB(),
+        client=common.GatedClient(
+            "the MySQL wire protocol needs a driver (reference uses "
+            "JDBC); run with --fake"),
+        nemesis=nemesis_ns.partition_random_halves(),
+        nemesis_gen=common.standard_nemesis_gen(5, 5))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    def opt_spec(p):
+        p.add_argument("--workload", default="bank",
+                       choices=["bank", "dirty-reads"])
+
+    cli.main(cli.suite_commands(test, opt_spec=opt_spec), argv)
+
+
+if __name__ == "__main__":
+    main()

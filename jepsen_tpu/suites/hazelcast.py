@@ -1,0 +1,87 @@
+"""Hazelcast suite — the multi-workload registry
+(hazelcast/src/jepsen/hazelcast.clj).
+
+The reference's richest workload table (hazelcast.clj:364-399):
+crdt-map / map (set semantics), **lock** (the Mutex-model workload whose
+histories are BASELINE config #3's shape — checked linearizable on the
+device mutex kernel), queue (total-queue), and three unique-id
+generators. Nemesis: partition-majorities-ring on a 30s/15s start-stop
+cycle (hazelcast.clj:403-427). ``--workload`` selects, exactly like the
+reference's opt-spec (hazelcast.clj:433-439).
+
+Hazelcast only speaks its Java client protocol, so wire clients are
+gated; every workload runs no-cluster against its fake.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu import os_debian
+from jepsen_tpu.suites import common, workloads
+
+
+def hazelcast_workloads() -> dict:
+    """workload name -> workload map (hazelcast.clj:364-399)."""
+    return {
+        "crdt-map": workloads.set_workload(),
+        "map": workloads.set_workload(),
+        "lock": workloads.lock_workload(),
+        "queue": workloads.queue_workload(),
+        "atomic-ref-ids": workloads.ids_workload(),
+        "atomic-long-ids": workloads.ids_workload(),
+        "id-gen-ids": workloads.ids_workload(),
+    }
+
+
+class HazelcastDB(common.TarballDB):
+    """Uberjar server upload + java daemon (hazelcast.clj:59-120: the
+    reference builds a bundled server project and scps the jar)."""
+
+    name = "hazelcast"
+    dir = "/opt/hazelcast"
+    binary = "java"
+
+    def __init__(self, jar: str = "hazelcast-server.jar"):
+        self.url = None
+        self.jar = jar
+
+    def post_install(self, test, node) -> None:
+        os_debian.install_jdk()
+
+    def start_args(self, test, node) -> list:
+        members = ",".join(test["nodes"])
+        return ["-jar", f"{self.dir}/{self.jar}", "--members", members]
+
+
+def test(opts: dict | None = None) -> dict:
+    """The hazelcast test map (hazelcast.clj:400-433)."""
+    opts = dict(opts or {})
+    name = opts.pop("workload", None) or "lock"
+    table = hazelcast_workloads()
+    if name not in table:
+        raise ValueError(
+            f"unknown workload {name!r}; one of {sorted(table)}")
+    return common.suite_test(
+        f"hazelcast {name}", opts,
+        workload=table[name],
+        db=HazelcastDB(),
+        client=common.GatedClient(
+            "hazelcast speaks its Java client protocol only; "
+            "run with --fake"),
+        nemesis=nemesis_ns.partition_majorities_ring(),
+        nemesis_gen=common.standard_nemesis_gen(30, 15))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    def opt_spec(p):
+        p.add_argument("--workload", default="lock",
+                       choices=sorted(hazelcast_workloads()),
+                       help="test workload to run (hazelcast.clj:433-439)")
+
+    cli.main(cli.suite_commands(test, opt_spec=opt_spec), argv)
+
+
+if __name__ == "__main__":
+    main()

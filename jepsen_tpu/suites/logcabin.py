@@ -1,0 +1,141 @@
+"""LogCabin suite — CAS register via the TreeOps CLI
+(logcabin/src/jepsen/logcabin.clj).
+
+LogCabin is the Raft reference implementation; its test drives a CAS
+register through the ``TreeOps`` binary executed *on the node over the
+control plane* (logcabin.clj:163-204) — reads/writes pipe through
+``echo -n value | TreeOps -c <servers> write <path>``, CAS adds the
+``-p path:oldvalue`` condition. This is the one suite whose client IS
+the SSH layer, so it exercises the control plane end to end.
+"""
+
+from __future__ import annotations
+
+import json
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import control
+from jepsen_tpu import db as db_ns
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu.control import RemoteError
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import common, workloads
+
+TREEOPS = "/root/TreeOps"
+PATH = "/jepsen"
+OP_TIMEOUT = 3
+
+
+def server_addrs(test) -> str:
+    return ",".join(f"{n}:5254" for n in test["nodes"])
+
+
+class LogCabinDB(db_ns.DB, db_ns.LogFiles):
+    """Build-from-source install + daemon bootstrap: first node
+    bootstraps the Raft config, all run logcabind (logcabin.clj:36-140)."""
+
+    dir = "/root/logcabin"
+    storage = "/root/storage"
+    logfile = "/root/logcabin.log"
+    pidfile = "/root/logcabin.pid"
+
+    def _config(self, test, node) -> str:
+        sid = test["nodes"].index(node) + 1
+        return (f"serverId = {sid}\n"
+                f"listenAddresses = {node}:5254\n"
+                f"storagePath = {self.storage}\n")
+
+    def setup(self, test, node) -> None:
+        with control.su():
+            control.exec_("tee", "/root/logcabin.conf",
+                          stdin=self._config(test, node))
+            if node == test["nodes"][0]:
+                control.exec_(f"{self.dir}/build/LogCabin",
+                              "--config", "/root/logcabin.conf",
+                              "--bootstrap", may_fail=True)
+            from jepsen_tpu.control import util as cu
+
+            cu.start_daemon(f"{self.dir}/build/LogCabin",
+                            "--config", "/root/logcabin.conf",
+                            logfile=self.logfile, pidfile=self.pidfile,
+                            chdir="/root")
+
+    def teardown(self, test, node) -> None:
+        from jepsen_tpu.control import util as cu
+
+        with control.su():
+            cu.stop_daemon(self.pidfile, binary="LogCabin")
+            control.exec_("rm", "-rf", self.storage, may_fail=True)
+
+    def log_files(self, test, node) -> list[str]:
+        return [self.logfile]
+
+
+class LogCabinClient(client_ns.Client):
+    """read/write/cas through TreeOps over the control plane
+    (logcabin.clj:163-246): CAS failure is reported by message match."""
+
+    def __init__(self, node: str | None = None):
+        self.node = node
+
+    def open(self, test, node):
+        return LogCabinClient(node)
+
+    def _treeops(self, test, *args, stdin=None) -> str:
+        def go():
+            with control.su(), control.cd("/root"):
+                return control.exec_(TREEOPS, "-c", server_addrs(test),
+                                     "-q", "-t", OP_TIMEOUT, *args,
+                                     stdin=stdin)
+        return control.on(test, self.node, go)
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                raw = self._treeops(test, "read", PATH)
+                return op.replace(type="ok",
+                                  value=json.loads(raw) if raw else None)
+            if op.f == "write":
+                self._treeops(test, "write", PATH,
+                              stdin=json.dumps(op.value))
+                return op.replace(type="ok")
+            if op.f == "cas":
+                old, new = op.value
+                try:
+                    self._treeops(test, "-p", f"{PATH}:{json.dumps(old)}",
+                                  "write", PATH, stdin=json.dumps(new))
+                    return op.replace(type="ok")
+                except RemoteError as e:
+                    if "CONDITION_NOT_MET" in str(e):
+                        return op.replace(type="fail")
+                    raise
+        except RemoteError as e:
+            if "timeout" in str(e).lower():
+                t = "fail" if op.f == "read" else "info"
+                return op.replace(type=t, error="timed-out")
+            raise
+        except OSError as e:
+            t = "fail" if op.f == "read" else "info"
+            return op.replace(type=t, error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+def test(opts: dict | None = None) -> dict:
+    """The logcabin test map (logcabin.clj:253-282)."""
+    return common.suite_test(
+        "logcabin", opts,
+        workload=workloads.single_register(),
+        db=LogCabinDB(),
+        client=LogCabinClient(),
+        nemesis=nemesis_ns.partition_random_halves(),
+        nemesis_gen=common.standard_nemesis_gen(5, 5))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    cli.main(cli.suite_commands(test), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""MongoDB-RocksDB suite — perf-only harness
+(mongodb-rocks/src/jepsen/mongodb_rocks.clj).
+
+The reference's one performance-focused suite (:163): generate document
+insert load, no safety checker beyond the perf graphs. DB install swaps
+mongod's storage engine to RocksDB. The Mongo wire protocol (OP_MSG)
+needs a driver, so the client is gated; no-cluster runs drive the
+workload fake and still exercise the latency/rate graph pipeline.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import control
+from jepsen_tpu import db as db_ns
+from jepsen_tpu import os_debian
+from jepsen_tpu.suites import common, workloads
+
+
+class MongoRocksDB(db_ns.DB, db_ns.LogFiles):
+    """mongod with --storageEngine rocksdb (mongodb_rocks.clj:40-120)."""
+
+    def setup(self, test, node) -> None:
+        with control.su():
+            os_debian.install(["mongodb-org-server"])
+            control.exec_("mkdir", "-p", "/var/lib/mongodb-rocks")
+            from jepsen_tpu.control import util as cu
+
+            cu.start_daemon("/usr/bin/mongod",
+                            "--storageEngine", "rocksdb",
+                            "--dbpath", "/var/lib/mongodb-rocks",
+                            "--bind_ip", node,
+                            logfile="/var/log/mongod-rocks.log",
+                            pidfile="/var/run/mongod-rocks.pid",
+                            chdir="/var/lib/mongodb-rocks")
+
+    def teardown(self, test, node) -> None:
+        from jepsen_tpu.control import util as cu
+
+        with control.su():
+            cu.stop_daemon("/var/run/mongod-rocks.pid", binary="mongod")
+            control.exec_("rm", "-rf", "/var/lib/mongodb-rocks",
+                          may_fail=True)
+
+    def log_files(self, test, node) -> list[str]:
+        return ["/var/log/mongod-rocks.log"]
+
+
+def test(opts: dict | None = None) -> dict:
+    """The perf test map (mongodb_rocks.clj:140-170): insert-heavy load,
+    perf graphs as the only analysis."""
+    return common.suite_test(
+        "mongodb-rocks", opts,
+        workload=workloads.dirty_read_workload(abort_prob=0.0),
+        db=MongoRocksDB(),
+        client=common.GatedClient(
+            "the Mongo wire protocol needs a driver; run with --fake"))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    cli.main(cli.suite_commands(test), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""MongoDB-on-SmartOS suite — document CAS + bank transfer
+(mongodb-smartos/src/jepsen/mongodb_smartos/{core,document_cas,transfer}.clj).
+
+The one suite that runs on SmartOS (os/smartos.clj pkgin provisioning —
+core.clj:60-150 installs mongod via pkgin and drives it through svcadm).
+Workloads: per-document CAS register checked linearizable
+(document_cas.clj, core.clj:390-392 — the reference defines a custom
+knossos Model inline at core.clj:34,198-205; here the stock
+cas-register device kernel covers it) and the bank transfer
+(transfer.clj). Mongo wire protocol gated.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import control
+from jepsen_tpu import db as db_ns
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu import os_smartos
+from jepsen_tpu.suites import common, workloads
+
+
+class MongoSmartosDB(db_ns.DB, db_ns.LogFiles):
+    """pkgin install + replica-set init via svcadm
+    (mongodb_smartos/core.clj:60-200)."""
+
+    def setup(self, test, node) -> None:
+        with control.su():
+            control.exec_("pkgin", "-y", "install", "mongodb",
+                          may_fail=True)
+            config = (f"replication:\n  replSetName: jepsen\n"
+                      f"net:\n  bindIp: {node}\n")
+            control.exec_("tee", "/opt/local/etc/mongod.conf",
+                          stdin=config)
+            control.exec_("svcadm", "enable", "mongodb", may_fail=True)
+
+    def teardown(self, test, node) -> None:
+        with control.su():
+            control.exec_("svcadm", "disable", "mongodb", may_fail=True)
+            control.exec_("rm", "-rf", "/var/mongodb", may_fail=True)
+
+    def log_files(self, test, node) -> list[str]:
+        return ["/var/log/mongodb/mongod.log"]
+
+
+def test(opts: dict | None = None) -> dict:
+    """The mongodb-smartos test map (core.clj:360-400). ``workload``
+    picks document-cas (default) or transfer."""
+    opts = dict(opts or {})
+    name = opts.pop("workload", None) or "document-cas"
+    wl = workloads.register() if name == "document-cas" \
+        else workloads.bank_workload()
+    if name == "document-cas":
+        threads_per_key = 10
+        if opts.get("concurrency", 0) < threads_per_key:
+            opts["concurrency"] = threads_per_key
+    return common.suite_test(
+        f"mongodb-smartos {name}", opts,
+        workload=wl,
+        db=MongoSmartosDB(),
+        client=common.GatedClient(
+            "the Mongo wire protocol needs a driver; run with --fake"),
+        os=os_smartos.os,
+        nemesis=nemesis_ns.partition_random_halves(),
+        nemesis_gen=common.standard_nemesis_gen(5, 5))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    def opt_spec(p):
+        p.add_argument("--workload", default="document-cas",
+                       choices=["document-cas", "transfer"])
+
+    cli.main(cli.suite_commands(test, opt_spec=opt_spec), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""MySQL Cluster (NDB) suite — infrastructure-only bring-up
+(mysql-cluster/src/jepsen/mysql_cluster.clj).
+
+The reference suite is a `simple-test` (:223-227) whose substance is the
+three-daemon NDB orchestration (:188-216): management daemon (ndb_mgmd)
+on the first node, data nodes (ndbd) on the rest, mysqld on all —
+verifying the harness can sequence a heterogeneous cluster. No workload
+checker beyond unbridled optimism; the fake path exercises the runner.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import control
+from jepsen_tpu import db as db_ns
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu import os_debian
+from jepsen_tpu.suites import common, workloads
+
+
+class NdbCluster(db_ns.DB, db_ns.LogFiles):
+    """Three-daemon orchestration (mysql_cluster.clj:188-216): mgmd on
+    node 1, ndbd on the others, mysqld everywhere."""
+
+    def _config_ini(self, test) -> str:
+        mgm = test["nodes"][0]
+        sections = [f"[ndb_mgmd]\nhostname={mgm}\ndatadir=/var/lib/ndb"]
+        for n in test["nodes"][1:]:
+            sections.append(f"[ndbd]\nhostname={n}\ndatadir=/var/lib/ndb")
+        sections.append("[mysqld]\n" * len(test["nodes"]))
+        return "[ndbd default]\nNoOfReplicas=2\n\n" + "\n\n".join(sections)
+
+    def setup(self, test, node) -> None:
+        with control.su():
+            os_debian.install(["mysql-cluster-community-server"])
+            control.exec_("mkdir", "-p", "/var/lib/ndb")
+            if node == test["nodes"][0]:
+                control.exec_("tee", "/var/lib/ndb/config.ini",
+                              stdin=self._config_ini(test))
+                control.exec_("ndb_mgmd", "-f", "/var/lib/ndb/config.ini",
+                              "--initial", may_fail=True)
+            else:
+                control.exec_("ndbd",
+                              f"--ndb-connectstring={test['nodes'][0]}",
+                              may_fail=True)
+            control.exec_("service", "mysql", "restart", may_fail=True)
+
+    def teardown(self, test, node) -> None:
+        with control.su():
+            control.exec_("service", "mysql", "stop", may_fail=True)
+            control.exec_("pkill", "-9", "ndbd", may_fail=True)
+            control.exec_("pkill", "-9", "ndb_mgmd", may_fail=True)
+            control.exec_("rm", "-rf", "/var/lib/ndb", may_fail=True)
+
+    def log_files(self, test, node) -> list[str]:
+        return ["/var/lib/ndb/ndb_1_cluster.log"]
+
+
+def test(opts: dict | None = None) -> dict:
+    """The simple-test map (mysql_cluster.clj:223-227): cluster cycles
+    up and down; generator is a light read load."""
+    return common.suite_test(
+        "mysql-cluster", opts,
+        workload=workloads.counter_workload(n=50),
+        db=NdbCluster(),
+        client=common.GatedClient(
+            "the MySQL wire protocol needs a driver; run with --fake"),
+        nemesis=nemesis_ns.partition_random_halves(),
+        nemesis_gen=common.standard_nemesis_gen(10, 10))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    cli.main(cli.suite_commands(test), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Percona XtraDB cluster suite — bank + dirty reads
+(percona/src/jepsen/percona.clj + percona/dirty_reads.clj).
+
+Same workload dialects as galera (bank invariant, percona.clj:77 custom
+checker; dirty reads, percona.clj:319) over Percona's XtraDB cluster
+packages. Nemesis: partition-random-halves (percona.clj:212). MySQL
+wire protocol gated as in the galera suite.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import control
+from jepsen_tpu import db as db_ns
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu import os_debian
+from jepsen_tpu.suites import common, workloads
+
+
+class PerconaDB(db_ns.DB, db_ns.LogFiles):
+    """percona-xtradb-cluster install + wsrep config
+    (percona.clj:40-180)."""
+
+    def setup(self, test, node) -> None:
+        with control.su():
+            os_debian.install(["percona-xtradb-cluster-57"])
+            cluster = ",".join(test["nodes"])
+            config = f"""[mysqld]
+wsrep_provider=/usr/lib/galera3/libgalera_smm.so
+wsrep_cluster_address=gcomm://{cluster}
+wsrep_node_address={node}
+wsrep_cluster_name=jepsen
+wsrep_sst_method=rsync
+pxc_strict_mode=ENFORCING
+binlog_format=ROW
+default_storage_engine=InnoDB
+innodb_autoinc_lock_mode=2
+"""
+            control.exec_("tee", "/etc/mysql/percona-xtradb-cluster.conf.d/"
+                          "jepsen.cnf", stdin=config)
+            if node == test["nodes"][0]:
+                control.exec_("service", "mysql", "bootstrap-pxc",
+                              may_fail=True)
+            else:
+                control.exec_("service", "mysql", "restart")
+
+    def teardown(self, test, node) -> None:
+        with control.su():
+            control.exec_("service", "mysql", "stop", may_fail=True)
+
+    def log_files(self, test, node) -> list[str]:
+        return ["/var/log/mysqld.log"]
+
+
+def test(opts: dict | None = None) -> dict:
+    """The percona test map (percona.clj:200-240)."""
+    opts = dict(opts or {})
+    name = opts.pop("workload", None) or "bank"
+    wl = workloads.bank_workload() if name == "bank" \
+        else workloads.dirty_read_workload()
+    return common.suite_test(
+        f"percona {name}", opts,
+        workload=wl,
+        db=PerconaDB(),
+        client=common.GatedClient(
+            "the MySQL wire protocol needs a driver; run with --fake"),
+        nemesis=nemesis_ns.partition_random_halves(),
+        nemesis_gen=common.standard_nemesis_gen(5, 5))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    def opt_spec(p):
+        p.add_argument("--workload", default="bank",
+                       choices=["bank", "dirty-reads"])
+
+    cli.main(cli.suite_commands(test, opt_spec=opt_spec), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,173 @@
+"""Minimal PostgreSQL wire-protocol (v3) client.
+
+The reference's SQL suites reach CockroachDB and Postgres-RDS through
+JDBC (cockroachdb/src/jepsen/cockroach/client.clj). The TPU build speaks
+the wire protocol directly from the stdlib instead of vendoring a
+driver: startup, trust/cleartext/md5 auth, and the simple-query flow —
+enough for the bank/register/sets/monotonic workload SQL.
+
+Protocol framing: every backend message is ``type:1 len:4 payload``;
+StartupMessage has no type byte. Simple query sends ``Q`` and reads
+RowDescription / DataRow / CommandComplete / ErrorResponse until
+ReadyForQuery.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+
+
+class PgError(Exception):
+    """ErrorResponse from the server; carries the severity/code/message
+    fields keyed by their protocol tags."""
+
+    def __init__(self, fields: dict):
+        self.fields = fields
+        super().__init__(fields.get("M", "postgres error"))
+
+    @property
+    def code(self) -> str:
+        return self.fields.get("C", "")
+
+    @property
+    def retryable(self) -> bool:
+        # 40001 serialization_failure / 40P01 deadlock — the txn retry
+        # loop of cockroach/client.clj wraps exactly these.
+        return self.code in ("40001", "40P01", "CR000")
+
+
+class PgClient:
+    def __init__(self, host: str, port: int = 5432, user: str = "root",
+                 database: str = "postgres", password: str = "",
+                 timeout: float = 10.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.buf = b""
+        self.user = user
+        self.password = password
+        self._startup(user, database)
+
+    # --- low-level framing ---------------------------------------------------
+
+    def _send(self, type_byte: bytes, payload: bytes) -> None:
+        msg = type_byte + struct.pack("!I", len(payload) + 4) + payload
+        self.sock.sendall(msg)
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("connection closed")
+            self.buf += chunk
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+    def _read_msg(self) -> tuple[bytes, bytes]:
+        head = self._read_exact(5)
+        t = head[:1]
+        (n,) = struct.unpack("!I", head[1:])
+        return t, self._read_exact(n - 4)
+
+    @staticmethod
+    def _cstr(b: bytes) -> str:
+        return b.split(b"\x00", 1)[0].decode()
+
+    @staticmethod
+    def _error_fields(payload: bytes) -> dict:
+        fields = {}
+        for part in payload.split(b"\x00"):
+            if part:
+                fields[chr(part[0])] = part[1:].decode(errors="replace")
+        return fields
+
+    # --- startup / auth ------------------------------------------------------
+
+    def _startup(self, user: str, database: str) -> None:
+        params = (f"user\x00{user}\x00database\x00{database}\x00\x00"
+                  .encode())
+        payload = struct.pack("!I", 196608) + params  # protocol 3.0
+        self.sock.sendall(struct.pack("!I", len(payload) + 4) + payload)
+        while True:
+            t, body = self._read_msg()
+            if t == b"R":
+                (kind,) = struct.unpack("!I", body[:4])
+                if kind == 0:            # AuthenticationOk
+                    continue
+                if kind == 3:            # cleartext password
+                    self._send(b"p", self.password.encode() + b"\x00")
+                    continue
+                if kind == 5:            # md5
+                    salt = body[4:8]
+                    inner = hashlib.md5(
+                        (self.password + self.user).encode()).hexdigest()
+                    outer = hashlib.md5(
+                        inner.encode() + salt).hexdigest()
+                    self._send(b"p", b"md5" + outer.encode() + b"\x00")
+                    continue
+                raise PgError({"M": f"unsupported auth method {kind}"})
+            if t == b"E":
+                raise PgError(self._error_fields(body))
+            if t == b"Z":                # ReadyForQuery
+                return
+            # ParameterStatus (S), BackendKeyData (K), NoticeResponse (N)
+            if t not in (b"S", b"K", b"N"):
+                raise PgError({"M": f"unexpected startup message {t!r}"})
+
+    # --- simple query --------------------------------------------------------
+
+    def query(self, sql: str) -> list[tuple]:
+        """Run one simple-protocol query; returns rows as tuples of
+        str|None. DDL/DML with no result set returns []. Raises
+        :class:`PgError` on ErrorResponse (after draining to
+        ReadyForQuery, so the connection stays usable)."""
+        self._send(b"Q", sql.encode() + b"\x00")
+        rows: list[tuple] = []
+        err: PgError | None = None
+        while True:
+            t, body = self._read_msg()
+            if t == b"D":
+                (ncol,) = struct.unpack("!H", body[:2])
+                off = 2
+                row = []
+                for _ in range(ncol):
+                    (ln,) = struct.unpack("!i", body[off:off + 4])
+                    off += 4
+                    if ln == -1:
+                        row.append(None)
+                    else:
+                        row.append(body[off:off + ln].decode())
+                        off += ln
+                rows.append(tuple(row))
+            elif t == b"E":
+                err = PgError(self._error_fields(body))
+            elif t == b"Z":
+                if err is not None:
+                    raise err
+                return rows
+            # T RowDescription, C CommandComplete, N notice, I empty — skip
+
+    def txn(self, statements: list[str], max_retries: int = 5) -> list:
+        """Run statements in a transaction with the serialization-failure
+        retry loop of cockroach/client.clj's with-txn-retry."""
+        for attempt in range(max_retries):
+            try:
+                self.query("BEGIN")
+                out = [self.query(s) for s in statements]
+                self.query("COMMIT")
+                return out
+            except PgError as e:
+                try:
+                    self.query("ROLLBACK")
+                except (PgError, ConnectionError):
+                    pass
+                if not e.retryable or attempt == max_retries - 1:
+                    raise
+        raise AssertionError("unreachable")
+
+    def close(self) -> None:
+        try:
+            self._send(b"X", b"")
+            self.sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,112 @@
+"""Postgres-RDS suite — bank invariant against a managed cloud database
+(postgres-rds/src/jepsen/postgres_rds.clj).
+
+The "nodes-less" client pattern (SURVEY §2.3): there is no DB setup or
+nemesis — the system under test is an RDS endpoint outside the cluster
+(postgres_rds.clj:238-293 runs the bank checker against it). The wire
+client speaks the PostgreSQL protocol directly
+(:mod:`jepsen_tpu.suites.pgwire`) with the serialization-failure retry
+loop; pass ``host`` / ``user`` / ``password`` / ``dbname`` in opts.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import common, workloads
+from jepsen_tpu.suites.pgwire import PgClient, PgError
+
+TABLE = "jepsen_accounts"
+
+
+class RdsBankClient(client_ns.Client):
+    """Bank transfers in SERIALIZABLE transactions over pgwire
+    (postgres_rds.clj:80-230)."""
+
+    def __init__(self, opts: dict | None = None,
+                 conn: PgClient | None = None):
+        self.opts = opts or {}
+        self.conn = conn
+
+    def open(self, test, node):
+        o = self.opts
+        conn = PgClient(o.get("host", node),
+                        port=int(o.get("port", 5432)),
+                        user=o.get("user", "jepsen"),
+                        database=o.get("dbname", "jepsen"),
+                        password=o.get("password", ""))
+        return RdsBankClient(o, conn)
+
+    def setup(self, test) -> None:
+        o = self.opts
+        conn = PgClient(o.get("host", test["nodes"][0]),
+                        port=int(o.get("port", 5432)),
+                        user=o.get("user", "jepsen"),
+                        database=o.get("dbname", "jepsen"),
+                        password=o.get("password", ""))
+        try:
+            conn.query(f"CREATE TABLE IF NOT EXISTS {TABLE} "
+                       f"(id int PRIMARY KEY, balance int NOT NULL)")
+            n, total = 5, 50
+            for i in range(n):
+                conn.query(f"INSERT INTO {TABLE} VALUES "
+                           f"({i}, {total // n}) "
+                           f"ON CONFLICT (id) DO NOTHING")
+        finally:
+            conn.close()
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                rows = self.conn.query(
+                    f"SELECT id, balance FROM {TABLE} ORDER BY id")
+                return op.replace(type="ok",
+                                  value=[int(b) for _, b in rows])
+            if op.f == "transfer":
+                t = op.value
+                try:
+                    self.conn.txn([
+                        "SET TRANSACTION ISOLATION LEVEL SERIALIZABLE",
+                        f"UPDATE {TABLE} SET balance = balance - "
+                        f"{t['amount']} WHERE id = {t['from']} "
+                        f"AND balance >= {t['amount']}",
+                        f"UPDATE {TABLE} SET balance = balance + "
+                        f"{t['amount']} WHERE id = {t['to']}",
+                    ])
+                    return op.replace(type="ok")
+                except PgError:
+                    return op.replace(type="fail")
+        except (OSError, ConnectionError) as e:
+            t = "fail" if op.f == "read" else "info"
+            return op.replace(type=t, error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()
+
+
+def test(opts: dict | None = None) -> dict:
+    """The postgres-rds test map (postgres_rds.clj:238-293): no DB/OS
+    hooks, no nemesis — just clients and the bank checker."""
+    opts = dict(opts or {})
+    return common.suite_test(
+        "postgres-rds", opts,
+        workload=workloads.bank_workload(),
+        client=RdsBankClient(opts))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    def opt_spec(p):
+        p.add_argument("--host", help="RDS endpoint hostname")
+        p.add_argument("--user", default="jepsen")
+        p.add_argument("--db-password", dest="password", default="")
+        p.add_argument("--dbname", default="jepsen")
+
+    cli.main(cli.suite_commands(test, opt_spec=opt_spec), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""RabbitMQ suite — queue + distributed mutex
+(rabbitmq/src/jepsen/rabbitmq.clj).
+
+Two workloads: the job queue checked by total-queue
+(rabbitmq.clj:100-170), and the **message-holding semaphore mutex**
+(rabbitmq.clj:263) — a lock built from a 1-message queue, checked
+linearizable against the Mutex model (device mutex kernel). DB install
+is the Debian rabbitmq-server package with a generated clustering
+config (rabbitmq.clj:38-98).
+
+The AMQP wire protocol needs a driver (the reference uses Langohr), so
+the client is gated; both workloads run no-cluster against their fakes.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import control
+from jepsen_tpu import db as db_ns
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu import os_debian
+from jepsen_tpu.suites import common, workloads
+
+
+class RabbitDB(db_ns.DB, db_ns.LogFiles):
+    """Package install + erlang cookie + cluster config
+    (rabbitmq.clj:38-98)."""
+
+    def setup(self, test, node) -> None:
+        with control.su():
+            os_debian.install(["rabbitmq-server"])
+            cluster = ", ".join(f"'rabbit@{n}'" for n in test["nodes"])
+            config = (f"[{{rabbit, [{{cluster_nodes, {{[{cluster}], "
+                      f"disc}}}}]}}].")
+            control.exec_("tee", "/etc/rabbitmq/rabbitmq.config",
+                          stdin=config)
+            control.exec_("tee", "/var/lib/rabbitmq/.erlang.cookie",
+                          stdin="jepsen-rabbitmq")
+            control.exec_("chown", "rabbitmq:rabbitmq",
+                          "/var/lib/rabbitmq/.erlang.cookie")
+            control.exec_("chmod", "600",
+                          "/var/lib/rabbitmq/.erlang.cookie")
+            control.exec_("service", "rabbitmq-server", "restart")
+
+    def teardown(self, test, node) -> None:
+        with control.su():
+            control.exec_("service", "rabbitmq-server", "stop",
+                          may_fail=True)
+            control.exec_("rm", "-rf", "/var/lib/rabbitmq/mnesia",
+                          may_fail=True)
+
+    def log_files(self, test, node) -> list[str]:
+        return [f"/var/log/rabbitmq/rabbit@{node}.log"]
+
+
+def test(opts: dict | None = None) -> dict:
+    """The rabbitmq test map (rabbitmq.clj:282-320). ``workload`` is
+    "queue" (default) or "mutex"."""
+    opts = dict(opts or {})
+    name = opts.pop("workload", None) or "queue"
+    wl = workloads.queue_workload() if name == "queue" \
+        else workloads.lock_workload()
+    return common.suite_test(
+        f"rabbitmq {name}", opts,
+        workload=wl,
+        db=RabbitDB(),
+        client=common.GatedClient(
+            "the AMQP wire protocol needs a driver (reference uses "
+            "Langohr); run with --fake"),
+        nemesis=nemesis_ns.partition_random_halves(),
+        nemesis_gen=common.standard_nemesis_gen(5, 5))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    def opt_spec(p):
+        p.add_argument("--workload", default="queue",
+                       choices=["queue", "mutex"])
+
+    cli.main(cli.suite_commands(test, opt_spec=opt_spec), argv)
+
+
+if __name__ == "__main__":
+    main()

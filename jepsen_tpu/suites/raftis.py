@@ -1,0 +1,96 @@
+"""Raftis suite — Redis-over-Raft register (raftis/src/jepsen/raftis.clj).
+
+Tarball install with the cluster string passed as daemon argv
+(raftis.clj:75-96); read/write register workload (no CAS primitive —
+the generator is ``mix [r w]`` against ``model/register 0``,
+raftis.clj:116-124); partition-random-halves nemesis. The wire client
+speaks RESP directly (:mod:`jepsen_tpu.suites.resp`) where the
+reference used the carmine driver.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import models
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import common, workloads
+from jepsen_tpu.suites.resp import RespClient, RespError
+
+VERSION = "v2.0.4"
+KEY = "jepsen"
+PORT = 6379
+
+
+class RaftisDB(common.TarballDB):
+    """raftis.clj:76-105: daemon argv is (cluster, node, raft-port,
+    data-dir, client-port)."""
+
+    name = "raftis"
+    dir = "/opt/raftis"
+    binary = "raftis"
+
+    def __init__(self, version: str = VERSION):
+        self.url = (f"https://github.com/Qihoo360/floyd/releases/download/"
+                    f"{version}/raftis-{version}.tar.gz")
+
+    @property
+    def logfile(self):
+        return f"{self.dir}/raftis.log"
+
+    def start_args(self, test, node) -> list:
+        cluster = ",".join(f"{n}:8901" for n in test["nodes"])
+        return [cluster, node, "8901", "data", str(PORT)]
+
+
+class RaftisClient(client_ns.Client):
+    """GET/SET register over RESP (the operations of raftis.clj:24-52)."""
+
+    def __init__(self, conn: RespClient | None = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return RaftisClient(RespClient(node, PORT))
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                v = self.conn.call("GET", KEY)
+                return op.replace(type="ok",
+                                  value=int(v) if v is not None else 0)
+            if op.f == "write":
+                self.conn.call("SET", KEY, op.value)
+                return op.replace(type="ok")
+        except RespError as e:
+            return op.replace(type="fail", error=str(e))
+        except OSError as e:
+            t = "fail" if op.f == "read" else "info"
+            return op.replace(type=t, error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()
+
+
+def test(opts: dict | None = None) -> dict:
+    """The raftis test map (raftis.clj:108-130): register 0, r/w mix."""
+    return common.suite_test(
+        "raftis", opts,
+        workload=workloads.single_register(
+            ops=(workloads.r, workloads.w), model=models.register(0),
+            initial=0),
+        db=RaftisDB(),
+        client=RaftisClient(),
+        nemesis=nemesis_ns.partition_random_halves(),
+        nemesis_gen=common.standard_nemesis_gen(5, 5))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    cli.main(cli.suite_commands(test), argv)
+
+
+if __name__ == "__main__":
+    main()

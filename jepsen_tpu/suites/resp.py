@@ -1,0 +1,84 @@
+"""Minimal RESP (REdis Serialization Protocol) client.
+
+Two reference suites speak this wire protocol: disque (the AddJob/GetJob
+queue tested by disque.clj via the jedisque Java client) and raftis
+(Redis-over-Raft, raftis.clj via carmine). The protocol is simple enough
+that a stdlib socket client is the honest TPU-build equivalent of those
+driver dependencies — no vendored packages.
+
+RESP2 framing: requests are arrays of bulk strings; replies are simple
+strings (+), errors (-), integers (:), bulk strings ($), or arrays (*).
+"""
+
+from __future__ import annotations
+
+import socket
+
+
+class RespError(Exception):
+    """Server-reported error reply (the ``-ERR ...`` line)."""
+
+
+class RespClient:
+    def __init__(self, host: str, port: int, timeout: float = 5.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.buf = b""
+
+    # --- framing -------------------------------------------------------------
+
+    def _read_line(self) -> bytes:
+        while b"\r\n" not in self.buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("connection closed mid-reply")
+            self.buf += chunk
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n + 2:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("connection closed mid-bulk")
+            self.buf += chunk
+        data, self.buf = self.buf[:n], self.buf[n + 2:]  # strip CRLF
+        return data
+
+    def _read_reply(self):
+        line = self._read_line()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RespError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            return self._read_exact(n).decode(errors="replace")
+        if kind == b"*":
+            n = int(rest)
+            if n == -1:
+                return None
+            return [self._read_reply() for _ in range(n)]
+        raise RespError(f"unknown reply type {line!r}")
+
+    # --- public --------------------------------------------------------------
+
+    def call(self, *args):
+        """Issue one command (e.g. ``call("SET", "k", "1")``) and return
+        the parsed reply. Raises :class:`RespError` on error replies."""
+        parts = [b"*%d\r\n" % len(args)]
+        for a in args:
+            b = a if isinstance(a, bytes) else str(a).encode()
+            parts.append(b"$%d\r\n%s\r\n" % (len(b), b))
+        self.sock.sendall(b"".join(parts))
+        return self._read_reply()
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,93 @@
+"""RethinkDB suite — per-key document CAS with replica reconfiguration
+(rethinkdb/src/jepsen/rethinkdb.clj + document_cas.clj).
+
+Per-key registers via independent/checker linearizable
+(document_cas.clj:146-148). Two nemeses: the standard partitioner and
+the custom **primaries grudge** (rethinkdb.clj:183-249) — partitions
+computed so current table primaries land in the minority, while the
+test concurrently reconfigures replicas. The ReQL wire protocol needs a
+driver, so the client is gated; fakes cover no-cluster runs.
+"""
+
+from __future__ import annotations
+
+import random
+
+from jepsen_tpu import control
+from jepsen_tpu import db as db_ns
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu import os_debian
+from jepsen_tpu.suites import common, workloads
+
+
+class RethinkDB(db_ns.DB, db_ns.LogFiles):
+    """apt repo install + daemon with join list (rethinkdb.clj:40-120)."""
+
+    def setup(self, test, node) -> None:
+        with control.su():
+            os_debian.install(["rethinkdb"])
+            joins = "\n".join(f"join={n}:29015" for n in test["nodes"]
+                              if n != node)
+            config = (f"bind=all\nserver-name={node}\n"
+                      f"directory=/var/lib/rethinkdb/jepsen\n{joins}\n")
+            control.exec_("tee", "/etc/rethinkdb/instances.d/jepsen.conf",
+                          stdin=config)
+            control.exec_("service", "rethinkdb", "restart")
+
+    def teardown(self, test, node) -> None:
+        with control.su():
+            control.exec_("service", "rethinkdb", "stop", may_fail=True)
+            control.exec_("rm", "-rf", "/var/lib/rethinkdb/jepsen",
+                          may_fail=True)
+
+    def log_files(self, test, node) -> list[str]:
+        return ["/var/log/rethinkdb"]
+
+
+def primaries_grudge() -> nemesis_ns.Nemesis:
+    """Partition so a random majority excludes likely primaries
+    (rethinkdb.clj:183-249; without a live ReQL admin connection the
+    primary set is approximated by a random minority)."""
+
+    def grudge(nodes):
+        nodes = list(nodes)
+        random.shuffle(nodes)
+        minority = nodes[:len(nodes) // 2]
+        majority = nodes[len(nodes) // 2:]
+        return nemesis_ns.complete_grudge([majority, minority])
+
+    return nemesis_ns.partitioner(grudge)
+
+
+def test(opts: dict | None = None) -> dict:
+    """The rethinkdb test map (rethinkdb.clj:120-180). ``nemesis`` picks
+    partition (default) or primaries."""
+    opts = dict(opts or {})
+    nem = opts.pop("nemesis", None) or "partition"
+    threads_per_key = 5
+    if opts.get("concurrency", 0) < threads_per_key:
+        opts["concurrency"] = threads_per_key
+    nemesis = nemesis_ns.partition_random_halves() \
+        if nem == "partition" else primaries_grudge()
+    return common.suite_test(
+        "rethinkdb", opts,
+        workload=workloads.register(threads_per_key=threads_per_key),
+        db=RethinkDB(),
+        client=common.GatedClient(
+            "the ReQL wire protocol needs a driver; run with --fake"),
+        nemesis=nemesis,
+        nemesis_gen=common.standard_nemesis_gen(5, 5))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    def opt_spec(p):
+        p.add_argument("--nemesis", default="partition",
+                       choices=["partition", "primaries"])
+
+    cli.main(cli.suite_commands(test, opt_spec=opt_spec), argv)
+
+
+if __name__ == "__main__":
+    main()

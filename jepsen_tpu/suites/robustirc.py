@@ -1,0 +1,65 @@
+"""RobustIRC suite — set over IRC messages
+(robustirc/src/jepsen/robustirc.clj).
+
+Clients post integers as IRC messages to a channel; the final read
+collects the channel log and the set checker verifies every
+acknowledged add survived (robustirc.clj:213-215). Nemesis:
+partition-random-halves (robustirc.clj:192). DB install downloads the
+robustirc binary and bootstraps the network (robustirc.clj:30-120).
+
+The IRC wire protocol needs a client library in the reference; here
+it is gated and no-cluster runs use the set workload fake.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu.suites import common, workloads
+
+
+class RobustIrcDB(common.TarballDB):
+    """Binary download + network bootstrap (robustirc.clj:30-120)."""
+
+    name = "robustirc"
+    dir = "/opt/robustirc"
+    binary = "robustirc"
+
+    def __init__(self):
+        self.url = None  # release binary fetched in post_install
+
+    def post_install(self, test, node) -> None:
+        from jepsen_tpu.control import util as cu
+
+        cu.wget("https://github.com/robustirc/robustirc/releases/"
+                "latest/download/robustirc_linux_amd64")
+
+    def start_args(self, test, node) -> list:
+        args = ["-network_name=jepsen", f"-peer_addr={node}:13001"]
+        if node != test["nodes"][0]:
+            args.append(f"-join={test['nodes'][0]}:13001")
+        else:
+            args.append("-singlenode")
+        return args
+
+
+def test(opts: dict | None = None) -> dict:
+    """The robustirc test map (robustirc.clj:180-220)."""
+    return common.suite_test(
+        "robustirc", opts,
+        workload=workloads.set_workload(),
+        db=RobustIrcDB(),
+        client=common.GatedClient(
+            "the IRC wire protocol needs a client library; "
+            "run with --fake"),
+        nemesis=nemesis_ns.partition_random_halves(),
+        nemesis_gen=common.standard_nemesis_gen(5, 5))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    cli.main(cli.suite_commands(test), argv)
+
+
+if __name__ == "__main__":
+    main()

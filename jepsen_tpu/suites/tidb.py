@@ -1,0 +1,112 @@
+"""TiDB suite — register / bank / sets over a three-component cluster
+(tidb/src/tidb/{core,db,sql,bank,register,sets,nemesis,basic}.clj).
+
+The DB layer sequences the three-daemon bring-up (pd → tikv → tidb,
+tidb/db.clj): placement drivers first on all nodes, then the KV stores,
+then the SQL layer. Workloads: per-key register checked linearizable
+(register.clj:68-74), the bank invariant (bank.clj), and sets
+(sets.clj:53-55). TiDB fronts MySQL's wire protocol, which needs a
+driver; clients are gated and fakes cover no-cluster runs.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import control
+from jepsen_tpu import db as db_ns
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.suites import common, workloads
+
+VERSION = "v2.0.4"
+
+
+class TiDBCluster(db_ns.DB, db_ns.LogFiles):
+    """pd → tikv → tidb ordered bring-up (tidb/db.clj, 223 LoC in the
+    reference). All three daemons run on every node; tidb-server waits
+    for the stores."""
+
+    dir = "/opt/tidb"
+
+    def __init__(self, version: str = VERSION):
+        self.version = version
+        self.url = (f"https://download.pingcap.org/"
+                    f"tidb-{version}-linux-amd64.tar.gz")
+
+    def _pd_args(self, test, node) -> list:
+        initial = ",".join(f"{n}=http://{n}:2380" for n in test["nodes"])
+        return ["--name", node,
+                "--client-urls", f"http://{node}:2379",
+                "--peer-urls", f"http://{node}:2380",
+                "--initial-cluster", initial,
+                "--data-dir", f"{self.dir}/pd"]
+
+    def setup(self, test, node) -> None:
+        pds = ",".join(f"{n}:2379" for n in test["nodes"])
+        with control.su():
+            cu.install_archive(self.url, self.dir)
+            cu.start_daemon(f"{self.dir}/bin/pd-server",
+                            *self._pd_args(test, node),
+                            logfile=f"{self.dir}/pd.log",
+                            pidfile=f"{self.dir}/pd.pid", chdir=self.dir)
+            cu.start_daemon(f"{self.dir}/bin/tikv-server",
+                            "--pd", pds,
+                            "--addr", f"{node}:20160",
+                            "--data-dir", f"{self.dir}/tikv",
+                            logfile=f"{self.dir}/tikv.log",
+                            pidfile=f"{self.dir}/tikv.pid",
+                            chdir=self.dir)
+            cu.start_daemon(f"{self.dir}/bin/tidb-server",
+                            "--store", "tikv",
+                            "--path", pds,
+                            logfile=f"{self.dir}/tidb.log",
+                            pidfile=f"{self.dir}/tidb.pid",
+                            chdir=self.dir)
+
+    def teardown(self, test, node) -> None:
+        with control.su():
+            for name in ("tidb", "tikv", "pd"):
+                cu.stop_daemon(f"{self.dir}/{name}.pid",
+                               binary=f"{name}-server")
+            control.exec_("rm", "-rf", self.dir, may_fail=True)
+
+    def log_files(self, test, node) -> list[str]:
+        return [f"{self.dir}/{n}.log" for n in ("pd", "tikv", "tidb")]
+
+
+def test(opts: dict | None = None) -> dict:
+    """The tidb test map (tidb/basic.clj + runner registry). ``workload``
+    picks register (default) / bank / sets."""
+    opts = dict(opts or {})
+    name = opts.pop("workload", None) or "register"
+    if name == "register":
+        threads_per_key = 5
+        if opts.get("concurrency", 0) < threads_per_key:
+            opts["concurrency"] = threads_per_key
+        wl = workloads.register(threads_per_key=threads_per_key)
+    elif name == "bank":
+        wl = workloads.bank_workload()
+    else:
+        wl = workloads.set_workload()
+    return common.suite_test(
+        f"tidb {name}", opts,
+        workload=wl,
+        db=TiDBCluster(),
+        client=common.GatedClient(
+            "TiDB fronts the MySQL wire protocol, which needs a driver; "
+            "run with --fake"),
+        nemesis=nemesis_ns.partition_random_halves(),
+        nemesis_gen=common.standard_nemesis_gen(5, 5))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    def opt_spec(p):
+        p.add_argument("--workload", default="register",
+                       choices=["register", "bank", "sets"])
+
+    cli.main(cli.suite_commands(test, opt_spec=opt_spec), argv)
+
+
+if __name__ == "__main__":
+    main()

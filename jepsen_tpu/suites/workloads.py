@@ -1,0 +1,544 @@
+"""Shared workload vocabulary for the DB suites.
+
+The reference's suites speak a small set of workload dialects (SURVEY §2.3:
+register / set / bank / queue / ids / counter / dirty-read / monotonic /
+sequential / comments / g2). Each builder here returns a *workload map*
+in the shape hazelcast.clj:364-399 established::
+
+    {"generator": ..., "final_generator": ... (optional),
+     "client": fake-client factory (no-cluster runs),
+     "checker": ..., "model": ...}
+
+Suites compose these with their own DB + wire client; the bundled fake
+client makes every suite runnable with zero infrastructure (the pg-local
+pattern, cockroach.clj:141-152).
+
+Checkers that exist only in suite code in the reference (bank
+`cockroach/bank.clj:112-143`, dirty reads `galera/dirty_reads.clj:77`,
+monotonic `cockroach/monotonic.clj`, sequential
+`cockroach/sequential.clj:141-165`, comments `cockroach/comments.clj
+:87-147`) are implemented here once and shared.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+from jepsen_tpu import checker as checker_ns
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu import models
+from jepsen_tpu.checker import FnChecker, timeline
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import fakes
+
+VALID = "valid?"
+
+
+# --- op constructors (etcd.clj:145-147) -------------------------------------
+
+def r(test, process):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def w(test, process):
+    return {"type": "invoke", "f": "write", "value": random.randint(0, 4)}
+
+
+def cas(test, process):
+    return {"type": "invoke", "f": "cas",
+            "value": (random.randint(0, 4), random.randint(0, 4))}
+
+
+# --- register ----------------------------------------------------------------
+
+def register(per_key: int = 300, threads_per_key: int = 10,
+             stagger: float = 1 / 30, faulty=None) -> dict:
+    """Per-key CAS register checked linearizable — the canonical workload
+    (etcd.clj:149-188): independent concurrent generator over keys, each
+    key a mix of r/w/cas, checker = independent(timeline + linearizable).
+    """
+    store = fakes.FakeKV(faulty=faulty)
+    return {
+        "generator": independent.concurrent_generator(
+            threads_per_key, iter(range(10 ** 9)),
+            lambda k: gen.limit(per_key,
+                                gen.stagger(stagger,
+                                            gen.mix([r, w, cas])))),
+        "client": fakes.KVClient(store),
+        "checker": independent.checker(checker_ns.compose({
+            "timeline": timeline.checker(),
+            "linear": checker_ns.linearizable(),
+        })),
+        "model": models.cas_register(),
+    }
+
+
+def single_register(n_ops: int = 300, stagger: float = 1 / 30,
+                    ops=(r, w, cas), model=None, initial=None,
+                    faulty=None) -> dict:
+    """One global register (consul/logcabin/raftis/zookeeper shape).
+    ``ops`` selects the vocabulary — raftis has no CAS primitive so its
+    mix is read/write only against ``models.register`` (raftis.clj:116-121).
+    ``initial`` seeds both the fake store and should match the model's
+    initial value.
+    """
+    store = fakes.FakeKV(faulty=faulty)
+    if initial is not None:
+        store.data[None] = initial
+    return {
+        "generator": gen.limit(n_ops,
+                               gen.stagger(stagger, gen.mix(list(ops)))),
+        "client": fakes.KVClient(store),
+        "checker": checker_ns.compose({
+            "timeline": timeline.checker(),
+            "linear": checker_ns.linearizable(),
+        }),
+        "model": model if model is not None else models.cas_register(),
+    }
+
+
+# --- set ---------------------------------------------------------------------
+
+def set_workload(n: int = 100, stagger: float = 1 / 10, faulty=None) -> dict:
+    """Concurrent adds then a final read (checker.clj:131-178)."""
+    counter = threading.Lock()
+    state = {"n": 0}
+
+    def add(test, process):
+        with counter:
+            v = state["n"]
+            state["n"] += 1
+        return {"type": "invoke", "f": "add", "value": v}
+
+    store = fakes.FakeSetStore(faulty=faulty)
+    return {
+        "generator": gen.limit(n, gen.stagger(stagger, gen.gen(add))),
+        "final_generator": gen.once(
+            {"type": "invoke", "f": "read", "value": None}),
+        "client": fakes.SetClient(store),
+        "checker": checker_ns.set_checker(),
+        "model": models.set_model(),
+    }
+
+
+# --- queue -------------------------------------------------------------------
+
+def queue_workload(n: int = 100, stagger: float = 1 / 10,
+                   faulty=None) -> dict:
+    """Enqueue/dequeue checked by total-queue (disque shape,
+    disque.clj:305-310): every enqueued element must be dequeued exactly
+    once after the final drain."""
+    store = fakes.FakeQueue(faulty=faulty)
+    return {
+        "generator": gen.limit(n, gen.stagger(stagger, gen.queue_gen())),
+        "final_generator": gen.once(
+            {"type": "invoke", "f": "drain", "value": None}),
+        "client": fakes.QueueClient(store),
+        "checker": checker_ns.total_queue(),
+        "model": models.unordered_queue(),
+    }
+
+
+# --- counter -----------------------------------------------------------------
+
+def counter_workload(n: int = 200, stagger: float = 1 / 20,
+                     faulty=None) -> dict:
+    """Increments + reads; reads must fall inside the possible bounds
+    (checker.clj:321-374, aerospike counter shape)."""
+
+    def add(test, process):
+        return {"type": "invoke", "f": "add", "value": 1}
+
+    store = fakes.FakeCounter(faulty=faulty)
+    return {
+        "generator": gen.limit(n, gen.stagger(stagger, gen.mix(
+            [add, r]))),
+        "client": fakes.CounterClient(store),
+        "checker": checker_ns.counter(),
+        "model": None,
+    }
+
+
+# --- lock (hazelcast.clj:379-386) -------------------------------------------
+
+def lock_workload(n: int = 100, faulty=None) -> dict:
+    """acquire/release alternation per process, checked against the Mutex
+    model — runs on the device mutex kernel."""
+    store = fakes.FakeLock(faulty=faulty)
+    return {
+        "generator": gen.limit(n, gen.each(lambda: gen.seq(
+            _cycle_ops([{"type": "invoke", "f": "acquire", "value": None},
+                        {"type": "invoke", "f": "release", "value": None}])
+        ))),
+        "client": fakes.LockClient(store),
+        "checker": checker_ns.linearizable(),
+        "model": models.mutex(),
+    }
+
+
+def _cycle_ops(ops):
+    while True:
+        yield from ops
+
+
+# --- unique ids (hazelcast.clj:389-399) -------------------------------------
+
+def ids_workload(n: int = 200, stagger: float = 1 / 20, faulty=None) -> dict:
+    store = fakes.FakeIdGen(faulty=faulty)
+    return {
+        "generator": gen.limit(n, gen.stagger(
+            stagger, {"type": "invoke", "f": "generate", "value": None})),
+        "client": fakes.IdGenClient(store),
+        "checker": checker_ns.unique_ids(),
+        "model": None,
+    }
+
+
+# --- bank --------------------------------------------------------------------
+
+def bank_checker(n: int = 5, total: int = 50) -> checker_ns.Checker:
+    """Every read of all balances must be non-negative and sum to the
+    invariant total (cockroach/bank.clj:112-143 custom checker)."""
+
+    def check(test, model, history, opts):
+        bad = []
+        for op in history:
+            if op.is_ok and op.f == "read" and op.value is not None:
+                bal = list(op.value)
+                if len(bal) != n or sum(bal) != total \
+                        or any(b < 0 for b in bal):
+                    bad.append({"op": op.to_dict(), "balances": bal,
+                                "sum": sum(bal)})
+        return {VALID: not bad, "bad-reads": bad[:10],
+                "bad-read-count": len(bad)}
+
+    return FnChecker(check)
+
+
+def bank_workload(n_accounts: int = 5, total: int = 50, n: int = 200,
+                  stagger: float = 1 / 20, faulty=None) -> dict:
+    """Balance transfers + full reads (cockroach/bank.clj, galera/percona
+    bank shape): total must be conserved in every snapshot."""
+
+    def transfer(test, process):
+        frm, to = random.sample(range(n_accounts), 2)
+        return {"type": "invoke", "f": "transfer",
+                "value": {"from": frm, "to": to,
+                          "amount": random.randint(1, 5)}}
+
+    store = fakes.FakeBank(n=n_accounts, total=total, faulty=faulty)
+    return {
+        "generator": gen.limit(n, gen.stagger(stagger, gen.mix(
+            [transfer, r]))),
+        "client": fakes.BankClient(store),
+        "checker": bank_checker(n=n_accounts, total=total),
+        "model": None,
+    }
+
+
+# --- dirty reads (galera/dirty_reads.clj:77, percona, crate) ----------------
+
+def dirty_read_checker() -> checker_ns.Checker:
+    """No read may observe a row whose insert aborted (or was never
+    acknowledged): reads ∩ (writes - committed-writes) must be empty."""
+
+    def check(test, model, history, opts):
+        committed = set()
+        aborted = set()
+        for op in history:
+            if op.f == "insert":
+                if op.is_ok:
+                    committed.add(op.value)
+                elif op.is_fail:
+                    aborted.add(op.value)
+        dirty = []
+        for op in history:
+            if op.is_ok and op.f == "read" and op.value is not None:
+                seen = set(op.value)
+                bad = seen & aborted
+                if bad:
+                    dirty.append({"op": op.to_dict(),
+                                  "dirty": sorted(bad)})
+        return {VALID: not dirty, "dirty-reads": dirty[:10],
+                "dirty-read-count": len(dirty)}
+
+    return FnChecker(check)
+
+
+def dirty_read_workload(n: int = 200, stagger: float = 1 / 20,
+                        abort_prob: float = 0.3, faulty=None) -> dict:
+    state = {"n": 0}
+    lock = threading.Lock()
+
+    def insert(test, process):
+        with lock:
+            v = state["n"]
+            state["n"] += 1
+        return {"type": "invoke", "f": "insert", "value": v,
+                "abort": random.random() < abort_prob}
+
+    store = fakes.FakeTable(faulty=faulty)
+    return {
+        "generator": gen.limit(n, gen.stagger(stagger, gen.mix(
+            [insert, r]))),
+        "client": fakes.TableClient(store),
+        "checker": dirty_read_checker(),
+        "model": None,
+    }
+
+
+# --- monotonic (cockroach/monotonic.clj) ------------------------------------
+
+def monotonic_checker() -> checker_ns.Checker:
+    """Inserted values carry (val, ts) pairs; timestamp order must agree
+    with value (insertion) order — the cockroach monotonic invariant."""
+
+    def check(test, model, history, opts):
+        rows = []
+        for op in history:
+            if op.is_ok and op.f == "insert" and op.value is not None:
+                rows.append(op.value)  # (val, ts)
+        rows.sort(key=lambda p: p[0])
+        anomalies = [
+            {"prev": list(a), "next": list(b)}
+            for a, b in zip(rows, rows[1:]) if not a[1] < b[1]
+        ]
+        return {VALID: not anomalies, "anomalies": anomalies[:10],
+                "anomaly-count": len(anomalies)}
+
+    return FnChecker(check)
+
+
+# --- sequential (cockroach/sequential.clj:141-165) --------------------------
+
+def sequential_checker() -> checker_ns.Checker:
+    """Writers write key k1 then k2 in order; a reader that observes k2
+    must also observe k1 (sequential consistency across keys)."""
+
+    def check(test, model, history, opts):
+        bad = []
+        for op in history:
+            if op.is_ok and op.f == "read" and op.value is not None:
+                # value: ordered list of keys written so far observed
+                seen = list(op.value)
+                expect = list(range(len(seen)))
+                if seen != expect:
+                    bad.append({"op": op.to_dict(), "saw": seen})
+        return {VALID: not bad, "bad-reads": bad[:10]}
+
+    return FnChecker(check)
+
+
+# --- comments (cockroach/comments.clj:87-147) -------------------------------
+
+def comments_checker() -> checker_ns.Checker:
+    """Realtime visibility: if insert A was acknowledged before read R was
+    invoked, R must observe A (no "time travelling" comments)."""
+
+    def check(test, model, history, opts):
+        acked: list[tuple[int, int]] = []  # (ack index, value)
+        pending: dict = {}
+        bad = []
+        for i, op in enumerate(history):
+            if op.f == "insert":
+                if op.is_invoke:
+                    pending[op.process] = op.value
+                elif op.is_ok:
+                    v = op.value if op.value is not None \
+                        else pending.get(op.process)
+                    acked.append((i, v))
+                    pending.pop(op.process, None)
+            elif op.f == "read":
+                if op.is_invoke:
+                    pending[(op.process, "r")] = i
+                elif op.is_ok and op.value is not None:
+                    inv = pending.pop((op.process, "r"), i)
+                    seen = set(op.value)
+                    must = {v for j, v in acked if j < inv}
+                    missing = must - seen
+                    if missing:
+                        bad.append({"op": op.to_dict(),
+                                    "missing": sorted(missing)})
+        return {VALID: not bad, "bad-reads": bad[:10]}
+
+    return FnChecker(check)
+
+
+def monotonic_workload(n: int = 200, stagger: float = 1 / 20,
+                       faulty=None) -> dict:
+    """Sequential inserts carrying (val, ts); timestamp order must agree
+    with insertion order (cockroach/monotonic.clj shape)."""
+    import time as time_mod
+
+    class Store:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.n = 0
+            self._flip = 0
+
+        def insert(self):
+            with self.lock:
+                v = self.n
+                self.n += 1
+                ts = time_mod.monotonic_ns()
+                self._flip += 1
+                if faulty == "ts-skew" and self._flip % 9 == 0:
+                    ts -= 10 ** 9  # timestamp regression
+                return (v, ts)
+
+    store = Store()
+
+    class Client(fakes.FakeClient):
+        def invoke(self, test, op: Op) -> Op:
+            if op.f == "insert":
+                return op.replace(type="ok", value=self.store.insert())
+            return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    return {
+        "generator": gen.limit(n, gen.stagger(
+            stagger, {"type": "invoke", "f": "insert", "value": None})),
+        "client": Client(store),
+        "checker": monotonic_checker(),
+        "model": None,
+    }
+
+
+def sequential_workload(n: int = 200, stagger: float = 1 / 20,
+                        faulty=None) -> dict:
+    """Writers append globally-sequential keys; a reader must observe a
+    prefix (cockroach/sequential.clj key-order shape)."""
+
+    class Store:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.keys: list = []
+            self._n = 0
+
+        def write(self):
+            with self.lock:
+                self._n += 1
+                if faulty == "skip" and self._n % 7 == 0 and self.keys:
+                    # Key becomes visible out of order: skip a slot.
+                    self.keys.append(len(self.keys) + 1)
+                else:
+                    self.keys.append(len(self.keys))
+                return self.keys[-1]
+
+        def read(self):
+            with self.lock:
+                return list(self.keys)
+
+    store = Store()
+
+    class Client(fakes.FakeClient):
+        def invoke(self, test, op: Op) -> Op:
+            if op.f == "write":
+                return op.replace(type="ok", value=self.store.write())
+            if op.f == "read":
+                return op.replace(type="ok", value=self.store.read())
+            return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def write(test, process):
+        return {"type": "invoke", "f": "write", "value": None}
+
+    return {
+        "generator": gen.limit(n, gen.stagger(stagger, gen.mix(
+            [write, r]))),
+        "client": Client(store),
+        "checker": sequential_checker(),
+        "model": None,
+    }
+
+
+def comments_workload(n: int = 200, stagger: float = 1 / 20,
+                      faulty=None) -> dict:
+    """Sequential inserts + reads with the realtime visibility checker
+    (cockroach/comments.clj shape): an insert acked before a read began
+    must be visible to it."""
+    state = {"n": 0}
+    lock = threading.Lock()
+
+    class Store:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.rows: list = []
+            self.old: list = []
+            self._n = 0
+
+        def insert(self, v):
+            with self.lock:
+                self.old = list(self.rows)
+                self.rows.append(v)
+
+        def read(self):
+            with self.lock:
+                self._n += 1
+                if faulty == "stale" and self._n % 4 == 0:
+                    return list(self.old)
+                return list(self.rows)
+
+    store = Store()
+
+    class Client(fakes.FakeClient):
+        def invoke(self, test, op: Op) -> Op:
+            if op.f == "insert":
+                self.store.insert(op.value)
+                return op.replace(type="ok")
+            if op.f == "read":
+                return op.replace(type="ok", value=self.store.read())
+            return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def insert(test, process):
+        with lock:
+            v = state["n"]
+            state["n"] += 1
+        return {"type": "invoke", "f": "insert", "value": v}
+
+    return {
+        "generator": gen.limit(n, gen.stagger(stagger, gen.mix(
+            [insert, r]))),
+        "client": Client(store),
+        "checker": comments_checker(),
+        "model": None,
+    }
+
+
+REGISTRY = {
+    "register": register,
+    "single-register": single_register,
+    "set": set_workload,
+    "queue": queue_workload,
+    "counter": counter_workload,
+    "lock": lock_workload,
+    "ids": ids_workload,
+    "bank": bank_workload,
+    "dirty-read": dirty_read_workload,
+    "monotonic": monotonic_workload,
+    "sequential": sequential_workload,
+    "comments": comments_workload,
+}
+
+
+def finalize(workload: dict, opts: dict | None = None,
+             nemesis_gen=None) -> "gen.Generator":
+    """Wire a workload's generator with nemesis schedule, time limit, and
+    optional healing + final phase (the hazelcast-test composition,
+    hazelcast.clj:403-420)."""
+    opts = opts or {}
+    g = workload["generator"]
+    if nemesis_gen is not None:
+        g = gen.nemesis(nemesis_gen, g)
+    tl = opts.get("time-limit")
+    if tl:
+        g = gen.time_limit(tl, g)
+    final = workload.get("final_generator")
+    if final is not None:
+        g = gen.phases(
+            g,
+            gen.log("Healing cluster"),
+            gen.nemesis(gen.once({"type": "info", "f": "stop",
+                                  "value": None})),
+            gen.clients(final))
+    return g

@@ -1,0 +1,92 @@
+"""ZooKeeper suite — CAS register over a zk-atom
+(zookeeper/src/jepsen/zookeeper.clj).
+
+DB install goes through Debian packages + per-node ``myid`` and a
+generated ``zoo.cfg`` server list, restarted via the service manager
+(zookeeper.clj:40-71). The workload is the canonical r/w/cas register
+checked linearizable (zookeeper.clj:78-129).
+
+The reference's client is an Avout distributed atom over the ZooKeeper
+jute wire protocol (zookeeper.clj:78-104); that binary protocol needs a
+real driver, so the wire client is gated (:class:`common.GatedClient`)
+and no-cluster runs use the register workload fake.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import control
+from jepsen_tpu import db as db_ns
+from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu import os_debian
+from jepsen_tpu.suites import common, workloads
+
+ZOO_CFG = """tickTime=2000
+initLimit=10
+syncLimit=5
+dataDir=/var/lib/zookeeper
+clientPort=2181
+"""
+
+
+def node_id(test, node) -> int:
+    """Node name -> myid (zookeeper.clj:19-31)."""
+    return test["nodes"].index(node)
+
+
+def zoo_cfg_servers(test) -> str:
+    """server.N lines for zoo.cfg (zookeeper.clj:33-39)."""
+    return "\n".join(f"server.{i}={n}:2888:3888"
+                     for i, n in enumerate(test["nodes"]))
+
+
+class ZookeeperDB(db_ns.DB, db_ns.LogFiles):
+    """Package install + myid/zoo.cfg + service restart
+    (zookeeper.clj:41-71)."""
+
+    def __init__(self, version: str = "3.4.5+dfsg-2"):
+        self.version = version
+
+    def setup(self, test, node) -> None:
+        with control.su():
+            os_debian.install([f"zookeeper={self.version}",
+                               f"zookeeper-bin={self.version}",
+                               f"zookeeperd={self.version}"])
+            control.exec_("mkdir", "-p", "/etc/zookeeper/conf")
+            control.exec_("tee", "/etc/zookeeper/conf/myid",
+                          stdin=str(node_id(test, node)))
+            control.exec_("tee", "/etc/zookeeper/conf/zoo.cfg",
+                          stdin=ZOO_CFG + "\n" + zoo_cfg_servers(test))
+            control.exec_("service", "zookeeper", "restart")
+
+    def teardown(self, test, node) -> None:
+        with control.su():
+            control.exec_("service", "zookeeper", "stop", may_fail=True)
+            control.exec_("bash", "-c",
+                          "rm -rf /var/lib/zookeeper/version-* "
+                          "/var/log/zookeeper/*", may_fail=True)
+
+    def log_files(self, test, node) -> list[str]:
+        return ["/var/log/zookeeper/zookeeper.log"]
+
+
+def test(opts: dict | None = None) -> dict:
+    """The zookeeper test map (zookeeper.clj:110-129)."""
+    return common.suite_test(
+        "zookeeper", opts,
+        workload=workloads.single_register(),
+        db=ZookeeperDB(),
+        client=common.GatedClient(
+            "the ZooKeeper wire protocol (jute) needs a zk driver; "
+            "run with --fake or provide a client"),
+        nemesis=nemesis_ns.partition_random_halves(),
+        nemesis_gen=common.standard_nemesis_gen(5, 5))
+
+
+def main(argv=None) -> None:
+    from jepsen_tpu import cli
+
+    cli.main(cli.suite_commands(test), argv)
+
+
+if __name__ == "__main__":
+    main()

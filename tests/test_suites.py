@@ -1,0 +1,277 @@
+"""Tests for the DB suites layer (SURVEY §2.3-2.8).
+
+Three tiers, mirroring the reference's no-cluster affordances:
+
+1. every suite's test map constructs (and carries the right components);
+2. representative suites run end-to-end through the real runner on their
+   in-memory fakes (the atom-db pattern of core_test.clj) and come back
+   valid;
+3. every injected-bug mode is caught by its checker — the suite-level
+   analogue of checker_test.clj's pathological histories.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from jepsen_tpu import adya, core
+from jepsen_tpu import suites
+from jepsen_tpu.suites import common, workloads
+
+
+def run_fake(test_map: dict) -> dict:
+    test_map["name"] = None  # no store writes from unit tests
+    result = core.run(test_map)
+    return result.get("results", {})
+
+
+def wl_result(res: dict) -> dict:
+    return res.get("workload", res)
+
+
+# --- tier 1: every suite constructs -----------------------------------------
+
+@pytest.mark.parametrize("name", sorted(suites.SUITES))
+def test_suite_constructs(name):
+    mod = suites.load(name)
+    t = mod.test({"fake": True, "time-limit": 1})
+    assert t["name"]
+    assert t["transport"] == "dummy"
+    assert t["generator"] is not None
+    assert t["checker"] is not None
+    assert callable(getattr(mod, "main"))
+
+
+def test_unknown_suite():
+    with pytest.raises(KeyError):
+        suites.load("nope")
+
+
+# --- tier 2: fake runs come back valid --------------------------------------
+
+@pytest.mark.parametrize("name,opts", [
+    ("etcd", {}),
+    ("consul", {}),
+    ("raftis", {}),
+    ("disque", {}),
+    ("hazelcast", {"workload": "lock"}),
+    ("hazelcast", {"workload": "queue"}),
+    ("galera", {"workload": "bank"}),
+    ("crate", {"workload": "lost-updates"}),
+    ("cockroachdb", {"workload": "monotonic"}),
+    ("cockroachdb", {"workload": "sequential"}),
+    ("cockroachdb", {"workload": "comments"}),
+    ("cockroachdb", {"workload": "g2"}),
+])
+def test_suite_fake_run_valid(name, opts):
+    random.seed(7)
+    mod = suites.load(name)
+    t = mod.test({"fake": True, "time-limit": 2, **opts})
+    res = run_fake(t)
+    assert res.get("valid?") is True, res
+
+
+# --- tier 3: checkers catch injected bugs -----------------------------------
+
+def run_workload(wl: dict, time_limit: float = 3,
+                 concurrency: int = 5) -> dict:
+    random.seed(11)
+    t = common.suite_test("faulty", {"time-limit": time_limit,
+                                     "concurrency": concurrency,
+                                     "fake": True},
+                          workload=wl)
+    t["name"] = None
+    return wl_result(run_fake(t))
+
+
+FAULTY_CASES = [
+    ("set lost-add",
+     lambda: workloads.set_workload(n=60, stagger=0.001,
+                                    faulty="lost-add")),
+    ("queue lost-enqueue",
+     lambda: workloads.queue_workload(n=60, stagger=0.001,
+                                      faulty="lost-enqueue")),
+    ("bank non-atomic",
+     lambda: workloads.bank_workload(n=300, stagger=0.001,
+                                     faulty="non-atomic")),
+    ("lock double-grant",
+     lambda: workloads.lock_workload(n=60, faulty="double-grant")),
+    ("ids duplicate",
+     lambda: workloads.ids_workload(n=60, stagger=0.001,
+                                    faulty="duplicate")),
+    ("dirty-read",
+     lambda: workloads.dirty_read_workload(n=200, stagger=0.001,
+                                           faulty="dirty-read")),
+    ("monotonic ts-skew",
+     lambda: workloads.monotonic_workload(n=60, stagger=0.001,
+                                          faulty="ts-skew")),
+    ("sequential skip",
+     lambda: workloads.sequential_workload(n=100, stagger=0.001,
+                                           faulty="skip")),
+    ("comments stale",
+     lambda: workloads.comments_workload(n=200, stagger=0.001,
+                                         faulty="stale")),
+]
+
+
+@pytest.mark.parametrize("label,factory", FAULTY_CASES,
+                         ids=[c[0] for c in FAULTY_CASES])
+def test_checker_catches_injected_bug(label, factory):
+    res = run_workload(factory())
+    assert res.get("valid?") is False, (label, res)
+
+
+def test_g2_checker_catches_double_insert():
+    random.seed(3)
+    t = common.suite_test("g2-faulty",
+                          {"time-limit": 2, "concurrency": 4,
+                           "fake": True},
+                          workload=adya.workload(faulty="g2"))
+    t["name"] = None
+    res = run_fake(t)
+    assert res.get("workload", res).get("valid?") is False, res
+
+
+def test_crate_lost_updates_checker():
+    from jepsen_tpu.suites import crate
+
+    res = run_workload(crate.lost_updates_workload(n=60,
+                                                   faulty="lost-update"))
+    assert res.get("valid?") is False, res
+
+
+# --- chronos: targets, matching, end-to-end ---------------------------------
+
+class TestChronos:
+    def test_job_targets_truncated_by_read_time(self):
+        from jepsen_tpu.suites import chronos
+
+        job = {"start": 0.0, "interval": 10, "count": 5,
+               "epsilon": 2, "duration": 1}
+        targets = chronos.job_targets(25.0, job)
+        # finish = 25-2-1 = 22: targets at 0, 10, 20 began before it
+        assert [t[0] for t in targets] == [0.0, 10.0, 20.0]
+        assert targets[0][1] == 2 + chronos.EPSILON_FORGIVENESS
+
+    def test_match_targets_perfect(self):
+        from jepsen_tpu.suites import chronos
+
+        targets = [(0, 5), (10, 15), (20, 25)]
+        assert chronos.match_targets(targets, [1.0, 11.0, 21.0])
+
+    def test_match_targets_needs_distinct_runs(self):
+        from jepsen_tpu.suites import chronos
+
+        # One run can't satisfy two targets even if windows overlap.
+        targets = [(0, 10), (5, 15)]
+        assert chronos.match_targets(targets, [7.0]) is None
+        assert chronos.match_targets(targets, [7.0, 8.0]) is not None
+
+    def test_match_targets_augmenting_path(self):
+        from jepsen_tpu.suites import chronos
+
+        # Greedy would bind run 5 to target (0,10) and fail (0,6);
+        # matching must reassign.
+        targets = [(0, 10), (0, 6)]
+        assert chronos.match_targets(targets, [5.0, 9.0]) is not None
+
+    def test_job_solution_invalid_on_missed_run(self):
+        from jepsen_tpu.suites import chronos
+
+        job = {"start": 0.0, "interval": 10, "count": 2,
+               "epsilon": 1, "duration": 0}
+        sol = chronos.job_solution(30.0, job, [0.5])  # missed t=10
+        assert sol["valid?"] is False
+
+    def test_fake_scheduler_end_to_end(self):
+        import time
+
+        from jepsen_tpu.suites import chronos
+
+        random.seed(5)
+        sched = chronos.FakeScheduler()
+        now = time.time()
+        sched.add({"name": "j1", "start": now + 0.1, "interval": 0.5,
+                   "count": 3, "epsilon": 1, "duration": 0})
+        time.sleep(2.5)
+        read = sched.read()
+        sol = chronos.job_solution(read["time"],
+                                   {"name": "j1", "start": now + 0.1,
+                                    "interval": 0.5, "count": 3,
+                                    "epsilon": 1, "duration": 0},
+                                   read["runs"]["j1"])
+        assert sol["valid?"] is True, sol
+
+    def test_dropped_runs_fail(self):
+        import time
+
+        from jepsen_tpu.suites import chronos
+
+        random.seed(5)
+        sched = chronos.FakeScheduler(drop_prob=1.0)
+        now = time.time()
+        job = {"name": "j1", "start": now + 0.05, "interval": 0.3,
+               "count": 2, "epsilon": 0.1, "duration": 0}
+        sched.add(job)
+        time.sleep(1.2)
+        read = sched.read()
+        sol = chronos.job_solution(read["time"], job,
+                                   read["runs"].get("j1", []))
+        assert sol["valid?"] is False
+
+
+# --- wire protocol clients ---------------------------------------------------
+
+class TestResp:
+    def test_roundtrip_against_fake_server(self):
+        import socket
+        import threading
+
+        from jepsen_tpu.suites.resp import RespClient
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+
+        def serve():
+            conn, _ = srv.accept()
+            data = b""
+            while b"\r\n" not in data:
+                data += conn.recv(4096)
+            # reply: simple string, then int, bulk, array, error
+            conn.sendall(b"+OK\r\n")
+            conn.recv(4096)
+            conn.sendall(b":42\r\n")
+            conn.recv(4096)
+            conn.sendall(b"$5\r\nhello\r\n")
+            conn.recv(4096)
+            conn.sendall(b"*2\r\n$1\r\na\r\n$-1\r\n")
+            conn.recv(4096)
+            conn.sendall(b"-ERR boom\r\n")
+            conn.close()
+
+        th = threading.Thread(target=serve, daemon=True)
+        th.start()
+        c = RespClient("127.0.0.1", port)
+        assert c.call("PING") == "OK"
+        assert c.call("X") == 42
+        assert c.call("X") == "hello"
+        assert c.call("X") == ["a", None]
+        from jepsen_tpu.suites.resp import RespError
+
+        with pytest.raises(RespError):
+            c.call("X")
+        c.close()
+        srv.close()
+
+
+class TestPgWire:
+    def test_error_fields_and_retryable(self):
+        from jepsen_tpu.suites.pgwire import PgError
+
+        e = PgError({"C": "40001", "M": "restart transaction"})
+        assert e.retryable
+        assert not PgError({"C": "23505", "M": "dup"}).retryable
