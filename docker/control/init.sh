@@ -1,0 +1,25 @@
+#!/bin/sh
+# Control-node init: materialize the SSH private key passed via env
+# (newlines encoded as "|" by up.sh), trust the five nodes, then idle so
+# the operator can `docker exec -it jepsen-tpu-control bash`.
+set -e
+
+mkdir -p /root/.ssh && chmod 700 /root/.ssh
+if [ -n "$SSH_PRIVATE_KEY" ]; then
+    printf '%s' "$SSH_PRIVATE_KEY" | tr '|' '\n' > /root/.ssh/id_rsa
+    chmod 600 /root/.ssh/id_rsa
+fi
+
+: > /root/.ssh/known_hosts
+for n in n1 n2 n3 n4 n5; do
+    for i in $(seq 1 60); do
+        if ssh-keyscan -T 2 "$n" >> /root/.ssh/known_hosts 2>/dev/null; then
+            break
+        fi
+        sleep 1
+    done
+done
+
+echo "jepsen-tpu control ready; nodes n1..n5 reachable over ssh as root."
+echo "try: python -m jepsen_tpu.cli --help"
+exec sleep infinity

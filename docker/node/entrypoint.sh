@@ -1,0 +1,15 @@
+#!/bin/sh
+# DB-node init: accept the control node's key, allow root login, start sshd.
+set -e
+
+if [ -n "$AUTHORIZED_KEYS" ]; then
+    echo "$AUTHORIZED_KEYS" > /root/.ssh/authorized_keys
+    chmod 600 /root/.ssh/authorized_keys
+fi
+if [ -n "$ROOT_PASS" ]; then
+    echo "root:$ROOT_PASS" | chpasswd
+fi
+
+sed -i 's/^#\?PermitRootLogin.*/PermitRootLogin yes/' /etc/ssh/sshd_config
+
+exec /usr/sbin/sshd -D -e

@@ -127,13 +127,18 @@ def _shade_nemesis(ax, history):
 
 
 def _save(fig, test, opts, filename):
-    from jepsen_tpu import store
-
-    path = store.path(test or {"name": "noname"},
-                      (opts or {}).get("subdirectory"), filename, make=True)
-    fig.savefig(path, dpi=110, bbox_inches="tight")
     import matplotlib.pyplot as plt
 
+    from jepsen_tpu import store
+
+    if not (isinstance(test, dict) and test.get("name")):
+        # Unnamed tests persist nothing (tests_support.noop_test contract;
+        # the runner gates save_1/save_2 the same way).
+        plt.close(fig)
+        return None
+    path = store.path(test, (opts or {}).get("subdirectory"), filename,
+                      make=True)
+    fig.savefig(path, dpi=110, bbox_inches="tight")
     plt.close(fig)
     return path
 

@@ -168,35 +168,28 @@ def _op_f_and_values(o: LinOp, intern: _Interner) -> tuple[int, list[int]]:
     return f_id, v
 
 
-def prepare(model, history, max_window: int = MAX_WINDOW) -> PackedHistory:
-    """Pack a history for the frontier search. See module docstring."""
-    history = list(history)
-    ops = pair_ops(history)
-    intern = _Interner()
+def _pack_events_native(invoke_pos, return_pos, op_f, op_v, max_window,
+                        fill_fv, R):
+    """The packing walk via native/history_pack.cc (ctypes). None when the
+    native library is unavailable."""
+    from jepsen_tpu import native_ext
 
     try:
-        kernel = kernel_for(model)
-    except ValueError:
-        kernel = None
+        out = native_ext.pack_events(
+            invoke_pos, return_pos, op_f, op_v[:, 0], op_v[:, 1],
+            nil_value=int(NIL), max_window=max_window,
+            fill_fv=fill_fv, R=R)
+    except native_ext.WindowOverflow as e:
+        raise UnsupportedHistory(
+            f"concurrency window exceeds {max_window} pending ops "
+            f"at history position {e.pos}") from None
+    return out
 
-    # Initial state: intern the model's observable value.
-    if isinstance(model, (model_ns.CASRegister, model_ns.Register)):
-        init_state = np.array([intern(model.value)], np.int32)
-    elif isinstance(model, model_ns.Mutex):
-        init_state = np.array([1 if model.locked else 0], np.int32)
-    else:
-        init_state = np.array([0], np.int32)
 
-    # Event stream over op endpoints: (pos, kind, op_id); invokes before
-    # returns at equal positions can't happen (distinct history positions).
-    events: list[tuple[int, int, int]] = []
-    for i, o in enumerate(ops):
-        events.append((o.invoke_pos, 0, i))
-        if o.return_pos is not None:
-            events.append((o.return_pos, 1, i))
-    events.sort()
-
-    R = sum(1 for o in ops if o.ok)
+def _pack_events_py(invoke_pos, return_pos, op_f, op_v, max_window,
+                    fill_fv, R):
+    """Pure-Python packing walk (semantics twin of jtpu_pack_events)."""
+    n = len(invoke_pos)
     W_alloc = max_window
     ret_slot = np.zeros(R, np.int32)
     ret_op = np.zeros(R, np.int32)
@@ -204,6 +197,15 @@ def prepare(model, history, max_window: int = MAX_WINDOW) -> PackedHistory:
     slot_f = np.zeros((R, W_alloc), np.int32)
     slot_v = np.full((R, W_alloc, VALUE_WIDTH), int(NIL), np.int32)
     slot_op = np.full((R, W_alloc), -1, np.int32)
+
+    # Event stream over op endpoints: (pos, kind, op_id); invokes before
+    # returns at equal positions can't happen (distinct history positions).
+    events: list[tuple[int, int, int]] = []
+    for i in range(n):
+        events.append((int(invoke_pos[i]), 0, i))
+        if return_pos[i] >= 0:
+            events.append((int(return_pos[i]), 1, i))
+    events.sort()
 
     free = list(range(W_alloc))[::-1]
     slot_of: dict[int, int] = {}
@@ -225,19 +227,64 @@ def prepare(model, history, max_window: int = MAX_WINDOW) -> PackedHistory:
             ret_slot[r] = s
             ret_op[r] = i
             for slot, op_id in cur_active.items():
-                o = ops[op_id]
                 active[r, slot] = True
                 slot_op[r, slot] = op_id
-                if kernel is not None:
-                    f_id, v = _op_f_and_values(o, intern)
-                    slot_f[r, slot] = f_id
-                    slot_v[r, slot] = v
+                if fill_fv:
+                    slot_f[r, slot] = op_f[op_id]
+                    slot_v[r, slot] = op_v[op_id]
             r += 1
             del cur_active[s]
             del slot_of[i]
             free.append(s)
+    return ret_slot, ret_op, active, slot_f, slot_v, slot_op, max_used
 
-    crashed = [ops[i] for i in slot_of]
+
+def prepare(model, history, max_window: int = MAX_WINDOW) -> PackedHistory:
+    """Pack a history for the frontier search. See module docstring."""
+    history = list(history)
+    ops = pair_ops(history)
+    intern = _Interner()
+
+    try:
+        kernel = kernel_for(model)
+    except ValueError:
+        kernel = None
+
+    # Initial state: intern the model's observable value.
+    if isinstance(model, (model_ns.CASRegister, model_ns.Register)):
+        init_state = np.array([intern(model.value)], np.int32)
+    elif isinstance(model, model_ns.Mutex):
+        init_state = np.array([1 if model.locked else 0], np.int32)
+    else:
+        init_state = np.array([0], np.int32)
+
+    n = len(ops)
+    R = sum(1 for o in ops if o.ok)
+
+    # Per-op (f, values) interned ONCE up front — the packing walk below
+    # references ops (R x W) times and must not re-intern per reference.
+    op_f = np.zeros(n, np.int32)
+    op_v = np.full((n, VALUE_WIDTH), int(NIL), np.int32)
+    if kernel is not None:
+        for i, o in enumerate(ops):
+            f_id, v = _op_f_and_values(o, intern)
+            op_f[i] = f_id
+            op_v[i] = v
+
+    invoke_pos = np.fromiter((o.invoke_pos for o in ops), np.int32, n)
+    return_pos = np.fromiter(
+        (-1 if o.return_pos is None else o.return_pos for o in ops),
+        np.int32, n)
+
+    fill_fv = kernel is not None
+    packed = _pack_events_native(
+        invoke_pos, return_pos, op_f, op_v, max_window, fill_fv, R)
+    if packed is None:
+        packed = _pack_events_py(
+            invoke_pos, return_pos, op_f, op_v, max_window, fill_fv, R)
+    ret_slot, ret_op, active, slot_f, slot_v, slot_op, max_used = packed
+
+    crashed = [o for o in ops if o.return_pos is None]
 
     W = max(1, max_used)
     return PackedHistory(

@@ -1,0 +1,138 @@
+"""ctypes loader for the native C++ helpers in ``native/``.
+
+The reference's native surface is C compiled on demand (the clock-fault
+programs, nemesis/time.clj:12-27); ours adds ``history_pack.cc`` — the
+O(R x W) packing walk of :mod:`jepsen_tpu.lin.prepare` — built the same
+way: from source, on first use, with the toolchain at hand. No native
+artifacts are vendored; everything degrades to the Python path.
+
+Set ``JTPU_NO_NATIVE=1`` to force the Python fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
+_BUILD_DIR = _NATIVE_DIR / "build"
+_SRC = _NATIVE_DIR / "history_pack.cc"
+_LIB = _BUILD_DIR / "libhistorypack.so"
+
+_lib = None
+_load_failed = False
+
+
+def _build() -> bool:
+    """Compile the shared library if missing or stale. Returns success."""
+    try:
+        if _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
+            return True
+        _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+        # Compile to a private temp name, then atomically rename: an
+        # interrupted/concurrent build must never leave a corrupt .so
+        # that passes the staleness check.
+        tmp = _BUILD_DIR / f".libhistorypack.{os.getpid()}.so"
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+             "-o", str(tmp), str(_SRC)],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load():
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    if os.environ.get("JTPU_NO_NATIVE"):
+        _load_failed = True
+        return None
+    if not _build():
+        _load_failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(str(_LIB))
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        lib.jtpu_pack_events.restype = ctypes.c_int
+        lib.jtpu_pack_events.argtypes = [
+            ctypes.c_int32,                 # n_ops
+            i32p, i32p, i32p, i32p, i32p,   # invoke/return/f/v0/v1
+            ctypes.c_int32,                 # nil_value
+            ctypes.c_int32,                 # max_window
+            ctypes.c_int32,                 # fill_fv
+            ctypes.c_int32,                 # R
+            i32p, i32p,                     # ret_slot, ret_op
+            u8p, i32p, i32p, i32p,          # active, slot_f, slot_v, slot_op
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        _lib = lib
+    except OSError:
+        _load_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class WindowOverflow(Exception):
+    """Concurrency window exceeded max_window at history position .pos."""
+
+    def __init__(self, pos: int):
+        super().__init__(f"window overflow at history position {pos}")
+        self.pos = pos
+
+
+def pack_events(invoke_pos, return_pos, f_id, v0, v1, *,
+                nil_value: int, max_window: int, fill_fv: bool, R: int):
+    """Run the native packing walk. Returns
+    (ret_slot, ret_op, active, slot_f, slot_v, slot_op, window) with
+    output tables pre-filled to the same defaults as the Python walk
+    (active False, slot_f 0, slot_v NIL, slot_op -1). None if the native
+    library is unavailable (caller falls back); raises WindowOverflow on
+    the same condition the Python walk raises UnsupportedHistory."""
+    from jepsen_tpu.models.kernels import VALUE_WIDTH
+
+    # The C ABI is fixed at two value words (v0/v1, slot_v[..., 2]); fail
+    # loudly rather than silently dropping columns if the kernel constant
+    # ever grows.
+    assert VALUE_WIDTH == 2, \
+        f"native packer supports VALUE_WIDTH == 2, got {VALUE_WIDTH}"
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(invoke_pos)
+    invoke_pos = np.ascontiguousarray(invoke_pos, np.int32)
+    return_pos = np.ascontiguousarray(return_pos, np.int32)
+    f_id = np.ascontiguousarray(f_id, np.int32)
+    v0 = np.ascontiguousarray(v0, np.int32)
+    v1 = np.ascontiguousarray(v1, np.int32)
+
+    ret_slot = np.zeros(R, np.int32)
+    ret_op = np.zeros(R, np.int32)
+    active = np.zeros((R, max_window), np.uint8)
+    slot_f = np.zeros((R, max_window), np.int32)
+    slot_v = np.full((R, max_window, 2), nil_value, np.int32)
+    slot_op = np.full((R, max_window), -1, np.int32)
+    out_w = ctypes.c_int32(0)
+
+    rc = lib.jtpu_pack_events(
+        np.int32(n), invoke_pos, return_pos, f_id, v0, v1,
+        np.int32(nil_value), np.int32(max_window), np.int32(int(fill_fv)),
+        np.int32(R), ret_slot, ret_op,
+        active.reshape(-1), slot_f.reshape(-1), slot_v.reshape(-1),
+        slot_op.reshape(-1), ctypes.byref(out_w))
+    if rc == -1:
+        raise WindowOverflow(int(out_w.value))
+    if rc != 0:
+        return None
+    return (ret_slot, ret_op, active.astype(bool), slot_f, slot_v,
+            slot_op, int(out_w.value))

