@@ -15,7 +15,7 @@ import numpy as np
 
 from jepsen_tpu.lin import bfs, prepare
 from jepsen_tpu.lin.prepare import PackedHistory
-from jepsen_tpu.models.kernels import F_NOOP, VALUE_WIDTH
+from jepsen_tpu.models.kernels import F_NOOP
 
 BATCH_CAP_SCHEDULE = (64, 1024)
 
@@ -25,6 +25,7 @@ def _pad_to(p: PackedHistory, r_pad: int, w_pad: int):
     key's own window are inactive; missing rows are identity rows on the
     shared pad slot w_pad (see bfs._pad_rows)."""
     R, W = p.active.shape
+    vw = p.slot_v.shape[2]
     ret_slot = np.concatenate(
         [p.ret_slot, np.full(r_pad - R, w_pad, np.int32)])
     active = np.zeros((r_pad, w_pad + 1), bool)
@@ -33,7 +34,7 @@ def _pad_to(p: PackedHistory, r_pad: int, w_pad: int):
     slot_f = np.zeros((r_pad, w_pad + 1), np.int32)
     slot_f[:R, :W] = p.slot_f
     slot_f[R:, w_pad] = F_NOOP
-    slot_v = np.zeros((r_pad, w_pad + 1, VALUE_WIDTH), np.int32)
+    slot_v = np.zeros((r_pad, w_pad + 1, vw), np.int32)
     slot_v[:R, :W] = p.slot_v
     return ret_slot, active, slot_f, slot_v
 
@@ -57,6 +58,15 @@ def try_check_batch(model, subs: dict) -> dict | None:
         if p.kernel is None:
             return None
         packed[k] = p
+
+    # Every key must share one step function (and thus state/value widths)
+    # for the stacked batch to be well-formed; history-sized kernels
+    # (set/queue) can differ per key, in which case fall back to per-key.
+    steps = {p.kernel.step for p in packed.values()}
+    if len(steps) > 1:
+        return None
+    if len({tuple(p.init_state.shape) for p in packed.values()}) > 1:
+        return None
 
     w_pad = max(p.window for p in packed.values())
     if w_pad + 1 > bfs.MAX_DEVICE_WINDOW:

@@ -333,9 +333,11 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
 
     # Single-u32-key dedup packing: possible when the one-word state's
     # values (interned ids or 0/1 flags; NIL remapped to nil_id) fit next
-    # to the W-bit bitset under the bit-31 invalid flag.
+    # to the W-bit bitset under the bit-31 invalid flag. Only the register
+    # and mutex families qualify — other one-word states (e.g. a
+    # single-value unordered-queue count) range past the intern table.
     state_bits = nil_id = None
-    if S == 1:
+    if S == 1 and p.kernel.name in ("cas-register", "register", "mutex"):
         nid = max(len(p.unintern), 2)
         b = nid.bit_length()
         if p.window + b <= 31:

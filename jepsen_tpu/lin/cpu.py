@@ -27,9 +27,16 @@ from typing import Any
 from jepsen_tpu.history import Op
 from jepsen_tpu.lin.prepare import PackedHistory, py_step_fn
 from jepsen_tpu.models import is_inconsistent
-from jepsen_tpu.models.kernels import NIL
+from jepsen_tpu.models.kernels import NIL, SET_BITS
 
 MAX_REPORT_CONFIGS = 32
+
+
+def _decode_bitmask(p: PackedHistory, state):
+    """Elements of a SET_BITS-per-word bitmask state, uninterned."""
+    return (p.unintern[w * SET_BITS + b]
+            for w, word in enumerate(state)
+            for b in range(SET_BITS) if (word >> b) & 1)
 
 
 def decode_state(p: PackedHistory, state: tuple) -> Any:
@@ -40,6 +47,17 @@ def decode_state(p: PackedHistory, state: tuple) -> Any:
         return None if state[0] == int(NIL) else p.unintern[state[0]]
     if p.kernel.name == "mutex":
         return bool(state[0])
+    if p.kernel.name == "set":
+        return frozenset(_decode_bitmask(p, state))
+    if p.kernel.name == "unordered-queue":
+        return tuple(sorted(
+            (p.unintern[i] for i, c in enumerate(state) for _ in range(c)),
+            key=repr))
+    if p.kernel.name == "unordered-unique":
+        return tuple(sorted(_decode_bitmask(p, state), key=repr))
+    if p.kernel.name == "fifo-queue":
+        size = state[0]
+        return tuple(p.unintern[e] for e in state[1:1 + size])
     return state
 
 
@@ -89,8 +107,8 @@ def check_packed(p: PackedHistory, witness: bool = False,
             return {"valid?": "unknown", "analyzer": "cpu-jit",
                     "error": "cancelled"}
         act = p.active[r]
-        f_row = p.slot_f[r]
-        v_row = p.slot_v[r]
+        f_ints = p.slot_f[r].tolist()
+        v_tups = [tuple(row) for row in p.slot_v[r].tolist()]
         window = p.window
         seen = set(configs)
         frontier = list(configs)
@@ -109,8 +127,7 @@ def check_packed(p: PackedHistory, witness: bool = False,
                 bits, st = cfg
                 for j in range(window):
                     if act[j] and not (bits >> j) & 1:
-                        ok, st2 = step(st, int(f_row[j]),
-                                       (int(v_row[j, 0]), int(v_row[j, 1])))
+                        ok, st2 = step(st, f_ints[j], v_tups[j])
                         if ok:
                             c2 = (bits | (1 << j), st2)
                             if c2 not in seen:

@@ -35,6 +35,7 @@ import numpy as np
 
 from jepsen_tpu import models as model_ns
 from jepsen_tpu.history import Op
+from jepsen_tpu.models import kernels as K
 from jepsen_tpu.models.kernels import (F_IDS, NIL, VALUE_WIDTH, KernelModel,
                                        kernel_for)
 
@@ -86,12 +87,16 @@ MAX_WINDOW = 64
 
 
 def _semantic_value(f: str, invoke: Op, completion: Op | None) -> Any:
-    """The value the model checks: reads are checked against what they
-    *observed* (the completion's value, knossos.history/complete semantics);
-    mutations against what they *requested* (the invocation's value)."""
+    """The value the model checks: reads and dequeues are checked against
+    what they *observed* (the completion's value, knossos.history/complete
+    semantics); mutations against what they *requested* (the invocation's
+    value)."""
     if f == "read":
         return completion.value if (completion is not None
                                     and completion.is_ok) else None
+    if f == "dequeue" and completion is not None and completion.is_ok \
+            and completion.value is not None:
+        return completion.value
     return invoke.value
 
 
@@ -168,6 +173,154 @@ def _op_f_and_values(o: LinOp, intern: _Interner) -> tuple[int, list[int]]:
     return f_id, v
 
 
+# Device-formulation size bounds: histories past these fall back to the
+# generic CPU search (kernel=None) rather than failing.
+MAX_SET_WORDS = 16        # 16 x 31 = 496 distinct set elements
+MAX_QUEUE_VALUES = 32     # distinct unordered-queue values (state width)
+MAX_FIFO_CAP = 31         # fifo depth bound (state width 32)
+
+
+def _max_queue_depth(ops: list[LinOp], n_initial: int) -> int:
+    """Upper bound on FIFO depth over every possible linearization: at any
+    event position t, at most the enqueues *invoked* by t have linearized,
+    and at least the ok dequeues *returned* by t have linearized."""
+    events = []
+    for o in ops:
+        if o.f == "enqueue":
+            events.append((o.invoke_pos, 1))
+        elif o.f == "dequeue" and o.return_pos is not None:
+            events.append((o.return_pos, -1))
+    events.sort()
+    depth = peak = n_initial
+    for _, d in events:
+        depth += d
+        peak = max(peak, depth)
+    return peak
+
+
+def _no_kernel(n: int):
+    return (None, np.array([0], np.int32), np.zeros(n, np.int32),
+            np.full((n, VALUE_WIDTH), int(NIL), np.int32))
+
+
+def _kernelize(model, ops: list[LinOp], intern: _Interner):
+    """Build the device kernel sized for this history plus the per-op
+    interned (f, value-words) tables.
+
+    Returns ``(kernel, init_state, op_f, op_v)``; kernel is None when the
+    model — or this particular history — has no device formulation, in
+    which case the generic CPU search takes over with exact semantics.
+    The set/queue kernels are sized from the history (element count, value
+    count, queue depth bound), so their packed-state width is data-driven.
+    """
+    n = len(ops)
+
+    def tables(vw):
+        return (np.zeros(n, np.int32),
+                np.full((n, vw), int(NIL), np.int32))
+
+    if isinstance(model, (model_ns.CASRegister, model_ns.Register,
+                          model_ns.Mutex)):
+        kernel = kernel_for(model)
+        if isinstance(model, model_ns.Mutex):
+            init_state = kernel.init_state()
+        else:
+            init_state = np.array([intern(model.value)], np.int32)
+        op_f, op_v = tables(kernel.value_width)
+        for i, o in enumerate(ops):
+            f_id, v = _op_f_and_values(o, intern)
+            op_f[i] = f_id
+            op_v[i] = v
+        return kernel, init_state, op_f, op_v
+
+    if isinstance(model, model_ns.SetModel):
+        if any(o.f not in F_IDS for o in ops) or \
+                any(o.f == "add" and o.value is None for o in ops) or \
+                any(e is None for e in model.s):
+            return _no_kernel(n)
+        # Dense element ids: initial elements first, then history order.
+        initial_ids = [intern(e) for e in sorted(model.s, key=repr)]
+        for o in ops:
+            if o.f == "add":
+                intern(o.value)
+            elif o.f == "read":
+                try:
+                    for e in (o.value if o.value is not None else ()):
+                        intern(e)
+                except TypeError:
+                    pass
+        n_elements = max(1, len(intern.values))
+        n_words = -(-n_elements // K.SET_BITS)
+        if n_words > MAX_SET_WORDS:
+            return _no_kernel(n)
+        kernel = K.set_kernel(n_elements, initial_ids)
+        op_f, op_v = tables(kernel.value_width)
+        for i, o in enumerate(ops):
+            op_f[i] = F_IDS[o.f]
+            if o.f == "add":
+                op_v[i, 0] = intern(o.value)
+            elif o.f == "read":
+                try:
+                    elems = [intern(e) for e in o.value] \
+                        if o.value is not None else None
+                except TypeError:
+                    elems = None
+                if elems is not None and int(NIL) in elems:
+                    # A None element can never be in the state (nil adds
+                    # were rejected above), so this read can never match.
+                    elems = None
+                if elems is not None:
+                    # Observed mask; all-NIL (never matches) when the
+                    # read's value is not a collection (= inconsistent).
+                    op_v[i, :n_words] = 0
+                    for e in elems:
+                        op_v[i, e // K.SET_BITS] |= np.int32(
+                            1 << (e % K.SET_BITS))
+        return kernel, kernel.init_state(), op_f, op_v
+
+    if isinstance(model, (model_ns.UnorderedQueue, model_ns.FIFOQueue)):
+        initial = list(model.pending)
+        if any(o.f not in F_IDS for o in ops) \
+                or any(v is None for v in initial) \
+                or any(o.f == "enqueue" and o.value is None for o in ops):
+            return _no_kernel(n)
+        initial_ids = [intern(v) for v in initial]
+        for o in ops:
+            if o.f in ("enqueue", "dequeue") and o.value is not None:
+                intern(o.value)
+        if isinstance(model, model_ns.FIFOQueue):
+            depth = _max_queue_depth(ops, len(initial))
+            if depth > MAX_FIFO_CAP:
+                return _no_kernel(n)
+            kernel = K.fifo_queue_kernel(max(1, depth), initial_ids)
+        else:
+            n_values = max(1, len(intern.values))
+            enq_ids = initial_ids + [intern(o.value) for o in ops
+                                     if o.f == "enqueue"]
+            if len(set(enq_ids)) == len(enq_ids):
+                # All enqueued values distinct: pending multiset is a set,
+                # packed as a bitmask (31 values/word).
+                n_words = -(-n_values // K.SET_BITS)
+                if n_words > MAX_SET_WORDS:
+                    return _no_kernel(n)
+                kernel = K.unordered_unique_kernel(n_values, initial_ids)
+            elif n_values <= MAX_QUEUE_VALUES:
+                kernel = K.unordered_queue_kernel(n_values, initial_ids)
+            else:
+                return _no_kernel(n)
+        op_f, op_v = tables(kernel.value_width)
+        for i, o in enumerate(ops):
+            op_f[i] = F_IDS[o.f]
+            if o.f in ("enqueue", "dequeue"):
+                # A nil dequeue interns to NIL, which is never legal — the
+                # same verdict the Python models give (None not in pending,
+                # since nil enqueues were rejected above).
+                op_v[i, 0] = intern(o.value)
+        return kernel, kernel.init_state(), op_f, op_v
+
+    return _no_kernel(n)
+
+
 def _pack_events_native(invoke_pos, return_pos, op_f, op_v, max_window,
                         fill_fv, R):
     """The packing walk via native/history_pack.cc (ctypes). None when the
@@ -191,11 +344,12 @@ def _pack_events_py(invoke_pos, return_pos, op_f, op_v, max_window,
     """Pure-Python packing walk (semantics twin of jtpu_pack_events)."""
     n = len(invoke_pos)
     W_alloc = max_window
+    vw = op_v.shape[1]
     ret_slot = np.zeros(R, np.int32)
     ret_op = np.zeros(R, np.int32)
     active = np.zeros((R, W_alloc), bool)
     slot_f = np.zeros((R, W_alloc), np.int32)
-    slot_v = np.full((R, W_alloc, VALUE_WIDTH), int(NIL), np.int32)
+    slot_v = np.full((R, W_alloc, vw), int(NIL), np.int32)
     slot_op = np.full((R, W_alloc), -1, np.int32)
 
     # Event stream over op endpoints: (pos, kind, op_id); invokes before
@@ -245,31 +399,12 @@ def prepare(model, history, max_window: int = MAX_WINDOW) -> PackedHistory:
     ops = pair_ops(history)
     intern = _Interner()
 
-    try:
-        kernel = kernel_for(model)
-    except ValueError:
-        kernel = None
-
-    # Initial state: intern the model's observable value.
-    if isinstance(model, (model_ns.CASRegister, model_ns.Register)):
-        init_state = np.array([intern(model.value)], np.int32)
-    elif isinstance(model, model_ns.Mutex):
-        init_state = np.array([1 if model.locked else 0], np.int32)
-    else:
-        init_state = np.array([0], np.int32)
+    # Per-op (f, values) interned ONCE up front — the packing walk below
+    # references ops (R x W) times and must not re-intern per reference.
+    kernel, init_state, op_f, op_v = _kernelize(model, ops, intern)
 
     n = len(ops)
     R = sum(1 for o in ops if o.ok)
-
-    # Per-op (f, values) interned ONCE up front — the packing walk below
-    # references ops (R x W) times and must not re-intern per reference.
-    op_f = np.zeros(n, np.int32)
-    op_v = np.full((n, VALUE_WIDTH), int(NIL), np.int32)
-    if kernel is not None:
-        for i, o in enumerate(ops):
-            f_id, v = _op_f_and_values(o, intern)
-            op_f[i] = f_id
-            op_v[i] = v
 
     invoke_pos = np.fromiter((o.invoke_pos for o in ops), np.int32, n)
     return_pos = np.fromiter(
@@ -277,8 +412,10 @@ def prepare(model, history, max_window: int = MAX_WINDOW) -> PackedHistory:
         np.int32, n)
 
     fill_fv = kernel is not None
-    packed = _pack_events_native(
-        invoke_pos, return_pos, op_f, op_v, max_window, fill_fv, R)
+    packed = None
+    if op_v.shape[1] == 2:  # the native walk is specialized to 2-word values
+        packed = _pack_events_native(
+            invoke_pos, return_pos, op_f, op_v, max_window, fill_fv, R)
     if packed is None:
         packed = _pack_events_py(
             invoke_pos, return_pos, op_f, op_v, max_window, fill_fv, R)
@@ -331,6 +468,75 @@ def py_step_fn(kernel_name: str) -> Callable:
                 return locked == 0, (1,)
             if f == K.F_RELEASE:
                 return locked == 1, (0,)
+            return False, state
+
+        return step
+
+    if kernel_name == "set":
+        def step(state, f, v):
+            if f == K.F_ADD:
+                e = v[0]
+                if e == nil:
+                    return False, state
+                w, b = divmod(e, K.SET_BITS)
+                s = list(state)
+                s[w] |= 1 << b
+                return True, tuple(s)
+            if f == K.F_READ:
+                return tuple(v[:len(state)]) == tuple(state), state
+            return False, state
+
+        return step
+
+    if kernel_name == "unordered-unique":
+        def step(state, f, v):
+            e = v[0]
+            if e == nil:
+                return False, state
+            w, b = divmod(e, K.SET_BITS)
+            has = bool((state[w] >> b) & 1)
+            if f == K.F_ENQUEUE and not has:
+                s = list(state)
+                s[w] |= 1 << b
+                return True, tuple(s)
+            if f == K.F_DEQUEUE and has:
+                s = list(state)
+                s[w] &= ~(1 << b)
+                return True, tuple(s)
+            return False, state
+
+        return step
+
+    if kernel_name == "unordered-queue":
+        def step(state, f, v):
+            e = v[0]
+            if f == K.F_ENQUEUE:
+                s = list(state)
+                s[e] += 1
+                return True, tuple(s)
+            if f == K.F_DEQUEUE:
+                if 0 <= e < len(state) and state[e] > 0:
+                    s = list(state)
+                    s[e] -= 1
+                    return True, tuple(s)
+                return False, state
+            return False, state
+
+        return step
+
+    if kernel_name == "fifo-queue":
+        def step(state, f, v):
+            size, buf = state[0], state[1:]
+            if f == K.F_ENQUEUE:
+                if size >= len(buf):
+                    return False, state
+                s = list(buf)
+                s[size] = v[0]
+                return True, (size + 1, *s)
+            if f == K.F_DEQUEUE:
+                if size > 0 and buf[0] == v[0]:
+                    return True, (size - 1, *buf[1:], 0)
+                return False, state
             return False, state
 
         return step

@@ -22,6 +22,7 @@ Conventions:
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import lru_cache
 from typing import Callable
 
 import jax.numpy as jnp
@@ -37,9 +38,13 @@ F_RELEASE = 4
 # kernel's identity padding rows (bucketing history length to a few static
 # shapes so XLA compiles once per bucket, not once per history).
 F_NOOP = 5
+F_ENQUEUE = 6
+F_DEQUEUE = 7
+F_ADD = 8
 
 F_IDS = {"read": F_READ, "write": F_WRITE, "cas": F_CAS,
-         "acquire": F_ACQUIRE, "release": F_RELEASE}
+         "acquire": F_ACQUIRE, "release": F_RELEASE,
+         "enqueue": F_ENQUEUE, "dequeue": F_DEQUEUE, "add": F_ADD}
 
 # Sentinel for nil/unknown values. Never produced by interning.
 NIL = np.int32(-(2 ** 31))
@@ -55,7 +60,8 @@ class KernelModel:
     name: str
     state_width: int
     init_state: Callable[[], np.ndarray]  # initial packed state (host)
-    step: Callable  # (i32[S], i32, i32[2]) -> (bool_, i32[S])
+    step: Callable  # (i32[S], i32, i32[VW]) -> (bool_, i32[S])
+    value_width: int = VALUE_WIDTH  # words per op value (VW)
 
 
 # --- cas-register (reference model.clj:21-40) -------------------------------
@@ -93,6 +99,159 @@ def _mutex_step(state, f, v):
     new = jnp.where(is_acq, jnp.int32(1),
                     jnp.where(is_rel, jnp.int32(0), locked))
     return ok, state.at[0].set(new)
+
+
+# --- set (reference model.clj:58-71) ----------------------------------------
+#
+# State is a bitmask over interned element ids, SET_BITS bits per i32 word
+# (31, keeping words non-negative so no word ever equals the NIL sentinel).
+# ``add e`` sets bit e; ``read S`` succeeds iff the observed mask — packed
+# host-side by prepare into the op's value words — equals the state exactly.
+# A nil/unpackable read carries all-NIL value words, which no state can
+# equal (mask words are non-negative) — matching SetModel, where a read of
+# a non-collection is inconsistent.
+
+SET_BITS = 31
+
+
+@lru_cache(maxsize=None)
+def _set_step_fn(n_words):
+    def step(state, f, v):
+        is_add = f == F_ADD
+        is_read = f == F_READ
+        e = v[0]
+        word = e // SET_BITS
+        bit = jnp.uint32(1) << (e % SET_BITS).astype(jnp.uint32)
+        add_mask = jnp.where(jnp.arange(n_words) == word,
+                             bit.astype(jnp.int32), 0)
+        match = jnp.all(state == v[:n_words])
+        ok = is_add | (is_read & match) | (f == F_NOOP)
+        new = jnp.where(is_add, state | add_mask, state)
+        return ok, new
+
+    return step
+
+
+def set_kernel(n_elements: int, initial_ids=()) -> KernelModel:
+    n_words = max(1, -(-n_elements // SET_BITS))
+
+    def init():
+        st = np.zeros(n_words, np.int32)
+        for e in initial_ids:
+            st[e // SET_BITS] |= np.int32(1 << (e % SET_BITS))
+        return st
+
+    return KernelModel("set", n_words, init, _set_step_fn(n_words),
+                       value_width=max(VALUE_WIDTH, n_words))
+
+
+# --- unordered-queue (reference model.clj:73-85) ----------------------------
+#
+# A multiset: state is a count per interned value id. Enqueue always
+# succeeds; dequeue succeeds iff its value's count is positive.
+
+@lru_cache(maxsize=None)
+def _unordered_queue_step_fn(n_values):
+    def step(state, f, v):
+        is_enq = f == F_ENQUEUE
+        is_deq = f == F_DEQUEUE
+        onehot = (jnp.arange(n_values) == v[0]).astype(jnp.int32)
+        cnt = jnp.sum(state * onehot)
+        ok = is_enq | (is_deq & (cnt > 0)) | (f == F_NOOP)
+        delta = jnp.where(is_enq, onehot, jnp.where(is_deq, -onehot, 0))
+        return ok, state + delta
+
+    return step
+
+
+def unordered_queue_kernel(n_values: int, initial_ids=()) -> KernelModel:
+    n = max(1, n_values)
+
+    def init():
+        st = np.zeros(n, np.int32)
+        for e in initial_ids:
+            st[e] += 1
+        return st
+
+    return KernelModel("unordered-queue", n, init,
+                       _unordered_queue_step_fn(n))
+
+
+# Specialization for the common queue-workload shape (reference disque/
+# rabbitmq suites enqueue unique ints): when every enqueued value is
+# distinct, the pending multiset is a set, packed as a bitmask like the set
+# kernel — 31 values per word instead of one count word per value.
+
+@lru_cache(maxsize=None)
+def _unordered_unique_step_fn(n_words):
+    def step(state, f, v):
+        is_enq = f == F_ENQUEUE
+        is_deq = f == F_DEQUEUE
+        e = v[0]
+        word = e // SET_BITS
+        bit = jnp.uint32(1) << (e % SET_BITS).astype(jnp.uint32)
+        mask_vec = jnp.where(jnp.arange(n_words) == word,
+                             bit.astype(jnp.int32), 0)
+        has = jnp.any((state & mask_vec) != 0)
+        ok = (is_enq & ~has) | (is_deq & has) | (f == F_NOOP)
+        new = jnp.where(is_enq, state | mask_vec,
+                        jnp.where(is_deq, state & ~mask_vec, state))
+        return ok, new
+
+    return step
+
+
+def unordered_unique_kernel(n_values: int, initial_ids=()) -> KernelModel:
+    n_words = max(1, -(-max(1, n_values) // SET_BITS))
+
+    def init():
+        st = np.zeros(n_words, np.int32)
+        for e in initial_ids:
+            st[e // SET_BITS] |= np.int32(1 << (e % SET_BITS))
+        return st
+
+    return KernelModel("unordered-unique", n_words, init,
+                       _unordered_unique_step_fn(n_words))
+
+
+# --- fifo-queue (reference model.clj:87-105) --------------------------------
+#
+# State is [size, buf[0..cap-1]] with buf[0] the front; empty cells are 0
+# (canonical, so dedup equality is exact). Enqueue writes at index size;
+# dequeue requires buf[0] == v and shifts left.
+
+@lru_cache(maxsize=None)
+def _fifo_queue_step_fn(capacity):
+    def step(state, f, v):
+        is_enq = f == F_ENQUEUE
+        is_deq = f == F_DEQUEUE
+        size = state[0]
+        buf = state[1:]
+        front = buf[0]
+        ok = ((is_enq & (size < capacity))
+              | (is_deq & (size > 0) & (front == v[0]))
+              | (f == F_NOOP))
+        enq_buf = buf.at[jnp.clip(size, 0, capacity - 1)].set(v[0])
+        deq_buf = jnp.concatenate([buf[1:], jnp.zeros(1, jnp.int32)])
+        new_buf = jnp.where(is_enq, enq_buf, jnp.where(is_deq, deq_buf, buf))
+        new_size = size + jnp.where(is_enq, 1, jnp.where(is_deq, -1, 0))
+        return ok, jnp.concatenate([new_size[None], new_buf])
+
+    return step
+
+
+def fifo_queue_kernel(capacity: int, initial_ids=()) -> KernelModel:
+    cap = max(1, capacity)
+
+    def init():
+        st = np.zeros(cap + 1, np.int32)
+        st[0] = len(initial_ids)
+        for i, e in enumerate(initial_ids):
+            st[1 + i] = e
+        return st
+
+    return KernelModel("fifo-queue", cap + 1, init,
+                       _fifo_queue_step_fn(cap))
 
 
 def cas_register_kernel(initial: int = int(NIL)) -> KernelModel:

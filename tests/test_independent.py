@@ -150,7 +150,8 @@ class TestIndependentChecker:
         ck = ind.checker(c.linearizable("cpu"))
         r = ck.check(None, m.set_model(), h, {})
         assert r[c.VALID] is True
-        assert r["results"]["k"]["analyzer"] == "cpu-generic"
+        # set histories now pack for the device/py-twin path
+        assert r["results"]["k"]["analyzer"] == "cpu-jit"
 
     def test_empty_history(self):
         ck = ind.checker(c.linearizable("tpu"))

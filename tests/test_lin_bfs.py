@@ -49,7 +49,7 @@ class TestBasics:
             invoke_op(1, "acquire", None), ok_op(1, "acquire", None)))
 
     def test_unsupported_model_unknown(self):
-        p = prepare.prepare(m.set_model(), History.of(
+        p = prepare.prepare(m.noop, History.of(
             invoke_op(0, "add", 1), ok_op(0, "add", 1)))
         assert bfs.check_packed(p)["valid?"] == "unknown"
 

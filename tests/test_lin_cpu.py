@@ -112,10 +112,16 @@ class TestMutexHistories:
 
 
 class TestGenericModels:
-    def test_set_model_generic_path(self):
+    def test_set_model_packed_path(self):
         h = H(invoke_op(0, "add", 1), ok_op(0, "add", 1),
               invoke_op(1, "read", [1]), ok_op(1, "read", [1]))
         p = prepare.prepare(m.set_model(), h)
+        assert p.kernel is not None and p.kernel.name == "set"
+        assert cpu.check_packed(p)["valid?"]
+
+    def test_noop_model_generic_path(self):
+        h = H(invoke_op(0, "add", 1), ok_op(0, "add", 1))
+        p = prepare.prepare(m.noop, h)
         assert p.kernel is None
         assert cpu.check_packed(p)["valid?"]
 

@@ -1,0 +1,240 @@
+"""Parity tests for the set / fifo-queue / unordered-queue device kernels.
+
+Three implementations must agree on every history: the generic CPU search
+over the Python models (the semantic reference, check_generic), the packed
+CPU search over the py_step_fn twins, and the device BFS kernel. Mirrors
+the reference's model semantics at model.clj:58-105.
+"""
+
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu.history import History, invoke_op, ok_op, info_op
+from jepsen_tpu.lin import batched, bfs, cpu, prepare, synth
+
+
+def verdicts(model, history):
+    """(generic, packed-cpu, device) verdicts for one history."""
+    p = prepare.prepare(model, history)
+    assert p.kernel is not None, "expected a device kernel"
+    generic = cpu.check_generic(p)["valid?"]
+    packed = cpu.check_packed(p)["valid?"]
+    device = bfs.check_packed(p)["valid?"]
+    assert generic == packed == device, \
+        f"generic={generic} packed={packed} device={device}"
+    return device
+
+
+class TestSetKernel:
+    def test_sequential_valid(self):
+        assert verdicts(m.set_model(), History.of(
+            invoke_op(0, "add", "a"), ok_op(0, "add", "a"),
+            invoke_op(0, "add", "b"), ok_op(0, "add", "b"),
+            invoke_op(0, "read", None), ok_op(0, "read", ["a", "b"])))
+
+    def test_read_missing_element_invalid(self):
+        assert not verdicts(m.set_model(), History.of(
+            invoke_op(0, "add", "a"), ok_op(0, "add", "a"),
+            invoke_op(0, "read", None), ok_op(0, "read", [])))
+
+    def test_read_phantom_element_invalid(self):
+        assert not verdicts(m.set_model(), History.of(
+            invoke_op(0, "add", "a"), ok_op(0, "add", "a"),
+            invoke_op(0, "read", None), ok_op(0, "read", ["a", "z"])))
+
+    def test_concurrent_add_read_either_way(self):
+        # read concurrent with an add may or may not observe it
+        assert verdicts(m.set_model(), History.of(
+            invoke_op(0, "add", "a"), ok_op(0, "add", "a"),
+            invoke_op(1, "add", "b"),
+            invoke_op(2, "read", None), ok_op(2, "read", ["a"]),
+            ok_op(1, "add", "b")))
+        assert verdicts(m.set_model(), History.of(
+            invoke_op(0, "add", "a"), ok_op(0, "add", "a"),
+            invoke_op(1, "add", "b"),
+            invoke_op(2, "read", None), ok_op(2, "read", ["a", "b"]),
+            ok_op(1, "add", "b")))
+
+    def test_crashed_add_observed_or_not(self):
+        assert verdicts(m.set_model(), History.of(
+            invoke_op(0, "add", "a"), info_op(0, "add", "a"),
+            invoke_op(1, "read", None), ok_op(1, "read", ["a"]),
+            invoke_op(1, "read", None), ok_op(1, "read", ["a"])))
+        # once unobserved after observed => invalid (sets only grow)
+        assert not verdicts(m.set_model(), History.of(
+            invoke_op(0, "add", "a"), info_op(0, "add", "a"),
+            invoke_op(1, "read", None), ok_op(1, "read", ["a"]),
+            invoke_op(1, "read", None), ok_op(1, "read", [])))
+
+    def test_initial_elements(self):
+        assert verdicts(m.SetModel(frozenset(["x"])), History.of(
+            invoke_op(0, "read", None), ok_op(0, "read", ["x"])))
+        assert not verdicts(m.SetModel(frozenset(["x"])), History.of(
+            invoke_op(0, "read", None), ok_op(0, "read", [])))
+
+    def test_read_with_none_element_never_matches(self):
+        assert not verdicts(m.set_model(), History.of(
+            invoke_op(0, "add", 1), ok_op(0, "add", 1),
+            invoke_op(0, "read", None), ok_op(0, "read", [1, None])))
+
+    def test_none_in_initial_set_falls_back(self):
+        p = prepare.prepare(m.SetModel(frozenset([None])), History.of(
+            invoke_op(0, "read", None), ok_op(0, "read", [None])))
+        assert p.kernel is None
+        assert cpu.check_packed(p)["valid?"] is True
+
+    def test_nil_add_falls_back(self):
+        p = prepare.prepare(m.set_model(), History.of(
+            invoke_op(0, "add", None), ok_op(0, "add", None)))
+        assert p.kernel is None  # generic CPU handles it
+        assert cpu.check_packed(p)["valid?"] is True
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_parity(self, seed):
+        h = synth.generate_set_history(40, concurrency=3, seed=seed)
+        assert verdicts(m.set_model(), h)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_corrupted_parity(self, seed):
+        h = synth.generate_set_history(40, concurrency=3, seed=seed,
+                                       read_prob=0.4)
+        bad = [o if not (o.is_ok and o.f == "read" and o.value)
+               else o.replace(value=list(o.value) + [9999])
+               for o in h]
+        verdicts(m.set_model(), History(bad))
+
+
+class TestFifoQueueKernel:
+    def test_fifo_order_valid(self):
+        assert verdicts(m.fifo_queue(), History.of(
+            invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+            invoke_op(0, "enqueue", 2), ok_op(0, "enqueue", 2),
+            invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 1),
+            invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 2)))
+
+    def test_fifo_reorder_invalid(self):
+        assert not verdicts(m.fifo_queue(), History.of(
+            invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+            invoke_op(0, "enqueue", 2), ok_op(0, "enqueue", 2),
+            invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 2)))
+
+    def test_concurrent_enqueues_either_order(self):
+        assert verdicts(m.fifo_queue(), History.of(
+            invoke_op(0, "enqueue", 1),
+            invoke_op(1, "enqueue", 2),
+            ok_op(0, "enqueue", 1), ok_op(1, "enqueue", 2),
+            invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 2),
+            invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 1)))
+
+    def test_dequeue_never_enqueued_invalid(self):
+        assert not verdicts(m.fifo_queue(), History.of(
+            invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+            invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 7)))
+
+    def test_crashed_enqueue_dequeued(self):
+        assert verdicts(m.fifo_queue(), History.of(
+            invoke_op(0, "enqueue", 1), info_op(0, "enqueue", 1),
+            invoke_op(1, "dequeue", None), ok_op(1, "dequeue", 1)))
+
+    def test_initial_pending(self):
+        assert verdicts(m.FIFOQueue((7,)), History.of(
+            invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 7)))
+        assert not verdicts(m.FIFOQueue((7,)), History.of(
+            invoke_op(0, "enqueue", 8), ok_op(0, "enqueue", 8),
+            invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 8)))
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_parity(self, seed):
+        h = synth.generate_queue_history(36, concurrency=3, seed=seed,
+                                         fifo=True, crash_prob=0.05)
+        assert verdicts(m.fifo_queue(), h)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_lifo_vs_fifo_parity(self, seed):
+        # histories from a *random-order* queue checked against FIFO:
+        # verdict may go either way; the three checkers must agree
+        h = synth.generate_queue_history(24, concurrency=3,
+                                         seed=seed, fifo=False)
+        verdicts(m.fifo_queue(), h)
+
+
+class TestUnorderedQueueKernel:
+    def test_any_order_valid(self):
+        assert verdicts(m.unordered_queue(), History.of(
+            invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+            invoke_op(0, "enqueue", 2), ok_op(0, "enqueue", 2),
+            invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 2),
+            invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 1)))
+
+    def test_double_dequeue_invalid(self):
+        assert not verdicts(m.unordered_queue(), History.of(
+            invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+            invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 1),
+            invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 1)))
+
+    def test_equal_values_distinct_repr_not_unique(self):
+        # 1 == True, so these enqueues are NOT distinct values; the
+        # bitmask specialization must not fire (regression: repr-based
+        # uniqueness chose it and gave a wrong invalid verdict)
+        assert verdicts(m.unordered_queue(), History.of(
+            invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+            invoke_op(0, "enqueue", True), ok_op(0, "enqueue", True),
+            invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 1),
+            invoke_op(0, "dequeue", None), ok_op(0, "dequeue", True)))
+
+    def test_duplicate_values_multiset(self):
+        assert verdicts(m.unordered_queue(), History.of(
+            invoke_op(0, "enqueue", 5), ok_op(0, "enqueue", 5),
+            invoke_op(0, "enqueue", 5), ok_op(0, "enqueue", 5),
+            invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 5),
+            invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 5)))
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_parity(self, seed):
+        h = synth.generate_queue_history(36, concurrency=3, seed=seed,
+                                         fifo=False, crash_prob=0.05)
+        assert verdicts(m.unordered_queue(), h)
+
+
+class TestDecodeAndBatch:
+    def test_decode_states(self):
+        p = prepare.prepare(m.set_model(), History.of(
+            invoke_op(0, "add", "a"), ok_op(0, "add", "a")))
+        r = cpu.check_packed(p)
+        assert r["valid?"] is True
+        assert r["configs"][0]["model"] == frozenset(["a"])
+
+        p = prepare.prepare(m.fifo_queue(), History.of(
+            invoke_op(0, "enqueue", 3), ok_op(0, "enqueue", 3),
+            invoke_op(0, "enqueue", 4), ok_op(0, "enqueue", 4)))
+        r = cpu.check_packed(p)
+        assert r["configs"][0]["model"] == (3, 4)
+
+        p = prepare.prepare(m.unordered_queue(), History.of(
+            invoke_op(0, "enqueue", 3), ok_op(0, "enqueue", 3)))
+        r = cpu.check_packed(p)
+        assert r["configs"][0]["model"] == (3,)
+
+    def test_batch_mixed_kernel_sizes_falls_back(self):
+        # per-key FIFO kernels sized differently -> no common step fn
+        subs = {
+            1: History.of(invoke_op(0, "enqueue", 1),
+                          ok_op(0, "enqueue", 1)),
+            2: History.of(invoke_op(0, "enqueue", 1),
+                          ok_op(0, "enqueue", 1),
+                          invoke_op(0, "enqueue", 2),
+                          ok_op(0, "enqueue", 2)),
+        }
+        assert batched.try_check_batch(m.fifo_queue(), subs) is None
+
+    def test_batch_same_sized_queue_keys(self):
+        subs = {
+            k: History.of(invoke_op(0, "enqueue", 1),
+                          ok_op(0, "enqueue", 1),
+                          invoke_op(0, "dequeue", None),
+                          ok_op(0, "dequeue", 1))
+            for k in (1, 2)
+        }
+        r = batched.try_check_batch(m.unordered_queue(), subs)
+        assert r is not None
+        assert all(v["valid?"] is True for v in r.values())
