@@ -45,11 +45,69 @@ MAX_DEVICE_WINDOW = 32
 CHUNK = 512
 
 
+def _compact_gather(mask, n, cap):
+    """Positions of the first ``cap`` mask-survivors, via cumsum + binary
+    search (TPU-friendly; scatter compaction serializes on TPU). Returns
+    (sel[cap] clipped indices, total survivors)."""
+    csum = jnp.cumsum(mask.astype(jnp.int32))
+    total = csum[-1]
+    sel = jnp.searchsorted(csum, jnp.arange(1, cap + 1, dtype=jnp.int32),
+                           method='scan_unrolled')
+    return jnp.clip(sel, 0, n - 1), total
+
+
+KEY_FILL = jnp.uint32(0xFFFFFFFF)  # pad beyond count; sorts after any config
+
+
+def _dedup_keys(key, valid, cap, prune_mask=None):
+    """Single-u32-key sort-dedup (invalid flag in bit 31) with optional
+    crashed-op dominance pruning, compacted by gather.
+
+    ``prune_mask`` is a u32 bitmask of key bits holding *crashed* pending
+    ops: a config whose key with one such bit cleared is also present is
+    dominated — the subset config can do everything it can (a crashed op
+    never returns, so nothing ever requires it linearized) — and is
+    dropped. Pruning runs pre-compaction so capacity overflow is judged on
+    the *pruned* frontier.
+
+    Returns (keys[cap] ascending + KEY_FILL padding, count, overflow).
+    """
+    n = key.shape[0]
+    key = key | ((~valid).astype(jnp.uint32) << 31)
+    key_s = lax.sort(key)
+    inv_s = key_s >> 31
+
+    prev_differs = key_s != jnp.roll(key_s, 1)
+    first = jnp.arange(n) == 0
+    mask = (inv_s == 0) & (first | prev_differs)
+
+    if prune_mask is not None:
+        # Parent join: clear each crashed bit; a binary-search hit on any
+        # parent marks this config dominated. Matching a duplicate or a
+        # dominated config is fine (domination is transitive).
+        j_bits = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+        rel = (prune_mask & j_bits) != 0              # [32] crashed bits
+        has = (key_s[:, None] & j_bits[None, :]) != 0  # [n,32]
+        parent = key_s[:, None] & ~j_bits[None, :]
+        idx = jnp.searchsorted(key_s, parent.reshape(-1),
+                               method='scan_unrolled').reshape(n, 32)
+        found = key_s[jnp.clip(idx, 0, n - 1)] == parent
+        dominated = jnp.any(has & found & rel[None, :] & mask[:, None],
+                            axis=1)
+        mask = mask & ~dominated
+
+    sel, total = _compact_gather(mask, n, cap)
+    overflow = total > cap
+    out = jnp.where(jnp.arange(cap) < total, key_s[sel], KEY_FILL)
+    count = jnp.minimum(total, cap)
+    return out, count, overflow
+
+
 def _dedup(bits, state, valid, cap, state_bits=None, nil_id=None):
     """Sort-dedup-compact. Returns (bits[cap], state[cap,S], count, overflow).
 
     Invalid rows sort last; duplicates are adjacent after the lexicographic
-    sort and masked; survivors are scatter-compacted to the front.
+    sort and masked; survivors are gather-compacted to the front.
 
     When ``state_bits`` is set (single-word state whose values fit in that
     many bits next to the W-bit bitset), the whole config packs into ONE
@@ -65,27 +123,12 @@ def _dedup(bits, state, valid, cap, state_bits=None, nil_id=None):
         b = state_bits
         sv = state[:, 0]
         packed_state = jnp.where(sv == NIL, nil_id, sv).astype(jnp.uint32)
-        key = ((bits << b) | packed_state) \
-            | ((~valid).astype(jnp.uint32) << 31)
-        key_s = lax.sort(key)
-        inv_s = key_s >> 31
-        cfg_s = key_s & jnp.uint32(0x7FFFFFFF)
-
-        prev_differs = cfg_s != jnp.roll(cfg_s, 1)
-        first = jnp.arange(n) == 0
-        mask = (inv_s == 0) & (first | prev_differs)
-
-        total = jnp.sum(mask.astype(jnp.int32))
-        overflow = total > cap
-        pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
-        idx = jnp.where(mask & (pos < cap), pos, n)
-
-        out_n = max(n, cap) + 1
-        out_cfg = jnp.zeros(out_n, jnp.uint32).at[idx].set(cfg_s)[:cap]
+        key = (bits << b) | packed_state
+        out_key, count, overflow = _dedup_keys(key, valid, cap)
+        out_cfg = jnp.where(out_key == KEY_FILL, jnp.uint32(0), out_key)
         out_bits = out_cfg >> b
         sv_out = (out_cfg & jnp.uint32((1 << b) - 1)).astype(jnp.int32)
         out_state = jnp.where(sv_out == nil_id, NIL, sv_out)[:, None]
-        count = jnp.minimum(total, cap)
         return out_bits, out_state, count, overflow
     s_width = state.shape[1]
     inv = (~valid).astype(jnp.uint32)
@@ -99,15 +142,11 @@ def _dedup(bits, state, valid, cap, state_bits=None, nil_id=None):
     first = jnp.arange(n) == 0
     mask = (inv_s == 0) & (first | prev_differs)
 
-    total = jnp.sum(mask.astype(jnp.int32))
+    sel, total = _compact_gather(mask, n, cap)
     overflow = total > cap
-    pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
-    idx = jnp.where(mask & (pos < cap), pos, n)
-
-    out_n = max(n, cap) + 1
-    out_bits = jnp.zeros(out_n, jnp.uint32).at[idx].set(bits_s)[:cap]
-    out_state = jnp.zeros((out_n, s_width), jnp.int32) \
-        .at[idx].set(state_s)[:cap]
+    live = jnp.arange(cap) < total
+    out_bits = jnp.where(live, bits_s[sel], 0)
+    out_state = jnp.where(live[:, None], state_s[sel], 0)
     count = jnp.minimum(total, cap)
     return out_bits, out_state, count, overflow
 
@@ -188,10 +227,10 @@ def _search(ret_slot, active, slot_f, slot_v, init_state, *, cap, step_fn):
 
 
 @partial(jax.jit, static_argnames=("cap", "step_fn", "state_bits",
-                                   "nil_id"))
-def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v,
+                                   "nil_id", "prune"))
+def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v, crashed,
                   bits, state, count, *, cap, step_fn,
-                  state_bits=None, nil_id=None):
+                  state_bits=None, nil_id=None, prune=False):
     """Process up to n_rows return events (tables are CHUNK-row static
     shapes; rows past n_rows are ignored) starting from a carried frontier.
 
@@ -201,8 +240,18 @@ def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v,
     transient frontier spike re-runs one chunk at a bigger cap instead of
     the whole search.
 
+    With ``state_bits`` set the whole row loop runs on packed u32 config
+    keys; with ``prune`` also set, crashed-op dominance pruning keeps the
+    frontier at the antichain of minimal crashed subsets (the 2^crashes
+    blowup from ops that never return collapses to ~#states x #crashes).
+
     Returns (bits[cap], state[cap,S], count, rows_done, dead, overflow).
     """
+    if state_bits is not None:
+        return _search_chunk_keys(
+            n_rows, ret_slot, active, slot_f, slot_v, crashed,
+            bits, state, count, cap=cap, step_fn=step_fn,
+            state_bits=state_bits, nil_id=nil_id, prune=prune)
     C, W = active.shape
     S = state.shape[1]
 
@@ -235,8 +284,7 @@ def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v,
                 [state, new_state.reshape(-1, S)], axis=0)
             cand_valid = jnp.concatenate([cfg_valid, legal.reshape(-1)])
 
-            b2, s2, n2, o2 = _dedup(cand_bits, cand_state, cand_valid, cap,
-                                    state_bits, nil_id)
+            b2, s2, n2, o2 = _dedup(cand_bits, cand_state, cand_valid, cap)
             return (b2, s2, n2, count, ovf | o2)
 
         init = (bits, state, count, jnp.int32(-1), ovf)
@@ -247,8 +295,7 @@ def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v,
         cfg_valid = jnp.arange(cap) < count
         keep = cfg_valid & ((bits & s_bit) != 0)
         bits = bits & ~s_bit
-        bits, state, count, o2 = _dedup(bits, state, keep, cap,
-                                        state_bits, nil_id)
+        bits, state, count, o2 = _dedup(bits, state, keep, cap)
         dead = count == 0
         return (r + 1, bits, state, count, dead, ovf | o2)
 
@@ -260,6 +307,99 @@ def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v,
         row_cond, row_body,
         (jnp.int32(0), bits, state, count, False, False))
     return bits, state, count, r, dead, ovf
+
+
+def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v, crashed,
+                       bits, state, count, *, cap, step_fn,
+                       state_bits, nil_id, prune):
+    """Packed-u32-key row loop (see _search_chunk): each config is ONE
+    uint32 (bits << state_bits | state id), so dedup is a single payload-
+    free sort, compaction a gather, and dominance pruning a binary-search
+    join on bit-cleared parent keys. Closure fixpoint is frontier
+    set-equality (count equality is not sound under pruning: the minimal-
+    antichain size can plateau while membership still moves)."""
+    from jepsen_tpu.models.kernels import NIL
+
+    C, W = active.shape
+    b = state_bits
+    bmask = jnp.uint32((1 << b) - 1)
+
+    step_cfg_slot = jax.vmap(
+        jax.vmap(step_fn, in_axes=(None, 0, 0)),
+        in_axes=(0, None, None))
+    slot_bit = (jnp.uint32(1) << jnp.arange(W, dtype=jnp.uint32))
+
+    def to_keys(bits, state, count):
+        sv = state[:, 0]
+        ps = jnp.where(sv == NIL, nil_id, sv).astype(jnp.uint32)
+        return jnp.where(jnp.arange(cap) < count,
+                         (bits << b) | ps, KEY_FILL)
+
+    def from_keys(keys, count):
+        live = jnp.arange(cap) < count
+        cfg = jnp.where(live, keys, 0)
+        bits = cfg >> b
+        sv = (cfg & bmask).astype(jnp.int32)
+        state = jnp.where(sv == nil_id, NIL, sv)[:, None]
+        return jnp.where(live, bits, 0), jnp.where(live[:, None], state, 0)
+
+    def row_body(carry):
+        r, keys, count, dead, ovf = carry
+        act = active[r]
+        f_row = slot_f[r]
+        v_row = slot_v[r]
+        s = ret_slot[r]
+        if prune:
+            crash_mask = (jnp.sum(jnp.where(crashed[r], slot_bit, 0)
+                                  .astype(jnp.uint32)) << b)
+        else:
+            crash_mask = None
+
+        def closure_cond(c):
+            keys, _, prev_keys, ovf = c
+            return jnp.any(keys != prev_keys) & ~ovf
+
+        def closure_body(c):
+            keys, count, _, ovf = c
+            cfg_valid = jnp.arange(cap) < count
+            bits, state = from_keys(keys, count)
+            ok, new_state = step_cfg_slot(state, f_row, v_row)
+            already = (bits[:, None] & slot_bit[None, :]) != 0
+            legal = ok & act[None, :] & ~already & cfg_valid[:, None]
+            nsv = new_state[..., 0]
+            pns = jnp.where(nsv == NIL, nil_id, nsv).astype(jnp.uint32)
+            new_keys = (((bits[:, None] | slot_bit[None, :]) << b) | pns)
+
+            cand = jnp.concatenate([jnp.where(cfg_valid, keys, 0),
+                                    new_keys.reshape(-1)])
+            cand_valid = jnp.concatenate([cfg_valid, legal.reshape(-1)])
+            k2, n2, o2 = _dedup_keys(cand, cand_valid, cap, crash_mask)
+            return (k2, n2, keys, ovf | o2)
+
+        init = (keys, count, jnp.full(cap, 0, jnp.uint32), ovf)
+        keys, count, _, ovf = lax.while_loop(
+            closure_cond, closure_body, init)
+
+        # Filter: the returner's linearization point must precede its
+        # return; then recycle its slot bit.
+        s_key_bit = jnp.uint32(1) << (b + s).astype(jnp.uint32)
+        cfg_valid = jnp.arange(cap) < count
+        keep = cfg_valid & ((keys & s_key_bit) != 0)
+        keys, count, o2 = _dedup_keys(
+            jnp.where(keep, keys & ~s_key_bit, 0), keep, cap, crash_mask)
+        dead = count == 0
+        return (r + 1, keys, count, dead, ovf | o2)
+
+    def row_cond(carry):
+        r, _, _, dead, ovf = carry
+        return (r < n_rows) & ~dead & ~ovf
+
+    keys0 = to_keys(bits, state, count)
+    r, keys, count, dead, ovf = lax.while_loop(
+        row_cond, row_body,
+        (jnp.int32(0), keys0, count, False, False))
+    out_bits, out_state = from_keys(keys, count)
+    return out_bits, out_state, count, r, dead, ovf
 
 
 def _chunk_slice(a: np.ndarray, base: int, chunk: int) -> np.ndarray:
@@ -328,6 +468,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     active_h = np.asarray(p.active)
     slot_f_h = np.asarray(p.slot_f)
     slot_v_h = np.asarray(p.slot_v)
+    crashed_h = np.asarray(p.crashed)
     S = p.init_state.shape[0]
     step_fn = p.kernel.step
 
@@ -357,15 +498,20 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             return {"valid?": "unknown", "analyzer": "tpu-bfs",
                     "error": "cancelled"}
         n = min(chunk, p.R - base)
+        crashed_chunk = _chunk_slice(crashed_h, base, chunk)
         tables = (jnp.asarray(_chunk_slice(ret_slot_h, base, chunk)),
                   jnp.asarray(_chunk_slice(active_h, base, chunk)),
                   jnp.asarray(_chunk_slice(slot_f_h, base, chunk)),
-                  jnp.asarray(_chunk_slice(slot_v_h, base, chunk)))
+                  jnp.asarray(_chunk_slice(slot_v_h, base, chunk)),
+                  jnp.asarray(crashed_chunk))
+        # Dominance pruning only matters (and only compiles in) when this
+        # chunk actually has crashed pending ops.
+        prune = state_bits is not None and bool(crashed_chunk.any())
         while True:
             b2, s2, c2, r_done, dead, ovf = _search_chunk(
                 jnp.int32(n), *tables, bits, state, count,
                 cap=cap_schedule[level], step_fn=step_fn,
-                state_bits=state_bits, nil_id=nil_id)
+                state_bits=state_bits, nil_id=nil_id, prune=prune)
             if not bool(ovf):
                 break
             if level + 1 >= len(cap_schedule):

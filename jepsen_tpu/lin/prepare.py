@@ -73,6 +73,7 @@ class PackedHistory:
     slot_f: np.ndarray           # i32[R,W] function id per active slot
     slot_v: np.ndarray           # i32[R,W,VALUE_WIDTH] interned values
     slot_op: np.ndarray          # i32[R,W] index into ops per active slot
+    crashed: np.ndarray          # bool[R,W] active slot holds a crashed op
     init_state: np.ndarray       # i32[S]
     intern: dict                 # value -> id
     unintern: list               # id -> value
@@ -423,12 +424,18 @@ def prepare(model, history, max_window: int = MAX_WINDOW) -> PackedHistory:
 
     crashed = [o for o in ops if o.return_pos is None]
 
+    # Per-slot crashed mask (drives the device search's dominance pruning).
+    crashed_tbl = np.zeros_like(active)
+    live = active & (slot_op >= 0)
+    crashed_tbl[live] = return_pos[slot_op[live]] < 0
+
     W = max(1, max_used)
     return PackedHistory(
         model=model, kernel=kernel, ops=ops, window=W, R=R,
         ret_slot=ret_slot, ret_op=ret_op,
         active=active[:, :W], slot_f=slot_f[:, :W],
         slot_v=slot_v[:, :W], slot_op=slot_op[:, :W],
+        crashed=crashed_tbl[:, :W],
         init_state=init_state, intern=intern.ids, unintern=intern.values,
         crashed_ops=crashed)
 
