@@ -53,12 +53,27 @@ def analysis(model, history, algorithm: str = "competition", **kw) -> dict:
 
         return cpu.check_packed(packed, **kw)
     if algorithm == "tpu":
-        from jepsen_tpu.lin import bfs
-
-        return bfs.check_packed(packed, **kw)
+        return device_check_packed(packed, **kw)
     if algorithm == "competition":
         return _competition(packed, **kw)
     raise ValueError(f"unknown linearizability algorithm {algorithm!r}")
+
+
+def device_check_packed(packed: PackedHistory, cancel=None, **kw) -> dict:
+    """The device search, routed by history shape: the dense config-space
+    bitmap engine (:mod:`jepsen_tpu.lin.dense`) when window and state count
+    fit its bounds — including every crashed-op history within them — else
+    the sparse sort-dedup frontier (:mod:`jepsen_tpu.lin.bfs`)."""
+    from jepsen_tpu.lin import bfs, dense
+
+    known = {"chunk", "snapshots", "cap_schedule"}
+    if kw.keys() - known:
+        raise TypeError(f"unknown device-check options {kw.keys() - known}")
+    if dense.plan(packed) is not None:
+        dkw = {k: v for k, v in kw.items() if k in ("chunk", "snapshots")}
+        return dense.check_packed(packed, cancel=cancel, **dkw)
+    skw = {k: v for k, v in kw.items() if k in ("cap_schedule", "chunk")}
+    return bfs.check_packed(packed, cancel=cancel, **skw)
 
 
 def _competition(packed: PackedHistory, **kw) -> dict:
@@ -66,7 +81,7 @@ def _competition(packed: PackedHistory, **kw) -> dict:
     (knossos.competition/analysis semantics). A racer returning "unknown"
     (e.g. no device kernel for this model) does not end the race — only
     when both racers fail to decide is "unknown" returned."""
-    from jepsen_tpu.lin import bfs, cpu
+    from jepsen_tpu.lin import cpu
 
     lock = threading.Lock()
     state: dict = {"result": None, "finished": 0}
@@ -92,7 +107,8 @@ def _competition(packed: PackedHistory, **kw) -> dict:
                     done.set()
 
     threads = [threading.Thread(target=run, args=(cpu.check_packed, "cpu")),
-               threading.Thread(target=run, args=(bfs.check_packed, "tpu"))]
+               threading.Thread(target=run,
+                                args=(device_check_packed, "tpu"))]
     for t in threads:
         t.start()
     done.wait()

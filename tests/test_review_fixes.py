@@ -19,13 +19,19 @@ from jepsen_tpu.lin import analysis
 
 def test_competition_decides_generic_models():
     """The device racer instantly returns 'unknown' for models without a
-    kernel; competition must still wait for the host's definite verdict."""
+    kernel; competition must still wait for the host's definite verdict.
+    The noop model is permanently kernel-less (set models gained device
+    kernels, so they now legitimately route to the kernelized cpu-jit)."""
     h = History.of(invoke_op(0, "add", 1), ok_op(0, "add", 1),
                    invoke_op(0, "read", [1]), ok_op(0, "read", [1]))
     for _ in range(5):
-        r = analysis(m.set_model(), h, algorithm="competition")
+        r = analysis(m.noop, h, algorithm="competition")
         assert r["valid?"] is True
         assert r["analyzer"] == "cpu-generic"
+    r = analysis(m.set_model(), h, algorithm="competition")
+    assert r["valid?"] is True
+    # either racer may win the race; both must agree on the verdict
+    assert r["analyzer"] in ("cpu-jit", "tpu-bfs")
 
 
 def test_competition_detects_violation_on_generic_model():
