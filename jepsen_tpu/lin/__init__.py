@@ -66,14 +66,14 @@ def device_check_packed(packed: PackedHistory, cancel=None, **kw) -> dict:
     the sparse sort-dedup frontier (:mod:`jepsen_tpu.lin.bfs`)."""
     from jepsen_tpu.lin import bfs, dense
 
-    known = {"chunk", "snapshots", "cap_schedule"}
+    known = {"chunk", "cap_schedule"}
     if kw.keys() - known:
+        # e.g. snapshots= is dense-only: call dense.check_packed directly.
         raise TypeError(f"unknown device-check options {kw.keys() - known}")
     if dense.plan(packed) is not None:
-        dkw = {k: v for k, v in kw.items() if k in ("chunk", "snapshots")}
+        dkw = {k: v for k, v in kw.items() if k == "chunk"}
         return dense.check_packed(packed, cancel=cancel, **dkw)
-    skw = {k: v for k, v in kw.items() if k in ("cap_schedule", "chunk")}
-    return bfs.check_packed(packed, cancel=cancel, **skw)
+    return bfs.check_packed(packed, cancel=cancel, **kw)
 
 
 def _competition(packed: PackedHistory, **kw) -> dict:

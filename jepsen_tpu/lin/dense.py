@@ -27,12 +27,11 @@ checker.clj:90-93) becomes branchless word-parallel bit algebra:
   dense analogue because nothing ever needs pruning.
 
 The search is a `lax.while_loop` over return events inside chunked
-dispatches whose carries chain on device — the host enqueues all chunks
-without a single blocking sync and fetches the tiny verdict scalars once at
-the end. Entry-frontier snapshots per chunk (a few KB each) can be
-retained via ``check_packed(snapshots=[...])`` so a counterexample pass
-can replay just the failing tail on the CPU oracle (see
-:func:`decode_bitmap`).
+dispatches; the host's only blocking fetch per chunk is the one-bit dead
+flag (~13 round-trips for a 100k-op history). Entry-frontier snapshots
+per chunk (a few KB each) can be retained via
+``check_packed(snapshots=[...])`` so a counterexample pass can replay
+just the failing tail on the CPU oracle (see :func:`decode_bitmap`).
 
 Cost model: one closure pass is ``W * NS`` fused elementwise ops over
 ``2**W`` words. For the flagship 100k-op crashed-op history (W=15, NS~8)
@@ -220,7 +219,11 @@ def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
     w_cur = bucket_w(int(row_hi[:min(chunk, p.R)].max()))
     F = jnp.zeros(1 << w_cur, jnp.uint32).at[0].set(jnp.uint32(1) << init_id)
 
-    results = []   # (base, rows_in_chunk, r_done, dead) device scalars
+    # One blocking fetch (the dead flag) per chunk: chunks are strictly
+    # sequential so there is no pipelining to lose, it exits early on a
+    # dead frontier, and it keeps a competition-race cancel prompt. For
+    # the flagship 100k history that is ~13 round-trips total (the round-1
+    # sparse engine paid ~196).
     base = 0
     while base < p.R:
         if cancel is not None and cancel.is_set():
@@ -240,10 +243,6 @@ def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
             jnp.asarray(pad_w(_chunk_slice(slot_f_h, base, chunk), w_cur)),
             jnp.asarray(pad_w(_chunk_slice(slot_v_h, base, chunk), w_cur)),
             w=w_cur, ns=ns, step_fn=step_fn)
-        results.append((base, n, r_done, dead))
-        base += n
-
-    for base, n, r_done, dead in results:
         if bool(dead):
             r = base + int(r_done) - 1
             ret = p.ops[int(p.ret_op[r])]
@@ -253,6 +252,8 @@ def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
                            "value": ret.value, "index": ret.op_index,
                            "ok": ret.ok},
                     "configs": [], "final-paths": []}
+        base += n
+
     return {"valid?": True, "analyzer": "tpu-dense",
             "final-frontier-popcount": int(
                 jnp.sum(lax.population_count(F))),

@@ -171,9 +171,28 @@ DEFAULT_CAP_PER_DEVICE = (64, 1024, 16384)
 
 
 def check_packed(p: PackedHistory, mesh: Mesh | None = None,
-                 cap_schedule=DEFAULT_CAP_PER_DEVICE) -> dict:
+                 cap_schedule=DEFAULT_CAP_PER_DEVICE,
+                 engine: str = "auto") -> dict:
     """Decide linearizability with the frontier sharded over a mesh. With
-    no mesh, shards over all visible devices on axis 'd'."""
+    no mesh, shards over all visible devices on axis 'd'.
+
+    ``engine="auto"`` routes to the hypercube-sharded dense bitmap engine
+    (:mod:`jepsen_tpu.lin.sharded_dense`) whenever the history fits its
+    bounds — chunked, crash-proof, no capacity escalation — and falls back
+    to the sparse all_gather-dedup frontier here otherwise;
+    ``engine="sparse"`` forces the sparse path."""
+    if engine not in ("auto", "sparse"):
+        raise ValueError(f"unknown engine {engine!r}; use 'auto'/'sparse'")
+    if mesh is None:
+        mesh = Mesh(np.array(jax.devices()), ("d",))
+
+    if engine == "auto":
+        from jepsen_tpu.lin import sharded_dense
+
+        n_dev = int(np.prod(mesh.devices.shape))
+        if sharded_dense.plan(p, n_dev) is not None:
+            return sharded_dense.check_packed(p, mesh=mesh)
+
     if p.kernel is None:
         return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
                 "error": f"no device kernel for {type(p.model).__name__}"}
@@ -183,8 +202,6 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
     if p.R == 0:
         return {"valid?": True, "analyzer": "tpu-bfs-sharded"}
 
-    if mesh is None:
-        mesh = Mesh(np.array(jax.devices()), ("d",))
     axis = mesh.axis_names[0]
 
     ret_slot_h, active_h, slot_f_h, slot_v_h = _pad_rows(p)

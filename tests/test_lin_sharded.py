@@ -55,7 +55,8 @@ def test_overflow_escalates_per_device():
     h = synth.generate_register_history(40, concurrency=6, seed=9,
                                         crash_prob=0.5, max_crashes=5)
     p = prepare.prepare(m.cas_register(), h)
-    r = sharded.check_packed(p, mesh=mesh(2), cap_schedule=(1,))
+    r = sharded.check_packed(p, mesh=mesh(2), cap_schedule=(1,),
+                             engine="sparse")
     assert r["valid?"] == "unknown"
 
 
