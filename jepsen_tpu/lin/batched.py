@@ -87,20 +87,28 @@ def try_check_batch(model, subs: dict) -> dict | None:
         [packed[k].init_state for k in ks]))
 
     step_fn = packed[ks[0]].kernel.step
+    n_keys = len(ks)
+    S = init_state.shape[1]
+    nw = (w_pad + 1 + 31) // 32
     for cap in BATCH_CAP_SCHEDULE:
-        def one(rs, ac, sf, sv, ist):
-            return bfs._search(rs, ac, sf, sv, ist, cap=cap,
-                               step_fn=step_fn)
+        bits0 = jnp.zeros((n_keys, cap, nw), jnp.uint32)
+        state0 = jnp.zeros((n_keys, cap, S), jnp.int32) \
+            .at[:, 0, :].set(init_state)
+        count0 = jnp.ones(n_keys, jnp.int32)
 
-        ok, dead_row, overflow, count = jax.vmap(one)(
-            ret_slot, active, slot_f, slot_v, init_state)
+        def one(rs, ac, sf, sv, b0, s0, c0):
+            return bfs._search_chunk(jnp.int32(r_pad), rs, ac, sf, sv,
+                                     b0, s0, c0, cap=cap, step_fn=step_fn)
+
+        _, _, count, rows, dead, overflow = jax.vmap(one)(
+            ret_slot, active, slot_f, slot_v, bits0, state0, count0)
         if not bool(jnp.any(overflow)):
             break
     if bool(jnp.any(overflow)):
         return None
 
-    ok = np.asarray(ok)
-    dead_row = np.asarray(dead_row)
+    ok = np.asarray(~(dead | overflow))
+    dead_row = np.asarray(rows) - 1
     results = {}
     for i, k in enumerate(ks):
         p = packed[k]

@@ -1,32 +1,38 @@
-"""The device linearizability kernel: BFS frontier over
+"""The sparse device linearizability kernel: BFS frontier over
 (linearized-op-bitset x model-state) configurations.
 
 This replaces the reference's exponential JVM search (knossos.linear /
-knossos.wgl, selected at checker.clj:90-93) with a data-parallel formulation
-designed for the TPU's compilation model:
+knossos.wgl, selected at checker.clj:90-93) with a data-parallel
+formulation designed for the TPU's compilation model:
 
-- The frontier lives in fixed-capacity device arrays: ``bits: u32[CAP]``
+- The frontier lives in fixed-capacity device arrays: ``bits: u32[CAP,NW]``
   (which pending ops each config has linearized — slot-compressed by
-  :mod:`jepsen_tpu.lin.prepare` so 32 bits cover the concurrency window,
-  not the history length) and ``state: i32[CAP, S]`` (packed model state).
-- One outer `lax.while_loop` walks the R return events. Each step runs the
+  :mod:`jepsen_tpu.lin.prepare` so NW*32 bits cover the concurrency
+  window, not the history length; NW is 1 for windows <= 32, 2 up to 64)
+  and ``state: i32[CAP, S]`` (packed model state).
+- One `lax.while_loop` walks the R return events. Each step runs the
   just-in-time closure as an inner `lax.while_loop`: candidate transitions
   are the full cross product (config x pending slot), evaluated in one shot
   by the branchless model step kernels (vmap x vmap) — this is the op that
   fills the vector units; there is no per-config control flow anywhere.
 - Dedup is a lexicographic `lax.sort` over (invalid, bits, state) followed
-  by adjacent-duplicate masking and a cumsum scatter compaction. Fixpoint
-  is detected by the unique-config count not growing (the old frontier is
-  part of the candidate pool, so the set is monotone).
+  by adjacent-duplicate masking and a cumsum-gather compaction. When the
+  window plus a compact state id fit in 31 bits, the whole config packs
+  into ONE u32 sort key (several times faster on TPU).
 - Static shapes throughout: frontier capacity CAP is a compile-time
   constant. Searches run on an escalating CAP schedule — almost all real
   histories need a tiny frontier, so the common case compiles small and
   fast, and only pathological histories pay for big buffers. Overflow is
   detected exactly (a lost config could flip the verdict) and escalates.
 
-The same jitted function is the unit that :mod:`jepsen_tpu.lin.sharded`
-shards over a device mesh and that the independent-keys checker vmaps over
-batched per-key histories.
+This engine is the wide-window fallback: histories whose window and state
+count fit the dense config-space bitmap (:mod:`jepsen_tpu.lin.dense`) are
+routed there instead (`jepsen_tpu.lin.device_check_packed`), including
+every crash-heavy history within those bounds. Crash-heavy histories in
+the 33..64-slot range can legitimately explode the sparse frontier; the
+cap schedule bounds that honestly ("unknown" at exhaustion) rather than
+pruning — a round-1 dominance-pruning join here kernel-faulted the TPU
+runtime and was removed in favor of the dense engine.
 """
 
 from __future__ import annotations
@@ -41,7 +47,7 @@ from jax import lax
 from jepsen_tpu.lin.prepare import PackedHistory
 
 DEFAULT_CAP_SCHEDULE = (256, 2048, 16384, 131072)
-MAX_DEVICE_WINDOW = 32
+MAX_DEVICE_WINDOW = 64
 CHUNK = 512
 
 
@@ -59,19 +65,10 @@ def _compact_gather(mask, n, cap):
 KEY_FILL = jnp.uint32(0xFFFFFFFF)  # pad beyond count; sorts after any config
 
 
-def _dedup_keys(key, valid, cap, prune_mask=None):
-    """Single-u32-key sort-dedup (invalid flag in bit 31) with optional
-    crashed-op dominance pruning, compacted by gather.
-
-    ``prune_mask`` is a u32 bitmask of key bits holding *crashed* pending
-    ops: a config whose key with one such bit cleared is also present is
-    dominated — the subset config can do everything it can (a crashed op
-    never returns, so nothing ever requires it linearized) — and is
-    dropped. Pruning runs pre-compaction so capacity overflow is judged on
-    the *pruned* frontier.
-
-    Returns (keys[cap] ascending + KEY_FILL padding, count, overflow).
-    """
+def _dedup_keys(key, valid, cap):
+    """Single-u32-key sort-dedup (invalid flag in bit 31), compacted by
+    gather. Returns (keys[cap] ascending + KEY_FILL padding, count,
+    overflow)."""
     n = key.shape[0]
     key = key | ((~valid).astype(jnp.uint32) << 31)
     key_s = lax.sort(key)
@@ -81,21 +78,6 @@ def _dedup_keys(key, valid, cap, prune_mask=None):
     first = jnp.arange(n) == 0
     mask = (inv_s == 0) & (first | prev_differs)
 
-    if prune_mask is not None:
-        # Parent join: clear each crashed bit; a binary-search hit on any
-        # parent marks this config dominated. Matching a duplicate or a
-        # dominated config is fine (domination is transitive).
-        j_bits = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
-        rel = (prune_mask & j_bits) != 0              # [32] crashed bits
-        has = (key_s[:, None] & j_bits[None, :]) != 0  # [n,32]
-        parent = key_s[:, None] & ~j_bits[None, :]
-        idx = jnp.searchsorted(key_s, parent.reshape(-1),
-                               method='scan_unrolled').reshape(n, 32)
-        found = key_s[jnp.clip(idx, 0, n - 1)] == parent
-        dominated = jnp.any(has & found & rel[None, :] & mask[:, None],
-                            axis=1)
-        mask = mask & ~dominated
-
     sel, total = _compact_gather(mask, n, cap)
     overflow = total > cap
     out = jnp.where(jnp.arange(cap) < total, key_s[sel], KEY_FILL)
@@ -103,41 +85,23 @@ def _dedup_keys(key, valid, cap, prune_mask=None):
     return out, count, overflow
 
 
-def _dedup(bits, state, valid, cap, state_bits=None, nil_id=None):
-    """Sort-dedup-compact. Returns (bits[cap], state[cap,S], count, overflow).
-
-    Invalid rows sort last; duplicates are adjacent after the lexicographic
-    sort and masked; survivors are gather-compacted to the front.
-
-    When ``state_bits`` is set (single-word state whose values fit in that
-    many bits next to the W-bit bitset), the whole config packs into ONE
-    uint32 sort key — invalid flag in bit 31 — so the sort is a single
-    payload-free u32 sort instead of a multi-key lexicographic one. This is
-    the hot op of the whole search; on TPU the single-key sort is several
-    times faster.
-    """
-    n = bits.shape[0]
-    if state_bits is not None:
-        from jepsen_tpu.models.kernels import NIL
-
-        b = state_bits
-        sv = state[:, 0]
-        packed_state = jnp.where(sv == NIL, nil_id, sv).astype(jnp.uint32)
-        key = (bits << b) | packed_state
-        out_key, count, overflow = _dedup_keys(key, valid, cap)
-        out_cfg = jnp.where(out_key == KEY_FILL, jnp.uint32(0), out_key)
-        out_bits = out_cfg >> b
-        sv_out = (out_cfg & jnp.uint32((1 << b) - 1)).astype(jnp.int32)
-        out_state = jnp.where(sv_out == nil_id, NIL, sv_out)[:, None]
-        return out_bits, out_state, count, overflow
+def _dedup(bits, state, valid, cap):
+    """Sort-dedup-compact over multi-word configs. bits: u32[n, NW];
+    state: i32[n, S]. Returns (bits[cap,NW], state[cap,S], count,
+    overflow). Invalid rows sort last; duplicates are adjacent after the
+    lexicographic sort and masked; survivors are gather-compacted."""
+    n, nw = bits.shape
     s_width = state.shape[1]
     inv = (~valid).astype(jnp.uint32)
-    operands = (inv, bits) + tuple(state[:, k] for k in range(s_width))
+    operands = (inv,) + tuple(bits[:, k] for k in range(nw)) \
+        + tuple(state[:, k] for k in range(s_width))
     sorted_ops = lax.sort(operands, num_keys=len(operands))
-    inv_s, bits_s = sorted_ops[0], sorted_ops[1]
-    state_s = jnp.stack(sorted_ops[2:], axis=1)
+    inv_s = sorted_ops[0]
+    bits_s = jnp.stack(sorted_ops[1:1 + nw], axis=1)
+    state_s = jnp.stack(sorted_ops[1 + nw:], axis=1)
 
-    prev_differs = (bits_s != jnp.roll(bits_s, 1)) | \
+    prev_differs = \
+        jnp.any(bits_s != jnp.roll(bits_s, 1, axis=0), axis=1) | \
         jnp.any(state_s != jnp.roll(state_s, 1, axis=0), axis=1)
     first = jnp.arange(n) == 0
     mask = (inv_s == 0) & (first | prev_differs)
@@ -145,32 +109,52 @@ def _dedup(bits, state, valid, cap, state_bits=None, nil_id=None):
     sel, total = _compact_gather(mask, n, cap)
     overflow = total > cap
     live = jnp.arange(cap) < total
-    out_bits = jnp.where(live, bits_s[sel], 0)
+    out_bits = jnp.where(live[:, None], bits_s[sel], 0)
     out_state = jnp.where(live[:, None], state_s[sel], 0)
     count = jnp.minimum(total, cap)
     return out_bits, out_state, count, overflow
 
 
-@partial(jax.jit, static_argnames=("cap", "step_fn"))
-def _search(ret_slot, active, slot_f, slot_v, init_state, *, cap, step_fn):
-    """Run the full search. Returns (ok, dead_row, overflow, final_count).
+def _slot_bits(W: int, nw: int):
+    """u32[W, NW] table: row j has bit j%32 set in word j//32."""
+    tbl = np.zeros((W, nw), np.uint32)
+    for j in range(W):
+        tbl[j, j // 32] = np.uint32(1) << (j % 32)
+    return jnp.asarray(tbl)
 
-    ret_slot: i32[R]; active: bool[R,W]; slot_f: i32[R,W];
-    slot_v: i32[R,W,VW]; init_state: i32[S].
+
+@partial(jax.jit, static_argnames=("cap", "step_fn", "state_bits",
+                                   "nil_id"))
+def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v,
+                  bits, state, count, *, cap, step_fn,
+                  state_bits=None, nil_id=None):
+    """Process up to n_rows return events (tables are CHUNK-row static
+    shapes; rows past n_rows are ignored) starting from a carried frontier.
+
+    The chunk is the unit of device dispatch: every chunk of every history
+    reuses the same compiled program per (cap, step_fn), each program runs
+    for bounded time (no watchdog kills on 100k-row histories), and a
+    transient frontier spike re-runs one chunk at a bigger cap instead of
+    the whole search.
+
+    With ``state_bits`` set (windows <= 31 - state_bits) the whole row
+    loop runs on packed u32 config keys.
+
+    Returns (bits[cap,NW], state[cap,S], count, rows_done, dead, overflow).
     """
-    R, W = active.shape
-    S = init_state.shape[0]
+    if state_bits is not None:
+        return _search_chunk_keys(
+            n_rows, ret_slot, active, slot_f, slot_v,
+            bits, state, count, cap=cap, step_fn=step_fn,
+            state_bits=state_bits, nil_id=nil_id)
+    C, W = active.shape
+    S = state.shape[1]
+    nw = bits.shape[1]
 
-    bits0 = jnp.zeros(cap, jnp.uint32)
-    state0 = jnp.zeros((cap, S), jnp.int32) \
-        .at[0].set(init_state)
-    count0 = jnp.int32(1)
-
-    step_cfg_slot = jax.vmap(                 # over configs
-        jax.vmap(step_fn, in_axes=(None, 0, 0)),   # over slots
+    step_cfg_slot = jax.vmap(
+        jax.vmap(step_fn, in_axes=(None, 0, 0)),
         in_axes=(0, None, None))
-
-    slot_bit = (jnp.uint32(1) << jnp.arange(W, dtype=jnp.uint32))
+    slot_bit = _slot_bits(W, nw)                       # [W, NW]
 
     def closure_cond(c):
         _, _, count, prev, ovf = c
@@ -186,14 +170,14 @@ def _search(ret_slot, active, slot_f, slot_v, init_state, *, cap, step_fn):
         def closure_body(c):
             bits, state, count, prev, ovf = c
             cfg_valid = jnp.arange(cap) < count
-
-            # the hot op: every (config x pending-slot) transition at once
             ok, new_state = step_cfg_slot(state, f_row, v_row)
-            already = (bits[:, None] & slot_bit[None, :]) != 0
+            already = jnp.any(
+                (bits[:, None, :] & slot_bit[None, :, :]) != 0, axis=-1)
             legal = ok & act[None, :] & ~already & cfg_valid[:, None]
-            new_bits = bits[:, None] | slot_bit[None, :]
+            new_bits = bits[:, None, :] | slot_bit[None, :, :]
 
-            cand_bits = jnp.concatenate([bits, new_bits.reshape(-1)])
+            cand_bits = jnp.concatenate(
+                [bits, new_bits.reshape(-1, nw)])
             cand_state = jnp.concatenate(
                 [state, new_state.reshape(-1, S)], axis=0)
             cand_valid = jnp.concatenate([cfg_valid, legal.reshape(-1)])
@@ -207,94 +191,10 @@ def _search(ret_slot, active, slot_f, slot_v, init_state, *, cap, step_fn):
 
         # Filter: the returning op's linearization point must precede its
         # return; then recycle its slot bit.
-        s_bit = jnp.uint32(1) << s.astype(jnp.uint32)
+        s_mask = slot_bit[s]                           # [NW]
         cfg_valid = jnp.arange(cap) < count
-        keep = cfg_valid & ((bits & s_bit) != 0)
-        bits = bits & ~s_bit
-        bits, state, count, o2 = _dedup(bits, state, keep, cap)
-        dead = count == 0
-        return (r + 1, bits, state, count, dead, ovf | o2)
-
-    def row_cond(carry):
-        r, _, _, _, dead, ovf = carry
-        return (r < R) & ~dead & ~ovf
-
-    r, bits, state, count, dead, ovf = lax.while_loop(
-        row_cond, row_body,
-        (jnp.int32(0), bits0, state0, count0, False, False))
-    # dead_row is the row at which the frontier died (r was incremented)
-    return ~dead & ~ovf, r - 1, ovf, count
-
-
-@partial(jax.jit, static_argnames=("cap", "step_fn", "state_bits",
-                                   "nil_id", "prune"))
-def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v, crashed,
-                  bits, state, count, *, cap, step_fn,
-                  state_bits=None, nil_id=None, prune=False):
-    """Process up to n_rows return events (tables are CHUNK-row static
-    shapes; rows past n_rows are ignored) starting from a carried frontier.
-
-    The chunk is the unit of device dispatch: every chunk of every history
-    reuses the same compiled program per (cap, step_fn), each program runs
-    for bounded time (no watchdog kills on 100k-row histories), and a
-    transient frontier spike re-runs one chunk at a bigger cap instead of
-    the whole search.
-
-    With ``state_bits`` set the whole row loop runs on packed u32 config
-    keys; with ``prune`` also set, crashed-op dominance pruning keeps the
-    frontier at the antichain of minimal crashed subsets (the 2^crashes
-    blowup from ops that never return collapses to ~#states x #crashes).
-
-    Returns (bits[cap], state[cap,S], count, rows_done, dead, overflow).
-    """
-    if state_bits is not None:
-        return _search_chunk_keys(
-            n_rows, ret_slot, active, slot_f, slot_v, crashed,
-            bits, state, count, cap=cap, step_fn=step_fn,
-            state_bits=state_bits, nil_id=nil_id, prune=prune)
-    C, W = active.shape
-    S = state.shape[1]
-
-    step_cfg_slot = jax.vmap(
-        jax.vmap(step_fn, in_axes=(None, 0, 0)),
-        in_axes=(0, None, None))
-    slot_bit = (jnp.uint32(1) << jnp.arange(W, dtype=jnp.uint32))
-
-    def closure_cond(c):
-        _, _, count, prev, ovf = c
-        return (count != prev) & ~ovf
-
-    def row_body(carry):
-        r, bits, state, count, dead, ovf = carry
-        act = active[r]
-        f_row = slot_f[r]
-        v_row = slot_v[r]
-        s = ret_slot[r]
-
-        def closure_body(c):
-            bits, state, count, prev, ovf = c
-            cfg_valid = jnp.arange(cap) < count
-            ok, new_state = step_cfg_slot(state, f_row, v_row)
-            already = (bits[:, None] & slot_bit[None, :]) != 0
-            legal = ok & act[None, :] & ~already & cfg_valid[:, None]
-            new_bits = bits[:, None] | slot_bit[None, :]
-
-            cand_bits = jnp.concatenate([bits, new_bits.reshape(-1)])
-            cand_state = jnp.concatenate(
-                [state, new_state.reshape(-1, S)], axis=0)
-            cand_valid = jnp.concatenate([cfg_valid, legal.reshape(-1)])
-
-            b2, s2, n2, o2 = _dedup(cand_bits, cand_state, cand_valid, cap)
-            return (b2, s2, n2, count, ovf | o2)
-
-        init = (bits, state, count, jnp.int32(-1), ovf)
-        bits, state, count, _, ovf = lax.while_loop(
-            closure_cond, closure_body, init)
-
-        s_bit = jnp.uint32(1) << s.astype(jnp.uint32)
-        cfg_valid = jnp.arange(cap) < count
-        keep = cfg_valid & ((bits & s_bit) != 0)
-        bits = bits & ~s_bit
+        keep = cfg_valid & jnp.any((bits & s_mask[None, :]) != 0, axis=-1)
+        bits = bits & ~s_mask[None, :]
         bits, state, count, o2 = _dedup(bits, state, keep, cap)
         dead = count == 0
         return (r + 1, bits, state, count, dead, ovf | o2)
@@ -309,15 +209,12 @@ def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v, crashed,
     return bits, state, count, r, dead, ovf
 
 
-def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v, crashed,
+def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
                        bits, state, count, *, cap, step_fn,
-                       state_bits, nil_id, prune):
+                       state_bits, nil_id):
     """Packed-u32-key row loop (see _search_chunk): each config is ONE
     uint32 (bits << state_bits | state id), so dedup is a single payload-
-    free sort, compaction a gather, and dominance pruning a binary-search
-    join on bit-cleared parent keys. Closure fixpoint is frontier
-    set-equality (count equality is not sound under pruning: the minimal-
-    antichain size can plateau while membership still moves)."""
+    free sort and compaction a gather."""
     from jepsen_tpu.models.kernels import NIL
 
     C, W = active.shape
@@ -333,7 +230,7 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v, crashed,
         sv = state[:, 0]
         ps = jnp.where(sv == NIL, nil_id, sv).astype(jnp.uint32)
         return jnp.where(jnp.arange(cap) < count,
-                         (bits << b) | ps, KEY_FILL)
+                         (bits[:, 0] << b) | ps, KEY_FILL)
 
     def from_keys(keys, count):
         live = jnp.arange(cap) < count
@@ -341,7 +238,8 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v, crashed,
         bits = cfg >> b
         sv = (cfg & bmask).astype(jnp.int32)
         state = jnp.where(sv == nil_id, NIL, sv)[:, None]
-        return jnp.where(live, bits, 0), jnp.where(live[:, None], state, 0)
+        return (jnp.where(live, bits, 0)[:, None],
+                jnp.where(live[:, None], state, 0))
 
     def row_body(carry):
         r, keys, count, dead, ovf = carry
@@ -349,34 +247,30 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v, crashed,
         f_row = slot_f[r]
         v_row = slot_v[r]
         s = ret_slot[r]
-        if prune:
-            crash_mask = (jnp.sum(jnp.where(crashed[r], slot_bit, 0)
-                                  .astype(jnp.uint32)) << b)
-        else:
-            crash_mask = None
 
         def closure_cond(c):
-            keys, _, prev_keys, ovf = c
-            return jnp.any(keys != prev_keys) & ~ovf
+            _, count, prev, ovf = c
+            return (count != prev) & ~ovf
 
         def closure_body(c):
             keys, count, _, ovf = c
             cfg_valid = jnp.arange(cap) < count
             bits, state = from_keys(keys, count)
+            bits1 = bits[:, 0]
             ok, new_state = step_cfg_slot(state, f_row, v_row)
-            already = (bits[:, None] & slot_bit[None, :]) != 0
+            already = (bits1[:, None] & slot_bit[None, :]) != 0
             legal = ok & act[None, :] & ~already & cfg_valid[:, None]
             nsv = new_state[..., 0]
             pns = jnp.where(nsv == NIL, nil_id, nsv).astype(jnp.uint32)
-            new_keys = (((bits[:, None] | slot_bit[None, :]) << b) | pns)
+            new_keys = (((bits1[:, None] | slot_bit[None, :]) << b) | pns)
 
             cand = jnp.concatenate([jnp.where(cfg_valid, keys, 0),
                                     new_keys.reshape(-1)])
             cand_valid = jnp.concatenate([cfg_valid, legal.reshape(-1)])
-            k2, n2, o2 = _dedup_keys(cand, cand_valid, cap, crash_mask)
-            return (k2, n2, keys, ovf | o2)
+            k2, n2, o2 = _dedup_keys(cand, cand_valid, cap)
+            return (k2, n2, count, ovf | o2)
 
-        init = (keys, count, jnp.full(cap, 0, jnp.uint32), ovf)
+        init = (keys, count, jnp.int32(-1), ovf)
         keys, count, _, ovf = lax.while_loop(
             closure_cond, closure_body, init)
 
@@ -386,7 +280,7 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v, crashed,
         cfg_valid = jnp.arange(cap) < count
         keep = cfg_valid & ((keys & s_key_bit) != 0)
         keys, count, o2 = _dedup_keys(
-            jnp.where(keep, keys & ~s_key_bit, 0), keep, cap, crash_mask)
+            jnp.where(keep, keys & ~s_key_bit, 0), keep, cap)
         dead = count == 0
         return (r + 1, keys, count, dead, ovf | o2)
 
@@ -425,7 +319,7 @@ def _pad_rows(p: PackedHistory):
 
     R, W = p.active.shape
     R_pad = 1 << max(4, (R - 1).bit_length())
-    if R_pad == R or W >= MAX_DEVICE_WINDOW:
+    if R_pad == R or W >= 32:
         return (np.asarray(p.ret_slot), np.asarray(p.active),
                 np.asarray(p.slot_f), np.asarray(p.slot_v))
 
@@ -468,8 +362,8 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     active_h = np.asarray(p.active)
     slot_f_h = np.asarray(p.slot_f)
     slot_v_h = np.asarray(p.slot_v)
-    crashed_h = np.asarray(p.crashed)
     S = p.init_state.shape[0]
+    nw = (p.window + 31) // 32
     step_fn = p.kernel.step
 
     # Single-u32-key dedup packing: possible when the one-word state's
@@ -477,8 +371,10 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     # to the W-bit bitset under the bit-31 invalid flag. Only the register
     # and mutex families qualify — other one-word states (e.g. a
     # single-value unordered-queue count) range past the intern table.
+    from jepsen_tpu.models.kernels import PACKED_STATE_KERNELS
+
     state_bits = nil_id = None
-    if S == 1 and p.kernel.name in ("cas-register", "register", "mutex"):
+    if S == 1 and p.kernel.name in PACKED_STATE_KERNELS:
         nid = max(len(p.unintern), 2)
         b = nid.bit_length()
         if p.window + b <= 31:
@@ -486,7 +382,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
 
     level = 0
     cap = cap_schedule[level]
-    bits = jnp.zeros(cap, jnp.uint32)
+    bits = jnp.zeros((cap, nw), jnp.uint32)
     state = jnp.zeros((cap, S), jnp.int32).at[0].set(
         jnp.asarray(p.init_state))
     count = jnp.int32(1)
@@ -498,20 +394,15 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             return {"valid?": "unknown", "analyzer": "tpu-bfs",
                     "error": "cancelled"}
         n = min(chunk, p.R - base)
-        crashed_chunk = _chunk_slice(crashed_h, base, chunk)
         tables = (jnp.asarray(_chunk_slice(ret_slot_h, base, chunk)),
                   jnp.asarray(_chunk_slice(active_h, base, chunk)),
                   jnp.asarray(_chunk_slice(slot_f_h, base, chunk)),
-                  jnp.asarray(_chunk_slice(slot_v_h, base, chunk)),
-                  jnp.asarray(crashed_chunk))
-        # Dominance pruning only matters (and only compiles in) when this
-        # chunk actually has crashed pending ops.
-        prune = state_bits is not None and bool(crashed_chunk.any())
+                  jnp.asarray(_chunk_slice(slot_v_h, base, chunk)))
         while True:
             b2, s2, c2, r_done, dead, ovf = _search_chunk(
                 jnp.int32(n), *tables, bits, state, count,
                 cap=cap_schedule[level], step_fn=step_fn,
-                state_bits=state_bits, nil_id=nil_id, prune=prune)
+                state_bits=state_bits, nil_id=nil_id)
             if not bool(ovf):
                 break
             if level + 1 >= len(cap_schedule):
@@ -523,12 +414,13 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             cap = cap_schedule[level]
             max_cap_used = max(max_cap_used, cap)
             grow = cap - bits.shape[0]
-            bits = jnp.pad(bits, (0, grow))
+            bits = jnp.pad(bits, ((0, grow), (0, 0)))
             state = jnp.pad(state, ((0, grow), (0, 0)))
         if bool(dead):
             r = base + int(r_done) - 1
             ret = p.ops[int(p.ret_op[r])]
             return {"valid?": False, "analyzer": "tpu-bfs",
+                    "dead-row": r,
                     "op": {"process": ret.process, "f": ret.f,
                            "value": ret.value, "index": ret.op_index,
                            "ok": ret.ok},

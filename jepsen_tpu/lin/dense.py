@@ -62,15 +62,12 @@ CHUNK = 8192
 _W_BUCKETS = (4, 6, 8, 10, 12, 14, 16, 18, 20)
 _NS_BUCKETS = (4, 8, 16, 32)
 
-# Kernels whose one-word state ranges over interned ids (NIL remapped to a
-# dedicated id) — the same families the sparse packed-u32 path accepts.
-_DENSE_KERNELS = ("cas-register", "register", "mutex")
-
-
 def plan(p: PackedHistory):
     """Dense-searchability test. Returns ``(w, ns, nil_id, init_id)`` with
     bucketed w/ns, or None when this history needs the sparse engine."""
-    if p.kernel is None or p.kernel.name not in _DENSE_KERNELS:
+    from jepsen_tpu.models.kernels import PACKED_STATE_KERNELS
+
+    if p.kernel is None or p.kernel.name not in PACKED_STATE_KERNELS:
         return None
     if p.state_width != 1 or p.window > MAX_DENSE_WINDOW:
         return None
@@ -174,11 +171,12 @@ def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
                  snapshots: list | None = None) -> dict:
     """Decide linearizability of a packed history with the dense engine.
 
-    All chunk dispatches are enqueued without host synchronization — the
-    frontier carry chains device-side — and the per-chunk verdict scalars
-    are fetched once at the end. ``snapshots``, if a list, receives
-    ``(base_row, entry_bitmap)`` pairs (device arrays) for witness
-    reconstruction. ``cancel`` (threading.Event) stops between dispatches.
+    The frontier carry chains device-side between chunk dispatches; the
+    host's only blocking fetch per chunk is the one-bit dead flag, giving
+    early exit on invalid histories and prompt race cancellation.
+    ``snapshots``, if a list, receives ``(base_row, entry_bitmap)`` pairs
+    (device arrays) for witness reconstruction. ``cancel``
+    (threading.Event) stops between dispatches.
     """
     pl = plan(p)
     if pl is None:
@@ -260,7 +258,7 @@ def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
             "configs": []}
 
 
-def decode_bitmap(p: PackedHistory, F, nil_id: int) -> list[tuple[int, int]]:
+def decode_bitmap(F, nil_id: int) -> list[tuple[int, tuple]]:
     """Host-side decode of a frontier bitmap into (bitset, state-word)
     configs in the CPU oracle's representation (state NIL-restored)."""
     from jepsen_tpu.models.kernels import NIL

@@ -33,7 +33,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from jepsen_tpu.lin.bfs import MAX_DEVICE_WINDOW, _pad_rows
+from jepsen_tpu.lin.bfs import _pad_rows
+
+# The sparse sharded frontier keeps single-word bitsets (the all_gather
+# dedup keys stay u32); wider windows fall back to the single-chip engine.
+MAX_DEVICE_WINDOW = 32
 from jepsen_tpu.lin.prepare import PackedHistory
 
 

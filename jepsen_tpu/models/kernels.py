@@ -49,6 +49,12 @@ F_IDS = {"read": F_READ, "write": F_WRITE, "cas": F_CAS,
 # Sentinel for nil/unknown values. Never produced by interning.
 NIL = np.int32(-(2 ** 31))
 
+# Kernel families whose one-word state ranges over interned ids (NIL
+# remapped to a dedicated id): eligible for the dense config-space bitmap
+# engine (lin/dense.py) and the sparse engine's packed-u32 sort keys
+# (lin/bfs.py). Keep the two engines' routing in sync via this constant.
+PACKED_STATE_KERNELS = ("cas-register", "register", "mutex")
+
 # Max value words per op: cas carries [cur, new]; everything else uses v[0].
 VALUE_WIDTH = 2
 

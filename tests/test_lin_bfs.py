@@ -114,3 +114,55 @@ def test_cancel_stops_both_racers():
         r = checker(p, cancel=ev)
         assert r["valid?"] == "unknown"
         assert r["error"] == "cancelled"
+
+
+def _cas_chain_history(n_chain, prefix_ops=6, seed=0):
+    """A history whose window spikes to n_chain via concurrently-pending
+    cas ops with chained preconditions cas(i -> i+1): only prefixes of the
+    chain can linearize, so the config set stays O(n_chain) while the
+    bitset genuinely spans n_chain slots. (A burst of n independent writes
+    would be 2^n configs — exponential for ANY config-set checker; wide
+    windows are device-feasible exactly when legality prunes the
+    interleavings, as in partitioned-cluster stalls.)"""
+    h = [invoke_op(0, "write", 0), ok_op(0, "write", 0)]
+    for i in range(n_chain):
+        h.append(invoke_op(i + 1, "cas", [i, i + 1]))
+    for i in range(n_chain):
+        h.append(ok_op(i + 1, "cas", [i, i + 1]))
+    h.append(invoke_op(0, "read", None))
+    h.append(ok_op(0, "read", n_chain))
+    return History.of(*h)
+
+
+def test_wide_window_40_parity():
+    # Windows in 33..64 use the multi-word sparse bitset (the dense engine
+    # caps at 20 slots); a 40-wide pending spike must decide on device
+    # with oracle parity.
+    h = _cas_chain_history(40)
+    p = prepare.prepare(m.cas_register(), h)
+    assert p.window == 40
+    r = bfs.check_packed(p)
+    assert r["valid?"] is True
+    assert r["analyzer"] == "tpu-bfs"
+    assert cpu.check_packed(p)["valid?"] is True
+
+
+def test_wide_window_40_invalid():
+    # Same spike, but the final read observes a value the chain can't
+    # reach — the device must find the violation, not just "unknown".
+    h = _cas_chain_history(40)
+    ops = list(h)
+    ops[-1] = ok_op(0, "read", 999)
+    p = prepare.prepare(m.cas_register(), History.of(*ops))
+    assert p.window == 40
+    r = bfs.check_packed(p)
+    assert r["valid?"] is False
+    assert r["op"]["f"] == "read"
+    assert cpu.check_packed(p)["valid?"] is False
+
+
+def test_window_above_64_unknown():
+    h = _cas_chain_history(70)
+    p = prepare.prepare(m.cas_register(), h, max_window=80)
+    assert p.window == 70
+    assert bfs.check_packed(p)["valid?"] == "unknown"

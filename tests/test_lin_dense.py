@@ -148,7 +148,7 @@ def test_snapshots_decode_matches_oracle_frontier():
     dense.check_packed(p, chunk=16, snapshots=snaps)
     assert snaps[0][0] == 0
     w, ns, nil_id, init_id = dense.plan(p)
-    cfgs = dense.decode_bitmap(p, snaps[0][1], nil_id)
+    cfgs = dense.decode_bitmap(snaps[0][1], nil_id)
     assert cfgs == [(0, (int(np.int32(-(2 ** 31))),))] or \
         cfgs == [(0, (init_id,))]
     assert [b for b, _ in snaps] == list(range(0, p.R, 16))
