@@ -88,7 +88,15 @@ def linearizable(algorithm: str = "competition", **kw) -> Checker:
     def check(test, model, history, opts):
         from jepsen_tpu import lin
 
-        a = lin.analysis(model, history, algorithm=algorithm, **kw)
+        # Counterexample paths by default, like knossos: the host racer
+        # tracks witness order; the device racer replays the failing tail
+        # (checker.clj:96-107 renders :final-paths from these).
+        kw2 = dict(kw)
+        if algorithm in ("cpu", "competition"):
+            kw2.setdefault("witness", True)
+        if algorithm in ("tpu", "competition"):
+            kw2.setdefault("explain", True)
+        a = lin.analysis(model, history, algorithm=algorithm, **kw2)
         a = dict(a)
         if not a.get(VALID, False):
             try:

@@ -43,6 +43,9 @@ def analysis(model, history, algorithm: str = "competition", **kw) -> dict:
     ``"competition"`` — race both like knossos.competition (the reference
     selects among these at checker.clj:90-93).
     """
+    known = {"witness", "cancel", "chunk", "cap_schedule", "explain"}
+    if kw.keys() - known:
+        raise TypeError(f"unknown analysis options {kw.keys() - known}")
     try:
         packed = _prepare_mod.prepare(model, history)
     except UnsupportedHistory as e:
@@ -51,7 +54,8 @@ def analysis(model, history, algorithm: str = "competition", **kw) -> dict:
     if algorithm == "cpu":
         from jepsen_tpu.lin import cpu
 
-        return cpu.check_packed(packed, **kw)
+        ckw = {k: v for k, v in kw.items() if k in ("witness", "cancel")}
+        return cpu.check_packed(packed, **ckw)
     if algorithm == "tpu":
         return device_check_packed(packed, **kw)
     if algorithm == "competition":
@@ -66,14 +70,18 @@ def device_check_packed(packed: PackedHistory, cancel=None, **kw) -> dict:
     the sparse sort-dedup frontier (:mod:`jepsen_tpu.lin.bfs`)."""
     from jepsen_tpu.lin import bfs, dense
 
-    known = {"chunk", "cap_schedule"}
+    known = {"chunk", "cap_schedule", "explain"}
     if kw.keys() - known:
         # e.g. snapshots= is dense-only: call dense.check_packed directly.
         raise TypeError(f"unknown device-check options {kw.keys() - known}")
     if dense.plan(packed) is not None:
-        dkw = {k: v for k, v in kw.items() if k == "chunk"}
+        dkw = {k: v for k, v in kw.items() if k in ("chunk", "explain")}
         return dense.check_packed(packed, cancel=cancel, **dkw)
-    return bfs.check_packed(packed, cancel=cancel, **kw)
+    # The sparse fallback keeps no frontier snapshots, so explain (a dense
+    # feature) is inert there: wide-window violations report the dead op
+    # without final-paths.
+    skw = {k: v for k, v in kw.items() if k != "explain"}
+    return bfs.check_packed(packed, cancel=cancel, **skw)
 
 
 def _competition(packed: PackedHistory, **kw) -> dict:
@@ -83,14 +91,17 @@ def _competition(packed: PackedHistory, **kw) -> dict:
     when both racers fail to decide is "unknown" returned."""
     from jepsen_tpu.lin import cpu
 
+    cpu_kw = {k: v for k, v in kw.items() if k in ("witness",)}
+    dev_kw = {k: v for k, v in kw.items()
+              if k in ("chunk", "cap_schedule", "explain")}
     lock = threading.Lock()
     state: dict = {"result": None, "finished": 0}
     done = threading.Event()
     cancel = threading.Event()
 
-    def run(fn, name):
+    def run(fn, name, fkw):
         try:
-            r = fn(packed, cancel=cancel, **kw)
+            r = fn(packed, cancel=cancel, **fkw)
         except Exception as e:  # noqa: BLE001 - loser may die, race decides
             r = {"valid?": "unknown", "error": f"{name}: {e!r}"}
         with lock:
@@ -106,9 +117,10 @@ def _competition(packed: PackedHistory, **kw) -> dict:
                 if state["finished"] == 2:
                     done.set()
 
-    threads = [threading.Thread(target=run, args=(cpu.check_packed, "cpu")),
+    threads = [threading.Thread(target=run,
+                                args=(cpu.check_packed, "cpu", cpu_kw)),
                threading.Thread(target=run,
-                                args=(device_check_packed, "tpu"))]
+                                args=(device_check_packed, "tpu", dev_kw))]
     for t in threads:
         t.start()
     done.wait()

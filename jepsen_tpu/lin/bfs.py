@@ -26,13 +26,16 @@ formulation designed for the TPU's compilation model:
   detected exactly (a lost config could flip the verdict) and escalates.
 
 This engine is the wide-window fallback: histories whose window and state
-count fit the dense config-space bitmap (:mod:`jepsen_tpu.lin.dense`) are
-routed there instead (`jepsen_tpu.lin.device_check_packed`), including
-every crash-heavy history within those bounds. Crash-heavy histories in
-the 33..64-slot range can legitimately explode the sparse frontier; the
-cap schedule bounds that honestly ("unknown" at exhaustion) rather than
-pruning — a round-1 dominance-pruning join here kernel-faulted the TPU
-runtime and was removed in favor of the dense engine.
+count fit the dense config-space bitmap (:mod:`jepsen_tpu.lin.dense`,
+window <= 20 and <= 32 states) are routed there instead
+(`jepsen_tpu.lin.device_check_packed`), which absorbs crash-heavy
+histories for free. Crash-heavy histories OUTSIDE the dense bounds —
+windows 21..64 or value-rich registers past 32 states — can legitimately
+grow the sparse frontier by 2^crashes; the cap schedule bounds that
+honestly ("unknown" at exhaustion, CPU fallback via competition) rather
+than pruning: the round-1 dominance-pruning join that targeted this slice
+kernel-faulted the TPU runtime on its own flagship workload and was
+removed.
 """
 
 from __future__ import annotations

@@ -87,43 +87,59 @@ def _witness_path(p: PackedHistory, cons) -> list:
     return path
 
 
-def check_packed(p: PackedHistory, witness: bool = False,
-                 cancel=None) -> dict:
-    """Decide linearizability on a packed history. ``witness=True`` tracks a
-    representative linearization order (cheap cons-cell sharing; first
-    discovery of a config wins). ``cancel`` (a threading.Event) stops the
-    search between rows — set by a competition race once the other racer
-    has decided."""
-    if p.kernel is None:
-        return check_generic(p, witness=witness)
+def _final_paths(p: PackedHistory, seen, order) -> list:
+    """knossos-style final-paths: for each config alive when the frontier
+    died, its model state and the linearization path that reached it (from
+    the search's anchor point)."""
+    if order is None:
+        return []
+    out = []
+    for cfg in list(seen)[:MAX_REPORT_CONFIGS]:
+        out.append({"model": decode_state(p, cfg[1]),
+                    "path": _witness_path(p, order.get(cfg))})
+    return out
 
+
+class Dead(Exception):
+    """Internal: the frontier emptied at row ``r``; carries the closure
+    set + paths for counterexample reporting."""
+
+    def __init__(self, r, seen, order):
+        self.r, self.seen, self.order = r, seen, order
+
+
+class Cancelled(Exception):
+    pass
+
+
+def search_rows(p: PackedHistory, configs, order, r0: int, r1: int,
+                cancel=None):
+    """The just-in-time linearization closure over return events
+    [r0, r1): from ``configs`` (a set of (bits, state-tuple)), closure +
+    filter each row. Returns (configs, order) on survival; raises Dead at
+    the row where the frontier empties, Cancelled on a race cancel.
+    ``order`` (or None to skip witness tracking) maps config -> cons list
+    of op ids, shared-structure, anchored wherever the caller started."""
     step = py_step_fn(p.kernel.name)
-    init = (0, tuple(int(x) for x in p.init_state))
-    configs = {init}
-    order: dict | None = {init: None} if witness else None
-
-    for r in range(p.R):
+    window = p.window
+    for r in range(r0, r1):
         if cancel is not None and cancel.is_set():
-            return {"valid?": "unknown", "analyzer": "cpu-jit",
-                    "error": "cancelled"}
+            raise Cancelled
         act = p.active[r]
         f_ints = p.slot_f[r].tolist()
         v_tups = [tuple(row) for row in p.slot_v[r].tolist()]
-        window = p.window
         seen = set(configs)
         frontier = list(configs)
         while frontier:
             # One row's closure can itself be exponential (2^window waves);
             # poll here too so a competition loser dies promptly.
             if cancel is not None and cancel.is_set():
-                return {"valid?": "unknown", "analyzer": "cpu-jit",
-                        "error": "cancelled"}
+                raise Cancelled
             new = []
             for ci, cfg in enumerate(frontier):
                 if cancel is not None and ci % 4096 == 4095 \
                         and cancel.is_set():
-                    return {"valid?": "unknown", "analyzer": "cpu-jit",
-                            "error": "cancelled"}
+                    raise Cancelled
                 bits, st = cfg
                 for j in range(window):
                     if act[j] and not (bits >> j) & 1:
@@ -153,14 +169,39 @@ def check_packed(p: PackedHistory, witness: bool = False,
                     if new_order is not None:
                         new_order[c2] = order[cfg]
         if not survivors:
-            ret = p.ops[int(p.ret_op[r])]
-            return {"valid?": False,
-                    "analyzer": "cpu-jit",
-                    "op": _op_dict(ret),
-                    "configs": _decode_configs(p, seen, r),
-                    "final-paths": []}
+            raise Dead(r, seen, order)
         order = new_order
         configs = survivors
+    return configs, order
+
+
+def check_packed(p: PackedHistory, witness: bool = False,
+                 cancel=None) -> dict:
+    """Decide linearizability on a packed history. ``witness=True`` tracks a
+    representative linearization order (cheap cons-cell sharing; first
+    discovery of a config wins) and, on an invalid verdict, emits
+    knossos-style final-paths. ``cancel`` (a threading.Event) stops the
+    search between rows — set by a competition race once the other racer
+    has decided."""
+    if p.kernel is None:
+        return check_generic(p, witness=witness)
+
+    init = (0, tuple(int(x) for x in p.init_state))
+    configs = {init}
+    order: dict | None = {init: None} if witness else None
+    try:
+        configs, order = search_rows(p, configs, order, 0, p.R,
+                                     cancel=cancel)
+    except Cancelled:
+        return {"valid?": "unknown", "analyzer": "cpu-jit",
+                "error": "cancelled"}
+    except Dead as d:
+        ret = p.ops[int(p.ret_op[d.r])]
+        return {"valid?": False,
+                "analyzer": "cpu-jit",
+                "op": _op_dict(ret),
+                "configs": _decode_configs(p, d.seen, d.r),
+                "final-paths": _final_paths(p, d.seen, d.order)}
 
     out = {"valid?": True, "analyzer": "cpu-jit",
            "configs": _decode_configs(p, configs, None)}
@@ -224,7 +265,7 @@ def check_generic(p: PackedHistory, witness: bool = False) -> dict:
                     "op": _op_dict(ret),
                     "configs": [{"model": st, "pending": []}
                                 for _, st in list(seen)[:MAX_REPORT_CONFIGS]],
-                    "final-paths": []}
+                    "final-paths": _final_paths(p, seen, order)}
         order = new_order
         configs = survivors
 

@@ -168,21 +168,27 @@ def _dense_chunk(F, n_rows, nil_id, ret_slot, active, slot_f, slot_v,
 
 
 def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
-                 snapshots: list | None = None) -> dict:
+                 snapshots: list | None = None,
+                 explain: bool = False) -> dict:
     """Decide linearizability of a packed history with the dense engine.
 
     The frontier carry chains device-side between chunk dispatches; the
     host's only blocking fetch per chunk is the one-bit dead flag, giving
     early exit on invalid histories and prompt race cancellation.
     ``snapshots``, if a list, receives ``(base_row, entry_bitmap)`` pairs
-    (device arrays) for witness reconstruction. ``cancel``
-    (threading.Event) stops between dispatches.
+    (device arrays) for witness reconstruction; ``explain=True`` retains
+    them internally and, on an invalid verdict, replays the failing tail
+    on the CPU oracle to emit knossos-style configs + final-paths
+    (:mod:`jepsen_tpu.lin.witness`). ``cancel`` (threading.Event) stops
+    between dispatches.
     """
     pl = plan(p)
     if pl is None:
         return {"valid?": "unknown", "analyzer": "tpu-dense",
                 "error": "history outside dense engine bounds"}
     w, ns, nil_id, init_id = pl
+    if explain and snapshots is None:
+        snapshots = []
     if p.R == 0:
         return {"valid?": True, "analyzer": "tpu-dense", "configs": []}
 
@@ -244,12 +250,19 @@ def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
         if bool(dead):
             r = base + int(r_done) - 1
             ret = p.ops[int(p.ret_op[r])]
-            return {"valid?": False, "analyzer": "tpu-dense",
-                    "dead-row": r,
-                    "op": {"process": ret.process, "f": ret.f,
-                           "value": ret.value, "index": ret.op_index,
-                           "ok": ret.ok},
-                    "configs": [], "final-paths": []}
+            out = {"valid?": False, "analyzer": "tpu-dense",
+                   "dead-row": r,
+                   "op": {"process": ret.process, "f": ret.f,
+                          "value": ret.value, "index": ret.op_index,
+                          "ok": ret.ok},
+                   "configs": [], "final-paths": []}
+            if explain and snapshots and \
+                    not (cancel is not None and cancel.is_set()):
+                from jepsen_tpu.lin import witness
+
+                out.update(witness.tail_replay(p, nil_id, snapshots, r,
+                                               cancel=cancel))
+            return out
         base += n
 
     return {"valid?": True, "analyzer": "tpu-dense",

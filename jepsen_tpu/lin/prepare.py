@@ -424,7 +424,9 @@ def prepare(model, history, max_window: int = MAX_WINDOW) -> PackedHistory:
 
     crashed = [o for o in ops if o.return_pos is None]
 
-    # Per-slot crashed mask (drives the device search's dominance pruning).
+    # Per-slot crashed mask — diagnostics/reporting only; no engine
+    # consumes it on device (the dominance pruning that did was removed in
+    # favor of the dense bitmap engine, which needs no pruning).
     crashed_tbl = np.zeros_like(active)
     live = active & (slot_op >= 0)
     crashed_tbl[live] = return_pos[slot_op[live]] < 0

@@ -100,7 +100,8 @@ def render_analysis(history, analysis: dict, path) -> str:
     inv_col, comp_col, n_cols = _event_columns(history, pairs)
     width = LEFT_MARGIN + (n_cols + 1) * PX_PER_COL + 40
     height = (TOP_MARGIN + len(processes) * (BAR_H + LANE_GAP)
-              + 30 + 16 * min(6, len((analysis or {}).get("configs", []))))
+              + 30 + 16 * min(6, len((analysis or {}).get("configs", [])))
+              + (16 if (analysis or {}).get("final-paths") else 0))
 
     out = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
            f'height="{height}" font-family="sans-serif" font-size="11">',
@@ -131,7 +132,34 @@ def render_analysis(history, analysis: dict, path) -> str:
         y = TOP_MARGIN + lane * (BAR_H + LANE_GAP) + 15
         out.append(f'<text x="8" y="{y}">process {escape(str(p))}</text>')
 
+    # Path badges: number the ops along the first final-path (the
+    # linearization order that reached a dying config), so the SVG shows
+    # HOW the search got stuck, not just where (knossos render parity).
+    paths = (analysis or {}).get("final-paths") or []
+    first_path = next((fp.get("path") for fp in paths
+                       if isinstance(fp, dict) and fp.get("path")), None)
+    if first_path:
+        order_of = {o.get("index"): i + 1 for i, o in enumerate(first_path)
+                    if isinstance(o, dict) and o.get("index") is not None}
+        for inv, comp in pairs:
+            n = order_of.get(inv.index)
+            if n is None:
+                continue
+            lane = lane_of[inv.process]
+            y = TOP_MARGIN + lane * (BAR_H + LANE_GAP)
+            x0 = LEFT_MARGIN + inv_col[id(inv)] * PX_PER_COL
+            out.append(f'<circle cx="{x0:.0f}" cy="{y:.0f}" r="8" '
+                       f'fill="#4a6fd4"/>')
+            out.append(f'<text x="{x0 - 3:.0f}" y="{y + 4:.0f}" '
+                       f'fill="#fff" font-size="10">{n}</text>')
+
     y = TOP_MARGIN + len(processes) * (BAR_H + LANE_GAP) + 16
+    if first_path:
+        steps = " -> ".join(_op_label(o.get("f"), o.get("value"))
+                            for o in first_path if isinstance(o, dict))
+        out.append(f'<text x="8" y="{y}" fill="#333">path: '
+                   f'{escape(steps)}</text>')
+        y += 16
     for cfg in (analysis or {}).get("configs", [])[:6]:
         model = cfg.get("model") if isinstance(cfg, dict) else cfg
         pend = cfg.get("pending", []) if isinstance(cfg, dict) else []

@@ -1,0 +1,60 @@
+"""Counterexample reconstruction for device-decided violations.
+
+The dense engine (:mod:`jepsen_tpu.lin.dense`) decides validity with a
+frontier bitmap that carries no parent pointers — storing paths on device
+would burn HBM bandwidth on the 99% case (valid histories) to serve the
+1% (violations). Instead the device search retains its per-chunk entry
+bitmaps (a few KB each), and on an invalid verdict this module replays
+JUST the failing tail on the host:
+
+1. take the last snapshot at or before the dead row — the *exact* closed
+   config set the device search had there (the bitmap is the
+   characteristic function, so no information is lost);
+2. run the CPU oracle's closure (:func:`jepsen_tpu.lin.cpu.search_rows`)
+   from that set through the dead row, tracking linearization order via
+   shared-structure cons cells;
+3. emit knossos-style ``final-paths`` — for each config alive at the
+   failure, its model state and the op path that reached it — the shape
+   the reference renders at checker.clj:96-107.
+
+The replay is bounded by one chunk of return events regardless of history
+length, so a 100k-op violation costs a <=CHUNK-row host replay, not a
+full re-check.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu.lin import cpu, dense
+from jepsen_tpu.lin.prepare import PackedHistory
+
+
+def tail_replay(p: PackedHistory, nil_id: int, snapshots: list,
+                dead_row: int, cancel=None) -> dict:
+    """Reconstruct configs + final-paths for a dense-engine violation at
+    ``dead_row`` from the engine's chunk-entry ``snapshots``. Returns a
+    dict with "configs" and "final-paths", or {} if reconstruction fails
+    or is cancelled (reporting is best-effort, like the reference's
+    render at checker.clj:96-103). ``cancel`` keeps a competition loser's
+    replay from blocking the race join."""
+    usable = [(b, F) for b, F in snapshots if b <= dead_row]
+    if not usable:
+        return {}
+    base, F = usable[-1]
+    configs = set()
+    for bits, st in dense.decode_bitmap(F, nil_id):
+        configs.add((bits, st))
+    if not configs:
+        return {}
+    order = {cfg: None for cfg in configs}
+    try:
+        cpu.search_rows(p, configs, order, base, dead_row + 1,
+                        cancel=cancel)
+    except cpu.Dead as d:
+        return {"configs": cpu._decode_configs(p, d.seen, d.r),
+                "final-paths": cpu._final_paths(p, d.seen, d.order)}
+    except Exception:
+        return {}
+    # The tail replay survived where the device died: a disagreement
+    # between engines — surface it rather than fabricate a path.
+    return {"error": "tail replay disagrees with device verdict "
+                     f"(rows {base}..{dead_row} survive on host)"}

@@ -1,0 +1,109 @@
+"""Counterexample reconstruction: device-decided violations must carry
+knossos-style configs + final-paths (checker.clj:96-107), built by
+replaying the failing tail on the CPU oracle from the dense engine's
+chunk-entry bitmap snapshots."""
+
+from jepsen_tpu import models as m
+from jepsen_tpu.history import History, info_op, invoke_op, ok_op
+from jepsen_tpu.lin import cpu, dense, prepare, synth
+
+
+def _bad_history(n=200, seed=5):
+    h = synth.generate_register_history(n, concurrency=4, seed=seed,
+                                        value_range=3, crash_prob=0.05,
+                                        max_crashes=6)
+    return synth.corrupt_history(h, seed=seed)
+
+
+def _find_invalid(seeds=range(20)):
+    for s in seeds:
+        h = _bad_history(seed=s)
+        p = prepare.prepare(m.cas_register(), h)
+        if cpu.check_packed(p)["valid?"] is False:
+            return p
+    raise RuntimeError("no invalid corrupted history found")
+
+
+def test_dense_explain_produces_paths():
+    p = _find_invalid()
+    r = dense.check_packed(p, chunk=32, explain=True)
+    assert r["valid?"] is False
+    assert r["final-paths"], "device violation must carry final-paths"
+    assert r["configs"], "device violation must carry configs"
+    fp = r["final-paths"][0]
+    assert "model" in fp and isinstance(fp["path"], list)
+    # every path op must reference a real op of the history
+    idxs = {o.op_index for o in p.ops}
+    for path in r["final-paths"]:
+        for o in path["path"]:
+            assert o["index"] in idxs
+
+
+def test_dense_explain_agrees_with_cpu_dead_row():
+    p = _find_invalid()
+    r = dense.check_packed(p, chunk=32, explain=True)
+    rc = cpu.check_packed(p, witness=True)
+    assert rc["valid?"] is False
+    assert r["op"]["index"] == rc["op"]["index"]
+    assert rc["final-paths"], "cpu violation must carry final-paths too"
+
+
+def test_explain_off_keeps_empty_paths():
+    p = _find_invalid()
+    r = dense.check_packed(p, chunk=32)
+    assert r["valid?"] is False
+    assert r["final-paths"] == []
+
+
+def test_cpu_witness_path_replays_to_failure():
+    # The witness path from a dying config must be a legal linearization
+    # prefix under the model (replayed through the python step twin).
+    from jepsen_tpu.lin.prepare import py_step_fn
+    from jepsen_tpu.models.kernels import F_IDS, NIL
+
+    p = _find_invalid()
+    r = cpu.check_packed(p, witness=True)
+    path = r["final-paths"][0]["path"]
+    step = py_step_fn(p.kernel.name)
+    st = tuple(int(x) for x in p.init_state)
+    by_index = {o.op_index: o for o in p.ops}
+    for od in path:
+        o = by_index[od["index"]]
+        f_id = F_IDS[o.f]
+        if o.f == "cas":
+            v = (p.intern.get(o.value[0], int(NIL)),
+                 p.intern.get(o.value[1], int(NIL)))
+        else:
+            v = (int(NIL) if o.value is None
+                 else p.intern.get(o.value, int(NIL)), int(NIL))
+        ok, st = step(st, f_id, v)
+        assert ok, f"witness path op {od} illegal at state {st}"
+
+
+def test_svg_renders_path(tmp_path):
+    from jepsen_tpu.lin import report
+
+    h = History.of(
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "write", 2), info_op(1, "write", 2),
+        invoke_op(2, "read", None), ok_op(2, "read", 2),
+        invoke_op(2, "read", None), ok_op(2, "read", 999))
+    p = prepare.prepare(m.cas_register(), h)
+    r = dense.check_packed(p, explain=True)
+    assert r["valid?"] is False
+    svg = report.render_analysis(list(h), r, tmp_path / "linear.svg")
+    assert "path:" in svg           # the linearization path footer
+    assert "circle" in svg          # numbered path badges on op bars
+    assert "Non-linearizable" in svg
+
+
+def test_checker_defaults_paths_on(tmp_path):
+    from jepsen_tpu import checker as c
+
+    h = History.of(
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(0, "read", None), ok_op(0, "read", 0))
+    for algo in ("cpu", "tpu", "competition"):
+        r = c.linearizable(algo).check(None, m.cas_register(), h, {})
+        assert r["valid?"] is False
+        assert r["final-paths"], f"algorithm {algo} lost final-paths"
