@@ -5,10 +5,9 @@ Workloads: the bank-style invariant set (galera.clj:256-258) and the
 dirty-reads probe (dirty_reads.clj:77): readers must never observe rows
 from aborted transactions. Nemesis: partition-random-halves
 (galera.clj:195). DB install provisions mariadb-server with a wsrep
-cluster address over all nodes (galera.clj:40-150).
-
-MySQL's wire protocol needs a driver (the reference uses JDBC); the
-client is gated and no-cluster runs use the workload fakes.
+cluster address over all nodes (galera.clj:40-150). The client speaks
+the MySQL wire protocol natively (jepsen_tpu.suites.mysqlwire) where the
+reference uses JDBC.
 """
 
 from __future__ import annotations
@@ -57,17 +56,16 @@ innodb_autoinc_lock_mode=2
 def test(opts: dict | None = None) -> dict:
     """The galera test map (galera.clj:240-270). ``workload`` picks
     bank (default) or dirty-reads."""
+    from jepsen_tpu.suites import mysql_clients
+
     opts = dict(opts or {})
     name = opts.pop("workload", None) or "bank"
-    wl = workloads.bank_workload() if name == "bank" \
-        else workloads.dirty_read_workload()
+    wl, client = mysql_clients.bank_or_dirty_reads(name)
     return common.suite_test(
         f"galera {name}", opts,
         workload=wl,
         db=GaleraDB(),
-        client=common.GatedClient(
-            "the MySQL wire protocol needs a driver (reference uses "
-            "JDBC); run with --fake"),
+        client=client,
         nemesis=nemesis_ns.partition_random_halves(),
         nemesis_gen=common.standard_nemesis_gen(5, 5))
 

@@ -1,0 +1,169 @@
+"""Minimal IRC line-protocol client for the RobustIRC suite.
+
+The reference drives RobustIRC through an IRC client library
+(robustirc/src/jepsen/robustirc.clj:213-215): writers post integers as
+channel messages, a connected reader accumulates everything it sees, and
+the set checker decides whether every acknowledged add survived the
+nemesis. IRC is a line protocol (``COMMAND args :trailing\\r\\n``), so
+the stdlib speaks it directly: NICK/USER registration, JOIN, PRIVMSG,
+and PING/PONG keepalive, with a reader thread collecting channel
+traffic.
+
+Two acknowledged-write subtleties the protocol forces:
+
+- IRC carries no per-message ack, so :meth:`IrcClient.say` confirms each
+  PRIVMSG with a PING round-trip — TCP ordering means the PONG proves
+  the server consumed the message — and an unconfirmed send is
+  *indeterminate* (:info), never ok.
+- Servers do not echo a session's own PRIVMSGs back (RFC 2812), so the
+  observable set at read time is the union of channel traffic received
+  and this connection's own *confirmed* sends.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+
+from jepsen_tpu import client as client_ns
+
+CHANNEL = "#jepsen"
+
+
+class IrcError(Exception):
+    pass
+
+
+class IrcClient:
+    def __init__(self, host: str, port: int = 6667, nick: str = "jepsen",
+                 channel: str = CHANNEL, timeout: float = 10.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.timeout = timeout
+        self.channel = channel
+        self.messages: list[str] = []
+        self.confirmed: list[str] = []
+        self.lock = threading.Lock()
+        self.registered = threading.Event()
+        self.joined = threading.Event()
+        self.pong = threading.Event()
+        self.error: str | None = None
+        self.closed = False
+        self._ping_n = 0
+        self._sendline(f"NICK {nick}")
+        self._sendline(f"USER {nick} 0 * :{nick}")
+        self.reader = threading.Thread(target=self._read_loop, daemon=True)
+        self.reader.start()
+        if not self.registered.wait(timeout) or self.error:
+            raise IrcError(self.error
+                           or "registration timed out (no 001 welcome)")
+        self._sendline(f"JOIN {channel}")
+        if not self.joined.wait(timeout):
+            raise IrcError(f"JOIN {channel} timed out")
+
+    def _sendline(self, line: str) -> None:
+        self.sock.sendall((line + "\r\n").encode())
+
+    def _read_loop(self) -> None:
+        buf = b""
+        try:
+            while not self.closed:
+                chunk = self.sock.recv(65536)
+                if not chunk:
+                    return
+                buf += chunk
+                while b"\r\n" in buf:
+                    raw, buf = buf.split(b"\r\n", 1)
+                    self._handle(raw.decode(errors="replace"))
+        except OSError:
+            return
+
+    def _handle(self, line: str) -> None:
+        if line.startswith(":"):
+            _, _, line = line[1:].partition(" ")
+        parts = line.split(" ")
+        cmd = parts[0].upper() if parts else ""
+        if cmd == "PING":
+            token = line.partition(" ")[2]
+            self._sendline(f"PONG {token}")
+        elif cmd == "PONG":
+            self.pong.set()
+        elif cmd == "001":
+            self.registered.set()
+        elif cmd in ("433", "432"):      # nick in use / erroneous
+            self.error = f"nick rejected ({cmd})"
+            self.registered.set()
+        elif cmd in ("JOIN", "366"):     # JOIN echo or end-of-NAMES
+            self.joined.set()
+        elif cmd == "PRIVMSG" and len(parts) >= 2 \
+                and parts[1].lower() == self.channel.lower():
+            text = line.partition(" :")[2]
+            with self.lock:
+                self.messages.append(text)
+
+    def say(self, text: str) -> None:
+        """PRIVMSG to the channel, confirmed by a PING round-trip: the
+        PONG arriving proves the server consumed everything sent before
+        the PING (TCP ordering). Raises IrcError on confirmation timeout
+        — the caller must report the op indeterminate, not failed."""
+        self.pong.clear()
+        self._ping_n += 1
+        self._sendline(f"PRIVMSG {self.channel} :{text}")
+        self._sendline(f"PING :ack{self._ping_n}")
+        if not self.pong.wait(self.timeout):
+            raise IrcError(f"no PONG after PRIVMSG {text!r}")
+        with self.lock:
+            self.confirmed.append(text)
+
+    def seen(self) -> list[str]:
+        """Channel traffic received + this session's confirmed sends
+        (servers don't echo a session's own messages back to it)."""
+        with self.lock:
+            return list(self.messages) + list(self.confirmed)
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self._sendline("QUIT :bye")
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class IrcSetClient(client_ns.Client):
+    """Set workload over IRC messages (robustirc.clj:213-215): add =
+    confirmed PRIVMSG of an integer, read = everything this
+    (continuously connected) client has observed on the channel."""
+
+    _nicks = itertools.count(1)      # shared: workers open concurrently
+
+    def __init__(self, conn: IrcClient | None = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return IrcSetClient(
+            IrcClient(node, nick=f"jepsen{next(self._nicks)}"))
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "add":
+                self.conn.say(str(op.value))
+                return op.replace(type="ok")
+            if op.f == "read":
+                vals = []
+                for m in self.conn.seen():
+                    try:
+                        vals.append(int(m))
+                    except ValueError:
+                        pass
+                return op.replace(type="ok", value=sorted(set(vals)))
+        except (OSError, ConnectionError, IrcError) as e:
+            # An unconfirmed PRIVMSG may still be in the raft log:
+            # adds are indeterminate, never failed.
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()

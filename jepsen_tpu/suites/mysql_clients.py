@@ -1,0 +1,254 @@
+"""Workload clients for the MySQL-family suites (galera, percona,
+mysql-cluster, tidb) over the native wire client
+(:mod:`jepsen_tpu.suites.mysqlwire`).
+
+These mirror the JDBC clients of the reference — galera.clj:40-120's
+bank, galera/dirty_reads.clj:30-60's reader/writer pair,
+tidb/{register,bank,sets}.clj — in MySQL dialect: no UPSERT (INSERT ...
+ON DUPLICATE KEY UPDATE), no RETURNING (conditional CAS checks the OK
+packet's affected-rows count), explicit BEGIN/COMMIT transactions with
+the deadlock/write-conflict retry loop in MyClient.txn.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu import independent
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites.mysqlwire import MyClient, MyError
+
+PORT = 3306
+DB = "jepsen"
+
+
+def _fail_or_info(op: Op, e: Exception) -> Op:
+    """Reads can safely fail (definitely didn't happen); writes whose
+    fate is unknown crash the process (core.clj:185-217 semantics)."""
+    definite = isinstance(e, MyError)
+    return op.replace(
+        type="fail" if (op.f == "read" or definite) else "info",
+        error=str(e) if definite else repr(e))
+
+
+class _SqlClient(client_ns.Client):
+    """Shared open/close/setup plumbing: connect to the node's mysqld
+    (``port`` varies: 3306 for mysqld/mariadb, 4000 for tidb-server),
+    create the jepsen database + the client's table on first setup."""
+
+    CREATE: tuple = ()       # DDL statements, run once against node 0
+
+    def __init__(self, conn: MyClient | None = None, port: int = PORT,
+                 **kw):
+        self.conn = conn
+        self.port = port
+        self.kw = kw
+
+    def _connect(self, node, database=DB):
+        return MyClient(node, port=self.port, user="root",
+                        database=database)
+
+    def open(self, test, node):
+        return type(self)(conn=self._connect(node), port=self.port,
+                          **self.kw)
+
+    def setup(self, test) -> None:
+        conn = MyClient(test["nodes"][0], port=self.port, user="root")
+        try:
+            conn.query(f"CREATE DATABASE IF NOT EXISTS {DB}")
+            for ddl in self.CREATE:
+                conn.query(ddl.format(db=DB))
+            self.populate(conn)
+        finally:
+            conn.close()
+
+    def populate(self, conn: MyClient) -> None:
+        pass
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()
+
+
+class RegisterClient(_SqlClient):
+    """Per-key linearizable register (tidb/register.clj:30-74): read =
+    SELECT, write = INSERT .. ON DUPLICATE KEY UPDATE, cas = conditional
+    UPDATE in a txn judged by affected-rows."""
+
+    TABLE = f"{DB}.jepsen_registers"
+    CREATE = (f"CREATE TABLE IF NOT EXISTS {TABLE} "
+              f"(id INT PRIMARY KEY, val INT)",)
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value if independent.is_tuple(op.value) else (0, op.value)
+
+        def join(val):
+            return independent.tuple_(k, val) \
+                if independent.is_tuple(op.value) else val
+
+        try:
+            if op.f == "read":
+                rows = self.conn.query(
+                    f"SELECT val FROM {self.TABLE} WHERE id = {int(k)}")
+                val = int(rows[0][0]) if rows and rows[0][0] is not None \
+                    else None
+                return op.replace(type="ok", value=join(val))
+            if op.f == "write":
+                self.conn.query(
+                    f"INSERT INTO {self.TABLE} (id, val) VALUES "
+                    f"({int(k)}, {int(v)}) "
+                    f"ON DUPLICATE KEY UPDATE val = {int(v)}")
+                return op.replace(type="ok")
+            if op.f == "cas":
+                old, new = v
+                self.conn.txn([
+                    f"UPDATE {self.TABLE} SET val = {int(new)} "
+                    f"WHERE id = {int(k)} AND val = {int(old)}"])
+                return op.replace(
+                    type="ok" if self.conn.last_affected == 1 else "fail")
+        except (MyError, OSError, ConnectionError) as e:
+            return _fail_or_info(op, e)
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+class BankClient(_SqlClient):
+    """Balance transfers in explicit transactions (galera.clj bank,
+    tidb/bank.clj): the conditional debit must not overdraw."""
+
+    TABLE = f"{DB}.jepsen_accounts"
+    CREATE = (f"CREATE TABLE IF NOT EXISTS {TABLE} "
+              f"(id INT PRIMARY KEY, balance INT NOT NULL)",)
+
+    def __init__(self, conn=None, port: int = PORT, n: int = 5,
+                 total: int = 50):
+        super().__init__(conn=conn, port=port, n=n, total=total)
+        self.n = n
+        self.total = total
+
+    def populate(self, conn: MyClient) -> None:
+        for i in range(self.n):
+            conn.query(f"INSERT IGNORE INTO {self.TABLE} VALUES "
+                       f"({i}, {self.total // self.n})")
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                rows = self.conn.query(
+                    f"SELECT balance FROM {self.TABLE} ORDER BY id")
+                return op.replace(type="ok",
+                                  value=[int(r[0]) for r in rows])
+            if op.f == "transfer":
+                t = op.value
+                for attempt in range(5):
+                    try:
+                        self.conn.query("BEGIN")
+                        self.conn.query(
+                            f"UPDATE {self.TABLE} SET balance = balance - "
+                            f"{t['amount']} WHERE id = {t['from']} AND "
+                            f"balance >= {t['amount']}")
+                        if self.conn.last_affected != 1:
+                            self.conn.query("ROLLBACK")
+                            return op.replace(type="fail",
+                                              error="insufficient funds")
+                        self.conn.query(
+                            f"UPDATE {self.TABLE} SET balance = balance + "
+                            f"{t['amount']} WHERE id = {t['to']}")
+                        self.conn.query("COMMIT")
+                        return op.replace(type="ok")
+                    except MyError as e:
+                        try:
+                            self.conn.query("ROLLBACK")
+                        except (MyError, ConnectionError, OSError):
+                            pass
+                        if not e.retryable or attempt == 4:
+                            return op.replace(type="fail", error=str(e))
+        except (OSError, ConnectionError) as e:
+            return _fail_or_info(op, e)
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+class TableClient(_SqlClient):
+    """Dirty-reads probe (galera/dirty_reads.clj:30-77): inserts commit
+    or deliberately abort; readers must never observe aborted rows."""
+
+    TABLE = f"{DB}.jepsen_rows"
+    CREATE = (f"CREATE TABLE IF NOT EXISTS {TABLE} "
+              f"(id INT PRIMARY KEY)",)
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "insert":
+                abort = op.get("abort", False)
+                self.conn.query("BEGIN")
+                try:
+                    self.conn.query(
+                        f"INSERT INTO {self.TABLE} VALUES ({int(op.value)})")
+                finally:
+                    self.conn.query("ROLLBACK" if abort else "COMMIT")
+                return op.replace(type="fail" if abort else "ok")
+            if op.f == "read":
+                rows = self.conn.query(f"SELECT id FROM {self.TABLE}")
+                return op.replace(type="ok",
+                                  value=[int(r[0]) for r in rows])
+        except (MyError, OSError, ConnectionError) as e:
+            return _fail_or_info(op, e)
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+class SetClient(_SqlClient):
+    """Concurrent adds + final read (tidb/sets.clj:53-55)."""
+
+    TABLE = f"{DB}.jepsen_sets"
+    CREATE = (f"CREATE TABLE IF NOT EXISTS {TABLE} "
+              f"(val INT PRIMARY KEY)",)
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add":
+                self.conn.query(
+                    f"INSERT INTO {self.TABLE} VALUES ({int(op.value)})")
+                return op.replace(type="ok")
+            if op.f == "read":
+                rows = self.conn.query(
+                    f"SELECT val FROM {self.TABLE} ORDER BY val")
+                return op.replace(type="ok",
+                                  value=[int(r[0]) for r in rows])
+        except (MyError, OSError, ConnectionError) as e:
+            return _fail_or_info(op, e)
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+class CounterClient(_SqlClient):
+    """Increments + reads against a single row (mysql-cluster's
+    simple-test shape over ndb)."""
+
+    TABLE = f"{DB}.jepsen_counter"
+    CREATE = (f"CREATE TABLE IF NOT EXISTS {TABLE} "
+              f"(id INT PRIMARY KEY, val INT NOT NULL)",)
+
+    def populate(self, conn: MyClient) -> None:
+        conn.query(f"INSERT IGNORE INTO {self.TABLE} VALUES (0, 0)")
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add":
+                self.conn.query(f"UPDATE {self.TABLE} "
+                                f"SET val = val + {int(op.value)} "
+                                f"WHERE id = 0")
+                return op.replace(type="ok")
+            if op.f == "read":
+                rows = self.conn.query(
+                    f"SELECT val FROM {self.TABLE} WHERE id = 0")
+                return op.replace(type="ok", value=int(rows[0][0]))
+        except (MyError, OSError, ConnectionError) as e:
+            return _fail_or_info(op, e)
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+def bank_or_dirty_reads(name: str, port: int = PORT):
+    """(workload, client) for the galera/percona workload registry: the
+    shared bank/dirty-reads mapping both suites expose."""
+    from jepsen_tpu.suites import workloads
+
+    if name == "bank":
+        return workloads.bank_workload(), BankClient(port=port)
+    return workloads.dirty_read_workload(), TableClient(port=port)

@@ -58,12 +58,13 @@ class NdbCluster(db_ns.DB, db_ns.LogFiles):
 def test(opts: dict | None = None) -> dict:
     """The simple-test map (mysql_cluster.clj:223-227): cluster cycles
     up and down; generator is a light read load."""
+    from jepsen_tpu.suites import mysql_clients
+
     return common.suite_test(
         "mysql-cluster", opts,
         workload=workloads.counter_workload(n=50),
         db=NdbCluster(),
-        client=common.GatedClient(
-            "the MySQL wire protocol needs a driver; run with --fake"),
+        client=mysql_clients.CounterClient(),
         nemesis=nemesis_ns.partition_random_halves(),
         nemesis_gen=common.standard_nemesis_gen(10, 10))
 

@@ -1,0 +1,265 @@
+"""Minimal MySQL client/server wire-protocol client.
+
+The reference drives its MySQL-family suites through JDBC — galera
+(galera/src/jepsen/galera.clj:40-120), percona, mysql-cluster, and TiDB
+(tidb/src/tidb/sql.clj). The TPU build speaks the wire protocol directly
+from the stdlib instead of vendoring a driver (sibling of
+:mod:`jepsen_tpu.suites.pgwire`): the v10 initial handshake,
+``mysql_native_password`` auth (with auth-switch), and the COM_QUERY text
+protocol — enough for the register/bank/sets/dirty-reads workload SQL.
+
+Protocol framing: every packet is ``len:3 (LE) seq:1 payload``; the
+sequence id resets per command. A COM_QUERY response is either an OK
+(0x00) / ERR (0xFF) packet or a result set: column count (length-encoded
+int), column definitions, EOF, text rows (length-encoded strings, 0xFB
+for NULL), EOF.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+
+CLIENT_LONG_PASSWORD = 0x00000001
+CLIENT_FOUND_ROWS = 0x00000002
+CLIENT_CONNECT_WITH_DB = 0x00000008
+CLIENT_PROTOCOL_41 = 0x00000200
+CLIENT_TRANSACTIONS = 0x00002000
+CLIENT_SECURE_CONNECTION = 0x00008000
+CLIENT_PLUGIN_AUTH = 0x00080000
+
+# Errors the JDBC suites' txn retry loops wrap (tidb/sql.clj's
+# with-txn-retries): InnoDB deadlock / lock-wait, TiDB write conflicts.
+RETRYABLE_CODES = {1205, 1213, 8002, 9007}
+
+
+class MyError(Exception):
+    """ERR packet from the server."""
+
+    def __init__(self, code: int, sqlstate: str, message: str):
+        self.code = code
+        self.sqlstate = sqlstate
+        self.message = message
+        super().__init__(f"({code}) [{sqlstate}] {message}")
+
+    @property
+    def retryable(self) -> bool:
+        return self.code in RETRYABLE_CODES
+
+
+def _scramble(password: str, nonce: bytes) -> bytes:
+    """mysql_native_password: SHA1(pw) XOR SHA1(nonce + SHA1(SHA1(pw)))."""
+    if not password:
+        return b""
+    p1 = hashlib.sha1(password.encode()).digest()
+    p2 = hashlib.sha1(p1).digest()
+    mix = hashlib.sha1(nonce + p2).digest()
+    return bytes(a ^ b for a, b in zip(p1, mix))
+
+
+class MyClient:
+    def __init__(self, host: str, port: int = 3306, user: str = "root",
+                 password: str = "", database: str = "",
+                 timeout: float = 10.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.buf = b""
+        self.seq = 0
+        self.last_affected = 0   # affected_rows of the most recent OK
+        self._handshake(user, password, database)
+
+    # --- framing -------------------------------------------------------------
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("connection closed")
+            self.buf += chunk
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+    def _read_packet(self) -> bytes:
+        head = self._read_exact(4)
+        n = head[0] | (head[1] << 8) | (head[2] << 16)
+        self.seq = (head[3] + 1) & 0xFF
+        return self._read_exact(n)
+
+    def _send_packet(self, payload: bytes) -> None:
+        if len(payload) >= 0xFFFFFF:
+            raise MyError(0, "HY000", "packet too large")
+        head = struct.pack("<I", len(payload))[:3] + bytes([self.seq])
+        self.seq = (self.seq + 1) & 0xFF
+        self.sock.sendall(head + payload)
+
+    # --- length-encoded primitives ------------------------------------------
+
+    @staticmethod
+    def _lenenc_int(b: bytes, off: int) -> tuple[int | None, int]:
+        c = b[off]
+        if c < 0xFB:
+            return c, off + 1
+        if c == 0xFB:            # NULL in text rows
+            return None, off + 1
+        if c == 0xFC:
+            return struct.unpack_from("<H", b, off + 1)[0], off + 3
+        if c == 0xFD:
+            v = b[off + 1] | (b[off + 2] << 8) | (b[off + 3] << 16)
+            return v, off + 4
+        return struct.unpack_from("<Q", b, off + 1)[0], off + 9
+
+    @classmethod
+    def _lenenc_str(cls, b: bytes, off: int) -> tuple[str | None, int]:
+        n, off = cls._lenenc_int(b, off)
+        if n is None:
+            return None, off
+        return b[off:off + n].decode(errors="replace"), off + n
+
+    @staticmethod
+    def _err(payload: bytes) -> MyError:
+        (code,) = struct.unpack_from("<H", payload, 1)
+        off = 3
+        state = "HY000"
+        if len(payload) > 3 and payload[3:4] == b"#":
+            state = payload[4:9].decode(errors="replace")
+            off = 9
+        return MyError(code, state, payload[off:].decode(errors="replace"))
+
+    # --- handshake -----------------------------------------------------------
+
+    def _handshake(self, user: str, password: str, database: str) -> None:
+        greeting = self._read_packet()
+        if greeting[:1] == b"\xff":
+            raise self._err(greeting)
+        if greeting[0] != 10:
+            raise MyError(0, "08004",
+                          f"unsupported protocol version {greeting[0]}")
+        off = 1
+        off = greeting.index(b"\x00", off) + 1      # server version
+        off += 4                                     # thread id
+        nonce = greeting[off:off + 8]
+        off += 8 + 1                                 # auth data 1 + filler
+        cap = struct.unpack_from("<H", greeting, off)[0]
+        off += 2
+        if len(greeting) > off:
+            off += 1 + 2                             # charset + status
+            cap |= struct.unpack_from("<H", greeting, off)[0] << 16
+            off += 2
+            auth_len = greeting[off]
+            off += 1 + 10                            # auth len + reserved
+            if cap & CLIENT_SECURE_CONNECTION:
+                n2 = max(13, auth_len - 8) - 1       # trailing NUL
+                nonce += greeting[off:off + n2]
+                off += max(13, auth_len - 8)
+        nonce = nonce[:20]
+
+        # FOUND_ROWS: affected-rows must count MATCHED rows, not changed
+        # ones — otherwise a cas(v, v) whose UPDATE matches but changes
+        # no bytes reports 0 and the register client would fail an op
+        # that actually took effect (a false linearizability violation).
+        caps = (CLIENT_LONG_PASSWORD | CLIENT_FOUND_ROWS
+                | CLIENT_PROTOCOL_41 | CLIENT_TRANSACTIONS
+                | CLIENT_SECURE_CONNECTION | CLIENT_PLUGIN_AUTH)
+        if database:
+            caps |= CLIENT_CONNECT_WITH_DB
+        token = _scramble(password, nonce)
+        payload = struct.pack("<IIB23x", caps, 1 << 24, 33)  # utf8
+        payload += user.encode() + b"\x00"
+        payload += bytes([len(token)]) + token
+        if database:
+            payload += database.encode() + b"\x00"
+        payload += b"mysql_native_password\x00"
+        self._send_packet(payload)
+        self._auth_result(password)
+
+    def _auth_result(self, password: str) -> None:
+        pkt = self._read_packet()
+        if pkt[:1] == b"\x00":
+            return
+        if pkt[:1] == b"\xff":
+            raise self._err(pkt)
+        if pkt[:1] == b"\xfe":                      # AuthSwitchRequest
+            rest = pkt[1:]
+            if b"\x00" in rest:
+                plugin, _, data = rest.partition(b"\x00")
+            else:
+                plugin, data = rest, b""
+            if plugin not in (b"mysql_native_password", b""):
+                raise MyError(0, "08004",
+                              f"unsupported auth plugin "
+                              f"{plugin.decode(errors='replace')!r} "
+                              f"(only mysql_native_password)")
+            self._send_packet(_scramble(password, data.rstrip(b"\x00")))
+            self._auth_result(password)
+            return
+        raise MyError(0, "08004", f"unexpected auth packet {pkt[:1]!r}")
+
+    # --- COM_QUERY text protocol --------------------------------------------
+
+    def query(self, sql: str) -> list[tuple]:
+        """Run one text-protocol query; returns rows as tuples of
+        str|None. DDL/DML returns [] and records affected rows in
+        ``last_affected``. Raises :class:`MyError` on an ERR packet (the
+        response ends there, so the connection stays usable)."""
+        self.seq = 0
+        self._send_packet(b"\x03" + sql.encode())
+        first = self._read_packet()
+        if first[:1] == b"\xff":
+            raise self._err(first)
+        if first[:1] == b"\x00":                    # OK: no result set
+            affected, off = self._lenenc_int(first, 1)
+            self.last_affected = affected or 0
+            return []
+        ncols, _ = self._lenenc_int(first, 0)
+        for _ in range(ncols):                      # column definitions
+            self._read_packet()
+        pkt = self._read_packet()
+        if pkt[:1] == b"\xfe" and len(pkt) < 9:     # EOF after columns
+            pkt = self._read_packet()
+        rows: list[tuple] = []
+        while True:
+            if pkt[:1] == b"\xff":
+                raise self._err(pkt)
+            if pkt[:1] == b"\xfe" and len(pkt) < 9:  # EOF / OK terminator
+                self.last_affected = 0
+                return rows
+            row = []
+            off = 0
+            for _ in range(ncols):
+                v, off = self._lenenc_str(pkt, off)
+                row.append(v)
+            rows.append(tuple(row))
+            pkt = self._read_packet()
+
+    def txn(self, statements: list[str], max_retries: int = 5) -> list:
+        """Run statements in a transaction with the deadlock/conflict
+        retry loop the reference wraps around JDBC (tidb/sql.clj).
+        Returns per-statement results; the last entry is the affected-row
+        count of the final statement (MySQL has no RETURNING)."""
+        for attempt in range(max_retries):
+            try:
+                self.query("BEGIN")
+                out: list = []
+                affected = 0
+                for s in statements:
+                    out.append(self.query(s))
+                    affected = self.last_affected
+                self.query("COMMIT")
+                self.last_affected = affected
+                return out
+            except MyError as e:
+                try:
+                    self.query("ROLLBACK")
+                except (MyError, ConnectionError, OSError):
+                    pass
+                if not e.retryable or attempt == max_retries - 1:
+                    raise
+        raise AssertionError("unreachable")
+
+    def close(self) -> None:
+        try:
+            self.seq = 0
+            self._send_packet(b"\x01")              # COM_QUIT
+            self.sock.close()
+        except OSError:
+            pass

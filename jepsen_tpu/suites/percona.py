@@ -53,16 +53,16 @@ innodb_autoinc_lock_mode=2
 
 def test(opts: dict | None = None) -> dict:
     """The percona test map (percona.clj:200-240)."""
+    from jepsen_tpu.suites import mysql_clients
+
     opts = dict(opts or {})
     name = opts.pop("workload", None) or "bank"
-    wl = workloads.bank_workload() if name == "bank" \
-        else workloads.dirty_read_workload()
+    wl, client = mysql_clients.bank_or_dirty_reads(name)
     return common.suite_test(
         f"percona {name}", opts,
         workload=wl,
         db=PerconaDB(),
-        client=common.GatedClient(
-            "the MySQL wire protocol needs a driver; run with --fake"),
+        client=client,
         nemesis=nemesis_ns.partition_random_halves(),
         nemesis_gen=common.standard_nemesis_gen(5, 5))
 

@@ -7,8 +7,8 @@ acknowledged add survived (robustirc.clj:213-215). Nemesis:
 partition-random-halves (robustirc.clj:192). DB install downloads the
 robustirc binary and bootstraps the network (robustirc.clj:30-120).
 
-The IRC wire protocol needs a client library in the reference; here
-it is gated and no-cluster runs use the set workload fake.
+The reference uses an IRC client library; the TPU build speaks the IRC
+line protocol natively (:mod:`jepsen_tpu.suites.ircwire`).
 """
 
 from __future__ import annotations
@@ -44,13 +44,13 @@ class RobustIrcDB(common.TarballDB):
 
 def test(opts: dict | None = None) -> dict:
     """The robustirc test map (robustirc.clj:180-220)."""
+    from jepsen_tpu.suites.ircwire import IrcSetClient
+
     return common.suite_test(
         "robustirc", opts,
         workload=workloads.set_workload(),
         db=RobustIrcDB(),
-        client=common.GatedClient(
-            "the IRC wire protocol needs a client library; "
-            "run with --fake"),
+        client=IrcSetClient(),
         nemesis=nemesis_ns.partition_random_halves(),
         nemesis_gen=common.standard_nemesis_gen(5, 5))
 

@@ -76,6 +76,8 @@ class TiDBCluster(db_ns.DB, db_ns.LogFiles):
 def test(opts: dict | None = None) -> dict:
     """The tidb test map (tidb/basic.clj + runner registry). ``workload``
     picks register (default) / bank / sets."""
+    from jepsen_tpu.suites import mysql_clients
+
     opts = dict(opts or {})
     name = opts.pop("workload", None) or "register"
     if name == "register":
@@ -83,17 +85,19 @@ def test(opts: dict | None = None) -> dict:
         if opts.get("concurrency", 0) < threads_per_key:
             opts["concurrency"] = threads_per_key
         wl = workloads.register(threads_per_key=threads_per_key)
+        client = mysql_clients.RegisterClient(port=4000)
     elif name == "bank":
         wl = workloads.bank_workload()
+        client = mysql_clients.BankClient(port=4000)
     else:
         wl = workloads.set_workload()
+        client = mysql_clients.SetClient(port=4000)
+    # TiDB listens on 4000; the wire protocol is MySQL's.
     return common.suite_test(
         f"tidb {name}", opts,
         workload=wl,
         db=TiDBCluster(),
-        client=common.GatedClient(
-            "TiDB fronts the MySQL wire protocol, which needs a driver; "
-            "run with --fake"),
+        client=client,
         nemesis=nemesis_ns.partition_random_halves(),
         nemesis_gen=common.standard_nemesis_gen(5, 5))
 

@@ -163,15 +163,21 @@ def counter_workload(n: int = 200, stagger: float = 1 / 20,
 
 # --- lock (hazelcast.clj:379-386) -------------------------------------------
 
-def lock_workload(n: int = 100, faulty=None) -> dict:
+def lock_workload(n: int = 100, stagger: float = 1 / 100,
+                  faulty=None) -> dict:
     """acquire/release alternation per process, checked against the Mutex
-    model — runs on the device mutex kernel."""
+    model — runs on the device mutex kernel. clients() keeps lock ops off
+    the nemesis thread, and the stagger spreads the op budget across
+    processes — without it one hot thread can consume the whole limit,
+    and a single-process history can never exhibit a double grant."""
     store = fakes.FakeLock(faulty=faulty)
     return {
-        "generator": gen.limit(n, gen.each(lambda: gen.seq(
-            _cycle_ops([{"type": "invoke", "f": "acquire", "value": None},
-                        {"type": "invoke", "f": "release", "value": None}])
-        ))),
+        "generator": gen.clients(gen.limit(n, gen.stagger(
+            stagger, gen.each(lambda: gen.seq(
+                _cycle_ops([{"type": "invoke", "f": "acquire",
+                             "value": None},
+                            {"type": "invoke", "f": "release",
+                             "value": None}])))))),
         "client": fakes.LockClient(store),
         "checker": checker_ns.linearizable(),
         "model": models.mutex(),

@@ -1,0 +1,219 @@
+"""Minimal ZooKeeper client over the jute wire protocol.
+
+The reference reaches ZooKeeper through an Avout distributed atom
+(zookeeper/src/jepsen/zookeeper.clj:78-104), whose substrate is exactly
+four primitives: session connect, ``create``, ``getData`` (value +
+version), and ``setData`` conditioned on version — the znode-version CAS.
+This client speaks that protocol from the stdlib.
+
+Jute framing: every message is a 4-byte big-endian length prefix, then
+fields in network order. A session opens with ConnectRequest /
+ConnectResponse; every later request is ``RequestHeader{xid, type}`` +
+body, answered by ``ReplyHeader{xid, zxid, err}`` + body. Strings and
+buffers are 4-byte-length-prefixed; a Stat is 68 bytes with the data
+version at offset 32.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import client as client_ns
+import socket
+import struct
+
+# Op codes (zookeeper.h)
+OP_CREATE = 1
+OP_EXISTS = 3
+OP_GETDATA = 4
+OP_SETDATA = 5
+OP_CLOSE = -11
+
+# Error codes
+ZOK = 0
+ZNONODE = -101
+ZNODEEXISTS = -110
+ZBADVERSION = -103
+
+# world:anyone ACL with all permissions (perms=31)
+ACL_OPEN = struct.pack(">i", 1) + struct.pack(">i", 31) \
+    + struct.pack(">i", 5) + b"world" + struct.pack(">i", 6) + b"anyone"
+
+
+class ZkError(Exception):
+    def __init__(self, code: int, op: str):
+        self.code = code
+        super().__init__(f"zookeeper error {code} in {op}")
+
+    @property
+    def bad_version(self) -> bool:
+        return self.code == ZBADVERSION
+
+    @property
+    def no_node(self) -> bool:
+        return self.code == ZNONODE
+
+
+def _s(b: bytes) -> bytes:
+    """Length-prefixed string/buffer."""
+    return struct.pack(">i", len(b)) + b
+
+
+class ZkClient:
+    def __init__(self, host: str, port: int = 2181,
+                 timeout: float = 10.0, session_timeout_ms: int = 10000):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.buf = b""
+        self.xid = 0
+        self._connect(session_timeout_ms)
+
+    # --- framing -------------------------------------------------------------
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("connection closed")
+            self.buf += chunk
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+    def _read_frame(self) -> bytes:
+        (n,) = struct.unpack(">i", self._read_exact(4))
+        return self._read_exact(n)
+
+    def _send_frame(self, payload: bytes) -> None:
+        self.sock.sendall(struct.pack(">i", len(payload)) + payload)
+
+    # --- session -------------------------------------------------------------
+
+    def _connect(self, session_timeout_ms: int) -> None:
+        req = (struct.pack(">iqi", 0, 0, session_timeout_ms)
+               + struct.pack(">q", 0) + _s(b"\x00" * 16))
+        self._send_frame(req)
+        resp = self._read_frame()
+        proto, timeout, session = struct.unpack_from(">iiq", resp, 0)
+        if session == 0:
+            raise ZkError(-112, "connect")  # session expired/refused
+        self.session_id = session
+
+    def _call(self, op: int, body: bytes, name: str) -> bytes:
+        self.xid += 1
+        self._send_frame(struct.pack(">ii", self.xid, op) + body)
+        while True:
+            resp = self._read_frame()
+            xid, zxid, err = struct.unpack_from(">iqi", resp, 0)
+            if xid == -1:        # watch event notification — not ours
+                continue
+            if err != ZOK:
+                raise ZkError(err, name)
+            return resp[16:]
+
+    # --- the four Avout primitives ------------------------------------------
+
+    def create(self, path: str, data: bytes, ephemeral: bool = False) \
+            -> str:
+        flags = 1 if ephemeral else 0
+        body = (_s(path.encode()) + _s(data) + ACL_OPEN
+                + struct.pack(">i", flags))
+        out = self._call(OP_CREATE, body, "create")
+        (n,) = struct.unpack_from(">i", out, 0)
+        return out[4:4 + n].decode()
+
+    def exists(self, path: str) -> bool:
+        try:
+            self._call(OP_EXISTS, _s(path.encode()) + b"\x00", "exists")
+            return True
+        except ZkError as e:
+            if e.no_node:
+                return False
+            raise
+
+    def get_data(self, path: str) -> tuple[bytes, int]:
+        """Returns (data, version) — the CAS token pair."""
+        out = self._call(OP_GETDATA, _s(path.encode()) + b"\x00",
+                         "getData")
+        (n,) = struct.unpack_from(">i", out, 0)
+        n = max(n, 0)            # -1 encodes an empty buffer
+        data = out[4:4 + n]
+        (version,) = struct.unpack_from(">i", out, 4 + n + 32)
+        return data, version
+
+    def set_data(self, path: str, data: bytes, version: int = -1) -> int:
+        """setData conditioned on ``version`` (-1 = unconditional);
+        returns the new version. Raises ZkError(bad_version) when the
+        znode moved — the zk-atom CAS failure (zookeeper.clj:78-104)."""
+        out = self._call(OP_SETDATA,
+                         _s(path.encode()) + _s(data)
+                         + struct.pack(">i", version), "setData")
+        (new_version,) = struct.unpack_from(">i", out, 32)
+        return new_version
+
+    def close(self) -> None:
+        try:
+            self.xid += 1
+            self._send_frame(struct.pack(">ii", self.xid, OP_CLOSE))
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class ZkRegisterClient(client_ns.Client):
+    """The zk-atom register (zookeeper.clj:78-104): one znode holds the
+    value; read = getData, write = unconditional setData, cas = getData
+    then version-conditioned setData. Implements the suite Client
+    surface."""
+
+    PATH = "/jepsen-register"
+
+    def __init__(self, conn: ZkClient | None = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return ZkRegisterClient(ZkClient(node))
+
+    def setup(self, test) -> None:
+        conn = ZkClient(test["nodes"][0])
+        try:
+            if not conn.exists(self.PATH):
+                conn.create(self.PATH, b"")
+        except ZkError as e:
+            if e.code != ZNODEEXISTS:
+                raise
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _decode(data: bytes):
+        return int(data) if data else None
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "read":
+                data, _ = self.conn.get_data(self.PATH)
+                return op.replace(type="ok", value=self._decode(data))
+            if op.f == "write":
+                self.conn.set_data(self.PATH, str(op.value).encode())
+                return op.replace(type="ok")
+            if op.f == "cas":
+                old, new = op.value
+                data, version = self.conn.get_data(self.PATH)
+                if self._decode(data) != old:
+                    return op.replace(type="fail")
+                try:
+                    self.conn.set_data(self.PATH, str(new).encode(),
+                                       version=version)
+                    return op.replace(type="ok")
+                except ZkError as e:
+                    if e.bad_version:
+                        return op.replace(type="fail")
+                    raise
+        except ZkError as e:
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=str(e))
+        except (OSError, ConnectionError) as e:
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()

@@ -7,9 +7,10 @@ generated ``zoo.cfg`` server list, restarted via the service manager
 checked linearizable (zookeeper.clj:78-129).
 
 The reference's client is an Avout distributed atom over the ZooKeeper
-jute wire protocol (zookeeper.clj:78-104); that binary protocol needs a
-real driver, so the wire client is gated (:class:`common.GatedClient`)
-and no-cluster runs use the register workload fake.
+jute wire protocol (zookeeper.clj:78-104); the TPU build speaks jute
+natively (:mod:`jepsen_tpu.suites.zkwire`): session connect, create,
+getData, and the znode-version-conditioned setData that is the zk-atom
+CAS.
 """
 
 from __future__ import annotations
@@ -71,13 +72,13 @@ class ZookeeperDB(db_ns.DB, db_ns.LogFiles):
 
 def test(opts: dict | None = None) -> dict:
     """The zookeeper test map (zookeeper.clj:110-129)."""
+    from jepsen_tpu.suites.zkwire import ZkRegisterClient
+
     return common.suite_test(
         "zookeeper", opts,
         workload=workloads.single_register(),
         db=ZookeeperDB(),
-        client=common.GatedClient(
-            "the ZooKeeper wire protocol (jute) needs a zk driver; "
-            "run with --fake or provide a client"),
+        client=ZkRegisterClient(),
         nemesis=nemesis_ns.partition_random_halves(),
         nemesis_gen=common.standard_nemesis_gen(5, 5))
 

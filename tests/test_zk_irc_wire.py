@@ -1,0 +1,247 @@
+"""ZooKeeper jute + IRC line-protocol clients against in-process fake
+servers — the zk fake implements a real versioned znode store, so the
+version-conditioned setData CAS is exercised end to end."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from jepsen_tpu.suites.zkwire import (ZBADVERSION, ZNONODE, ZkClient,
+                                      ZkError, ZkRegisterClient)
+
+# --- fake ZooKeeper server ---------------------------------------------------
+
+
+class FakeZkServer:
+    """Single-session jute server with a real versioned znode store."""
+
+    def __init__(self):
+        self.nodes: dict[str, tuple[bytes, int]] = {}
+        self.srv = socket.socket()
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(4)
+        self.port = self.srv.getsockname()[1]
+        self.threads: list[threading.Thread] = []
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+
+    def _accept_loop(self):
+        while True:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self.threads.append(t)
+
+    @staticmethod
+    def _read_frame(conn, buf: bytearray) -> bytes:
+        while len(buf) < 4:
+            chunk = conn.recv(65536)
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        (n,) = struct.unpack(">i", bytes(buf[:4]))
+        while len(buf) < 4 + n:
+            buf += conn.recv(65536)
+        out = bytes(buf[4:4 + n])
+        del buf[:4 + n]
+        return out
+
+    @staticmethod
+    def _send_frame(conn, payload: bytes):
+        conn.sendall(struct.pack(">i", len(payload)) + payload)
+
+    @staticmethod
+    def _stat(version: int) -> bytes:
+        return (b"\x00" * 32 + struct.pack(">i", version)
+                + b"\x00" * (68 - 36))
+
+    def _serve(self, conn):
+        buf = bytearray()
+        try:
+            self._read_frame(conn, buf)          # ConnectRequest
+            self._send_frame(conn, struct.pack(">iiq", 0, 10000, 0x1234)
+                             + struct.pack(">i", 16) + b"\x00" * 16)
+            while True:
+                req = self._read_frame(conn, buf)
+                xid, op = struct.unpack_from(">ii", req, 0)
+                body = req[8:]
+                (plen,) = struct.unpack_from(">i", body, 0)
+                path = body[4:4 + plen].decode()
+                rest = body[4 + plen:]
+
+                def reply(err: int, payload: bytes = b""):
+                    self._send_frame(
+                        conn, struct.pack(">iqi", xid, 1, err) + payload)
+
+                if op == 1:                      # create
+                    if path in self.nodes:
+                        reply(-110)
+                        continue
+                    (dlen,) = struct.unpack_from(">i", rest, 0)
+                    self.nodes[path] = (rest[4:4 + max(dlen, 0)], 0)
+                    reply(0, struct.pack(">i", plen)
+                          + path.encode())
+                elif op == 3:                    # exists
+                    if path in self.nodes:
+                        reply(0, self._stat(self.nodes[path][1]))
+                    else:
+                        reply(ZNONODE)
+                elif op == 4:                    # getData
+                    if path not in self.nodes:
+                        reply(ZNONODE)
+                        continue
+                    data, version = self.nodes[path]
+                    reply(0, struct.pack(">i", len(data)) + data
+                          + self._stat(version))
+                elif op == 5:                    # setData
+                    if path not in self.nodes:
+                        reply(ZNONODE)
+                        continue
+                    (dlen,) = struct.unpack_from(">i", rest, 0)
+                    data = rest[4:4 + max(dlen, 0)]
+                    (want,) = struct.unpack_from(">i", rest,
+                                                 4 + max(dlen, 0))
+                    _, version = self.nodes[path]
+                    if want not in (-1, version):
+                        reply(ZBADVERSION)
+                        continue
+                    self.nodes[path] = (data, version + 1)
+                    reply(0, self._stat(version + 1))
+                elif op == -11:                  # close
+                    return
+                else:
+                    reply(-6)                    # unimplemented
+        except (ConnectionError, OSError):
+            return
+        finally:
+            conn.close()
+
+    def close(self):
+        self.srv.close()
+
+
+class TestZkWire:
+    def test_create_get_set_cas(self):
+        zk = FakeZkServer()
+        c = ZkClient("127.0.0.1", zk.port)
+        assert not c.exists("/r")
+        c.create("/r", b"5")
+        assert c.exists("/r")
+        data, version = c.get_data("/r")
+        assert (data, version) == (b"5", 0)
+        v2 = c.set_data("/r", b"7", version=0)
+        assert v2 == 1
+        with pytest.raises(ZkError) as ei:
+            c.set_data("/r", b"9", version=0)   # stale version = CAS fail
+        assert ei.value.bad_version
+        assert c.get_data("/r")[0] == b"7"
+        c.set_data("/r", b"8")                  # unconditional
+        assert c.get_data("/r")[0] == b"8"
+        c.close()
+        zk.close()
+
+    def test_register_client_semantics(self):
+        from jepsen_tpu.history import Op
+
+        zk = FakeZkServer()
+        # the fake's port is non-standard; connect + create manually
+        cl = ZkRegisterClient(ZkClient("127.0.0.1", zk.port))
+        cl.conn.create("/jepsen-register", b"")
+        assert cl.invoke(None, Op("invoke", "read", None, 0)).value is None
+        assert cl.invoke(None, Op("invoke", "write", 3, 0)).is_ok
+        assert cl.invoke(None, Op("invoke", "read", None, 0)).value == 3
+        assert cl.invoke(None, Op("invoke", "cas", [3, 4], 0)).is_ok
+        r = cl.invoke(None, Op("invoke", "cas", [3, 9], 0))
+        assert r.is_fail
+        assert cl.invoke(None, Op("invoke", "read", None, 0)).value == 4
+        cl.close(None)
+        zk.close()
+
+
+# --- fake IRC server ---------------------------------------------------------
+
+
+class TestIrcWire:
+    def test_register_join_say_collect(self):
+        from jepsen_tpu.suites.ircwire import IrcClient
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(2)
+        port = srv.getsockname()[1]
+
+        def run():
+            conn, _ = srv.accept()
+            buf = b""
+
+            def lines():
+                nonlocal buf, conn
+                while True:
+                    while b"\r\n" not in buf:
+                        buf += conn.recv(4096)
+                    line, buf = buf.split(b"\r\n", 1)
+                    yield line.decode()
+
+            it = lines()
+            nick = None
+            while nick is None:
+                ln = next(it)
+                if ln.startswith("NICK "):
+                    nick = ln.split()[1]
+            conn.sendall(f":srv 001 {nick} :welcome\r\n".encode())
+            while True:
+                ln = next(it)
+                if ln.startswith("JOIN "):
+                    chan = ln.split()[1]
+                    conn.sendall(
+                        f":{nick}!u@h JOIN {chan}\r\n".encode())
+                    break
+            conn.sendall(f"PING :tok\r\n".encode())
+            got_pong = False
+            try:
+                while True:
+                    ln = next(it)
+                    if ln.startswith("PONG"):
+                        got_pong = True
+                    elif ln.startswith("PING"):
+                        # the client's per-message ack round-trip
+                        tok = ln.partition(" ")[2]
+                        conn.sendall(f"PONG {tok}\r\n".encode())
+                    elif ln.startswith("PRIVMSG"):
+                        # deliver a peer's message (own msgs not echoed)
+                        conn.sendall(
+                            f":peer!u@h PRIVMSG {chan} :41\r\n".encode())
+                    elif ln.startswith("QUIT"):
+                        break
+            except (ConnectionError, OSError):
+                pass
+            assert got_pong
+
+        threading.Thread(target=run, daemon=True).start()
+        c = IrcClient("127.0.0.1", port, nick="jepsen1")
+        c.say("40")        # blocks until the PING ack round-trip
+        import time
+
+        deadline = time.time() + 5
+        while len(c.seen()) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        # own confirmed send (not echoed by the server) + the peer's
+        assert sorted(c.seen()) == ["40", "41"]
+        c.close()
+        srv.close()
+
+
+def test_zk_and_irc_suites_ungated():
+    from jepsen_tpu.suites import common, robustirc, zookeeper
+
+    for mod in (zookeeper, robustirc):
+        t = mod.test({})
+        assert not isinstance(t["client"], common.GatedClient), mod
