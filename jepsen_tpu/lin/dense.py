@@ -168,8 +168,8 @@ def _dense_chunk(F, n_rows, nil_id, ret_slot, active, slot_f, slot_v,
 
 
 def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
-                 snapshots: list | None = None,
-                 explain: bool = False) -> dict:
+                 snapshots: list | None = None, explain: bool = False,
+                 backend: str = "auto") -> dict:
     """Decide linearizability of a packed history with the dense engine.
 
     The frontier carry chains device-side between chunk dispatches; the
@@ -181,6 +181,11 @@ def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
     on the CPU oracle to emit knossos-style configs + final-paths
     (:mod:`jepsen_tpu.lin.witness`). ``cancel`` (threading.Event) stops
     between dispatches.
+
+    ``backend``: "pallas" runs the chunk loop as a TPU kernel with the
+    bitmap resident in VMEM (:mod:`jepsen_tpu.lin.dense_pallas`;
+    interpreted off-TPU), "xla" the lax.while_loop formulation, "auto"
+    pallas on TPU-class hardware when the window fits, xla otherwise.
     """
     pl = plan(p)
     if pl is None:
@@ -189,6 +194,26 @@ def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
     w, ns, nil_id, init_id = pl
     if explain and snapshots is None:
         snapshots = []
+
+    if backend not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown dense backend {backend!r}")
+    use_pallas = False
+    interpret = False
+    dp = None
+    if backend in ("auto", "pallas"):
+        from jepsen_tpu.lin import dense_pallas as dp
+
+        fits = dp.supported_w(w) is not None
+        on_tpu = jax.devices()[0].platform == "tpu"
+        interpret = not on_tpu
+        if backend == "pallas":
+            if not fits:
+                raise ValueError(
+                    f"window {w} exceeds the pallas kernel bound "
+                    f"{dp.MAX_PALLAS_W}; use backend='xla'")
+            use_pallas = True
+        else:
+            use_pallas = fits and on_tpu
     if p.R == 0:
         return {"valid?": True, "analyzer": "tpu-dense", "configs": []}
 
@@ -220,7 +245,11 @@ def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
         pad[1] = (0, wc - a.shape[1])
         return np.pad(a, pad)
 
-    w_cur = bucket_w(int(row_hi[:min(chunk, p.R)].max()))
+    def eng_w(need: int) -> int:
+        wc = bucket_w(need)
+        return dp.supported_w(wc) if use_pallas else wc
+
+    w_cur = eng_w(int(row_hi[:min(chunk, p.R)].max()))
     F = jnp.zeros(1 << w_cur, jnp.uint32).at[0].set(jnp.uint32(1) << init_id)
 
     # One blocking fetch (the dead flag) per chunk: chunks are strictly
@@ -234,19 +263,37 @@ def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
             return {"valid?": "unknown", "analyzer": "tpu-dense",
                     "error": "cancelled"}
         n = min(chunk, p.R - base)
-        w_c = bucket_w(int(row_hi[base:base + n].max()))
+        w_c = eng_w(int(row_hi[base:base + n].max()))
         if w_c > w_cur:
             F = jnp.pad(F, (0, (1 << w_c) - (1 << w_cur)))
             w_cur = w_c
         if snapshots is not None:
             snapshots.append((base, F))
-        F, r_done, dead = _dense_chunk(
-            F, jnp.int32(n), jnp.int32(nil_id),
-            jnp.asarray(_chunk_slice(ret_slot_h, base, chunk)),
-            jnp.asarray(pad_w(_chunk_slice(active_h, base, chunk), w_cur)),
-            jnp.asarray(pad_w(_chunk_slice(slot_f_h, base, chunk), w_cur)),
-            jnp.asarray(pad_w(_chunk_slice(slot_v_h, base, chunk), w_cur)),
-            w=w_cur, ns=ns, step_fn=step_fn)
+        if use_pallas:
+            # Bucket the kernel grid to the chunk's actual row count so a
+            # short final chunk doesn't pay for thousands of no-op steps
+            # (and don't upload the unused table tail at all).
+            n_pad = min(chunk, max(512, 1 << (n - 1).bit_length()))
+            sl = lambda a: _chunk_slice(a, base, chunk)[:n_pad]  # noqa: E731
+            masks = dp.transition_masks(
+                jnp.asarray(pad_w(sl(slot_f_h), w_cur)),
+                jnp.asarray(pad_w(sl(slot_v_h), w_cur)),
+                jnp.asarray(pad_w(sl(active_h), w_cur)),
+                jnp.int32(nil_id), ns=ns, step_fn=step_fn)
+            F, r_done, dead = dp.pallas_chunk(
+                F, jnp.int32(n), masks, jnp.asarray(sl(ret_slot_h)),
+                w=w_cur, ns=ns, chunk=n_pad, interpret=interpret)
+        else:
+            F, r_done, dead = _dense_chunk(
+                F, jnp.int32(n), jnp.int32(nil_id),
+                jnp.asarray(_chunk_slice(ret_slot_h, base, chunk)),
+                jnp.asarray(pad_w(_chunk_slice(active_h, base, chunk),
+                                  w_cur)),
+                jnp.asarray(pad_w(_chunk_slice(slot_f_h, base, chunk),
+                                  w_cur)),
+                jnp.asarray(pad_w(_chunk_slice(slot_v_h, base, chunk),
+                                  w_cur)),
+                w=w_cur, ns=ns, step_fn=step_fn)
         if bool(dead):
             r = base + int(r_done) - 1
             ret = p.ops[int(p.ret_op[r])]

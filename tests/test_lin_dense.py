@@ -152,3 +152,40 @@ def test_snapshots_decode_matches_oracle_frontier():
     assert cfgs == [(0, (int(np.int32(-(2 ** 31))),))] or \
         cfgs == [(0, (init_id,))]
     assert [b for b, _ in snaps] == list(range(0, p.R, 16))
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_pallas_backend_parity(seed):
+    # The pallas chunk kernel (interpreted off-TPU) must agree with the
+    # oracle on valid, corrupted, and crash-heavy histories.
+    h = synth.generate_register_history(80, concurrency=4, seed=seed,
+                                        value_range=3, crash_prob=0.1,
+                                        max_crashes=6)
+    if seed % 2:
+        h = synth.corrupt_history(h, seed=seed)
+    p = prepare.prepare(m.cas_register(), h)
+    want = cpu.check_packed(p)["valid?"]
+    r = dense.check_packed(p, backend="pallas")
+    assert r["valid?"] == want, f"pallas={r} cpu={want}"
+
+
+def test_pallas_chunk_boundary_and_mutex():
+    h = synth.generate_mutex_history(60, concurrency=4, seed=3,
+                                     crash_prob=0.1)
+    p = prepare.prepare(m.mutex(), h)
+    want = cpu.check_packed(p)["valid?"]
+    assert dense.check_packed(p, backend="pallas",
+                              chunk=16)["valid?"] == want
+
+
+def test_pallas_dead_row_matches_xla():
+    h = synth.corrupt_history(
+        synth.generate_register_history(120, concurrency=4, seed=7,
+                                        crash_prob=0.1), seed=7)
+    p = prepare.prepare(m.cas_register(), h)
+    rx = dense.check_packed(p, backend="xla")
+    rp = dense.check_packed(p, backend="pallas")
+    if rx["valid?"] is False:
+        assert rp["valid?"] is False
+        assert rp["dead-row"] == rx["dead-row"]
+        assert rp["op"] == rx["op"]
