@@ -19,6 +19,96 @@ from jepsen_tpu.models.kernels import F_NOOP
 
 BATCH_CAP_SCHEDULE = (64, 1024)
 
+# Dense-batch resource ceilings: one vmapped dispatch carries K bitmaps
+# of 2**w words plus [K, r_pad, w] tables; past these bounds return None
+# so the caller can fall back (sparse batch / per-key checks) instead of
+# an XLA allocation error escaping the checker.
+MAX_BATCH_BITMAP_WORDS = 1 << 24      # 64 MiB of frontier bitmaps
+MAX_BATCH_TABLE_CELLS = 1 << 27       # [K, r_pad, w] table budget
+MAX_BATCH_ROWS = 1 << 14
+
+
+def _result_rows(packed, ks, dead, r_done, analyzer) -> dict:
+    """Per-key verdict dicts from a batched search's (dead, rows_done)."""
+    results = {}
+    for i, k in enumerate(ks):
+        p = packed[k]
+        if not dead[i]:
+            results[k] = {"valid?": True, "analyzer": analyzer,
+                          "configs": [], "final-paths": []}
+        else:
+            r = int(r_done[i]) - 1
+            ret = p.ops[int(p.ret_op[r])] if 0 <= r < p.R else None
+            results[k] = {
+                "valid?": False, "analyzer": analyzer, "dead-row": r,
+                "op": None if ret is None else
+                {"process": ret.process, "f": ret.f, "value": ret.value,
+                 "index": ret.op_index, "ok": ret.ok},
+                "configs": [], "final-paths": []}
+    return results
+
+
+def _try_dense_batch(packed: dict) -> dict | None:
+    """Batch all keys through the dense bitmap engine: one vmapped chunk
+    over a leading key axis. Per-key history length (n_rows), state
+    count (nil_id), and initial state ride the batch as vectors, so no
+    identity-row padding is needed and crashed-op keys cost nothing.
+    Returns {key: result} or None when any key falls outside the dense
+    bounds or the batch exceeds the resource ceilings (caller tries the
+    sparse batch, then per-key host checks)."""
+    import jax
+    import jax.numpy as jnp
+
+    from jepsen_tpu.lin import dense
+
+    plans = {}
+    for k, p in packed.items():
+        pl = dense.plan(p)
+        if pl is None:
+            return None
+        plans[k] = pl
+
+    w = max(pl[0] for pl in plans.values())
+    ns = max(pl[1] for pl in plans.values())
+    r_max = max(p.R for p in packed.values())
+    r_pad = 1 << max(4, (r_max - 1).bit_length())
+    ks = sorted(packed, key=repr)
+    K = len(ks)
+    if r_pad > MAX_BATCH_ROWS or K * (1 << w) > MAX_BATCH_BITMAP_WORDS \
+            or K * r_pad * w > MAX_BATCH_TABLE_CELLS:
+        return None
+
+    F0 = np.zeros((K, 1 << w), np.uint32)
+    n_rows = np.zeros(K, np.int32)
+    nil_ids = np.zeros(K, np.int32)
+    ret_slot = np.zeros((K, r_pad), np.int32)
+    active = np.zeros((K, r_pad, w), bool)
+    slot_f = np.zeros((K, r_pad, w), np.int32)
+    slot_v = np.zeros((K, r_pad, w, packed[ks[0]].slot_v.shape[2]),
+                      np.int32)
+    for i, k in enumerate(ks):
+        p = packed[k]
+        _, _, nil_id, init_id = plans[k]
+        F0[i, 0] = np.uint32(1) << init_id
+        n_rows[i] = p.R
+        nil_ids[i] = nil_id
+        R, W = p.active.shape
+        ret_slot[i, :R] = p.ret_slot
+        active[i, :R, :W] = p.active
+        slot_f[i, :R, :W] = p.slot_f
+        slot_v[i, :R, :W] = p.slot_v
+
+    step_fn = packed[ks[0]].kernel.step
+    F, r_done, dead = jax.vmap(
+        lambda f, n, nid, rs, ac, sf, sv: dense._dense_chunk(
+            f, n, nid, rs, ac, sf, sv, w=w, ns=ns, step_fn=step_fn))(
+        jnp.asarray(F0), jnp.asarray(n_rows), jnp.asarray(nil_ids),
+        jnp.asarray(ret_slot), jnp.asarray(active), jnp.asarray(slot_f),
+        jnp.asarray(slot_v))
+
+    return _result_rows(packed, ks, np.asarray(dead), np.asarray(r_done),
+                        "tpu-dense-batch")
+
 
 def _pad_to(p: PackedHistory, r_pad: int, w_pad: int):
     """Pad one packed history to (r_pad, w_pad + 1): columns beyond the
@@ -68,6 +158,10 @@ def try_check_batch(model, subs: dict) -> dict | None:
     if len({tuple(p.init_state.shape) for p in packed.values()}) > 1:
         return None
 
+    dense_res = _try_dense_batch(packed)
+    if dense_res is not None:
+        return dense_res
+
     w_pad = max(p.window for p in packed.values())
     if w_pad + 1 > bfs.MAX_DEVICE_WINDOW:
         return None
@@ -107,21 +201,5 @@ def try_check_batch(model, subs: dict) -> dict | None:
     if bool(jnp.any(overflow)):
         return None
 
-    ok = np.asarray(~(dead | overflow))
-    dead_row = np.asarray(rows) - 1
-    results = {}
-    for i, k in enumerate(ks):
-        p = packed[k]
-        if bool(ok[i]):
-            results[k] = {"valid?": True, "analyzer": "tpu-bfs-batch",
-                          "configs": [], "final-paths": []}
-        else:
-            r = int(dead_row[i])
-            ret = p.ops[int(p.ret_op[r])] if 0 <= r < p.R else None
-            results[k] = {
-                "valid?": False, "analyzer": "tpu-bfs-batch",
-                "op": None if ret is None else
-                {"process": ret.process, "f": ret.f, "value": ret.value,
-                 "index": ret.op_index, "ok": ret.ok},
-                "configs": [], "final-paths": []}
-    return results
+    return _result_rows(packed, ks, np.asarray(dead | overflow),
+                        np.asarray(rows), "tpu-bfs-batch")

@@ -83,6 +83,27 @@ def plan(p: PackedHistory):
     return w, ns, nid, init_id
 
 
+def transition_tables(slot_f, slot_v, active, nil_id, *, ns, step_fn):
+    """Per-(row, slot, state) transition tables from the model step
+    kernel: ok[CH,w,ns] legality, to[CH,w,ns] successor state id (u32).
+    One triple-vmap evaluates every transition a chunk can ever take in
+    one shot. Inactive slots never linearize, and padded state ids past
+    nil_id are masked inert. Shared by the XLA and pallas backends so
+    the table semantics cannot diverge between them."""
+    from jepsen_tpu.models.kernels import NIL
+
+    sid = jnp.arange(ns, dtype=jnp.int32)
+    states = jnp.where(sid == nil_id, NIL, sid)[:, None]     # [ns, 1]
+    per_state = jax.vmap(step_fn, in_axes=(0, None, None))
+    per_slot = jax.vmap(per_state, in_axes=(None, 0, 0))
+    per_row = jax.vmap(per_slot, in_axes=(None, 0, 0))
+    ok, new = per_row(states, slot_f, slot_v)
+    to = jnp.where(new[..., 0] == NIL, nil_id, new[..., 0])
+    to = jnp.clip(to, 0, ns - 1).astype(jnp.uint32)
+    ok = ok & active[:, :, None] & (sid[None, None, :] <= nil_id)
+    return ok, to
+
+
 @partial(jax.jit, static_argnames=("w", "ns", "step_fn"))
 def _dense_chunk(F, n_rows, nil_id, ret_slot, active, slot_f, slot_v,
                  *, w, ns, step_fn):
@@ -93,25 +114,11 @@ def _dense_chunk(F, n_rows, nil_id, ret_slot, active, slot_f, slot_v,
     Returns (F, rows_done, dead) — dead means the frontier emptied while
     filtering row rows_done-1, i.e. the history is not linearizable.
     """
-    from jepsen_tpu.models.kernels import NIL
-
     n_words = 1 << w
     iota = lax.iota(jnp.uint32, n_words)
 
-    # Per-(row, slot, state) transition tables from the model step kernel:
-    # ok[CH,w,ns] legality, to[CH,w,ns] successor state id. One triple-vmap
-    # evaluates every transition the chunk can ever take in one shot.
-    sid = jnp.arange(ns, dtype=jnp.int32)
-    states = jnp.where(sid == nil_id, NIL, sid)[:, None]     # [ns, 1]
-    per_state = jax.vmap(step_fn, in_axes=(0, None, None))
-    per_slot = jax.vmap(per_state, in_axes=(None, 0, 0))
-    per_row = jax.vmap(per_slot, in_axes=(None, 0, 0))
-    ok, new = per_row(states, slot_f, slot_v)
-    to = jnp.where(new[..., 0] == NIL, nil_id, new[..., 0])
-    to = jnp.clip(to, 0, ns - 1).astype(jnp.uint32)
-    # Inactive slots never linearize; padded state ids are unreachable but
-    # masked anyway so their table rows are inert.
-    ok = ok & active[:, :, None] & (sid[None, None, :] <= nil_id)
+    ok, to = transition_tables(slot_f, slot_v, active, nil_id,
+                               ns=ns, step_fn=step_fn)
 
     def row_body(carry):
         r, F, dead = carry

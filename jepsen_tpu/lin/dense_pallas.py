@@ -49,21 +49,17 @@ MAX_PALLAS_W = 18          # 2**18 words = 1 MiB bitmap in VMEM
 @partial(jax.jit, static_argnames=("ns", "step_fn"))
 def transition_masks(slot_f, slot_v, active, nil_id, *, ns, step_fn):
     """u32[CH, w, ns] transition masks: bit s of mask[r, j, s'] set iff
-    op (r, j) is active, legal in state s, and maps s to s'."""
-    from jepsen_tpu.models.kernels import NIL
+    op (r, j) is active, legal in state s, and maps s to s'. Built on
+    the same shared tables as the XLA backend (dense.transition_tables),
+    just pivoted into per-target-state source bitmasks."""
+    from jepsen_tpu.lin.dense import transition_tables
 
-    sid = jnp.arange(ns, dtype=jnp.int32)
-    states = jnp.where(sid == nil_id, NIL, sid)[:, None]
-    per_state = jax.vmap(step_fn, in_axes=(0, None, None))
-    per_slot = jax.vmap(per_state, in_axes=(None, 0, 0))
-    per_row = jax.vmap(per_slot, in_axes=(None, 0, 0))
-    ok, new = per_row(states, slot_f, slot_v)          # [CH,w,ns]
-    to = jnp.where(new[..., 0] == NIL, nil_id, new[..., 0])
-    to = jnp.clip(to, 0, ns - 1)
-    ok = ok & active[:, :, None] & (sid[None, None, :] <= nil_id)
+    ok, to = transition_tables(slot_f, slot_v, active, nil_id,
+                               ns=ns, step_fn=step_fn)
+    sid = jnp.arange(ns, dtype=jnp.uint32)
     # mask[r,j,s'] = OR over source s of (ok & to==s') << s
     hit = ok[..., None] & (to[..., None] == sid[None, None, None, :])
-    bit = (jnp.uint32(1) << sid.astype(jnp.uint32))[None, None, :, None]
+    bit = (jnp.uint32(1) << sid)[None, None, :, None]
     return jnp.sum(jnp.where(hit, bit, jnp.uint32(0)), axis=2,
                    dtype=jnp.uint32)
 

@@ -128,7 +128,7 @@ class TestIndependentChecker:
         r = ck.check(None, m.cas_register(), self.kv_register_history(), {})
         assert r[c.VALID] is True
         assert set(r["results"]) == {"a", "b", "c"}
-        assert all(v["analyzer"] == "tpu-bfs-batch"
+        assert all(v["analyzer"] in ("tpu-dense-batch", "tpu-bfs-batch")
                    for v in r["results"].values())
         assert r["failures"] == []
 
@@ -191,3 +191,64 @@ class TestAdya:
         assert {o.value.key for o in ops} == {"k1", "k2"}
         ids = [(o.value.key, o.value.value["id"]) for o in ops]
         assert len(set(ids)) == 4
+
+
+class TestDenseBatch:
+    def test_dense_batch_engages_and_agrees(self):
+        from jepsen_tpu import models as m
+        from jepsen_tpu.lin import batched, cpu, prepare, synth
+
+        subs = {}
+        for k in range(6):
+            h = synth.generate_register_history(
+                40, concurrency=3, seed=k, value_range=3,
+                crash_prob=0.1, max_crashes=4)
+            if k == 3:
+                h = synth.corrupt_history(h, seed=k)
+            subs[k] = h
+        res = batched.try_check_batch(m.cas_register(), subs)
+        assert res is not None
+        for k, r in res.items():
+            assert r["analyzer"] == "tpu-dense-batch"
+            p = prepare.prepare(m.cas_register(), subs[k])
+            assert r["valid?"] == cpu.check_packed(p)["valid?"], k
+
+    def test_dense_batch_heterogeneous_lengths(self):
+        from jepsen_tpu import models as m
+        from jepsen_tpu.lin import batched, cpu, prepare, synth
+
+        subs = {"a": synth.generate_register_history(10, concurrency=2,
+                                                     seed=1),
+                "b": synth.generate_register_history(120, concurrency=4,
+                                                     seed=2,
+                                                     crash_prob=0.1)}
+        res = batched.try_check_batch(m.cas_register(), subs)
+        assert res is not None
+        for k, r in res.items():
+            p = prepare.prepare(m.cas_register(), subs[k])
+            assert r["valid?"] == cpu.check_packed(p)["valid?"], k
+
+    def test_wide_window_key_falls_back_to_sparse(self):
+        from jepsen_tpu import models as m
+        from jepsen_tpu.lin import batched, synth
+
+        from jepsen_tpu.history import History, invoke_op, ok_op
+        from jepsen_tpu.lin import prepare
+
+        # cas-chain spike: window deterministically 24 (> dense bound 20)
+        h = [invoke_op(0, "write", 0), ok_op(0, "write", 0)]
+        for i in range(24):
+            h.append(invoke_op(i + 1, "cas", [i, i + 1]))
+        for i in range(24):
+            h.append(ok_op(i + 1, "cas", [i, i + 1]))
+        wide = History.of(*h)
+        assert prepare.prepare(m.cas_register(), wide).window == 24
+        subs = {"w": wide,
+                "n": synth.generate_register_history(20, concurrency=3,
+                                                     seed=1)}
+        res = batched.try_check_batch(m.cas_register(), subs)
+        # wide key exceeds dense bounds: sparse batch (or None) takes over
+        if res is not None:
+            assert all(r["analyzer"] == "tpu-bfs-batch"
+                       for r in res.values())
+            assert all(r["valid?"] is True for r in res.values())
