@@ -1,0 +1,296 @@
+"""Minimal AMQP 0-9-1 client for the RabbitMQ suite.
+
+The reference drives RabbitMQ through Langohr
+(rabbitmq/src/jepsen/rabbitmq.clj:100-170); the TPU build speaks AMQP
+0-9-1 from the stdlib: protocol header, PLAIN authentication over the
+Connection.Start/Tune/Open negotiation, one channel, ``queue.declare``,
+``basic.publish`` (method + content-header + body frames), and
+synchronous ``basic.get`` — the enqueue/dequeue/drain surface the
+total-queue workload needs.
+
+Framing: ``type:1 channel:2 size:4 payload frame-end:0xCE`` (all
+big-endian); method payloads are ``class:2 method:2 args``. Only the
+argument shapes these six methods use are implemented; field tables are
+sent empty and skipped on receipt.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+from jepsen_tpu import client as client_ns
+
+FRAME_METHOD = 1
+FRAME_HEADER = 2
+FRAME_BODY = 3
+FRAME_HEARTBEAT = 8
+FRAME_END = 0xCE
+
+
+class AmqpError(Exception):
+    pass
+
+
+def _shortstr(s: str) -> bytes:
+    b = s.encode()
+    return bytes([len(b)]) + b
+
+
+def _longstr(b: bytes) -> bytes:
+    return struct.pack(">I", len(b)) + b
+
+
+class AmqpClient:
+    def __init__(self, host: str, port: int = 5672, user: str = "guest",
+                 password: str = "guest", vhost: str = "/",
+                 timeout: float = 10.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.buf = b""
+        self.sock.sendall(b"AMQP\x00\x00\x09\x01")
+        self._negotiate(user, password, vhost)
+        self._channel_open()
+
+    # --- framing -------------------------------------------------------------
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("connection closed")
+            self.buf += chunk
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+    def _read_frame(self) -> tuple[int, int, bytes]:
+        t, ch, size = struct.unpack(">BHI", self._read_exact(7))
+        payload = self._read_exact(size)
+        if self._read_exact(1) != bytes([FRAME_END]):
+            raise AmqpError("bad frame end")
+        return t, ch, payload
+
+    def _send_frame(self, t: int, ch: int, payload: bytes) -> None:
+        self.sock.sendall(struct.pack(">BHI", t, ch, len(payload))
+                          + payload + bytes([FRAME_END]))
+
+    def _send_method(self, ch: int, class_id: int, method_id: int,
+                     args: bytes) -> None:
+        self._send_frame(FRAME_METHOD, ch,
+                         struct.pack(">HH", class_id, method_id) + args)
+
+    def _expect_method(self, class_id: int, method_id: int) -> bytes:
+        """Read frames until the given method arrives; heartbeats are
+        answered, Connection.Close / Channel.Close raise."""
+        while True:
+            t, ch, payload = self._read_frame()
+            if t == FRAME_HEARTBEAT:
+                self._send_frame(FRAME_HEARTBEAT, 0, b"")
+                continue
+            if t != FRAME_METHOD:
+                raise AmqpError(f"unexpected frame type {t}")
+            cid, mid = struct.unpack_from(">HH", payload, 0)
+            if (cid, mid) == (10, 50) or (cid, mid) == (20, 40):
+                code, = struct.unpack_from(">H", payload, 4)
+                raise AmqpError(f"server closed ({cid}.{mid}) code {code}")
+            if (cid, mid) == (class_id, method_id):
+                return payload[4:]
+
+    # --- connection negotiation ----------------------------------------------
+
+    def _negotiate(self, user: str, password: str, vhost: str) -> None:
+        self._expect_method(10, 10)                   # Connection.Start
+        plain = _longstr(f"\x00{user}\x00{password}".encode())
+        args = (b"\x00\x00\x00\x00"                   # empty client props
+                + _shortstr("PLAIN") + plain + _shortstr("en_US"))
+        self._send_method(0, 10, 11, args)            # Start-Ok
+        tune = self._expect_method(10, 30)            # Tune
+        channel_max, frame_max, heartbeat = struct.unpack_from(
+            ">HIH", tune, 0)
+        self.frame_max = frame_max or (1 << 20)
+        self._send_method(0, 10, 31, struct.pack(     # Tune-Ok
+            ">HIH", channel_max, self.frame_max, 0))
+        self._send_method(0, 10, 40,                  # Open
+                          _shortstr(vhost) + _shortstr("") + b"\x00")
+        self._expect_method(10, 41)                   # Open-Ok
+
+    def _channel_open(self) -> None:
+        self._send_method(1, 20, 10, _shortstr(""))   # Channel.Open
+        self._expect_method(20, 11)
+
+    # --- the queue surface ---------------------------------------------------
+
+    def confirm_select(self) -> None:
+        """Enable publisher confirms (the reference's Langohr client
+        publishes confirmed): every publish then blocks on basic.ack, so
+        an \"ok\" enqueue really is in the broker."""
+        self._send_method(1, 85, 10, b"\x00")         # Confirm.Select
+        self._expect_method(85, 11)
+        self.confirms = True
+
+    def queue_declare(self, queue: str, durable: bool = True) -> None:
+        bits = 0x02 if durable else 0
+        args = (struct.pack(">H", 0) + _shortstr(queue) + bytes([bits])
+                + b"\x00\x00\x00\x00")                # empty arguments
+        self._send_method(1, 50, 10, args)
+        self._expect_method(50, 11)                   # Declare-Ok
+
+    confirms = False
+
+    def publish(self, queue: str, body: bytes,
+                persistent: bool = True) -> None:
+        args = (struct.pack(">H", 0) + _shortstr("")  # default exchange
+                + _shortstr(queue) + b"\x00")
+        self._send_method(1, 60, 40, args)            # Basic.Publish
+        # Content header: class, weight, body size, flags, delivery-mode
+        props = struct.pack(">HHQH", 60, 0, len(body), 0x1000) \
+            + bytes([2 if persistent else 1])
+        self._send_frame(FRAME_HEADER, 1, props)
+        self._send_frame(FRAME_BODY, 1, body)
+        if self.confirms:
+            while True:                               # await Ack/Nack
+                t, _, payload = self._read_frame()
+                if t == FRAME_HEARTBEAT:
+                    self._send_frame(FRAME_HEARTBEAT, 0, b"")
+                    continue
+                cid, mid = struct.unpack_from(">HH", payload, 0)
+                if (cid, mid) == (60, 80):            # Basic.Ack
+                    return
+                if (cid, mid) == (60, 120):           # Basic.Nack
+                    raise AmqpError("broker nacked publish")
+                if (cid, mid) in ((10, 50), (20, 40)):
+                    raise AmqpError(f"server closed ({cid}.{mid})")
+
+    def get(self, queue: str) -> bytes | None:
+        """Synchronous Basic.Get with auto-ack; None when empty."""
+        args = struct.pack(">H", 0) + _shortstr(queue) + b"\x01"  # no-ack
+        self._send_method(1, 60, 70, args)
+        while True:
+            t, ch, payload = self._read_frame()
+            if t == FRAME_HEARTBEAT:
+                self._send_frame(FRAME_HEARTBEAT, 0, b"")
+                continue
+            if t != FRAME_METHOD:
+                raise AmqpError(f"unexpected frame type {t}")
+            cid, mid = struct.unpack_from(">HH", payload, 0)
+            if (cid, mid) == (60, 72):                # Get-Empty
+                return None
+            if (cid, mid) == (60, 71):                # Get-Ok
+                break
+            if mid in (40, 50):
+                raise AmqpError(f"server closed ({cid}.{mid})")
+        t, _, header = self._read_frame()
+        if t != FRAME_HEADER:
+            raise AmqpError("expected content header")
+        (size,) = struct.unpack_from(">Q", header, 4)
+        body = b""
+        while len(body) < size:
+            t, _, part = self._read_frame()
+            if t != FRAME_BODY:
+                raise AmqpError("expected content body")
+            body += part
+        return body
+
+    def close(self) -> None:
+        try:
+            self._send_method(0, 10, 50,              # Connection.Close
+                              struct.pack(">HHH", 200, 0, 0) + b"\x00")
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class QueueClient(client_ns.Client):
+    """Enqueue/dequeue/drain over one AMQP queue (rabbitmq.clj:100-170):
+    publish persistent messages, consume with synchronous basic.get."""
+
+    QUEUE = "jepsen.queue"
+
+    def __init__(self, conn: AmqpClient | None = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        c = AmqpClient(node)
+        c.queue_declare(self.QUEUE)
+        c.confirm_select()
+        return QueueClient(c)
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "enqueue":
+                self.conn.publish(self.QUEUE, str(op.value).encode())
+                return op.replace(type="ok")
+            if op.f == "dequeue":
+                body = self.conn.get(self.QUEUE)
+                if body is None:
+                    return op.replace(type="fail")
+                return op.replace(type="ok", value=int(body))
+            if op.f == "drain":
+                drained = []
+                while True:
+                    body = self.conn.get(self.QUEUE)
+                    if body is None:
+                        break
+                    drained.append(int(body))
+                return op.replace(type="ok", value=drained)
+        except (AmqpError, OSError, ConnectionError) as e:
+            # All indeterminate: an unconfirmed publish may still land,
+            # and a no-ack get may have consumed a message the broker
+            # already removed — neither may claim "no effect".
+            return op.replace(type="info", error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()
+
+
+class MutexClient(client_ns.Client):
+    """The message-holding semaphore mutex (rabbitmq.clj:263): one token
+    message circulates; acquire = consume it (hold), release = publish
+    it back. A successful get IS the lock acquisition."""
+
+    QUEUE = "jepsen.mutex"
+
+    def __init__(self, conn: AmqpClient | None = None):
+        self.conn = conn
+        self.holding = False
+
+    def open(self, test, node):
+        c = AmqpClient(node)
+        c.queue_declare(self.QUEUE)
+        c.confirm_select()
+        return MutexClient(c)
+
+    def setup(self, test) -> None:
+        conn = AmqpClient(test["nodes"][0])
+        try:
+            conn.queue_declare(self.QUEUE)
+            while conn.get(self.QUEUE) is not None:
+                pass                     # drain stale tokens from reruns
+            conn.publish(self.QUEUE, b"token")
+        finally:
+            conn.close()
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "acquire":
+                if self.holding:
+                    return op.replace(type="fail", error="already held")
+                body = self.conn.get(self.QUEUE)
+                if body is None:
+                    return op.replace(type="fail")
+                self.holding = True
+                return op.replace(type="ok")
+            if op.f == "release":
+                if not self.holding:
+                    return op.replace(type="fail", error="not held")
+                self.conn.publish(self.QUEUE, b"token")
+                self.holding = False
+                return op.replace(type="ok")
+        except (AmqpError, OSError, ConnectionError) as e:
+            return op.replace(type="info", error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()

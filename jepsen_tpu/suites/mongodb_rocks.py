@@ -48,12 +48,13 @@ class MongoRocksDB(db_ns.DB, db_ns.LogFiles):
 def test(opts: dict | None = None) -> dict:
     """The perf test map (mongodb_rocks.clj:140-170): insert-heavy load,
     perf graphs as the only analysis."""
+    from jepsen_tpu.suites import mongowire
+
     return common.suite_test(
         "mongodb-rocks", opts,
         workload=workloads.dirty_read_workload(abort_prob=0.0),
         db=MongoRocksDB(),
-        client=common.GatedClient(
-            "the Mongo wire protocol needs a driver; run with --fake"))
+        client=mongowire.TableClient())
 
 
 def main(argv=None) -> None:

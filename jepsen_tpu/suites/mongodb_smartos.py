@@ -32,6 +32,38 @@ class MongoSmartosDB(db_ns.DB, db_ns.LogFiles):
             control.exec_("tee", "/opt/local/etc/mongod.conf",
                           stdin=config)
             control.exec_("svcadm", "enable", "mongodb", may_fail=True)
+        if node == test["nodes"][0]:
+            self._initiate(test)
+
+    def _initiate(self, test) -> None:
+        """replSetInitiate from the harness over the wire client
+        (core.clj's replica-set bring-up), retried until mongod answers.
+        AlreadyInitialized (code 23) makes re-runs idempotent."""
+        import time
+
+        from jepsen_tpu.suites.mongowire import MongoClient, MongoError
+
+        members = [{"_id": i, "host": f"{n}:27017"}
+                   for i, n in enumerate(test["nodes"])]
+        deadline = time.time() + 60
+        while True:
+            try:
+                conn = MongoClient(test["nodes"][0], follow_primary=False)
+                try:
+                    conn.command("admin", {"replSetInitiate": {
+                        "_id": "jepsen", "members": members}})
+                finally:
+                    conn.close()
+                return
+            except MongoError as e:
+                if e.code == 23:        # AlreadyInitialized
+                    return
+                if time.time() > deadline:
+                    raise
+            except (OSError, ConnectionError):
+                if time.time() > deadline:
+                    raise
+            time.sleep(1)
 
     def teardown(self, test, node) -> None:
         with control.su():
@@ -47,18 +79,25 @@ def test(opts: dict | None = None) -> dict:
     picks document-cas (default) or transfer."""
     opts = dict(opts or {})
     name = opts.pop("workload", None) or "document-cas"
-    wl = workloads.register() if name == "document-cas" \
-        else workloads.bank_workload()
+    from jepsen_tpu.suites import mongowire
+
     if name == "document-cas":
+        wl = workloads.register()
+        client = mongowire.DocumentCasClient()
         threads_per_key = 10
         if opts.get("concurrency", 0) < threads_per_key:
             opts["concurrency"] = threads_per_key
+    else:
+        # One source of truth for the bank shape: the client seeds the
+        # same accounts/total the workload's checker validates.
+        n_accounts, total = 5, 50
+        wl = workloads.bank_workload(n_accounts=n_accounts, total=total)
+        client = mongowire.BankClient(n=n_accounts, total=total)
     return common.suite_test(
         f"mongodb-smartos {name}", opts,
         workload=wl,
         db=MongoSmartosDB(),
-        client=common.GatedClient(
-            "the Mongo wire protocol needs a driver; run with --fake"),
+        client=client,
         os=os_smartos.os,
         nemesis=nemesis_ns.partition_random_halves(),
         nemesis_gen=common.standard_nemesis_gen(5, 5))

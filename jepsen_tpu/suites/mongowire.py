@@ -1,0 +1,421 @@
+"""Minimal MongoDB wire-protocol client with a built-in BSON codec.
+
+The reference drives MongoDB through the official driver
+(mongodb-smartos/src/jepsen/mongodb_smartos/core.clj, document_cas.clj);
+the TPU build speaks the wire protocol from the stdlib. Commands run as
+BSON documents over OP_QUERY against ``$cmd`` (MongoDB 2.6-5.0, the
+reference's era) or OP_MSG (3.6+), selected by the handshake's
+``maxWireVersion`` — so both the old SmartOS mongod and a modern one
+work.
+
+BSON subset: double, string, document, array, bool, null, int32, int64,
+ObjectId (opaque 12 bytes), binary (opaque) — everything the
+document-CAS / bank / insert workloads touch. Unknown element types
+raise rather than silently mis-parse.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from jepsen_tpu import client as client_ns
+
+OP_QUERY = 2004
+OP_REPLY = 1
+OP_MSG = 2013
+
+
+class MongoError(Exception):
+    """A definite server-reported command error: the op did not happen."""
+
+    def __init__(self, doc: dict):
+        self.doc = doc
+        super().__init__(doc.get("errmsg") or doc.get("$err")
+                         or f"mongo error {doc.get('code')}")
+
+    @property
+    def code(self):
+        return self.doc.get("code")
+
+
+class MongoIndeterminate(MongoError):
+    """The command may or may not have applied: reply unparsable, or the
+    server acknowledged the write but reported a write-concern failure
+    (the write can still be rolled back on primary step-down). Ops
+    hitting this must complete :info, never :fail."""
+
+
+# --- BSON ---------------------------------------------------------------
+
+
+def _enc_elem(key: str, v) -> bytes:
+    k = key.encode() + b"\x00"
+    if isinstance(v, bool):
+        return b"\x08" + k + (b"\x01" if v else b"\x00")
+    if isinstance(v, int):
+        if -(2 ** 31) <= v < 2 ** 31:
+            return b"\x10" + k + struct.pack("<i", v)
+        return b"\x12" + k + struct.pack("<q", v)
+    if isinstance(v, float):
+        return b"\x01" + k + struct.pack("<d", v)
+    if isinstance(v, str):
+        b = v.encode()
+        return b"\x02" + k + struct.pack("<i", len(b) + 1) + b + b"\x00"
+    if v is None:
+        return b"\x0a" + k
+    if isinstance(v, dict):
+        return b"\x03" + k + bson_encode(v)
+    if isinstance(v, (list, tuple)):
+        doc = {str(i): x for i, x in enumerate(v)}
+        return b"\x04" + k + bson_encode(doc)
+    if isinstance(v, bytes) and len(v) == 12:      # ObjectId passthrough
+        return b"\x07" + k + v
+    raise TypeError(f"cannot BSON-encode {type(v).__name__}: {v!r}")
+
+
+def bson_encode(doc: dict) -> bytes:
+    body = b"".join(_enc_elem(k, v) for k, v in doc.items())
+    return struct.pack("<i", len(body) + 5) + body + b"\x00"
+
+
+def _dec_elem(b: bytes, off: int):
+    t = b[off]
+    off += 1
+    end = b.index(b"\x00", off)
+    key = b[off:end].decode()
+    off = end + 1
+    if t == 0x01:
+        return key, struct.unpack_from("<d", b, off)[0], off + 8
+    if t == 0x02:
+        (n,) = struct.unpack_from("<i", b, off)
+        return key, b[off + 4:off + 3 + n].decode(), off + 4 + n
+    if t in (0x03, 0x04):
+        (n,) = struct.unpack_from("<i", b, off)
+        doc = bson_decode(b[off:off + n])
+        if t == 0x04:
+            doc = [doc[str(i)] for i in range(len(doc))]
+        return key, doc, off + n
+    if t == 0x05:                                  # binary: opaque
+        (n,) = struct.unpack_from("<i", b, off)
+        return key, b[off + 5:off + 5 + n], off + 5 + n
+    if t == 0x07:
+        return key, b[off:off + 12], off + 12
+    if t == 0x08:
+        return key, b[off] != 0, off + 1
+    if t == 0x09 or t == 0x12:                     # datetime / int64
+        return key, struct.unpack_from("<q", b, off)[0], off + 8
+    if t == 0x0A:
+        return key, None, off
+    if t == 0x10:
+        return key, struct.unpack_from("<i", b, off)[0], off + 4
+    if t == 0x11:                                  # timestamp
+        return key, struct.unpack_from("<Q", b, off)[0], off + 8
+    raise ValueError(f"unsupported BSON element type 0x{t:02x} at {off}")
+
+
+def bson_decode(b: bytes) -> dict:
+    (n,) = struct.unpack_from("<i", b, 0)
+    out: dict = {}
+    off = 4
+    while off < n - 1:
+        key, v, off = _dec_elem(b, off)
+        out[key] = v
+    return out
+
+
+# --- wire client ---------------------------------------------------------
+
+
+class MongoClient:
+    def __init__(self, host: str, port: int = 27017,
+                 timeout: float = 10.0, follow_primary: bool = True):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.buf = b""
+        self.req_id = 0
+        self.lock = threading.Lock()
+        hello = self._command_query("admin", {"ismaster": 1})
+        self.use_msg = hello.get("maxWireVersion", 0) >= 6
+        # Replica-set primary routing: writes against a secondary fail
+        # NotMaster, so follow the hello response's primary pointer (the
+        # driver behavior the reference's client gets from mongo-java).
+        primary = hello.get("primary")
+        if follow_primary and primary and not hello.get("ismaster", True):
+            phost, _, pport = primary.partition(":")
+            if (phost, int(pport or port)) != (host, port):
+                self.sock.close()
+                self.sock = socket.create_connection(
+                    (phost, int(pport or port)), timeout=timeout)
+                self.buf = b""
+                hello = self._command_query("admin", {"ismaster": 1})
+                self.use_msg = hello.get("maxWireVersion", 0) >= 6
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("connection closed")
+            self.buf += chunk
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+    def _send(self, opcode: int, payload: bytes) -> int:
+        self.req_id += 1
+        head = struct.pack("<iiii", len(payload) + 16, self.req_id, 0,
+                           opcode)
+        self.sock.sendall(head + payload)
+        return self.req_id
+
+    def _recv(self) -> tuple[int, bytes]:
+        head = self._read_exact(16)
+        length, _, _, opcode = struct.unpack("<iiii", head)
+        return opcode, self._read_exact(length - 16)
+
+    def _command_query(self, db: str, cmd: dict) -> dict:
+        """Command via OP_QUERY on <db>.$cmd (wire versions < 6)."""
+        payload = (struct.pack("<i", 0) + f"{db}.$cmd\x00".encode()
+                   + struct.pack("<ii", 0, -1) + bson_encode(cmd))
+        self._send(OP_QUERY, payload)
+        opcode, body = self._recv()
+        if opcode != OP_REPLY:
+            # The command was sent: an unparsable reply is indeterminate.
+            raise MongoIndeterminate(
+                {"errmsg": f"unexpected opcode {opcode}"})
+        # flags i32, cursorId i64, startingFrom i32, numberReturned i32
+        (num,) = struct.unpack_from("<i", body, 16)
+        if num < 1:
+            raise MongoIndeterminate({"errmsg": "empty reply"})
+        doc = bson_decode(body[20:])
+        return self._check(doc)
+
+    def _command_msg(self, db: str, cmd: dict) -> dict:
+        """Command via OP_MSG (wire versions >= 6)."""
+        body = dict(cmd)
+        body["$db"] = db
+        payload = struct.pack("<I", 0) + b"\x00" + bson_encode(body)
+        self._send(OP_MSG, payload)
+        opcode, resp = self._recv()
+        if opcode != OP_MSG:
+            raise MongoIndeterminate(
+                {"errmsg": f"unexpected opcode {opcode}"})
+        if resp[4:5] != b"\x00":
+            raise MongoIndeterminate({"errmsg": "unexpected OP_MSG section"})
+        return self._check(bson_decode(resp[5:]))
+
+    @staticmethod
+    def _check(doc: dict) -> dict:
+        if doc.get("ok") not in (1, 1.0, True):
+            raise MongoError(doc)
+        errs = doc.get("writeErrors")
+        if errs:
+            raise MongoError(errs[0])
+        if doc.get("writeConcernError"):
+            # Acknowledged but under-replicated: may roll back later.
+            raise MongoIndeterminate(doc["writeConcernError"])
+        return doc
+
+    def command(self, db: str, cmd: dict) -> dict:
+        with self.lock:
+            if self.use_msg:
+                return self._command_msg(db, cmd)
+            return self._command_query(db, cmd)
+
+    # --- the operations the workloads use --------------------------------
+
+    def find_one(self, db: str, coll: str, query: dict) -> dict | None:
+        r = self.command(db, {"find": coll, "filter": query, "limit": 1,
+                              "singleBatch": True})
+        batch = r.get("cursor", {}).get("firstBatch", [])
+        return batch[0] if batch else None
+
+    def find_all(self, db: str, coll: str, query: dict | None = None) \
+            -> list[dict]:
+        r = self.command(db, {"find": coll, "filter": query or {},
+                              "singleBatch": True, "batchSize": 10 ** 6})
+        return r.get("cursor", {}).get("firstBatch", [])
+
+    def insert(self, db: str, coll: str, doc: dict, majority=True) -> None:
+        cmd = {"insert": coll, "documents": [doc]}
+        if majority:
+            cmd["writeConcern"] = {"w": "majority"}
+        self.command(db, cmd)
+
+    def upsert(self, db: str, coll: str, query: dict, update: dict,
+               majority=True) -> None:
+        cmd = {"update": coll,
+               "updates": [{"q": query, "u": update, "upsert": True}]}
+        if majority:
+            cmd["writeConcern"] = {"w": "majority"}
+        self.command(db, cmd)
+
+    def find_and_modify(self, db: str, coll: str, query: dict,
+                        update: dict, majority=True) -> dict | None:
+        """Atomic conditional update returning the PRE-image (None if the
+        query matched nothing) — the document-CAS primitive
+        (document_cas.clj)."""
+        cmd = {"findAndModify": coll, "query": query, "update": update}
+        if majority:
+            cmd["writeConcern"] = {"w": "majority"}
+        r = self.command(db, cmd)
+        return r.get("value")
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# --- workload clients -----------------------------------------------------
+
+DB = "jepsen"
+
+
+def _fail_or_info(op, e: Exception):
+    definite = isinstance(e, MongoError) \
+        and not isinstance(e, MongoIndeterminate)
+    return op.replace(
+        type="fail" if (op.f == "read" or definite) else "info",
+        error=str(e) if isinstance(e, MongoError) else repr(e))
+
+
+class _MongoSuiteClient(client_ns.Client):
+    """Shared plumbing (jepsen_tpu.client.Client surface)."""
+
+    COLL = "jepsen"
+
+    def __init__(self, conn: MongoClient | None = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return type(self)(MongoClient(node))
+
+    def setup(self, test) -> None:
+        pass
+
+    def teardown(self, test) -> None:
+        pass
+
+    def invoke(self, test, op):
+        raise NotImplementedError
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()
+
+
+class DocumentCasClient(_MongoSuiteClient):
+    """Per-key register over one document per key
+    (mongodb_smartos/document_cas.clj): read = find, write = upsert with
+    majority write concern, cas = findAndModify conditioned on the
+    current value (atomic within one document)."""
+
+    COLL = "registers"
+
+    def invoke(self, test, op):
+        from jepsen_tpu import independent
+
+        k, v = op.value if independent.is_tuple(op.value) \
+            else (0, op.value)
+
+        def join(val):
+            return independent.tuple_(k, val) \
+                if independent.is_tuple(op.value) else val
+
+        try:
+            if op.f == "read":
+                doc = self.conn.find_one(DB, self.COLL, {"_id": int(k)})
+                return op.replace(
+                    type="ok",
+                    value=join(None if doc is None else doc.get("value")))
+            if op.f == "write":
+                self.conn.upsert(DB, self.COLL, {"_id": int(k)},
+                                 {"$set": {"value": int(v)}})
+                return op.replace(type="ok")
+            if op.f == "cas":
+                old, new = v
+                pre = self.conn.find_and_modify(
+                    DB, self.COLL, {"_id": int(k), "value": int(old)},
+                    {"$set": {"value": int(new)}})
+                return op.replace(type="ok" if pre is not None else "fail")
+        except (MongoError, OSError, ConnectionError) as e:
+            return _fail_or_info(op, e)
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+class BankClient(_MongoSuiteClient):
+    """Balance transfers (mongodb_smartos/transfer.clj shape): the debit
+    is an atomic conditional findAndModify; debit and credit are NOT
+    one transaction (the reference era predates multi-document txns) —
+    exactly the anomaly surface the bank checker probes."""
+
+    COLL = "accounts"
+
+    def __init__(self, conn=None, n: int = 5, total: int = 50):
+        super().__init__(conn)
+        self.n = n
+        self.total = total
+
+    def open(self, test, node):
+        return BankClient(MongoClient(node), self.n, self.total)
+
+    def setup(self, test) -> None:
+        conn = MongoClient(test["nodes"][0])
+        try:
+            for i in range(self.n):
+                if conn.find_one(DB, self.COLL, {"_id": i}) is None:
+                    conn.insert(DB, self.COLL,
+                                {"_id": i,
+                                 "balance": self.total // self.n})
+        finally:
+            conn.close()
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "read":
+                docs = self.conn.find_all(DB, self.COLL)
+                docs.sort(key=lambda d: d["_id"])
+                return op.replace(type="ok",
+                                  value=[int(d["balance"]) for d in docs])
+            if op.f == "transfer":
+                t = op.value
+                pre = self.conn.find_and_modify(
+                    DB, self.COLL,
+                    {"_id": t["from"], "balance": {"$gte": t["amount"]}},
+                    {"$inc": {"balance": -t["amount"]}})
+                if pre is None:
+                    return op.replace(type="fail",
+                                      error="insufficient funds")
+                try:
+                    self.conn.find_and_modify(
+                        DB, self.COLL, {"_id": t["to"]},
+                        {"$inc": {"balance": t["amount"]}})
+                except (MongoError, OSError, ConnectionError) as e:
+                    # The debit already applied: half-applied transfers
+                    # are indeterminate, never "fail" (= no effect).
+                    return op.replace(type="info",
+                                      error=f"credit leg: {e!r}")
+                return op.replace(type="ok")
+        except (MongoError, OSError, ConnectionError) as e:
+            return _fail_or_info(op, e)
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+class TableClient(_MongoSuiteClient):
+    """Insert/read rows (mongodb_rocks perf harness shape)."""
+
+    COLL = "rows"
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "insert":
+                self.conn.insert(DB, self.COLL, {"_id": int(op.value)})
+                return op.replace(type="ok")
+            if op.f == "read":
+                docs = self.conn.find_all(DB, self.COLL)
+                return op.replace(
+                    type="ok", value=sorted(int(d["_id"]) for d in docs))
+        except (MongoError, OSError, ConnectionError) as e:
+            return _fail_or_info(op, e)
+        return op.replace(type="fail", error=f"unknown f {op.f}")

@@ -9,7 +9,7 @@ is the Debian rabbitmq-server package with a generated clustering
 config (rabbitmq.clj:38-98).
 
 The AMQP wire protocol needs a driver (the reference uses Langohr), so
-the client is gated; both workloads run no-cluster against their fakes.
+the client speaks AMQP 0-9-1 natively (jepsen_tpu.suites.amqpwire).
 """
 
 from __future__ import annotations
@@ -56,16 +56,20 @@ def test(opts: dict | None = None) -> dict:
     """The rabbitmq test map (rabbitmq.clj:282-320). ``workload`` is
     "queue" (default) or "mutex"."""
     opts = dict(opts or {})
+    from jepsen_tpu.suites import amqpwire
+
     name = opts.pop("workload", None) or "queue"
-    wl = workloads.queue_workload() if name == "queue" \
-        else workloads.lock_workload()
+    if name == "queue":
+        wl = workloads.queue_workload()
+        client = amqpwire.QueueClient()
+    else:
+        wl = workloads.lock_workload()
+        client = amqpwire.MutexClient()
     return common.suite_test(
         f"rabbitmq {name}", opts,
         workload=wl,
         db=RabbitDB(),
-        client=common.GatedClient(
-            "the AMQP wire protocol needs a driver (reference uses "
-            "Langohr); run with --fake"),
+        client=client,
         nemesis=nemesis_ns.partition_random_halves(),
         nemesis_gen=common.standard_nemesis_gen(5, 5))
 

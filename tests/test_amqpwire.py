@@ -1,0 +1,201 @@
+"""AMQP 0-9-1 client against an in-process fake broker with a real
+queue store, exercising negotiation, publish framing (method + header +
+body), synchronous get, and the queue/mutex workload clients."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from collections import deque
+
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites.amqpwire import (AmqpClient, MutexClient,
+                                        QueueClient)
+
+FRAME_END = 0xCE
+
+
+def _shortstr(s: str) -> bytes:
+    b = s.encode()
+    return bytes([len(b)]) + b
+
+
+class FakeBroker:
+    def __init__(self):
+        self.queues: dict[str, deque] = {}
+        self.srv = socket.socket()
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(4)
+        self.port = self.srv.getsockname()[1]
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while True:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        buf = bytearray()
+
+        def read_exact(n):
+            while len(buf) < n:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf.extend(chunk)
+            out = bytes(buf[:n])
+            del buf[:n]
+            return out
+
+        def read_frame():
+            t, ch, size = struct.unpack(">BHI", read_exact(7))
+            payload = read_exact(size)
+            assert read_exact(1) == bytes([FRAME_END])
+            return t, ch, payload
+
+        def send_frame(t, ch, payload):
+            conn.sendall(struct.pack(">BHI", t, ch, len(payload))
+                         + payload + bytes([FRAME_END]))
+
+        def send_method(ch, cid, mid, args=b""):
+            send_frame(1, ch, struct.pack(">HH", cid, mid) + args)
+
+        try:
+            assert read_exact(8) == b"AMQP\x00\x00\x09\x01"
+            send_method(0, 10, 10,                      # Start
+                        b"\x00\x09" + b"\x00\x00\x00\x00"
+                        + _longstr(b"PLAIN") + _longstr(b"en_US"))
+            _, _, start_ok = read_frame()
+            assert b"PLAIN" in start_ok and b"guest" in start_ok
+            send_method(0, 10, 30,                      # Tune
+                        struct.pack(">HIH", 0, 131072, 0))
+            read_frame()                                # Tune-Ok
+            read_frame()                                # Open
+            send_method(0, 10, 41, _shortstr(""))       # Open-Ok
+            read_frame()                                # Channel.Open
+            send_method(1, 20, 11, b"\x00\x00\x00\x00")  # Open-Ok
+
+            while True:
+                t, ch, payload = read_frame()
+                cid, mid = struct.unpack_from(">HH", payload, 0)
+                if (cid, mid) == (50, 10):              # queue.declare
+                    qn = payload[7:7 + payload[6]].decode()
+                    self.queues.setdefault(qn, deque())
+                    send_method(1, 50, 11, _shortstr(qn)
+                                + struct.pack(">II", 0, 0))
+                elif (cid, mid) == (60, 40):            # basic.publish
+                    off = 6 + 1 + payload[6]            # skip exchange
+                    qn = payload[off + 1:off + 1 + payload[off]].decode()
+                    _, _, header = read_frame()
+                    (size,) = struct.unpack_from(">Q", header, 4)
+                    body = b""
+                    while len(body) < size:
+                        _, _, part = read_frame()
+                        body += part
+                    self.queues.setdefault(qn, deque()).append(body)
+                    send_method(1, 60, 80,              # Basic.Ack
+                                struct.pack(">QB", 1, 0))
+                elif (cid, mid) == (85, 10):            # confirm.select
+                    send_method(1, 85, 11)
+                elif (cid, mid) == (60, 70):            # basic.get
+                    qn = payload[7:7 + payload[6]].decode()
+                    q = self.queues.setdefault(qn, deque())
+                    if not q:
+                        send_method(1, 60, 72, _shortstr(""))
+                    else:
+                        body = q.popleft()
+                        send_method(1, 60, 71,
+                                    struct.pack(">QB", 1, 0)
+                                    + _shortstr("") + _shortstr(qn)
+                                    + struct.pack(">I", len(q)))
+                        send_frame(2, 1, struct.pack(
+                            ">HHQH", 60, 0, len(body), 0))
+                        send_frame(3, 1, body)
+                elif (cid, mid) == (10, 50):            # Connection.Close
+                    return
+        except (ConnectionError, OSError, AssertionError):
+            return
+        finally:
+            conn.close()
+
+    def close(self):
+        self.srv.close()
+
+
+def _longstr(b: bytes) -> bytes:
+    return struct.pack(">I", len(b)) + b
+
+
+def test_negotiate_publish_get_roundtrip():
+    srv = FakeBroker()
+    c = AmqpClient("127.0.0.1", srv.port)
+    c.queue_declare("q1")
+    c.confirm_select()
+    assert c.get("q1") is None
+    c.publish("q1", b"41")
+    c.publish("q1", b"42")
+    assert c.get("q1") == b"41"
+    assert c.get("q1") == b"42"
+    assert c.get("q1") is None
+    c.close()
+    srv.close()
+
+
+def test_queue_client_semantics():
+    srv = FakeBroker()
+    # connect directly: the fake's port is non-standard
+    conn = AmqpClient("127.0.0.1", srv.port)
+    conn.queue_declare(QueueClient.QUEUE)
+    cl = QueueClient(conn)
+    assert cl.invoke(None, Op("invoke", "enqueue", 7, 0)).is_ok
+    assert cl.invoke(None, Op("invoke", "enqueue", 9, 0)).is_ok
+    d = cl.invoke(None, Op("invoke", "dequeue", None, 0))
+    assert d.is_ok and d.value == 7
+    dr = cl.invoke(None, Op("invoke", "drain", None, 0))
+    assert dr.is_ok and dr.value == [9]
+    assert cl.invoke(None, Op("invoke", "dequeue", None, 0)).is_fail
+    cl.close(None)
+    srv.close()
+
+
+def test_mutex_client_token_semantics():
+    srv = FakeBroker()
+
+    def make():
+        conn = AmqpClient("127.0.0.1", srv.port)
+        conn.queue_declare(MutexClient.QUEUE)
+        conn.confirm_select()
+        return MutexClient(conn)
+
+    a, b = make(), make()
+    a.conn.publish(MutexClient.QUEUE, b"token")   # seed one token
+    assert a.invoke(None, Op("invoke", "acquire", None, 0)).is_ok
+    assert b.invoke(None, Op("invoke", "acquire", None, 1)).is_fail
+    assert b.invoke(None, Op("invoke", "release", None, 1)).is_fail
+    assert a.invoke(None, Op("invoke", "release", None, 0)).is_ok
+    # publish is async on a's connection; b's broker thread may race it
+    import time
+
+    deadline = time.time() + 5
+    while True:
+        r = b.invoke(None, Op("invoke", "acquire", None, 1))
+        if r.is_ok or time.time() > deadline:
+            break
+        time.sleep(0.01)
+    assert r.is_ok
+    a.close(None)
+    b.close(None)
+    srv.close()
+
+
+def test_rabbitmq_suite_ungated():
+    from jepsen_tpu.suites import common, rabbitmq
+
+    for opts in ({}, {"workload": "mutex"}):
+        t = rabbitmq.test(dict(opts))
+        assert not isinstance(t["client"], common.GatedClient)

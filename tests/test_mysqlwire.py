@@ -204,8 +204,10 @@ def test_mysql_family_suites_ungated():
         assert not isinstance(t["client"], common.GatedClient)
 
 
-def test_gated_suite_count_below_nine():
-    # Round-1 had 12 gated wire clients; the VERDICT target is <= 8.
+def test_gated_suite_count_below_four():
+    # Round-1 had 12 gated wire clients; the VERDICT target was <= 8.
+    # The mysql/zk/irc/mongo/amqp wire clients brought it to 3
+    # (aerospike, hazelcast, rethinkdb remain).
     import importlib
     import pkgutil
 
@@ -223,4 +225,4 @@ def test_gated_suite_count_below_nine():
             continue
         if isinstance(t.get("client"), common.GatedClient):
             gated.append(info.name)
-    assert len(gated) <= 8, gated
+    assert len(gated) <= 3, gated
