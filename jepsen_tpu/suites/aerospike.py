@@ -88,17 +88,21 @@ def test(opts: dict | None = None) -> dict:
     opts = dict(opts or {})
     name = opts.pop("workload", None) or "cas-register"
     nem = opts.pop("nemesis", None) or "partition"
-    wl = workloads.single_register() if name == "cas-register" \
-        else workloads.counter_workload()
+    from jepsen_tpu.suites import aerowire
+
+    if name == "cas-register":
+        wl = workloads.single_register()
+        client = aerowire.RegisterClient()
+    else:
+        wl = workloads.counter_workload()
+        client = aerowire.CounterClient()
     nemesis = nemesis_ns.partition_random_halves() \
         if nem == "partition" else kill_nemesis()
     return common.suite_test(
         f"aerospike {name}", opts,
         workload=wl,
         db=AerospikeDB(),
-        client=common.GatedClient(
-            "aerospike speaks a proprietary binary protocol; "
-            "run with --fake"),
+        client=client,
         nemesis=nemesis,
         nemesis_gen=common.standard_nemesis_gen(5, 5))
 

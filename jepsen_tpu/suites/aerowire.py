@@ -1,0 +1,331 @@
+"""Minimal Aerospike wire-protocol client.
+
+The reference drives Aerospike through the official Java client
+(aerospike/src/aerospike/core.clj:330-480); the TPU build speaks the
+binary data protocol from the stdlib: the 8-byte proto header
+(version 2, type 3, 48-bit length), the 22-byte message header with
+info/result/generation words, fields (namespace, set, RIPEMD160 key
+digest), and bin operations (read-all, write, add). CAS is a write with
+an expected generation (info2 GENERATION bit, result code 3 on
+mismatch) — the same read-version-then-conditional-write shape the
+reference's check-and-set uses (core.clj:408-430).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu.suites.common import SocketIO
+
+# info bits
+INFO1_READ = 1
+INFO1_GET_ALL = 2
+INFO2_WRITE = 1
+INFO2_GENERATION = 2
+
+# ops
+OP_READ = 1
+OP_WRITE = 2
+OP_INCR = 5
+
+# bin types
+BIN_INT = 1
+BIN_STR = 3
+
+# fields
+FIELD_NAMESPACE = 0
+FIELD_SET = 1
+FIELD_DIGEST = 4
+
+RC_OK = 0
+RC_NOT_FOUND = 2
+RC_GENERATION = 3
+
+
+class AerospikeError(Exception):
+    def __init__(self, code: int):
+        self.code = code
+        super().__init__(f"aerospike result code {code}")
+
+    @property
+    def not_found(self):
+        return self.code == RC_NOT_FOUND
+
+    @property
+    def generation_mismatch(self):
+        return self.code == RC_GENERATION
+
+
+# --- RIPEMD-160 ------------------------------------------------------------
+#
+# OpenSSL 3 ships ripemd160 in the (often disabled) legacy provider, so
+# hashlib may not have it; the pure-Python implementation below is the
+# fallback. Every Aerospike client computes this digest client-side.
+
+def _rmd160_py(msg: bytes) -> bytes:
+    # Standard RIPEMD-160 (ISO/IEC 10118-3), 32-bit word little-endian.
+    r1 = [0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15,
+          7, 4, 13, 1, 10, 6, 15, 3, 12, 0, 9, 5, 2, 14, 11, 8,
+          3, 10, 14, 4, 9, 15, 8, 1, 2, 7, 0, 6, 13, 11, 5, 12,
+          1, 9, 11, 10, 0, 8, 12, 4, 13, 3, 7, 15, 14, 5, 6, 2,
+          4, 0, 5, 9, 7, 12, 2, 10, 14, 1, 3, 8, 11, 6, 15, 13]
+    r2 = [5, 14, 7, 0, 9, 2, 11, 4, 13, 6, 15, 8, 1, 10, 3, 12,
+          6, 11, 3, 7, 0, 13, 5, 10, 14, 15, 8, 12, 4, 9, 1, 2,
+          15, 5, 1, 3, 7, 14, 6, 9, 11, 8, 12, 2, 10, 0, 4, 13,
+          8, 6, 4, 1, 3, 11, 15, 0, 5, 12, 2, 13, 9, 7, 10, 14,
+          12, 15, 10, 4, 1, 5, 8, 7, 6, 2, 13, 14, 0, 3, 9, 11]
+    s1 = [11, 14, 15, 12, 5, 8, 7, 9, 11, 13, 14, 15, 6, 7, 9, 8,
+          7, 6, 8, 13, 11, 9, 7, 15, 7, 12, 15, 9, 11, 7, 13, 12,
+          11, 13, 6, 7, 14, 9, 13, 15, 14, 8, 13, 6, 5, 12, 7, 5,
+          11, 12, 14, 15, 14, 15, 9, 8, 9, 14, 5, 6, 8, 6, 5, 12,
+          9, 15, 5, 11, 6, 8, 13, 12, 5, 12, 13, 14, 11, 8, 5, 6]
+    s2 = [8, 9, 9, 11, 13, 15, 15, 5, 7, 7, 8, 11, 14, 14, 12, 6,
+          9, 13, 15, 7, 12, 8, 9, 11, 7, 7, 12, 7, 6, 15, 13, 11,
+          9, 7, 15, 11, 8, 6, 6, 14, 12, 13, 5, 14, 13, 13, 7, 5,
+          15, 5, 8, 11, 14, 14, 6, 14, 6, 9, 12, 9, 12, 5, 15, 8,
+          8, 5, 12, 9, 12, 5, 14, 6, 8, 13, 6, 5, 15, 13, 11, 11]
+    k1 = [0, 0x5A827999, 0x6ED9EBA1, 0x8F1BBCDC, 0xA953FD4E]
+    k2 = [0x50A28BE6, 0x5C4DD124, 0x6D703EF3, 0x7A6D76E9, 0]
+
+    def f(j, x, y, z):
+        if j < 16:
+            return x ^ y ^ z
+        if j < 32:
+            return (x & y) | (~x & z)
+        if j < 48:
+            return (x | ~y) ^ z
+        if j < 64:
+            return (x & z) | (y & ~z)
+        return x ^ (y | ~z)
+
+    def rol(x, n):
+        x &= 0xFFFFFFFF
+        return ((x << n) | (x >> (32 - n))) & 0xFFFFFFFF
+
+    h = [0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0]
+    padded = msg + b"\x80" + b"\x00" * ((55 - len(msg)) % 64) \
+        + struct.pack("<Q", 8 * len(msg))
+    for off in range(0, len(padded), 64):
+        x = struct.unpack("<16I", padded[off:off + 64])
+        a1, b1, c1, d1, e1 = h
+        a2, b2, c2, d2, e2 = h
+        for j in range(80):
+            a1 = rol(a1 + f(j, b1, c1, d1) + x[r1[j]] + k1[j // 16],
+                     s1[j]) + e1 & 0xFFFFFFFF
+            a1, b1, c1, d1, e1 = e1, a1, b1, rol(c1, 10), d1
+            a2 = rol(a2 + f(79 - j, b2, c2, d2) + x[r2[j]]
+                     + k2[j // 16], s2[j]) + e2 & 0xFFFFFFFF
+            a2, b2, c2, d2, e2 = e2, a2, b2, rol(c2, 10), d2
+        t = (h[1] + c1 + d2) & 0xFFFFFFFF
+        h = [t, (h[2] + d1 + e2) & 0xFFFFFFFF,
+             (h[3] + e1 + a2) & 0xFFFFFFFF,
+             (h[4] + a1 + b2) & 0xFFFFFFFF,
+             (h[0] + b1 + c2) & 0xFFFFFFFF]
+    return struct.pack("<5I", *h)
+
+
+def _rmd160(data: bytes) -> bytes:
+    try:
+        h = hashlib.new("ripemd160")
+    except (ValueError, TypeError):
+        return _rmd160_py(data)
+    h.update(data)
+    return h.digest()
+
+
+def digest(set_name: str, key) -> bytes:
+    """RIPEMD160 over set + key-type + key bytes (the client-side record
+    digest every Aerospike client computes)."""
+    if isinstance(key, int):
+        kt, kb = 1, struct.pack(">q", key)
+    else:
+        kt, kb = 3, str(key).encode()
+    return _rmd160(set_name.encode() + bytes([kt]) + kb)
+
+
+def _field(ftype: int, data: bytes) -> bytes:
+    return struct.pack(">I", len(data) + 1) + bytes([ftype]) + data
+
+
+def _bin_value(v) -> tuple[int, bytes]:
+    if isinstance(v, int):
+        return BIN_INT, struct.pack(">q", v)
+    return BIN_STR, str(v).encode()
+
+
+def _op(op: int, name: str, v=None) -> bytes:
+    btype, data = (0, b"") if v is None else _bin_value(v)
+    nb = name.encode()
+    return (struct.pack(">I", 4 + len(nb) + len(data))
+            + bytes([op, btype, 0, len(nb)]) + nb + data)
+
+
+class AerospikeClient:
+    def __init__(self, host: str, port: int = 3000,
+                 namespace: str = "test", set_name: str = "jepsen",
+                 timeout: float = 10.0):
+        self.io = SocketIO(
+            socket.create_connection((host, port), timeout=timeout))
+        self.ns = namespace
+        self.set = set_name
+
+    def _call(self, info1: int, info2: int, key, ops: list[bytes],
+              generation: int = 0) -> tuple[int, int, dict]:
+        """One request/response. Returns (result_code, generation,
+        bins)."""
+        fields = [_field(FIELD_NAMESPACE, self.ns.encode()),
+                  _field(FIELD_SET, self.set.encode()),
+                  _field(FIELD_DIGEST, digest(self.set, key))]
+        msg = (bytes([22, info1, info2, 0, 0, 0])
+               + struct.pack(">IIIHH", generation, 0, 1000,
+                             len(fields), len(ops))
+               + b"".join(fields) + b"".join(ops))
+        proto = (2 << 56) | (3 << 48) | len(msg)
+        self.io.send(struct.pack(">Q", proto) + msg)
+
+        (head,) = struct.unpack(">Q", self.io.read_exact(8))
+        body = self.io.read_exact(head & ((1 << 48) - 1))
+        rc = body[5]
+        gen = struct.unpack_from(">I", body, 6)[0]
+        n_fields, n_ops = struct.unpack_from(">HH", body, 18)
+        off = body[0]                       # header size
+        for _ in range(n_fields):
+            (sz,) = struct.unpack_from(">I", body, off)
+            off += 4 + sz
+        bins: dict = {}
+        for _ in range(n_ops):
+            (sz,) = struct.unpack_from(">I", body, off)
+            btype = body[off + 5]
+            name_len = body[off + 7]
+            name = body[off + 8:off + 8 + name_len].decode()
+            data = body[off + 8 + name_len:off + 4 + sz]
+            if btype == BIN_INT:
+                bins[name] = struct.unpack(">q", data)[0]
+            else:
+                bins[name] = data.decode(errors="replace")
+            off += 4 + sz
+        return rc, gen, bins
+
+    def get(self, key) -> tuple[dict, int] | None:
+        """(bins, generation), or None when the record doesn't exist."""
+        rc, gen, bins = self._call(INFO1_READ | INFO1_GET_ALL, 0, key, [])
+        if rc == RC_NOT_FOUND:
+            return None
+        if rc != RC_OK:
+            raise AerospikeError(rc)
+        return bins, gen
+
+    def put(self, key, bins: dict, expect_gen: int | None = None) -> None:
+        """Write bins; with ``expect_gen`` the write only applies when
+        the record generation matches (the CAS primitive; result code 3
+        = lost the race)."""
+        info2 = INFO2_WRITE
+        gen = 0
+        if expect_gen is not None:
+            info2 |= INFO2_GENERATION
+            gen = expect_gen
+        ops = [_op(OP_WRITE, k, v) for k, v in bins.items()]
+        rc, _, _ = self._call(0, info2, key, ops, generation=gen)
+        if rc != RC_OK:
+            raise AerospikeError(rc)
+
+    def incr(self, key, bin_name: str, delta: int) -> None:
+        rc, _, _ = self._call(0, INFO2_WRITE, key,
+                              [_op(OP_INCR, bin_name, delta)])
+        if rc != RC_OK:
+            raise AerospikeError(rc)
+
+    def close(self) -> None:
+        try:
+            self.io.close()
+        except OSError:
+            pass
+
+
+# --- workload clients -------------------------------------------------------
+
+
+class RegisterClient(client_ns.Client):
+    """CAS register over one record (aerospike core.clj:395-430): read
+    returns (value, generation); cas re-reads and writes conditioned on
+    the generation — atomic server-side."""
+
+    KEY = "register"
+    BIN = "value"
+
+    def __init__(self, conn: AerospikeClient | None = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return RegisterClient(AerospikeClient(node))
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "read":
+                r = self.conn.get(self.KEY)
+                return op.replace(type="ok",
+                                  value=None if r is None
+                                  else r[0].get(self.BIN))
+            if op.f == "write":
+                self.conn.put(self.KEY, {self.BIN: int(op.value)})
+                return op.replace(type="ok")
+            if op.f == "cas":
+                old, new = op.value
+                r = self.conn.get(self.KEY)
+                if r is None or r[0].get(self.BIN) != old:
+                    return op.replace(type="fail")
+                try:
+                    self.conn.put(self.KEY, {self.BIN: int(new)},
+                                  expect_gen=r[1])
+                    return op.replace(type="ok")
+                except AerospikeError as e:
+                    if e.generation_mismatch:
+                        return op.replace(type="fail")
+                    raise
+        except AerospikeError as e:
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=str(e))
+        except (OSError, ConnectionError) as e:
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()
+
+
+class CounterClient(client_ns.Client):
+    """Increment-only counter (aerospike core.clj:540-557): add = the
+    server-side INCR op, read = get."""
+
+    KEY = "counter"
+    BIN = "count"
+
+    def __init__(self, conn: AerospikeClient | None = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return CounterClient(AerospikeClient(node))
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "add":
+                self.conn.incr(self.KEY, self.BIN, int(op.value))
+                return op.replace(type="ok")
+            if op.f == "read":
+                r = self.conn.get(self.KEY)
+                return op.replace(type="ok",
+                                  value=0 if r is None
+                                  else r[0].get(self.BIN, 0))
+        except (AerospikeError, OSError, ConnectionError) as e:
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()

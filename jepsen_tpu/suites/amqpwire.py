@@ -20,6 +20,7 @@ import socket
 import struct
 
 from jepsen_tpu import client as client_ns
+from jepsen_tpu.suites.common import SocketIO
 
 FRAME_METHOD = 1
 FRAME_HEADER = 2
@@ -45,33 +46,24 @@ class AmqpClient:
     def __init__(self, host: str, port: int = 5672, user: str = "guest",
                  password: str = "guest", vhost: str = "/",
                  timeout: float = 10.0):
-        self.sock = socket.create_connection((host, port), timeout=timeout)
-        self.buf = b""
-        self.sock.sendall(b"AMQP\x00\x00\x09\x01")
+        self.io = SocketIO(
+            socket.create_connection((host, port), timeout=timeout))
+        self.io.send(b"AMQP\x00\x00\x09\x01")
         self._negotiate(user, password, vhost)
         self._channel_open()
 
     # --- framing -------------------------------------------------------------
 
-    def _read_exact(self, n: int) -> bytes:
-        while len(self.buf) < n:
-            chunk = self.sock.recv(65536)
-            if not chunk:
-                raise ConnectionError("connection closed")
-            self.buf += chunk
-        out, self.buf = self.buf[:n], self.buf[n:]
-        return out
-
     def _read_frame(self) -> tuple[int, int, bytes]:
-        t, ch, size = struct.unpack(">BHI", self._read_exact(7))
-        payload = self._read_exact(size)
-        if self._read_exact(1) != bytes([FRAME_END]):
+        t, ch, size = struct.unpack(">BHI", self.io.read_exact(7))
+        payload = self.io.read_exact(size)
+        if self.io.read_exact(1) != bytes([FRAME_END]):
             raise AmqpError("bad frame end")
         return t, ch, payload
 
     def _send_frame(self, t: int, ch: int, payload: bytes) -> None:
-        self.sock.sendall(struct.pack(">BHI", t, ch, len(payload))
-                          + payload + bytes([FRAME_END]))
+        self.io.send(struct.pack(">BHI", t, ch, len(payload))
+                     + payload + bytes([FRAME_END]))
 
     def _send_method(self, ch: int, class_id: int, method_id: int,
                      args: bytes) -> None:
@@ -160,9 +152,16 @@ class AmqpClient:
                 if (cid, mid) in ((10, 50), (20, 40)):
                     raise AmqpError(f"server closed ({cid}.{mid})")
 
-    def get(self, queue: str) -> bytes | None:
-        """Synchronous Basic.Get with auto-ack; None when empty."""
-        args = struct.pack(">H", 0) + _shortstr(queue) + b"\x01"  # no-ack
+    def get(self, queue: str, auto_ack: bool = True) \
+            -> tuple[int, bytes] | None:
+        """Synchronous Basic.Get in MANUAL-ack mode: the broker keeps the
+        message as an unacked delivery until we ack it, so a connection
+        death between delivery and ack redelivers instead of silently
+        destroying data (the reference consumes with manual acks for
+        exactly this). ``auto_ack=True`` acks once the body is fully
+        read; ``auto_ack=False`` leaves the delivery held (the
+        message-holding mutex). Returns (delivery_tag, body) or None."""
+        args = struct.pack(">H", 0) + _shortstr(queue) + b"\x00"
         self._send_method(1, 60, 70, args)
         while True:
             t, ch, payload = self._read_frame()
@@ -175,6 +174,7 @@ class AmqpClient:
             if (cid, mid) == (60, 72):                # Get-Empty
                 return None
             if (cid, mid) == (60, 71):                # Get-Ok
+                (tag,) = struct.unpack_from(">Q", payload, 4)
                 break
             if mid in (40, 50):
                 raise AmqpError(f"server closed ({cid}.{mid})")
@@ -188,31 +188,57 @@ class AmqpClient:
             if t != FRAME_BODY:
                 raise AmqpError("expected content body")
             body += part
-        return body
+        if auto_ack:
+            self.ack(tag)
+        return tag, body
+
+    def ack(self, delivery_tag: int) -> None:
+        self._send_method(1, 60, 80,                  # Basic.Ack
+                          struct.pack(">QB", delivery_tag, 0))
+
+    def reject(self, delivery_tag: int, requeue: bool = True) -> None:
+        self._send_method(1, 60, 90,                  # Basic.Reject
+                          struct.pack(">QB", delivery_tag,
+                                      1 if requeue else 0))
 
     def close(self) -> None:
         try:
             self._send_method(0, 10, 50,              # Connection.Close
                               struct.pack(">HHH", 200, 0, 0) + b"\x00")
-            self.sock.close()
+            self.io.close()
         except OSError:
             pass
 
 
 class QueueClient(client_ns.Client):
     """Enqueue/dequeue/drain over one AMQP queue (rabbitmq.clj:100-170):
-    publish persistent messages, consume with synchronous basic.get."""
+    confirmed persistent publishes, manually-acked synchronous gets."""
 
     QUEUE = "jepsen.queue"
+    DRAIN_RETRIES = 10
 
-    def __init__(self, conn: AmqpClient | None = None):
+    def __init__(self, conn: AmqpClient | None = None, node=None):
         self.conn = conn
+        self.node = node
 
-    def open(self, test, node):
+    def _connect(self, node):
         c = AmqpClient(node)
         c.queue_declare(self.QUEUE)
         c.confirm_select()
-        return QueueClient(c)
+        return c
+
+    def open(self, test, node):
+        return QueueClient(self._connect(node), node)
+
+    def setup(self, test) -> None:
+        # Purge leftovers from a previous run against the same durable
+        # queue, or run 2 would "unexpectedly" dequeue run 1's values.
+        conn = self._connect(test["nodes"][0])
+        try:
+            while conn.get(self.QUEUE) is not None:
+                pass
+        finally:
+            conn.close()
 
     def invoke(self, test, op):
         try:
@@ -220,24 +246,48 @@ class QueueClient(client_ns.Client):
                 self.conn.publish(self.QUEUE, str(op.value).encode())
                 return op.replace(type="ok")
             if op.f == "dequeue":
-                body = self.conn.get(self.QUEUE)
-                if body is None:
+                r = self.conn.get(self.QUEUE)
+                if r is None:
                     return op.replace(type="fail")
-                return op.replace(type="ok", value=int(body))
+                return op.replace(type="ok", value=int(r[1]))
             if op.f == "drain":
-                drained = []
-                while True:
-                    body = self.conn.get(self.QUEUE)
-                    if body is None:
-                        break
-                    drained.append(int(body))
-                return op.replace(type="ok", value=drained)
+                return self._drain(op)
         except (AmqpError, OSError, ConnectionError) as e:
-            # All indeterminate: an unconfirmed publish may still land,
-            # and a no-ack get may have consumed a message the broker
-            # already removed — neither may claim "no effect".
+            # Indeterminate: an unconfirmed publish may still land, and
+            # an unacked delivery is redelivered rather than lost.
             return op.replace(type="info", error=repr(e))
         return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def _drain(self, op):
+        """Drain with reconnect-retry: the total-queue checker cannot
+        interpret a crashed drain (checker.clj raises on it), so every
+        failure — get OR reconnect — consumes retry budget, the dead
+        connection is closed first (the broker then requeues its unacked
+        delivery instead of hiding it from the new connection), and only
+        exhaustion propagates."""
+        import time
+
+        drained = []
+        attempts = 0
+        while True:
+            try:
+                r = self.conn.get(self.QUEUE)
+                if r is None:
+                    return op.replace(type="ok", value=drained)
+                drained.append(int(r[1]))
+            except (AmqpError, OSError, ConnectionError):
+                attempts += 1
+                if attempts > self.DRAIN_RETRIES:
+                    raise
+                try:
+                    self.conn.close()
+                except Exception:
+                    pass
+                time.sleep(0.5)
+                try:
+                    self.conn = self._connect(self.node)
+                except (AmqpError, OSError, ConnectionError):
+                    continue        # reconnect failure burns budget too
 
     def close(self, test) -> None:
         if self.conn is not None:
@@ -246,14 +296,16 @@ class QueueClient(client_ns.Client):
 
 class MutexClient(client_ns.Client):
     """The message-holding semaphore mutex (rabbitmq.clj:263): one token
-    message circulates; acquire = consume it (hold), release = publish
-    it back. A successful get IS the lock acquisition."""
+    message circulates; acquire = get the token WITHOUT acking (the held
+    unacked delivery IS the lock), release = basic.reject with requeue.
+    A holder's death closes its channel and the broker requeues the
+    token automatically — a crashed holder cannot destroy the lock."""
 
     QUEUE = "jepsen.mutex"
 
     def __init__(self, conn: AmqpClient | None = None):
         self.conn = conn
-        self.holding = False
+        self.held_tag: int | None = None
 
     def open(self, test, node):
         c = AmqpClient(node)
@@ -267,6 +319,7 @@ class MutexClient(client_ns.Client):
             conn.queue_declare(self.QUEUE)
             while conn.get(self.QUEUE) is not None:
                 pass                     # drain stale tokens from reruns
+            conn.confirm_select()
             conn.publish(self.QUEUE, b"token")
         finally:
             conn.close()
@@ -274,18 +327,18 @@ class MutexClient(client_ns.Client):
     def invoke(self, test, op):
         try:
             if op.f == "acquire":
-                if self.holding:
+                if self.held_tag is not None:
                     return op.replace(type="fail", error="already held")
-                body = self.conn.get(self.QUEUE)
-                if body is None:
+                r = self.conn.get(self.QUEUE, auto_ack=False)
+                if r is None:
                     return op.replace(type="fail")
-                self.holding = True
+                self.held_tag = r[0]
                 return op.replace(type="ok")
             if op.f == "release":
-                if not self.holding:
+                if self.held_tag is None:
                     return op.replace(type="fail", error="not held")
-                self.conn.publish(self.QUEUE, b"token")
-                self.holding = False
+                self.conn.reject(self.held_tag, requeue=True)
+                self.held_tag = None
                 return op.replace(type="ok")
         except (AmqpError, OSError, ConnectionError) as e:
             return op.replace(type="info", error=repr(e))

@@ -130,6 +130,30 @@ def http_json(method: str, url: str, body=None, timeout: float = 5.0,
         return status, raw
 
 
+class SocketIO:
+    """Buffered exact-read over a stream socket — the framing loop every
+    wire client needs (one shared copy instead of one per protocol)."""
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.buf = b""
+
+    def read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("connection closed")
+            self.buf += chunk
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+    def send(self, data: bytes) -> None:
+        self.sock.sendall(data)
+
+    def close(self) -> None:
+        self.sock.close()
+
+
 class GatedClient(client_ns.Client):
     """Client for a wire protocol whose driver isn't vendored: fails
     loudly at open() with the reason, rather than silently faking."""

@@ -21,6 +21,7 @@ import struct
 import threading
 
 from jepsen_tpu import client as client_ns
+from jepsen_tpu.suites.common import SocketIO
 
 OP_QUERY = 2004
 OP_REPLY = 1
@@ -131,8 +132,8 @@ def bson_decode(b: bytes) -> dict:
 class MongoClient:
     def __init__(self, host: str, port: int = 27017,
                  timeout: float = 10.0, follow_primary: bool = True):
-        self.sock = socket.create_connection((host, port), timeout=timeout)
-        self.buf = b""
+        self.io = SocketIO(
+            socket.create_connection((host, port), timeout=timeout))
         self.req_id = 0
         self.lock = threading.Lock()
         hello = self._command_query("admin", {"ismaster": 1})
@@ -144,33 +145,23 @@ class MongoClient:
         if follow_primary and primary and not hello.get("ismaster", True):
             phost, _, pport = primary.partition(":")
             if (phost, int(pport or port)) != (host, port):
-                self.sock.close()
-                self.sock = socket.create_connection(
-                    (phost, int(pport or port)), timeout=timeout)
-                self.buf = b""
+                self.io.close()
+                self.io = SocketIO(socket.create_connection(
+                    (phost, int(pport or port)), timeout=timeout))
                 hello = self._command_query("admin", {"ismaster": 1})
                 self.use_msg = hello.get("maxWireVersion", 0) >= 6
-
-    def _read_exact(self, n: int) -> bytes:
-        while len(self.buf) < n:
-            chunk = self.sock.recv(65536)
-            if not chunk:
-                raise ConnectionError("connection closed")
-            self.buf += chunk
-        out, self.buf = self.buf[:n], self.buf[n:]
-        return out
 
     def _send(self, opcode: int, payload: bytes) -> int:
         self.req_id += 1
         head = struct.pack("<iiii", len(payload) + 16, self.req_id, 0,
                            opcode)
-        self.sock.sendall(head + payload)
+        self.io.send(head + payload)
         return self.req_id
 
     def _recv(self) -> tuple[int, bytes]:
-        head = self._read_exact(16)
+        head = self.io.read_exact(16)
         length, _, _, opcode = struct.unpack("<iiii", head)
-        return opcode, self._read_exact(length - 16)
+        return opcode, self.io.read_exact(length - 16)
 
     def _command_query(self, db: str, cmd: dict) -> dict:
         """Command via OP_QUERY on <db>.$cmd (wire versions < 6)."""
@@ -262,7 +253,7 @@ class MongoClient:
 
     def close(self) -> None:
         try:
-            self.sock.close()
+            self.io.close()
         except OSError:
             pass
 

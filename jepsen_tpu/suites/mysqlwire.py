@@ -21,6 +21,8 @@ import hashlib
 import socket
 import struct
 
+from jepsen_tpu.suites.common import SocketIO
+
 CLIENT_LONG_PASSWORD = 0x00000001
 CLIENT_FOUND_ROWS = 0x00000002
 CLIENT_CONNECT_WITH_DB = 0x00000008
@@ -62,35 +64,26 @@ class MyClient:
     def __init__(self, host: str, port: int = 3306, user: str = "root",
                  password: str = "", database: str = "",
                  timeout: float = 10.0):
-        self.sock = socket.create_connection((host, port), timeout=timeout)
-        self.buf = b""
+        self.io = SocketIO(
+            socket.create_connection((host, port), timeout=timeout))
         self.seq = 0
         self.last_affected = 0   # affected_rows of the most recent OK
         self._handshake(user, password, database)
 
     # --- framing -------------------------------------------------------------
 
-    def _read_exact(self, n: int) -> bytes:
-        while len(self.buf) < n:
-            chunk = self.sock.recv(65536)
-            if not chunk:
-                raise ConnectionError("connection closed")
-            self.buf += chunk
-        out, self.buf = self.buf[:n], self.buf[n:]
-        return out
-
     def _read_packet(self) -> bytes:
-        head = self._read_exact(4)
+        head = self.io.read_exact(4)
         n = head[0] | (head[1] << 8) | (head[2] << 16)
         self.seq = (head[3] + 1) & 0xFF
-        return self._read_exact(n)
+        return self.io.read_exact(n)
 
     def _send_packet(self, payload: bytes) -> None:
         if len(payload) >= 0xFFFFFF:
             raise MyError(0, "HY000", "packet too large")
         head = struct.pack("<I", len(payload))[:3] + bytes([self.seq])
         self.seq = (self.seq + 1) & 0xFF
-        self.sock.sendall(head + payload)
+        self.io.send(head + payload)
 
     # --- length-encoded primitives ------------------------------------------
 
@@ -260,6 +253,6 @@ class MyClient:
         try:
             self.seq = 0
             self._send_packet(b"\x01")              # COM_QUIT
-            self.sock.close()
+            self.io.close()
         except OSError:
             pass

@@ -18,6 +18,8 @@ import hashlib
 import socket
 import struct
 
+from jepsen_tpu.suites.common import SocketIO
+
 
 class PgError(Exception):
     """ErrorResponse from the server; carries the severity/code/message
@@ -42,8 +44,8 @@ class PgClient:
     def __init__(self, host: str, port: int = 5432, user: str = "root",
                  database: str = "postgres", password: str = "",
                  timeout: float = 10.0):
-        self.sock = socket.create_connection((host, port), timeout=timeout)
-        self.buf = b""
+        self.io = SocketIO(
+            socket.create_connection((host, port), timeout=timeout))
         self.user = user
         self.password = password
         self._startup(user, database)
@@ -52,22 +54,13 @@ class PgClient:
 
     def _send(self, type_byte: bytes, payload: bytes) -> None:
         msg = type_byte + struct.pack("!I", len(payload) + 4) + payload
-        self.sock.sendall(msg)
-
-    def _read_exact(self, n: int) -> bytes:
-        while len(self.buf) < n:
-            chunk = self.sock.recv(65536)
-            if not chunk:
-                raise ConnectionError("connection closed")
-            self.buf += chunk
-        out, self.buf = self.buf[:n], self.buf[n:]
-        return out
+        self.io.send(msg)
 
     def _read_msg(self) -> tuple[bytes, bytes]:
-        head = self._read_exact(5)
+        head = self.io.read_exact(5)
         t = head[:1]
         (n,) = struct.unpack("!I", head[1:])
-        return t, self._read_exact(n - 4)
+        return t, self.io.read_exact(n - 4)
 
     @staticmethod
     def _cstr(b: bytes) -> str:
@@ -87,7 +80,7 @@ class PgClient:
         params = (f"user\x00{user}\x00database\x00{database}\x00\x00"
                   .encode())
         payload = struct.pack("!I", 196608) + params  # protocol 3.0
-        self.sock.sendall(struct.pack("!I", len(payload) + 4) + payload)
+        self.io.send(struct.pack("!I", len(payload) + 4) + payload)
         while True:
             t, body = self._read_msg()
             if t == b"R":
@@ -168,6 +161,6 @@ class PgClient:
     def close(self) -> None:
         try:
             self._send(b"X", b"")
-            self.sock.close()
+            self.io.close()
         except OSError:
             pass

@@ -67,14 +67,15 @@ def test(opts: dict | None = None) -> dict:
     threads_per_key = 5
     if opts.get("concurrency", 0) < threads_per_key:
         opts["concurrency"] = threads_per_key
+    from jepsen_tpu.suites import rethinkwire
+
     nemesis = nemesis_ns.partition_random_halves() \
         if nem == "partition" else primaries_grudge()
     return common.suite_test(
         "rethinkdb", opts,
         workload=workloads.register(threads_per_key=threads_per_key),
         db=RethinkDB(),
-        client=common.GatedClient(
-            "the ReQL wire protocol needs a driver; run with --fake"),
+        client=rethinkwire.RegisterClient(),
         nemesis=nemesis,
         nemesis_gen=common.standard_nemesis_gen(5, 5))
 

@@ -1,0 +1,207 @@
+"""Minimal RethinkDB client: V0_4 handshake + the JSON query protocol.
+
+The reference drives RethinkDB through the official Clojure driver
+(rethinkdb/src/jepsen/rethinkdb.clj, document_cas.clj); the TPU build
+speaks the wire protocol from the stdlib. The V0_4 handshake is three
+little-endian magics (version, auth-key length+bytes, JSON protocol),
+answered by a NUL-terminated "SUCCESS". Queries are
+``token:u64 length:u32 json`` frames whose payload is
+``[QueryType, term, optargs]`` with ReQL terms as nested
+``[TermType, args, optargs]`` arrays — only the handful of terms the
+per-key register workload needs are assembled here, including the
+branch-in-replace that makes CAS a single atomic server-side operation
+(document_cas.clj's compare-and-set).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu.suites.common import SocketIO
+
+V0_4 = 0x400C2D20
+PROTOCOL_JSON = 0x7E6970C7
+
+START = 1
+
+# ReQL term ids (ql2.proto)
+T_DATUM_JSON = 157      # unused; plain JSON literals serve as datums
+T_DB = 14
+T_TABLE = 15
+T_GET = 16
+T_EQ = 17
+T_GET_FIELD = 31
+T_VAR = 10
+T_FUNC = 69
+T_MAKE_ARRAY = 2
+T_BRANCH = 65
+T_INSERT = 56
+T_REPLACE = 55
+T_DB_CREATE = 57
+T_TABLE_CREATE = 60
+T_DB_LIST = 59
+T_TABLE_LIST = 62
+
+SUCCESS_ATOM = 1
+SUCCESS_SEQUENCE = 2
+
+
+class RethinkError(Exception):
+    def __init__(self, rtype, msg):
+        self.rtype = rtype
+        super().__init__(f"rethinkdb error {rtype}: {msg}")
+
+
+class RethinkClient:
+    def __init__(self, host: str, port: int = 28015, auth_key: str = "",
+                 timeout: float = 10.0):
+        self.io = SocketIO(
+            socket.create_connection((host, port), timeout=timeout))
+        self.token = 0
+        key = auth_key.encode()
+        self.io.send(struct.pack("<I", V0_4)
+                          + struct.pack("<I", len(key)) + key
+                          + struct.pack("<I", PROTOCOL_JSON))
+        greeting = b""
+        while not greeting.endswith(b"\x00"):
+            greeting += self.io.read_exact(1)
+        if greeting.rstrip(b"\x00") != b"SUCCESS":
+            raise RethinkError(0, greeting.rstrip(b"\x00").decode(
+                errors="replace"))
+
+    def run(self, term, db: str = "test"):
+        """START a query term; returns the decoded result (atom or
+        sequence). Raises RethinkError on client/compile/runtime errors.
+        """
+        self.token += 1
+        q = json.dumps([START, term, {"db": [T_DB, [db]]}]).encode()
+        self.io.send(struct.pack("<Q", self.token)
+                     + struct.pack("<I", len(q)) + q)
+        token, n = struct.unpack("<QI", self.io.read_exact(12))
+        resp = json.loads(self.io.read_exact(n))
+        t = resp.get("t")
+        if t == SUCCESS_ATOM:
+            return resp["r"][0]
+        if t == SUCCESS_SEQUENCE:
+            return resp["r"]
+        raise RethinkError(t, resp.get("r"))
+
+    def close(self) -> None:
+        try:
+            self.io.close()
+        except OSError:
+            pass
+
+
+# --- term builders ---------------------------------------------------------
+
+
+def table(name: str):
+    return [T_TABLE, [name]]
+
+
+def get(tbl, key):
+    return [T_GET, [tbl, key]]
+
+
+def insert(tbl, doc, conflict: str = "error"):
+    return [T_INSERT, [tbl, {k: v for k, v in doc.items()}],
+            {"conflict": conflict}]
+
+
+def cas_replace(tbl, key, field: str, old, new_doc):
+    """REPLACE with a branch function: if row[field] == old, write
+    new_doc, else keep the row — one atomic server-side CAS whose
+    outcome is read from the reply's replaced/unchanged counts."""
+    row = [T_VAR, [1]]
+    cond = [T_EQ, [[T_GET_FIELD, [row, field]], old]]
+    fn = [T_FUNC, [[T_MAKE_ARRAY, [1]],
+                   [T_BRANCH, [cond, new_doc, row]]]]
+    return [T_REPLACE, [get(tbl, key), fn]]
+
+
+# --- the register workload client ------------------------------------------
+
+DB_NAME = "jepsen"
+TABLE_NAME = "registers"
+
+
+class RegisterClient(client_ns.Client):
+    """Per-key linearizable register over one document per key
+    (rethinkdb/document_cas.clj): read = get, write = insert with
+    conflict replace (majority-acked by default write concern), cas =
+    the branch-in-replace judged by the replaced count."""
+
+    def __init__(self, conn: RethinkClient | None = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return RegisterClient(RethinkClient(node))
+
+    def setup(self, test) -> None:
+        conn = RethinkClient(test["nodes"][0])
+        try:
+            if DB_NAME not in conn.run([T_DB_LIST, []]):
+                conn.run([T_DB_CREATE, [DB_NAME]])
+            if TABLE_NAME not in conn.run([T_TABLE_LIST, []], db=DB_NAME):
+                conn.run([T_TABLE_CREATE, [TABLE_NAME]], db=DB_NAME)
+        except RethinkError:
+            pass    # racing setup from another worker: already exists
+        finally:
+            conn.close()
+
+    def invoke(self, test, op):
+        from jepsen_tpu import independent
+
+        k, v = op.value if independent.is_tuple(op.value) \
+            else (0, op.value)
+
+        def join(val):
+            return independent.tuple_(k, val) \
+                if independent.is_tuple(op.value) else val
+
+        tbl = table(TABLE_NAME)
+        try:
+            if op.f == "read":
+                doc = self.conn.run(get(tbl, int(k)), db=DB_NAME)
+                return op.replace(
+                    type="ok",
+                    value=join(None if doc is None else doc.get("value")))
+            if op.f == "write":
+                r = self.conn.run(
+                    insert(tbl, {"id": int(k), "value": int(v)},
+                           conflict="replace"), db=DB_NAME)
+                if isinstance(r, dict) and r.get("errors", 0):
+                    # RethinkDB embeds write failures in the SUCCESS
+                    # summary (e.g. lost contact with the primary) — the
+                    # write may or may not have applied: indeterminate.
+                    return op.replace(type="info",
+                                      error=str(r.get("first_error")))
+                return op.replace(type="ok")
+            if op.f == "cas":
+                old, new = v
+                r = self.conn.run(
+                    cas_replace(tbl, int(k), "value", int(old),
+                                {"id": int(k), "value": int(new)}),
+                    db=DB_NAME)
+                if not isinstance(r, dict) or r.get("errors", 0):
+                    return op.replace(
+                        type="info",
+                        error=str(r.get("first_error")
+                                  if isinstance(r, dict) else r))
+                return op.replace(
+                    type="ok" if r.get("replaced", 0) == 1 else "fail")
+        except RethinkError as e:
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=str(e))
+        except (OSError, ConnectionError) as e:
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()

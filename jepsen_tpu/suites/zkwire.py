@@ -16,9 +16,11 @@ version at offset 32.
 
 from __future__ import annotations
 
-from jepsen_tpu import client as client_ns
 import socket
 import struct
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu.suites.common import SocketIO
 
 # Op codes (zookeeper.h)
 OP_CREATE = 1
@@ -60,28 +62,19 @@ def _s(b: bytes) -> bytes:
 class ZkClient:
     def __init__(self, host: str, port: int = 2181,
                  timeout: float = 10.0, session_timeout_ms: int = 10000):
-        self.sock = socket.create_connection((host, port), timeout=timeout)
-        self.buf = b""
+        self.io = SocketIO(
+            socket.create_connection((host, port), timeout=timeout))
         self.xid = 0
         self._connect(session_timeout_ms)
 
     # --- framing -------------------------------------------------------------
 
-    def _read_exact(self, n: int) -> bytes:
-        while len(self.buf) < n:
-            chunk = self.sock.recv(65536)
-            if not chunk:
-                raise ConnectionError("connection closed")
-            self.buf += chunk
-        out, self.buf = self.buf[:n], self.buf[n:]
-        return out
-
     def _read_frame(self) -> bytes:
-        (n,) = struct.unpack(">i", self._read_exact(4))
-        return self._read_exact(n)
+        (n,) = struct.unpack(">i", self.io.read_exact(4))
+        return self.io.read_exact(n)
 
     def _send_frame(self, payload: bytes) -> None:
-        self.sock.sendall(struct.pack(">i", len(payload)) + payload)
+        self.io.send(struct.pack(">i", len(payload)) + payload)
 
     # --- session -------------------------------------------------------------
 
@@ -151,7 +144,7 @@ class ZkClient:
         try:
             self.xid += 1
             self._send_frame(struct.pack(">ii", self.xid, OP_CLOSE))
-            self.sock.close()
+            self.io.close()
         except OSError:
             pass
 

@@ -65,6 +65,8 @@ class FakeBroker:
         def send_method(ch, cid, mid, args=b""):
             send_frame(1, ch, struct.pack(">HH", cid, mid) + args)
 
+        unacked: dict[int, tuple[str, bytes]] = {}
+        next_tag = [0]
         try:
             assert read_exact(8) == b"AMQP\x00\x00\x09\x01"
             send_method(0, 10, 10,                      # Start
@@ -109,18 +111,32 @@ class FakeBroker:
                         send_method(1, 60, 72, _shortstr(""))
                     else:
                         body = q.popleft()
+                        next_tag[0] += 1
+                        unacked[next_tag[0]] = (qn, body)
                         send_method(1, 60, 71,
-                                    struct.pack(">QB", 1, 0)
+                                    struct.pack(">QB", next_tag[0], 0)
                                     + _shortstr("") + _shortstr(qn)
                                     + struct.pack(">I", len(q)))
                         send_frame(2, 1, struct.pack(
                             ">HHQH", 60, 0, len(body), 0))
                         send_frame(3, 1, body)
+                elif (cid, mid) == (60, 80):            # client Basic.Ack
+                    (tag,) = struct.unpack_from(">Q", payload, 4)
+                    unacked.pop(tag, None)
+                elif (cid, mid) == (60, 90):            # Basic.Reject
+                    (tag,) = struct.unpack_from(">Q", payload, 4)
+                    requeue = payload[12]
+                    qn, body = unacked.pop(tag)
+                    if requeue:
+                        self.queues[qn].append(body)
                 elif (cid, mid) == (10, 50):            # Connection.Close
                     return
         except (ConnectionError, OSError, AssertionError):
             return
         finally:
+            # a dead connection's unacked deliveries are redelivered
+            for qn, body in unacked.values():
+                self.queues.setdefault(qn, deque()).append(body)
             conn.close()
 
     def close(self):
@@ -139,8 +155,8 @@ def test_negotiate_publish_get_roundtrip():
     assert c.get("q1") is None
     c.publish("q1", b"41")
     c.publish("q1", b"42")
-    assert c.get("q1") == b"41"
-    assert c.get("q1") == b"42"
+    assert c.get("q1")[1] == b"41"
+    assert c.get("q1")[1] == b"42"
     assert c.get("q1") is None
     c.close()
     srv.close()
@@ -199,3 +215,32 @@ def test_rabbitmq_suite_ungated():
     for opts in ({}, {"workload": "mutex"}):
         t = rabbitmq.test(dict(opts))
         assert not isinstance(t["client"], common.GatedClient)
+
+
+def test_crashed_holder_redelivers_token():
+    # The held token is an unacked delivery: the holder's death must
+    # return it to the queue (the property the reference's design needs).
+    import time
+
+    srv = FakeBroker()
+    a = AmqpClient("127.0.0.1", srv.port)
+    a.queue_declare(MutexClient.QUEUE)
+    a.confirm_select()
+    a.publish(MutexClient.QUEUE, b"token")
+    ma = MutexClient(a)
+    assert ma.invoke(None, Op("invoke", "acquire", None, 0)).is_ok
+    a.io.sock.close()                    # holder dies without releasing
+
+    b = AmqpClient("127.0.0.1", srv.port)
+    b.queue_declare(MutexClient.QUEUE)
+    b.confirm_select()
+    mb = MutexClient(b)
+    deadline = time.time() + 5
+    while True:
+        r = mb.invoke(None, Op("invoke", "acquire", None, 1))
+        if r.is_ok or time.time() > deadline:
+            break
+        time.sleep(0.01)
+    assert r.is_ok
+    b.close()
+    srv.close()
