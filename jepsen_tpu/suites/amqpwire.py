@@ -275,10 +275,18 @@ class QueueClient(client_ns.Client):
                 if r is None:
                     return op.replace(type="ok", value=drained)
                 drained.append(int(r[1]))
-            except (AmqpError, OSError, ConnectionError):
+            except (AmqpError, OSError, ConnectionError) as e:
                 attempts += 1
                 if attempts > self.DRAIN_RETRIES:
-                    raise
+                    # The values drained so far are ACKED — permanently
+                    # consumed — so they must be reported as dequeued or
+                    # the checker counts them lost. Completing ok with
+                    # the partial list (plus an error note for the
+                    # reader) is the only shape the total-queue checker
+                    # can digest; messages genuinely still enqueued will
+                    # show as lost, which is the honest upper bound.
+                    return op.replace(type="ok", value=drained,
+                                      error=f"partial drain: {e!r}")
                 try:
                     self.conn.close()
                 except Exception:

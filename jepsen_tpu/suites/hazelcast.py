@@ -10,7 +10,8 @@ cycle (hazelcast.clj:403-427). ``--workload`` selects, exactly like the
 reference's opt-spec (hazelcast.clj:433-439).
 
 Hazelcast only speaks its Java client protocol, so wire clients are
-gated; every workload runs no-cluster against its fake.
+spoken natively over the Open Client Protocol
+(jepsen_tpu.suites.hazelwire).
 """
 
 from __future__ import annotations
@@ -56,18 +57,25 @@ class HazelcastDB(common.TarballDB):
 def test(opts: dict | None = None) -> dict:
     """The hazelcast test map (hazelcast.clj:400-433)."""
     opts = dict(opts or {})
+    from jepsen_tpu.suites import hazelwire
+
     name = opts.pop("workload", None) or "lock"
     table = hazelcast_workloads()
     if name not in table:
         raise ValueError(
             f"unknown workload {name!r}; one of {sorted(table)}")
+    clients = {"lock": hazelwire.LockClient,
+               "map": hazelwire.SetClient,
+               "crdt-map": hazelwire.SetClient,
+               "queue": hazelwire.QueueClient,
+               "atomic-ref-ids": hazelwire.IdClient,
+               "atomic-long-ids": hazelwire.IdClient,
+               "id-gen-ids": hazelwire.IdClient}
     return common.suite_test(
         f"hazelcast {name}", opts,
         workload=table[name],
         db=HazelcastDB(),
-        client=common.GatedClient(
-            "hazelcast speaks its Java client protocol only; "
-            "run with --fake"),
+        client=clients[name](),   # KeyError = workload missing a client
         nemesis=nemesis_ns.partition_majorities_ring(),
         nemesis_gen=common.standard_nemesis_gen(30, 15))
 

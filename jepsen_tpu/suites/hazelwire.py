@@ -1,0 +1,355 @@
+"""Minimal Hazelcast Open Client Protocol (1.x) client.
+
+The reference drives Hazelcast through its Java client
+(hazelcast/src/jepsen/hazelcast.clj:364-399, plus a bundled server
+uberjar); the TPU build speaks the 3.x-era Open Client Protocol from
+the stdlib: the ``CB2`` protocol preamble, the 22-byte little-endian
+client-message header (frameLength, version, flags, messageType,
+correlationId, partitionId, dataOffset), string/nullable-string
+parameter encoding, and the handful of codecs the suite's workloads
+need — authentication, lock lock/tryLock/unlock, map put/get/values,
+queue offer/poll, and atomic-long incrementAndGet.
+
+Codec message-type ids follow the published protocol definitions for
+Hazelcast 3.x (hazelcast-client-protocol, protocol version 1.x);
+they're listed next to each method so a mismatch against a specific
+server build is one constant away from fixed. Payload values travel as
+Hazelcast serialization-format integers/strings (the suite only needs
+ints and strings).
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import struct
+import threading
+
+from jepsen_tpu import client as client_ns
+from jepsen_tpu.suites.common import SocketIO
+
+VERSION = 1
+FLAGS_BEGIN_END = 0xC0
+HEADER = 22                      # bytes up to and including dataOffset
+
+# message types (hazelcast-client-protocol 1.x definitions)
+AUTH = 0x0002
+AUTH_RESPONSE = 0x0107
+
+LOCK_LOCK = 0x0705
+LOCK_UNLOCK = 0x0706
+LOCK_TRYLOCK = 0x0708
+
+MAP_PUT = 0x0101
+MAP_GET = 0x0102
+MAP_VALUES = 0x012A
+
+QUEUE_OFFER = 0x0301
+QUEUE_POLL = 0x0304
+
+ATOMIC_LONG_INC_GET = 0x0A05
+
+BOOL_RESPONSE = 0x0065
+LONG_RESPONSE = 0x0067
+DATA_RESPONSE = 0x0069
+LIST_DATA_RESPONSE = 0x006A
+ERROR_RESPONSE = 0x006D
+
+# Hazelcast serialization type ids (big-endian payload after a 4-byte
+# partition hash): int = -7, long = -8, string = -11.
+SER_STRING = -11
+SER_LONG = -8
+
+
+class HazelcastError(Exception):
+    pass
+
+
+def _s(v: str) -> bytes:
+    b = v.encode()
+    return struct.pack("<i", len(b)) + b
+
+
+def _nullable(v: str | None) -> bytes:
+    if v is None:
+        return b"\x01"
+    return b"\x00" + _s(v)
+
+
+def _data_long(v: int) -> bytes:
+    """Hazelcast Data blob for a long: partition-hash(4) + type id (BE)
+    + 8-byte BE value, wrapped in the <i length prefix."""
+    blob = struct.pack(">iiq", 0, SER_LONG, v)
+    return struct.pack("<i", len(blob)) + blob
+
+
+def _parse_data_long(blob: bytes) -> int | None:
+    if len(blob) < 8:
+        return None
+    tid = struct.unpack_from(">i", blob, 4)[0]
+    if tid == SER_LONG:
+        return struct.unpack_from(">q", blob, 8)[0]
+    return None
+
+
+class HazelcastClient:
+    def __init__(self, host: str, port: int = 5701,
+                 timeout: float = 10.0, group: str = "dev",
+                 password: str = "dev-pass"):
+        self.io = SocketIO(
+            socket.create_connection((host, port), timeout=timeout))
+        self.corr = itertools.count(1)
+        self.lock = threading.Lock()
+        self.thread_id = threading.get_ident() & 0x7FFFFFFF
+        self.io.send(b"CB2")
+        self._authenticate(group, password)
+
+    # --- framing -------------------------------------------------------------
+
+    def _send(self, msg_type: int, payload: bytes,
+              partition: int = -1) -> int:
+        corr = next(self.corr)
+        frame = struct.pack("<iBBHqiH", HEADER + len(payload), VERSION,
+                            FLAGS_BEGIN_END, msg_type, corr, partition,
+                            HEADER) + payload
+        self.io.send(frame)
+        return corr
+
+    def _recv(self) -> tuple[int, int, bytes]:
+        head = self.io.read_exact(HEADER)
+        length, _ver, _flags, mtype, corr, _part, off = struct.unpack(
+            "<iBBHqiH", head)
+        body = self.io.read_exact(length - HEADER)
+        return mtype, corr, body[off - HEADER:]
+
+    def _call(self, msg_type: int, payload: bytes,
+              partition: int = -1) -> tuple[int, bytes]:
+        with self.lock:
+            corr = self._send(msg_type, payload, partition)
+            while True:
+                mtype, rcorr, body = self._recv()
+                if rcorr != corr:
+                    continue              # stale event/response
+                if mtype == ERROR_RESPONSE:
+                    raise HazelcastError(f"server error for 0x{msg_type:04x}")
+                return mtype, body
+
+    # --- authentication ------------------------------------------------------
+
+    def _authenticate(self, group: str, password: str) -> None:
+        payload = (_s(group) + _s(password) + _nullable(None)
+                   + _nullable(None) + b"\x01" + _s("PYH")
+                   + bytes([1]) + _s("3.12"))
+        mtype, body = self._call(AUTH, payload)
+        if mtype != AUTH_RESPONSE or (body and body[0] != 0):
+            raise HazelcastError(
+                f"authentication failed (type 0x{mtype:04x}, "
+                f"status {body[0] if body else '?'})")
+
+    # --- lock service (hazelcast.clj:379-386's ILock) ------------------------
+
+    def try_lock(self, name: str, lease_ms: int = -1,
+                 timeout_ms: int = 0) -> bool:
+        payload = (_s(name) + struct.pack("<q", self.thread_id)
+                   + struct.pack("<q", lease_ms)
+                   + struct.pack("<q", timeout_ms)
+                   + struct.pack("<q", 0))      # reference id (3.7+)
+        mtype, body = self._call(LOCK_TRYLOCK, payload)
+        return bool(body and body[0])
+
+    def unlock(self, name: str) -> None:
+        payload = (_s(name) + struct.pack("<q", self.thread_id)
+                   + struct.pack("<q", 0))
+        self._call(LOCK_UNLOCK, payload)
+
+    # --- map service (set semantics via keys) --------------------------------
+
+    def map_put(self, name: str, key: int, value: int) -> None:
+        payload = (_s(name) + _data_long(key) + _data_long(value)
+                   + struct.pack("<q", self.thread_id)
+                   + struct.pack("<q", -1))     # ttl
+        self._call(MAP_PUT, payload)
+
+    def map_get(self, name: str, key: int) -> int | None:
+        payload = (_s(name) + _data_long(key)
+                   + struct.pack("<q", self.thread_id))
+        mtype, body = self._call(MAP_GET, payload)
+        if not body or body[0] == 1:            # null data
+            return None
+        (n,) = struct.unpack_from("<i", body, 1)
+        return _parse_data_long(body[5:5 + n])
+
+    def map_values(self, name: str) -> list[int]:
+        mtype, body = self._call(MAP_VALUES, _s(name))
+        (count,) = struct.unpack_from("<i", body, 0)
+        out = []
+        off = 4
+        for _ in range(count):
+            (n,) = struct.unpack_from("<i", body, off)
+            v = _parse_data_long(body[off + 4:off + 4 + n])
+            if v is not None:
+                out.append(v)
+            off += 4 + n
+        return out
+
+    # --- queue service --------------------------------------------------------
+
+    def queue_offer(self, name: str, value: int,
+                    timeout_ms: int = 0) -> bool:
+        payload = (_s(name) + _data_long(value)
+                   + struct.pack("<q", timeout_ms))
+        mtype, body = self._call(QUEUE_OFFER, payload)
+        return bool(body and body[0])
+
+    def queue_poll(self, name: str, timeout_ms: int = 0) -> int | None:
+        payload = _s(name) + struct.pack("<q", timeout_ms)
+        mtype, body = self._call(QUEUE_POLL, payload)
+        if not body or body[0] == 1:
+            return None
+        (n,) = struct.unpack_from("<i", body, 1)
+        return _parse_data_long(body[5:5 + n])
+
+    # --- atomic long (unique ids) --------------------------------------------
+
+    def atomic_increment(self, name: str) -> int:
+        mtype, body = self._call(ATOMIC_LONG_INC_GET, _s(name))
+        (v,) = struct.unpack_from("<q", body, 0)
+        return v
+
+    def close(self) -> None:
+        try:
+            self.io.close()
+        except OSError:
+            pass
+
+
+# --- workload clients --------------------------------------------------------
+
+
+class LockClient(client_ns.Client):
+    """The ILock mutex (hazelcast.clj:379-386): acquire = tryLock with
+    no wait, release = unlock. Checked against the Mutex model on the
+    device mutex kernel."""
+
+    NAME = "jepsen-lock"
+
+    def __init__(self, conn: HazelcastClient | None = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return LockClient(HazelcastClient(node))
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "acquire":
+                ok = self.conn.try_lock(self.NAME)
+                return op.replace(type="ok" if ok else "fail")
+            if op.f == "release":
+                try:
+                    self.conn.unlock(self.NAME)
+                    return op.replace(type="ok")
+                except HazelcastError:
+                    return op.replace(type="fail", error="not held")
+        except HazelcastError as e:
+            # A server-side rejection is definite: the op did not happen.
+            return op.replace(type="fail", error=str(e))
+        except (OSError, ConnectionError) as e:
+            return op.replace(type="info", error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()
+
+
+class SetClient(client_ns.Client):
+    """Set semantics over an IMap's keys (hazelcast.clj's map/crdt-map
+    workloads): add = put(v, v), read = values()."""
+
+    NAME = "jepsen-map"
+
+    def __init__(self, conn: HazelcastClient | None = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return SetClient(HazelcastClient(node))
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "add":
+                self.conn.map_put(self.NAME, int(op.value), int(op.value))
+                return op.replace(type="ok")
+            if op.f == "read":
+                return op.replace(
+                    type="ok", value=sorted(self.conn.map_values(self.NAME)))
+        except (HazelcastError, OSError, ConnectionError) as e:
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()
+
+
+class QueueClient(client_ns.Client):
+    """IQueue enqueue/dequeue/drain (hazelcast.clj:387-388)."""
+
+    NAME = "jepsen-queue"
+
+    def __init__(self, conn: HazelcastClient | None = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return QueueClient(HazelcastClient(node))
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "enqueue":
+                ok = self.conn.queue_offer(self.NAME, int(op.value))
+                return op.replace(type="ok" if ok else "fail")
+            if op.f == "dequeue":
+                v = self.conn.queue_poll(self.NAME)
+                if v is None:
+                    return op.replace(type="fail")
+                return op.replace(type="ok", value=v)
+            if op.f == "drain":
+                drained = []
+                while True:
+                    v = self.conn.queue_poll(self.NAME)
+                    if v is None:
+                        return op.replace(type="ok", value=drained)
+                    drained.append(v)
+        except (HazelcastError, OSError, ConnectionError) as e:
+            return op.replace(type="info", error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()
+
+
+class IdClient(client_ns.Client):
+    """Unique ids from an IAtomicLong (hazelcast.clj:389-399)."""
+
+    NAME = "jepsen-ids"
+
+    def __init__(self, conn: HazelcastClient | None = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return IdClient(HazelcastClient(node))
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "generate":
+                return op.replace(type="ok",
+                                  value=self.conn.atomic_increment(
+                                      self.NAME))
+        except (HazelcastError, OSError, ConnectionError) as e:
+            return op.replace(type="info", error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()

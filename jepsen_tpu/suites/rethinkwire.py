@@ -38,6 +38,7 @@ T_VAR = 10
 T_FUNC = 69
 T_MAKE_ARRAY = 2
 T_BRANCH = 65
+T_DEFAULT = 92
 T_INSERT = 56
 T_REPLACE = 55
 T_DB_CREATE = 57
@@ -115,9 +116,13 @@ def insert(tbl, doc, conflict: str = "error"):
 def cas_replace(tbl, key, field: str, old, new_doc):
     """REPLACE with a branch function: if row[field] == old, write
     new_doc, else keep the row — one atomic server-side CAS whose
-    outcome is read from the reply's replaced/unchanged counts."""
+    outcome is read from the reply's replaced/unchanged counts. The
+    field access is wrapped in r.default(None) so a cas against a
+    not-yet-written key evaluates to a clean no-match (replaced: 0)
+    instead of a runtime error on null."""
     row = [T_VAR, [1]]
-    cond = [T_EQ, [[T_GET_FIELD, [row, field]], old]]
+    cond = [T_EQ, [[T_DEFAULT, [[T_GET_FIELD, [row, field]], None]],
+                   old]]
     fn = [T_FUNC, [[T_MAKE_ARRAY, [1]],
                    [T_BRANCH, [cond, new_doc, row]]]]
     return [T_REPLACE, [get(tbl, key), fn]]

@@ -204,10 +204,10 @@ def test_mysql_family_suites_ungated():
         assert not isinstance(t["client"], common.GatedClient)
 
 
-def test_gated_suite_count_below_four():
+def test_no_gated_wire_clients():
     # Round-1 had 12 gated wire clients; the VERDICT target was <= 8.
-    # The mysql/zk/irc/mongo/amqp wire clients brought it to 3
-    # (aerospike, hazelcast, rethinkdb remain).
+    # Native mysql/zk/irc/mongo/amqp/rethink/aerospike/hazelcast wire
+    # clients brought it to zero.
     import importlib
     import pkgutil
 
@@ -225,4 +225,4 @@ def test_gated_suite_count_below_four():
             continue
         if isinstance(t.get("client"), common.GatedClient):
             gated.append(info.name)
-    assert len(gated) <= 3, gated
+    assert len(gated) == 0, gated

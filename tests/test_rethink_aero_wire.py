@@ -15,6 +15,11 @@ from jepsen_tpu.suites import aerowire, rethinkwire
 # --- fake rethinkdb --------------------------------------------------------
 
 
+class _NullAccess(Exception):
+    """Field access on null (real RethinkDB raises a runtime error that
+    only r.default catches)."""
+
+
 class FakeRethink:
     """Single-table store evaluating the exact term shapes the client
     builds (get / insert / branch-replace / db+table admin)."""
@@ -71,7 +76,17 @@ class FakeRethink:
             return self._eval(args[0], row) == self._eval(args[1], row)
         if tid == rethinkwire.T_GET_FIELD:
             base = self._eval(args[0], row)
-            return None if base is None else base.get(args[1])
+            if base is None:
+                # real RethinkDB errors on field access of null; the
+                # client wraps these in r.default, evaluated below
+                raise _NullAccess()
+            return base.get(args[1])
+        if tid == rethinkwire.T_DEFAULT:
+            try:
+                v = self._eval(args[0], row)
+                return args[1] if v is None else v
+            except _NullAccess:
+                return self._eval(args[1], row)
         if tid == rethinkwire.T_VAR:
             return row
         if tid == rethinkwire.T_DB_LIST:
@@ -112,8 +127,8 @@ class FakeRethink:
                         resp = {"t": 2, "r": r}
                     else:
                         resp = {"t": 1, "r": [r]}
-                except ValueError as e:
-                    resp = {"t": 18, "r": [str(e)]}
+                except (ValueError, _NullAccess) as e:
+                    resp = {"t": 18, "r": [str(e) or "null access"]}
                 out = json.dumps(resp).encode()
                 conn.sendall(struct.pack("<QI", token, len(out)) + out)
         except (ConnectionError, OSError):
@@ -136,6 +151,17 @@ class TestRethink:
         assert cl.invoke(None, Op("invoke", "cas", [3, 4], 0)).is_ok
         assert cl.invoke(None, Op("invoke", "cas", [3, 9], 0)).is_fail
         assert cl.invoke(None, Op("invoke", "read", None, 0)).value == 4
+        cl.close(None)
+        srv.close()
+
+    def test_cas_on_missing_key_fails_cleanly(self):
+        # field access on null must route through r.default -> clean
+        # no-match, not a runtime error reported as :info
+        srv = FakeRethink()
+        cl = rethinkwire.RegisterClient(
+            rethinkwire.RethinkClient("127.0.0.1", srv.port))
+        r = cl.invoke(None, Op("invoke", "cas", [1, 2], 0))
+        assert r.is_fail, r
         cl.close(None)
         srv.close()
 
@@ -329,8 +355,8 @@ def test_suites_ungated_and_final_count():
             continue
         if isinstance(t.get("client"), common.GatedClient):
             gated.append(info.name)
-    # hazelcast's Open Client Protocol is the one remaining gated client
-    assert gated in ([], ["hazelcast"]), gated
+    # every suite now carries a native wire client
+    assert gated == [], gated
 
 
 def test_ripemd160_fallback_vectors():
