@@ -37,9 +37,14 @@ def _check_timed(history, n_ops):
     p = prepare.prepare(m.cas_register(), history)
     prep_s = time.time() - t0
 
+    # Big chunks amortize the per-dispatch fixed costs (the bench wants
+    # peak sustained throughput; the default is tuned for verdict+witness
+    # latency instead).
+    kw = {"chunk": 32768}
+
     # Warm run: compiles every (window-bucket, state-bucket) program this
     # history touches, so the timed runs measure steady-state throughput.
-    r = device_check_packed(p)
+    r = device_check_packed(p, **kw)
     if r["valid?"] is not True:
         raise RuntimeError(f"unexpected verdict {r}")
 
@@ -47,7 +52,7 @@ def _check_timed(history, n_ops):
     check_s = float("inf")
     for _ in range(3):
         t0 = time.time()
-        r = device_check_packed(p)
+        r = device_check_packed(p, **kw)
         check_s = min(check_s, time.time() - t0)
         if r["valid?"] is not True:
             raise RuntimeError(f"unexpected verdict {r}")

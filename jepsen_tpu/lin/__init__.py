@@ -77,11 +77,7 @@ def device_check_packed(packed: PackedHistory, cancel=None, **kw) -> dict:
     if dense.plan(packed) is not None:
         dkw = {k: v for k, v in kw.items() if k in ("chunk", "explain")}
         return dense.check_packed(packed, cancel=cancel, **dkw)
-    # The sparse fallback keeps no frontier snapshots, so explain (a dense
-    # feature) is inert there: wide-window violations report the dead op
-    # without final-paths.
-    skw = {k: v for k, v in kw.items() if k != "explain"}
-    return bfs.check_packed(packed, cancel=cancel, **skw)
+    return bfs.check_packed(packed, cancel=cancel, **kw)
 
 
 def _competition(packed: PackedHistory, **kw) -> dict:

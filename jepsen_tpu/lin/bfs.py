@@ -340,7 +340,8 @@ def _pad_rows(p: PackedHistory):
 
 
 def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
-                 chunk: int = CHUNK, cancel=None) -> dict:
+                 chunk: int = CHUNK, cancel=None,
+                 explain: bool = False) -> dict:
     """Decide linearizability of a packed history on device.
 
     Host loop over CHUNK-row device dispatches; the frontier carries
@@ -349,7 +350,10 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     when the frontier shrinks the cap drops back so the common case keeps
     running on the small fast program. ``cancel`` (a threading.Event) stops
     the search between chunks — set by a competition race once the other
-    racer has decided.
+    racer has decided. ``explain=True`` keeps chunk-entry frontier
+    snapshots and, on an invalid verdict, replays the failing tail on
+    the CPU oracle to emit configs + final-paths
+    (:mod:`jepsen_tpu.lin.witness`).
     """
     if p.kernel is None:
         return {"valid?": "unknown", "analyzer": "tpu-bfs",
@@ -390,9 +394,14 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
         jnp.asarray(p.init_state))
     count = jnp.int32(1)
     max_cap_used = cap
+    snapshots: list | None = [] if explain else None
 
     base = 0
     while base < p.R:
+        if snapshots is not None:
+            # only the last snapshot is ever replayed (the dead row is
+            # always inside the current chunk): keep HBM flat
+            snapshots[:] = [(base, bits, state, count)]
         if cancel is not None and cancel.is_set():
             return {"valid?": "unknown", "analyzer": "tpu-bfs",
                     "error": "cancelled"}
@@ -422,12 +431,18 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
         if bool(dead):
             r = base + int(r_done) - 1
             ret = p.ops[int(p.ret_op[r])]
-            return {"valid?": False, "analyzer": "tpu-bfs",
-                    "dead-row": r,
-                    "op": {"process": ret.process, "f": ret.f,
-                           "value": ret.value, "index": ret.op_index,
-                           "ok": ret.ok},
-                    "configs": [], "final-paths": []}
+            out = {"valid?": False, "analyzer": "tpu-bfs",
+                   "dead-row": r,
+                   "op": {"process": ret.process, "f": ret.f,
+                          "value": ret.value, "index": ret.op_index,
+                          "ok": ret.ok},
+                   "configs": [], "final-paths": []}
+            if snapshots and not (cancel is not None and cancel.is_set()):
+                from jepsen_tpu.lin import witness
+
+                out.update(witness.tail_replay_sparse(p, snapshots, r,
+                                                      cancel=cancel))
+            return out
         bits, state, count = b2, s2, c2
         base += n
         # Frontier is compacted to the front, so a shrunken frontier can

@@ -57,7 +57,11 @@ from jepsen_tpu.lin.prepare import PackedHistory
 MAX_DENSE_WINDOW = 20
 # States must fit one u32 word of bitmap per bitset row.
 MAX_DENSE_STATES = 32
-CHUNK = 8192
+# Per-chunk fixed costs (table upload over the host link, dispatch)
+# dominate at small chunks: measured on a v5e chip, 100k ops run at
+# 42k/70k/102k/118k ops/s for chunks of 4k/8k/16k/32k. 16k balances
+# throughput against the witness tail-replay window (one chunk).
+CHUNK = 16384
 
 _W_BUCKETS = (4, 6, 8, 10, 12, 14, 16, 18, 20)
 _NS_BUCKETS = (4, 8, 16, 32)
@@ -199,6 +203,10 @@ def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
         return {"valid?": "unknown", "analyzer": "tpu-dense",
                 "error": "history outside dense engine bounds"}
     w, ns, nil_id, init_id = pl
+    # Explicit callers get every chunk-entry snapshot; internal explain
+    # only ever replays from the LAST one (the dead row is always inside
+    # the current chunk), so retain just that and keep HBM flat.
+    keep_all = snapshots is not None
     if explain and snapshots is None:
         snapshots = []
 
@@ -275,7 +283,10 @@ def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
             F = jnp.pad(F, (0, (1 << w_c) - (1 << w_cur)))
             w_cur = w_c
         if snapshots is not None:
-            snapshots.append((base, F))
+            if keep_all:
+                snapshots.append((base, F))
+            else:
+                snapshots[:] = [(base, F)]
         if use_pallas:
             # Bucket the kernel grid to the chunk's actual row count so a
             # short final chunk doesn't pay for thousands of no-op steps

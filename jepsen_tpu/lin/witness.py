@@ -28,21 +28,13 @@ from jepsen_tpu.lin import cpu, dense
 from jepsen_tpu.lin.prepare import PackedHistory
 
 
-def tail_replay(p: PackedHistory, nil_id: int, snapshots: list,
-                dead_row: int, cancel=None) -> dict:
-    """Reconstruct configs + final-paths for a dense-engine violation at
-    ``dead_row`` from the engine's chunk-entry ``snapshots``. Returns a
-    dict with "configs" and "final-paths", or {} if reconstruction fails
-    or is cancelled (reporting is best-effort, like the reference's
-    render at checker.clj:96-103). ``cancel`` keeps a competition loser's
-    replay from blocking the race join."""
-    usable = [(b, F) for b, F in snapshots if b <= dead_row]
-    if not usable:
-        return {}
-    base, F = usable[-1]
-    configs = set()
-    for bits, st in dense.decode_bitmap(F, nil_id):
-        configs.add((bits, st))
+def replay_configs(p: PackedHistory, configs: set, base: int,
+                   dead_row: int, cancel=None) -> dict:
+    """Run the CPU oracle's closure from a known config set at row
+    ``base`` through ``dead_row``, tracking linearization order, and
+    emit knossos-style configs + final-paths at the death. Returns {}
+    on failure/cancel (reporting is best-effort, like the reference's
+    render at checker.clj:96-103)."""
     if not configs:
         return {}
     order = {cfg: None for cfg in configs}
@@ -58,3 +50,40 @@ def tail_replay(p: PackedHistory, nil_id: int, snapshots: list,
     # between engines — surface it rather than fabricate a path.
     return {"error": "tail replay disagrees with device verdict "
                      f"(rows {base}..{dead_row} survive on host)"}
+
+
+def tail_replay(p: PackedHistory, nil_id: int, snapshots: list,
+                dead_row: int, cancel=None) -> dict:
+    """Dense-engine counterexample: decode the last chunk-entry bitmap
+    at or before ``dead_row`` and replay the failing tail.
+    ``cancel`` keeps a competition loser's replay from blocking the
+    race join."""
+    usable = [(b, F) for b, F in snapshots if b <= dead_row]
+    if not usable:
+        return {}
+    base, F = usable[-1]
+    configs = {(bits, st) for bits, st in dense.decode_bitmap(F, nil_id)}
+    return replay_configs(p, configs, base, dead_row, cancel=cancel)
+
+
+def tail_replay_sparse(p: PackedHistory, snapshots: list,
+                       dead_row: int, cancel=None) -> dict:
+    """Sparse-engine counterexample: snapshots are
+    ``(base_row, bits[cap,NW], state[cap,S], count)`` chunk-entry
+    frontiers; decode the multi-word bitsets and replay the tail."""
+    import numpy as np
+
+    usable = [s for s in snapshots if s[0] <= dead_row]
+    if not usable:
+        return {}
+    base, bits, state, count = usable[-1]
+    bits = np.asarray(bits)
+    state = np.asarray(state)
+    n = int(count)
+    configs = set()
+    for i in range(min(n, bits.shape[0])):
+        b = 0
+        for w in range(bits.shape[1]):
+            b |= int(bits[i, w]) << (32 * w)
+        configs.add((b, tuple(int(x) for x in state[i])))
+    return replay_configs(p, configs, base, dead_row, cancel=cancel)

@@ -107,3 +107,23 @@ def test_checker_defaults_paths_on(tmp_path):
         r = c.linearizable(algo).check(None, m.cas_register(), h, {})
         assert r["valid?"] is False
         assert r["final-paths"], f"algorithm {algo} lost final-paths"
+
+
+def test_sparse_engine_explain_produces_paths():
+    # Wide-window violations (sparse engine) must carry final-paths too:
+    # the 40-slot cas-chain with a read the chain can't explain.
+    from jepsen_tpu.lin import bfs
+
+    h = [invoke_op(0, "write", 0), ok_op(0, "write", 0)]
+    for i in range(40):
+        h.append(invoke_op(i + 1, "cas", [i, i + 1]))
+    for i in range(40):
+        h.append(ok_op(i + 1, "cas", [i, i + 1]))
+    h += [invoke_op(0, "read", None), ok_op(0, "read", 999)]
+    p = prepare.prepare(m.cas_register(), History.of(*h))
+    assert p.window == 40
+    r = bfs.check_packed(p, explain=True)
+    assert r["valid?"] is False
+    assert r["analyzer"] == "tpu-bfs"
+    assert r["final-paths"], "sparse violation must carry final-paths"
+    assert r["configs"]
