@@ -226,7 +226,12 @@ def checker(inner: checker_ns.Checker,
                     [results[k].get(checker_ns.VALID) for k in ks])
                 if ks else True,
                 "results": results,
-                "failures": failures}
+                "failures": failures,
+                # Visibility into whether the vmapped device batch
+                # engaged or the per-key fallback ran (round-1 review:
+                # the silent fallback was unmeasurable).
+                "batch-engaged": batched is not None,
+                "n-keys": len(ks)}
 
     return checker_ns.FnChecker(check)
 

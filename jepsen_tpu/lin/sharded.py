@@ -38,6 +38,9 @@ from jepsen_tpu.lin.bfs import _pad_rows
 # The sparse sharded frontier keeps single-word bitsets (the all_gather
 # dedup keys stay u32); wider windows fall back to the single-chip engine.
 MAX_DEVICE_WINDOW = 32
+# Whole-history single-program bound (no chunking in the sparse mesh
+# path; the dense hypercube engine handles long histories chunked).
+MAX_SHARDED_ROWS = 8192
 from jepsen_tpu.lin.prepare import PackedHistory
 
 
@@ -205,6 +208,14 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
                 "error": f"window {p.window} exceeds device bitset"}
     if p.R == 0:
         return {"valid?": True, "analyzer": "tpu-bfs-sharded"}
+    if p.R > MAX_SHARDED_ROWS:
+        # The sparse sharded search runs the whole history as ONE device
+        # program (no chunking); past this bound a single dispatch risks
+        # watchdog kills. Dense-shardable histories never get here.
+        return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
+                "error": f"history length {p.R} exceeds the unchunked "
+                         f"sparse-sharded bound {MAX_SHARDED_ROWS}; "
+                         f"use the single-chip engine"}
 
     axis = mesh.axis_names[0]
 

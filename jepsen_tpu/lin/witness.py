@@ -77,13 +77,12 @@ def tail_replay_sparse(p: PackedHistory, snapshots: list,
     if not usable:
         return {}
     base, bits, state, count = usable[-1]
-    bits = np.asarray(bits)
-    state = np.asarray(state)
-    n = int(count)
-    configs = set()
-    for i in range(min(n, bits.shape[0])):
-        b = 0
-        for w in range(bits.shape[1]):
-            b |= int(bits[i, w]) << (32 * w)
-        configs.add((b, tuple(int(x) for x in state[i])))
+    n = min(int(count), np.asarray(bits).shape[0])
+    bits = np.asarray(bits)[:n].astype(object)
+    state = np.asarray(state)[:n]
+    packed = bits[:, 0]
+    for w in range(1, bits.shape[1]):
+        packed = packed | (bits[:, w] << (32 * w))
+    configs = set(zip((int(b) for b in packed),
+                      map(tuple, state.tolist())))
     return replay_configs(p, configs, base, dead_row, cancel=cancel)

@@ -252,3 +252,21 @@ class TestDenseBatch:
             assert all(r["analyzer"] == "tpu-bfs-batch"
                        for r in res.values())
             assert all(r["valid?"] is True for r in res.values())
+
+
+def test_batch_engagement_reported():
+    from jepsen_tpu import checker as c
+    from jepsen_tpu import models as m
+    from jepsen_tpu.history import History, invoke_op, ok_op
+    import jepsen_tpu.independent as ind
+
+    h = History.of(invoke_op(0, "write", ind.KV("k", 1)),
+                   ok_op(0, "write", ind.KV("k", 1)))
+    r = ind.checker(c.linearizable("tpu")).check(
+        None, m.cas_register(), h, {})
+    assert r["batch-engaged"] is True
+    assert r["n-keys"] == 1
+    # a lifted NON-linearizable checker must not engage the batch
+    r2 = ind.checker(c.unbridled_optimism()).check(
+        None, m.cas_register(), h, {})
+    assert r2["batch-engaged"] is False

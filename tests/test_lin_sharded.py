@@ -65,3 +65,19 @@ def test_mutex_sharded():
                    invoke_op(1, "acquire", None), ok_op(1, "acquire", None))
     p = prepare.prepare(m.mutex(), h)
     assert sharded.check_packed(p, mesh=mesh(2))["valid?"] is False
+
+
+def test_sparse_sharded_rejects_unchunked_long_history():
+    # the sparse mesh path runs the whole history as one program; past
+    # the bound it must refuse rather than risk a watchdog kill
+    from jepsen_tpu.lin import sharded
+
+    p = prepare.prepare(m.cas_register(), synth.generate_register_history(
+        30, concurrency=3, seed=1))
+    # simulate a long history by patching R past the bound
+    import dataclasses
+
+    big = dataclasses.replace(p, R=sharded.MAX_SHARDED_ROWS + 1)
+    r = sharded.check_packed(big, mesh=mesh(2), engine="sparse")
+    assert r["valid?"] == "unknown"
+    assert "exceeds" in r["error"]
