@@ -110,10 +110,11 @@ def _try_dense_batch(packed: dict) -> dict | None:
                         "tpu-dense-batch")
 
 
-def _pad_to(p: PackedHistory, r_pad: int, w_pad: int):
+def _pad_to(p: PackedHistory, r_pad: int, w_pad: int, nw: int):
     """Pad one packed history to (r_pad, w_pad + 1): columns beyond the
     key's own window are inactive; missing rows are identity rows on the
-    shared pad slot w_pad (see bfs._pad_rows)."""
+    shared pad slot w_pad (see bfs._pad_rows). Reduction tables pad inert
+    (pad slot is impure and unchained)."""
     R, W = p.active.shape
     vw = p.slot_v.shape[2]
     ret_slot = np.concatenate(
@@ -126,7 +127,12 @@ def _pad_to(p: PackedHistory, r_pad: int, w_pad: int):
     slot_f[R:, w_pad] = F_NOOP
     slot_v = np.zeros((r_pad, w_pad + 1, vw), np.int32)
     slot_v[:R, :W] = p.slot_v
-    return ret_slot, active, slot_f, slot_v
+    pure_k, pred_bit_k = bfs.reduction_bit_tables(p, nw)
+    pure = np.zeros((r_pad, w_pad + 1), bool)
+    pure[:R, :W] = pure_k
+    pred_bit = np.zeros((r_pad, w_pad + 1, nw), np.uint32)
+    pred_bit[:R, :W] = pred_bit_k
+    return ret_slot, active, slot_f, slot_v, pure, pred_bit
 
 
 def try_check_batch(model, subs: dict) -> dict | None:
@@ -172,30 +178,34 @@ def try_check_batch(model, subs: dict) -> dict | None:
     r_pad = 1 << max(4, (r_max - 1).bit_length())
 
     ks = sorted(packed, key=repr)
-    rows = [_pad_to(packed[k], r_pad, w_pad) for k in ks]
+    nw = (w_pad + 1 + 31) // 32
+    rows = [_pad_to(packed[k], r_pad, w_pad, nw) for k in ks]
     ret_slot = jnp.asarray(np.stack([r[0] for r in rows]))
     active = jnp.asarray(np.stack([r[1] for r in rows]))
     slot_f = jnp.asarray(np.stack([r[2] for r in rows]))
     slot_v = jnp.asarray(np.stack([r[3] for r in rows]))
+    pure = jnp.asarray(np.stack([r[4] for r in rows]))
+    pred_bit = jnp.asarray(np.stack([r[5] for r in rows]))
     init_state = jnp.asarray(np.stack(
         [packed[k].init_state for k in ks]))
 
     step_fn = packed[ks[0]].kernel.step
     n_keys = len(ks)
     S = init_state.shape[1]
-    nw = (w_pad + 1 + 31) // 32
     for cap in BATCH_CAP_SCHEDULE:
         bits0 = jnp.zeros((n_keys, cap, nw), jnp.uint32)
         state0 = jnp.zeros((n_keys, cap, S), jnp.int32) \
             .at[:, 0, :].set(init_state)
         count0 = jnp.ones(n_keys, jnp.int32)
 
-        def one(rs, ac, sf, sv, b0, s0, c0):
+        def one(rs, ac, sf, sv, pu, pb, b0, s0, c0):
             return bfs._search_chunk(jnp.int32(r_pad), rs, ac, sf, sv,
-                                     b0, s0, c0, cap=cap, step_fn=step_fn)
+                                     pu, pb, b0, s0, c0,
+                                     cap=cap, step_fn=step_fn)
 
         _, _, count, rows, dead, overflow = jax.vmap(one)(
-            ret_slot, active, slot_f, slot_v, bits0, state0, count0)
+            ret_slot, active, slot_f, slot_v, pure, pred_bit,
+            bits0, state0, count0)
         if not bool(jnp.any(overflow)):
             break
     if bool(jnp.any(overflow)):

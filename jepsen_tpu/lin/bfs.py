@@ -49,29 +49,34 @@ from jax import lax
 
 from jepsen_tpu.lin.prepare import PackedHistory
 
+# Caps for the nested-while chunked engine. 131072 is the largest level
+# that holds up on the axon TPU runtime: the same program at 262144
+# kernel-faults the worker (the components — sorts to 32M elements, the
+# vmapped step, the expansion algebra — are each fine standalone at that
+# scale; only the full nested-while program trips the runtime). Frontier
+# spikes past this cap switch to the host-driven per-pass executor
+# (_hostloop_rows), whose top-level dispatches stay on proven ground up to
+# HOSTLOOP_CAP_SCHEDULE[-1].
 DEFAULT_CAP_SCHEDULE = (256, 2048, 16384, 131072)
+HOSTLOOP_CAP_SCHEDULE = (262144, 1048576)
+# Frontier size at which the spike executor hands back to the chunked
+# engine (a row boundary with count at most this).
+HOSTLOOP_DROPBACK = 32768
 MAX_DEVICE_WINDOW = 64
 CHUNK = 512
-
-
-def _compact_gather(mask, n, cap):
-    """Positions of the first ``cap`` mask-survivors, via cumsum + binary
-    search (TPU-friendly; scatter compaction serializes on TPU). Returns
-    (sel[cap] clipped indices, total survivors)."""
-    csum = jnp.cumsum(mask.astype(jnp.int32))
-    total = csum[-1]
-    sel = jnp.searchsorted(csum, jnp.arange(1, cap + 1, dtype=jnp.int32),
-                           method='scan_unrolled')
-    return jnp.clip(sel, 0, n - 1), total
 
 
 KEY_FILL = jnp.uint32(0xFFFFFFFF)  # pad beyond count; sorts after any config
 
 
 def _dedup_keys(key, valid, cap):
-    """Single-u32-key sort-dedup (invalid flag in bit 31), compacted by
-    gather. Returns (keys[cap] ascending + KEY_FILL padding, count,
-    overflow)."""
+    """Single-u32-key sort-dedup (invalid flag in bit 31), compacted by a
+    SECOND sort: survivors keep their key, duplicates/invalid become
+    KEY_FILL, so sorting packs survivors (still ascending) to the front.
+    Two plain sorts, no searchsorted and no big gather — both of which
+    kernel-fault the axon TPU runtime past ~2^17-row frontiers, while
+    lax.sort is proven safe standalone to 32M elements here. Returns
+    (keys[cap] ascending + KEY_FILL padding, count, overflow)."""
     n = key.shape[0]
     key = key | ((~valid).astype(jnp.uint32) << 31)
     key_s = lax.sort(key)
@@ -81,9 +86,9 @@ def _dedup_keys(key, valid, cap):
     first = jnp.arange(n) == 0
     mask = (inv_s == 0) & (first | prev_differs)
 
-    sel, total = _compact_gather(mask, n, cap)
+    total = jnp.sum(mask.astype(jnp.int32))
     overflow = total > cap
-    out = jnp.where(jnp.arange(cap) < total, key_s[sel], KEY_FILL)
+    out = lax.sort(jnp.where(mask, key_s, KEY_FILL))[:cap]
     count = jnp.minimum(total, cap)
     return out, count, overflow
 
@@ -92,7 +97,9 @@ def _dedup(bits, state, valid, cap):
     """Sort-dedup-compact over multi-word configs. bits: u32[n, NW];
     state: i32[n, S]. Returns (bits[cap,NW], state[cap,S], count,
     overflow). Invalid rows sort last; duplicates are adjacent after the
-    lexicographic sort and masked; survivors are gather-compacted."""
+    lexicographic sort and masked; survivors are compacted by a second
+    rank-keyed sort (see _dedup_keys: searchsorted/gather compaction
+    faults the TPU runtime at large caps)."""
     n, nw = bits.shape
     s_width = state.shape[1]
     inv = (~valid).astype(jnp.uint32)
@@ -109,11 +116,17 @@ def _dedup(bits, state, valid, cap):
     first = jnp.arange(n) == 0
     mask = (inv_s == 0) & (first | prev_differs)
 
-    sel, total = _compact_gather(mask, n, cap)
+    total = jnp.sum(mask.astype(jnp.int32))
     overflow = total > cap
+    rank = jnp.where(mask, jnp.arange(n, dtype=jnp.int32), jnp.int32(n))
+    packed = lax.sort((rank,) + tuple(bits_s[:, k] for k in range(nw))
+                      + tuple(state_s[:, k] for k in range(s_width)),
+                      num_keys=1)
     live = jnp.arange(cap) < total
-    out_bits = jnp.where(live[:, None], bits_s[sel], 0)
-    out_state = jnp.where(live[:, None], state_s[sel], 0)
+    out_bits = jnp.where(live[:, None],
+                         jnp.stack(packed[1:1 + nw], axis=1)[:cap], 0)
+    out_state = jnp.where(live[:, None],
+                          jnp.stack(packed[1 + nw:], axis=1)[:cap], 0)
     count = jnp.minimum(total, cap)
     return out_bits, out_state, count, overflow
 
@@ -126,11 +139,26 @@ def _slot_bits(W: int, nw: int):
     return jnp.asarray(tbl)
 
 
+def reduction_bit_tables(p: PackedHistory, nw: int):
+    """Host-side (pure[R,W], pred_bit[R,W,nw]) from
+    prepare.reduction_tables: pred slot indices become per-word bitmasks
+    (all-zero when a slot has no chain predecessor)."""
+    from jepsen_tpu.lin.prepare import reduction_tables
+
+    pure, pred = reduction_tables(p)
+    R, W = pred.shape
+    pred_bit = np.zeros((R, W, nw), np.uint32)
+    rr, jj = np.nonzero(pred >= 0)
+    pj = pred[rr, jj]
+    pred_bit[rr, jj, pj // 32] = np.uint32(1) << (pj % 32).astype(np.uint32)
+    return pure, pred_bit
+
+
 @partial(jax.jit, static_argnames=("cap", "step_fn", "state_bits",
-                                   "nil_id"))
-def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v,
+                                   "nil_id", "read_value_match"))
+def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v, pure, pred_bit,
                   bits, state, count, *, cap, step_fn,
-                  state_bits=None, nil_id=None):
+                  state_bits=None, nil_id=None, read_value_match=False):
     """Process up to n_rows return events (tables are CHUNK-row static
     shapes; rows past n_rows are ignored) starting from a carried frontier.
 
@@ -140,6 +168,13 @@ def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v,
     transient frontier spike re-runs one chunk at a bigger cap instead of
     the whole search.
 
+    ``pure``/``pred_bit`` carry the exact search-space reductions of
+    prepare.reduction_tables: pure[C,W] marks state-preserving slots —
+    these never branch the search; instead every config greedily absorbs
+    the bit of each legal pure slot (saturation). pred_bit[C,W,NW] is the
+    canonical-chain gate: slot j may linearize only in configs that
+    already hold its identical earlier-returning sibling's bit.
+
     With ``state_bits`` set (windows <= 31 - state_bits) the whole row
     loop runs on packed u32 config keys.
 
@@ -147,9 +182,10 @@ def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v,
     """
     if state_bits is not None:
         return _search_chunk_keys(
-            n_rows, ret_slot, active, slot_f, slot_v,
+            n_rows, ret_slot, active, slot_f, slot_v, pure, pred_bit,
             bits, state, count, cap=cap, step_fn=step_fn,
-            state_bits=state_bits, nil_id=nil_id)
+            state_bits=state_bits, nil_id=nil_id,
+            read_value_match=read_value_match)
     C, W = active.shape
     S = state.shape[1]
     nw = bits.shape[1]
@@ -160,23 +196,42 @@ def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v,
     slot_bit = _slot_bits(W, nw)                       # [W, NW]
 
     def closure_cond(c):
-        _, _, count, prev, ovf = c
-        return (count != prev) & ~ovf
+        _, _, _, changed, ovf = c
+        return changed & ~ovf
 
     def row_body(carry):
         r, bits, state, count, dead, ovf = carry
         act = active[r]
         f_row = slot_f[r]
         v_row = slot_v[r]
+        pure_row = pure[r]                             # [W]
+        pred_row = pred_bit[r]                         # [W, NW]
         s = ret_slot[r]
 
         def closure_body(c):
-            bits, state, count, prev, ovf = c
+            bits_in, state, count, _, ovf = c
             cfg_valid = jnp.arange(cap) < count
             ok, new_state = step_cfg_slot(state, f_row, v_row)
             already = jnp.any(
-                (bits[:, None, :] & slot_bit[None, :, :]) != 0, axis=-1)
-            legal = ok & act[None, :] & ~already & cfg_valid[:, None]
+                (bits_in[:, None, :] & slot_bit[None, :, :]) != 0, axis=-1)
+            fresh = ok & act[None, :] & ~already & cfg_valid[:, None]
+            # Saturation: carried configs absorb every legal pure bit in
+            # place (new configs pick theirs up next pass, when carried).
+            # Statically unrolled OR per slot, not a vector reduce:
+            # axis-reductions inside the nested while loops kernel-fault
+            # this TPU runtime.
+            sat_w = [jnp.zeros(cap, jnp.uint32) for _ in range(nw)]
+            for j in range(W):
+                cond = fresh[:, j] & pure_row[j]
+                sat_w[j // 32] = sat_w[j // 32] | jnp.where(
+                    cond, jnp.uint32(1) << (j % 32), jnp.uint32(0))
+            sat = jnp.stack(sat_w, axis=1)             # [cap, NW]
+            bits = jnp.where(cfg_valid[:, None], bits_in | sat, bits_in)
+            # Expansion: non-pure slots only, gated by the canonical chain.
+            chain_ok = jnp.all(
+                (bits[:, None, :] & pred_row[None, :, :]) == pred_row,
+                axis=-1)
+            legal = fresh & ~pure_row[None, :] & chain_ok
             new_bits = bits[:, None, :] | slot_bit[None, :, :]
 
             cand_bits = jnp.concatenate(
@@ -186,9 +241,14 @@ def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v,
             cand_valid = jnp.concatenate([cfg_valid, legal.reshape(-1)])
 
             b2, s2, n2, o2 = _dedup(cand_bits, cand_state, cand_valid, cap)
-            return (b2, s2, n2, count, ovf | o2)
+            # Fixpoint test is against the pass INPUT (the stable set
+            # keeps both a config and its saturated twin; see
+            # _search_chunk_keys.closure_body).
+            changed = jnp.any(b2 != bits_in) | jnp.any(s2 != state) | \
+                (n2 != count)
+            return (b2, s2, n2, changed, ovf | o2)
 
-        init = (bits, state, count, jnp.int32(-1), ovf)
+        init = (bits, state, count, jnp.bool_(True), ovf)
         bits, state, count, _, ovf = lax.while_loop(
             closure_cond, closure_body, init)
 
@@ -212,22 +272,146 @@ def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v,
     return bits, state, count, r, dead, ovf
 
 
+def _closure_pass_keys(keys_in, count, act, f_row, v_row, pure_row,
+                       pred_row, *, cap, W, b, nil_id, step_fn,
+                       read_value_match):
+    """ONE just-in-time closure pass over packed u32 keys
+    (bits << b | state id). Saturation ORs legal pure-slot bits into the
+    carried keys in place; expansion covers non-pure slots gated by the
+    canonical-chain pred mask. Shared verbatim by the nested-while chunk
+    engine and the host-driven spike executor so their semantics cannot
+    diverge. Returns (keys, count, changed, overflow)."""
+    from jepsen_tpu.models.kernels import NIL
+
+    bmask = jnp.uint32((1 << b) - 1)
+    slot_bit = (jnp.uint32(1) << jnp.arange(W, dtype=jnp.uint32))
+    step_cfg_slot = jax.vmap(
+        jax.vmap(step_fn, in_axes=(None, 0, 0)),
+        in_axes=(0, None, None))
+
+    cfg_valid = jnp.arange(cap) < count
+    cfg = jnp.where(cfg_valid, keys_in, 0)
+    bits1 = cfg >> b
+    sv = (cfg & bmask).astype(jnp.int32)
+    state = jnp.where(cfg_valid, jnp.where(sv == nil_id, NIL, sv),
+                      0)[:, None]
+    ok, new_state = step_cfg_slot(state, f_row, v_row)
+    already = (bits1[:, None] & slot_bit[None, :]) != 0
+    fresh = ok & act[None, :] & ~already & cfg_valid[:, None]
+    nsv = new_state[..., 0]
+    pns = jnp.where(nsv == NIL, nil_id, nsv).astype(jnp.uint32)
+    # Saturation: every config (carried in place, and each expansion
+    # against its post-transition state) absorbs the bits of all its
+    # legal pure slots. Statically unrolled ORs, not vector reduces
+    # (axis-reductions inside the nested while loops kernel-fault this
+    # TPU runtime — see the dense-engine comment).
+    if read_value_match and b <= 6:
+        # Register-family read legality is a plain value match, so the
+        # pure-slot mask depends only on the state ID: one tiny per-row
+        # table (W ops over [2^b]), then a 2^b-way unrolled select —
+        # O(W + 2^b) program ops instead of O(W^2). Value-rich histories
+        # (b > 6) take the generic branch to keep the unroll bounded.
+        sid = jnp.arange(1 << b, dtype=jnp.int32)
+        raw = jnp.where(sid == nil_id, NIL, sid)
+        sat_tbl = jnp.zeros(1 << b, jnp.uint32)
+        for k in range(W):
+            m = (v_row[k, 0] == NIL) | (v_row[k, 0] == raw)
+            sat_tbl = sat_tbl | jnp.where(
+                m & pure_row[k] & act[k], slot_bit[k], jnp.uint32(0))
+        sat = jnp.zeros_like(keys_in)
+        nsat = jnp.zeros(pns.shape, jnp.uint32)
+        for s_id in range(1 << b):
+            sat = sat | jnp.where(sv == s_id, sat_tbl[s_id],
+                                  jnp.uint32(0))
+            nsat = nsat | jnp.where(pns == jnp.uint32(s_id),
+                                    sat_tbl[s_id], jnp.uint32(0))
+    else:
+        # Generic packed kernels (mutex: no pure ops — this folds away):
+        # carried keys absorb legal pure bits via the step kernel's own
+        # legality; expansions pick theirs up next pass, when carried.
+        sat = jnp.zeros_like(keys_in)
+        for j in range(W):
+            sat = sat | jnp.where(fresh[:, j] & pure_row[j],
+                                  slot_bit[j], jnp.uint32(0))
+        nsat = jnp.zeros(pns.shape, jnp.uint32)
+    keys = jnp.where(cfg_valid, keys_in | (sat << b), keys_in)
+    bits1 = bits1 | sat
+    chain_ok = (bits1[:, None] & pred_row[None, :]) == pred_row[None, :]
+    legal = fresh & ~pure_row[None, :] & chain_ok
+    new_bits = bits1[:, None] | slot_bit[None, :] | nsat
+    new_keys = (new_bits << b) | pns
+
+    cand = jnp.concatenate([jnp.where(cfg_valid, keys, 0),
+                            new_keys.reshape(-1)])
+    cand_valid = jnp.concatenate([cfg_valid, legal.reshape(-1)])
+    k2, n2, o2 = _dedup_keys(cand, cand_valid, cap)
+    # Fixpoint test is against the pass INPUT: the stable set contains
+    # both a config and its saturated twin (expansion keeps regenerating
+    # the unsaturated parent), so comparing against the in-place-saturated
+    # array would never settle.
+    changed = jnp.any(k2 != keys_in) | (n2 != count)
+    return k2, n2, changed, o2
+
+
+def _filter_pass_keys(keys, count, s, *, cap, b):
+    """Return-event filter over packed keys: the returner's linearization
+    point must precede its return; survivors drop its (recycled) bit.
+    Returns (keys, count, dead)."""
+    s_key_bit = jnp.uint32(1) << (b + s).astype(jnp.uint32)
+    cfg_valid = jnp.arange(cap) < count
+    keep = cfg_valid & ((keys & s_key_bit) != 0)
+    keys, count, _ = _dedup_keys(
+        jnp.where(keep, keys & ~s_key_bit, 0), keep, cap)
+    return keys, count, count == 0
+
+
+_closure_pass_jit = partial(jax.jit, static_argnames=(
+    "cap", "W", "b", "nil_id", "step_fn", "read_value_match"))(
+        _closure_pass_keys)
+_filter_pass_jit = partial(jax.jit, static_argnames=("cap", "b"))(
+    _filter_pass_keys)
+
+
+@partial(jax.jit, static_argnames=("cap", "W", "b", "nil_id", "step_fn",
+                                   "read_value_match"))
+def _row_jit(keys, count, act, f_row, v_row, pure_row, pred_row, s, *,
+             cap, W, b, nil_id, step_fn, read_value_match):
+    """One full return-event row (closure fixpoint + filter) as a single
+    device program for the spike executor: a SINGLE-level while_loop —
+    the two-level nested row×closure loop of _search_chunk_keys is what
+    kernel-faults the axon runtime at caps past 131072, while this shape
+    holds to HOSTLOOP_CAP_SCHEDULE[-1]. On overflow the output keys are
+    clipped garbage; the caller retries the row from its preserved entry
+    frontier at the next cap. Returns (keys, count, dead, overflow)."""
+    def cond(c):
+        _, _, changed, ovf = c
+        return changed & ~ovf
+
+    def body(c):
+        keys_in, count, _, ovf = c
+        k2, n2, changed, o2 = _closure_pass_keys(
+            keys_in, count, act, f_row, v_row, pure_row, pred_row,
+            cap=cap, W=W, b=b, nil_id=nil_id, step_fn=step_fn,
+            read_value_match=read_value_match)
+        return (k2, n2, changed, ovf | o2)
+
+    keys, count, _, ovf = lax.while_loop(
+        cond, body, (keys, count, jnp.bool_(True), jnp.bool_(False)))
+    keys, count, dead = _filter_pass_keys(keys, count, s, cap=cap, b=b)
+    return keys, count, dead, ovf
+
+
 def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
-                       bits, state, count, *, cap, step_fn,
-                       state_bits, nil_id):
+                       pure, pred_bit, bits, state, count, *, cap, step_fn,
+                       state_bits, nil_id, read_value_match=False):
     """Packed-u32-key row loop (see _search_chunk): each config is ONE
     uint32 (bits << state_bits | state id), so dedup is a single payload-
-    free sort and compaction a gather."""
+    free sort and compaction a second sort."""
     from jepsen_tpu.models.kernels import NIL
 
     C, W = active.shape
     b = state_bits
     bmask = jnp.uint32((1 << b) - 1)
-
-    step_cfg_slot = jax.vmap(
-        jax.vmap(step_fn, in_axes=(None, 0, 0)),
-        in_axes=(0, None, None))
-    slot_bit = (jnp.uint32(1) << jnp.arange(W, dtype=jnp.uint32))
 
     def to_keys(bits, state, count):
         sv = state[:, 0]
@@ -249,43 +433,28 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
         act = active[r]
         f_row = slot_f[r]
         v_row = slot_v[r]
-        s = ret_slot[r]
+        pure_row = pure[r]                              # [W]
+        pred_row = pred_bit[r, :, 0]                    # [W] slot-space
 
         def closure_cond(c):
-            _, count, prev, ovf = c
-            return (count != prev) & ~ovf
+            _, _, changed, ovf = c
+            return changed & ~ovf
 
         def closure_body(c):
-            keys, count, _, ovf = c
-            cfg_valid = jnp.arange(cap) < count
-            bits, state = from_keys(keys, count)
-            bits1 = bits[:, 0]
-            ok, new_state = step_cfg_slot(state, f_row, v_row)
-            already = (bits1[:, None] & slot_bit[None, :]) != 0
-            legal = ok & act[None, :] & ~already & cfg_valid[:, None]
-            nsv = new_state[..., 0]
-            pns = jnp.where(nsv == NIL, nil_id, nsv).astype(jnp.uint32)
-            new_keys = (((bits1[:, None] | slot_bit[None, :]) << b) | pns)
+            keys_in, count, _, ovf = c
+            k2, n2, changed, o2 = _closure_pass_keys(
+                keys_in, count, act, f_row, v_row, pure_row, pred_row,
+                cap=cap, W=W, b=b, nil_id=nil_id, step_fn=step_fn,
+                read_value_match=read_value_match)
+            return (k2, n2, changed, ovf | o2)
 
-            cand = jnp.concatenate([jnp.where(cfg_valid, keys, 0),
-                                    new_keys.reshape(-1)])
-            cand_valid = jnp.concatenate([cfg_valid, legal.reshape(-1)])
-            k2, n2, o2 = _dedup_keys(cand, cand_valid, cap)
-            return (k2, n2, count, ovf | o2)
-
-        init = (keys, count, jnp.int32(-1), ovf)
+        init = (keys, count, jnp.bool_(True), ovf)
         keys, count, _, ovf = lax.while_loop(
             closure_cond, closure_body, init)
 
-        # Filter: the returner's linearization point must precede its
-        # return; then recycle its slot bit.
-        s_key_bit = jnp.uint32(1) << (b + s).astype(jnp.uint32)
-        cfg_valid = jnp.arange(cap) < count
-        keep = cfg_valid & ((keys & s_key_bit) != 0)
-        keys, count, o2 = _dedup_keys(
-            jnp.where(keep, keys & ~s_key_bit, 0), keep, cap)
-        dead = count == 0
-        return (r + 1, keys, count, dead, ovf | o2)
+        keys, count, dead = _filter_pass_keys(keys, count, ret_slot[r],
+                                              cap=cap, b=b)
+        return (r + 1, keys, count, dead, ovf)
 
     def row_cond(carry):
         r, _, _, dead, ovf = carry
@@ -297,6 +466,99 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
         (jnp.int32(0), keys0, count, False, False))
     out_bits, out_state = from_keys(keys, count)
     return out_bits, out_state, count, r, dead, ovf
+
+
+def _hostloop_rows(p, r0, keys, count, *, tables_h, b, nil_id, step_fn,
+                   read_value_match, cancel, caps=HOSTLOOP_CAP_SCHEDULE,
+                   dropback=HOSTLOOP_DROPBACK, min_rows=64):
+    """Host-driven spike executor: rows one at a time, each closure pass
+    ONE top-level device program. The nested-while chunk engine kernel-
+    faults this TPU runtime past cap 131072; the same pass logic
+    (_closure_pass_keys, shared) dispatched at top level is solid to
+    HOSTLOOP_CAP_SCHEDULE[-1], at the price of a few host syncs per row —
+    negligible against the sort cost at these frontier sizes, and this
+    path only runs while the frontier is actually spiking.
+
+    Processes rows from ``r0`` until death, cancel, overflow of the last
+    cap, history end, or — after at least ``min_rows`` rows, so dense
+    spike regions don't thrash between the two engines — the frontier
+    shrinking to ``dropback`` (hand back to the chunked engine at a row
+    boundary).
+    Returns (keys, count_int, next_row, dead, overflowed, cancelled,
+    dead_entry) — dead_entry is the dead row's ENTRY frontier
+    ``(keys, count_int)`` when dead (so a counterexample replay is
+    bounded to that single row), else None.
+    """
+    ret_slot_h, active_h, slot_f_h, slot_v_h, pure_h, pred_bit_h = tables_h
+    W = active_h.shape[1]
+    if keys.shape[0] < caps[0]:
+        keys = jnp.concatenate([keys, jnp.full(
+            caps[0] - keys.shape[0], KEY_FILL, jnp.uint32)])
+    cap = keys.shape[0]
+    cap_idx = caps.index(cap) if cap in caps else 0
+    count = jnp.int32(count)
+    r = r0
+    while r < p.R:
+        if cancel is not None and cancel.is_set():
+            return keys, int(count), r, False, False, True, None
+        act = jnp.asarray(active_h[r])
+        f_row = jnp.asarray(slot_f_h[r])
+        v_row = jnp.asarray(slot_v_h[r])
+        pure_row = jnp.asarray(pure_h[r])
+        pred_row = jnp.asarray(pred_bit_h[r, :, 0])
+        s = jnp.int32(int(ret_slot_h[r]))
+        entry = keys  # preserved: on overflow the row output is garbage
+        entry_count = int(count)
+        while True:
+            keys, count_d, dead, ovf = _row_jit(
+                entry, count, act, f_row, v_row, pure_row, pred_row, s,
+                cap=cap, W=W, b=b, nil_id=nil_id, step_fn=step_fn,
+                read_value_match=read_value_match)
+            if not bool(ovf):
+                count = count_d
+                break
+            if cap_idx + 1 >= len(caps):
+                return entry, int(count), r, False, True, False, None
+            cap_idx += 1
+            entry = jnp.concatenate([entry, jnp.full(
+                caps[cap_idx] - cap, KEY_FILL, jnp.uint32)])
+            cap = caps[cap_idx]
+        r += 1
+        if bool(dead):
+            return (keys, int(count), r, True, False, False,
+                    (entry, entry_count))
+        if r - r0 >= min_rows and int(count) <= dropback:
+            return keys, int(count), r, False, False, False, None
+    return keys, int(count), r, False, False, False, None
+
+
+def _entry_keys(bits, state, count, cap, b, nil_id):
+    """Pack a (bits, state) frontier into u32 keys padded to ``cap`` (for
+    handing a chunk-entry frontier to the spike executor)."""
+    from jepsen_tpu.models.kernels import NIL
+
+    n = bits.shape[0]
+    sv = state[:, 0]
+    ps = jnp.where(sv == NIL, nil_id, sv).astype(jnp.uint32)
+    keys = jnp.where(jnp.arange(n) < count, (bits[:, 0] << b) | ps,
+                     KEY_FILL)
+    if cap > n:
+        keys = jnp.concatenate(
+            [keys, jnp.full(cap - n, KEY_FILL, jnp.uint32)])
+    return keys[:cap]
+
+
+def _keys_to_bits_state(keys, count, cap, b, nil_id):
+    """Unpack sorted spike-executor keys back into (bits[cap,1],
+    state[cap,1]) for the chunked engine (count must fit cap)."""
+    from jepsen_tpu.models.kernels import NIL
+
+    k = keys[:cap]
+    live = jnp.arange(cap) < count
+    cfg = jnp.where(live, k, 0)
+    sv = (cfg & jnp.uint32((1 << b) - 1)).astype(jnp.int32)
+    state = jnp.where(live, jnp.where(sv == nil_id, NIL, sv), 0)
+    return (cfg >> b)[:, None], state[:, None]
 
 
 def _chunk_slice(a: np.ndarray, base: int, chunk: int) -> np.ndarray:
@@ -340,8 +602,9 @@ def _pad_rows(p: PackedHistory):
 
 
 def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
-                 chunk: int = CHUNK, cancel=None,
-                 explain: bool = False) -> dict:
+                 chunk: int = CHUNK, cancel=None, explain: bool = False,
+                 spike_caps=HOSTLOOP_CAP_SCHEDULE,
+                 spike_dropback: int = HOSTLOOP_DROPBACK) -> dict:
     """Decide linearizability of a packed history on device.
 
     Host loop over CHUNK-row device dispatches; the frontier carries
@@ -371,6 +634,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     slot_v_h = np.asarray(p.slot_v)
     S = p.init_state.shape[0]
     nw = (p.window + 31) // 32
+    pure_h, pred_bit_h = reduction_bit_tables(p, nw)
     step_fn = p.kernel.step
 
     # Single-u32-key dedup packing: possible when the one-word state's
@@ -380,12 +644,15 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     # single-value unordered-queue count) range past the intern table.
     from jepsen_tpu.models.kernels import PACKED_STATE_KERNELS
 
+    from jepsen_tpu.models.kernels import READ_VALUE_MATCH_KERNELS
+
     state_bits = nil_id = None
     if S == 1 and p.kernel.name in PACKED_STATE_KERNELS:
         nid = max(len(p.unintern), 2)
         b = nid.bit_length()
         if p.window + b <= 31:
             state_bits, nil_id = b, nid
+    read_value_match = p.kernel.name in READ_VALUE_MATCH_KERNELS
 
     level = 0
     cap = cap_schedule[level]
@@ -409,18 +676,55 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
         tables = (jnp.asarray(_chunk_slice(ret_slot_h, base, chunk)),
                   jnp.asarray(_chunk_slice(active_h, base, chunk)),
                   jnp.asarray(_chunk_slice(slot_f_h, base, chunk)),
-                  jnp.asarray(_chunk_slice(slot_v_h, base, chunk)))
+                  jnp.asarray(_chunk_slice(slot_v_h, base, chunk)),
+                  jnp.asarray(_chunk_slice(pure_h, base, chunk)),
+                  jnp.asarray(_chunk_slice(pred_bit_h, base, chunk)))
+        spiked = None
         while True:
             b2, s2, c2, r_done, dead, ovf = _search_chunk(
                 jnp.int32(n), *tables, bits, state, count,
                 cap=cap_schedule[level], step_fn=step_fn,
-                state_bits=state_bits, nil_id=nil_id)
+                state_bits=state_bits, nil_id=nil_id,
+                read_value_match=read_value_match)
             if not bool(ovf):
                 break
             if level + 1 >= len(cap_schedule):
-                return {"valid?": "unknown", "analyzer": "tpu-bfs",
-                        "error": ("frontier exceeded capacity "
-                                  f"{cap_schedule[-1]}")}
+                if state_bits is None:
+                    # Multi-word configs have no spike executor (yet):
+                    # honest unknown, competition falls back to the host.
+                    return {"valid?": "unknown", "analyzer": "tpu-bfs",
+                            "error": ("frontier exceeded capacity "
+                                      f"{cap_schedule[-1]}")}
+                # Recover the frontier just before the spike row with ONE
+                # re-run of the rows that did fit (the failed run's
+                # r_done-1), so the spike executor starts at the spike,
+                # not at chunk entry.
+                n_pre = int(r_done) - 1
+                if n_pre > 0:
+                    b2, s2, c2, _, _, o_pre = _search_chunk(
+                        jnp.int32(n_pre), *tables, bits, state, count,
+                        cap=cap_schedule[level], step_fn=step_fn,
+                        state_bits=state_bits, nil_id=nil_id,
+                        read_value_match=read_value_match)
+                    if not bool(o_pre):
+                        bits, state, count = b2, s2, c2
+                    else:
+                        n_pre = 0  # extremely rare: spike at first row
+                spiked = _hostloop_rows(
+                    p, base + n_pre,
+                    _entry_keys(bits, state, count, spike_caps[0],
+                                state_bits, nil_id),
+                    count, tables_h=(ret_slot_h, active_h, slot_f_h,
+                                     slot_v_h, pure_h, pred_bit_h),
+                    b=state_bits, nil_id=nil_id, step_fn=step_fn,
+                    read_value_match=read_value_match, cancel=cancel,
+                    caps=spike_caps,
+                    # Clamped so the handed-back frontier always fits the
+                    # chunked engine's top cap — a larger count would be
+                    # silently truncated by _keys_to_bits_state and could
+                    # flip the verdict.
+                    dropback=min(spike_dropback, cap_schedule[-1]))
+                break
             # Retry this chunk from its entry frontier at the next cap.
             level += 1
             cap = cap_schedule[level]
@@ -428,6 +732,49 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             grow = cap - bits.shape[0]
             bits = jnp.pad(bits, ((0, grow), (0, 0)))
             state = jnp.pad(state, ((0, grow), (0, 0)))
+        if spiked is not None:
+            (keys, count_i, next_r, dead_h, ovf_h, cancelled,
+             dead_entry) = spiked
+            max_cap_used = max(max_cap_used, keys.shape[0])
+            if cancelled:
+                return {"valid?": "unknown", "analyzer": "tpu-bfs",
+                        "error": "cancelled"}
+            if ovf_h:
+                return {"valid?": "unknown", "analyzer": "tpu-bfs",
+                        "error": ("frontier exceeded capacity "
+                                  f"{spike_caps[-1]}")}
+            if dead_h:
+                r_done = jnp.int32(next_r - base)
+                dead = True
+                if snapshots is not None and dead_entry is not None:
+                    # Re-anchor the counterexample replay at the dead
+                    # row's ENTRY frontier so the plain CPU replay is one
+                    # row, not the whole spike region it could never
+                    # traverse.
+                    e_keys, e_count = dead_entry
+                    e_bits, e_state = _keys_to_bits_state(
+                        e_keys, e_count, e_keys.shape[0], state_bits,
+                        nil_id)
+                    snapshots[:] = [(next_r - 1, e_bits, e_state,
+                                     e_count)]
+            elif next_r >= p.R:
+                return {"valid?": True, "analyzer": "tpu-bfs",
+                        "configs": [], "final-frontier-size": count_i,
+                        "max-cap": max_cap_used}
+            else:
+                # Resume the chunked engine at the hand-back row with the
+                # (shrunken) spike frontier — at the TOP chunked level:
+                # the neighbourhood of a spike tends to spike again, and
+                # re-climbing the whole cap ladder there costs far more
+                # than one over-provisioned chunk. The shrink logic below
+                # drops the level back once chunks run clean.
+                level = len(cap_schedule) - 1
+                cap = cap_schedule[level]
+                bits, state = _keys_to_bits_state(
+                    keys, count_i, cap, state_bits, nil_id)
+                count = jnp.int32(count_i)
+                base = next_r
+                continue
         if bool(dead):
             r = base + int(r_done) - 1
             ret = p.ops[int(p.ret_op[r])]

@@ -113,21 +113,50 @@ class Cancelled(Exception):
 
 
 def search_rows(p: PackedHistory, configs, order, r0: int, r1: int,
-                cancel=None):
+                cancel=None, reduce: bool = False):
     """The just-in-time linearization closure over return events
     [r0, r1): from ``configs`` (a set of (bits, state-tuple)), closure +
     filter each row. Returns (configs, order) on survival; raises Dead at
     the row where the frontier empties, Cancelled on a race cancel.
     ``order`` (or None to skip witness tracking) maps config -> cons list
-    of op ids, shared-structure, anchored wherever the caller started."""
+    of op ids, shared-structure, anchored wherever the caller started.
+
+    ``reduce=True`` applies the exact search-space reductions of
+    :func:`jepsen_tpu.lin.prepare.reduction_tables` (pure-op saturation +
+    canonical chains). Verdict and death row are provably identical to the
+    plain search (and parity-fuzzed so); the surviving config SETS differ
+    (reduced keeps canonical representatives), so witness tracking
+    requires ``reduce=False``."""
+    if reduce and order is not None:
+        raise ValueError("witness tracking requires the unreduced search")
     step = py_step_fn(p.kernel.name)
     window = p.window
+    if reduce:
+        from jepsen_tpu.lin.prepare import reduction_tables
+
+        pure_tbl, pred_tbl = reduction_tables(p)
     for r in range(r0, r1):
         if cancel is not None and cancel.is_set():
             raise Cancelled
         act = p.active[r]
         f_ints = p.slot_f[r].tolist()
         v_tups = [tuple(row) for row in p.slot_v[r].tolist()]
+        if reduce:
+            pure_r = pure_tbl[r]
+            pred_r = pred_tbl[r].tolist()
+            pure_mask = 0
+            for j in range(window):
+                if pure_r[j]:
+                    pure_mask |= 1 << j
+
+            def saturate(bits, st):
+                for j in range(window):
+                    if (pure_mask >> j) & 1 and not (bits >> j) & 1 \
+                            and step(st, f_ints[j], v_tups[j])[0]:
+                        bits |= 1 << j
+                return bits
+
+            configs = {(saturate(b, st), st) for b, st in configs}
         seen = set(configs)
         frontier = list(configs)
         while frontier:
@@ -143,9 +172,16 @@ def search_rows(p: PackedHistory, configs, order, r0: int, r1: int,
                 bits, st = cfg
                 for j in range(window):
                     if act[j] and not (bits >> j) & 1:
+                        if reduce and ((pure_mask >> j) & 1 or
+                                       (pred_r[j] >= 0 and
+                                        not (bits >> pred_r[j]) & 1)):
+                            continue
                         ok, st2 = step(st, f_ints[j], v_tups[j])
                         if ok:
-                            c2 = (bits | (1 << j), st2)
+                            b2 = bits | (1 << j)
+                            if reduce:
+                                b2 = saturate(b2, st2)
+                            c2 = (b2, st2)
                             if c2 not in seen:
                                 seen.add(c2)
                                 new.append(c2)
@@ -191,7 +227,7 @@ def check_packed(p: PackedHistory, witness: bool = False,
     order: dict | None = {init: None} if witness else None
     try:
         configs, order = search_rows(p, configs, order, 0, p.R,
-                                     cancel=cancel)
+                                     cancel=cancel, reduce=not witness)
     except Cancelled:
         return {"valid?": "unknown", "analyzer": "cpu-jit",
                 "error": "cancelled"}

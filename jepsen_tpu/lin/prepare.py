@@ -442,6 +442,98 @@ def prepare(model, history, max_window: int = MAX_WINDOW) -> PackedHistory:
         crashed_ops=crashed)
 
 
+# --- search-space reductions -------------------------------------------------
+#
+# Two exact (verdict- and death-row-preserving) reductions of the frontier
+# search, consumed by the CPU oracle and the sparse device engine. Both are
+# new to this build — knossos has no analogue; they are what lets the sparse
+# band (windows 21..64, e.g. cockroach's concurrency-30 registers,
+# cockroach.clj:40-41) stay tractable where the JVM search DNFs.
+#
+# 1. **Pure-op saturation.** A pure op (one whose step never changes state:
+#    register/set reads) need not branch the search. Its linearization
+#    point can be ANY moment its legality predicate holds between invoke
+#    and return, so the search just marks its bit the first moment the
+#    config's state matches ("greedy read linearization"). Soundness: read
+#    bits are only ever tested positively at the op's return and never
+#    affect other transitions, so greedily setting them dominates; any
+#    plain survivor maps to a greedy survivor of the same row and vice
+#    versa. This removes pure ops from the exponential branching entirely.
+#
+# 2. **Canonical chains.** Two concurrently-pending identical live ops
+#    (same f, same value — e.g. two pending write(3)s, two mutex acquires)
+#    are exchangeable: swapping their linearization points yields another
+#    valid linearization (both intervals cover both points while both are
+#    pending, and the earlier-returning op's interval is the binding one).
+#    So the search may WLOG linearize them in return order: slot j with an
+#    active unlinearized identical sibling that returns earlier is blocked
+#    until the sibling's bit is set. Crashed ops never chain (they have no
+#    return to order by, and chaining them to live ops would force
+#    linearizing an op that may never have happened).
+#
+# Config counts on a 2k-op concurrency-30 register history (window 28):
+# plain search >170k configs by row 40 (DNF); with both reductions the
+# peak frontier is ~20k and the whole history closes.
+
+
+def reduction_tables(p: PackedHistory) -> tuple[np.ndarray, np.ndarray]:
+    """Per-row reduction tables ``(pure, pred)`` for a packed history.
+
+    pure: bool[R, W] — active slot holds a pure (state-preserving) op.
+    pred: i32[R, W]  — canonical-chain predecessor slot (-1 when none):
+    slot j may linearize in row r only once ``pred[r, j]``'s bit is set.
+    Cached on the PackedHistory after first computation.
+    """
+    cached = getattr(p, "_reduction_tables", None)
+    if cached is not None:
+        return cached
+
+    R, W = p.active.shape
+    if p.kernel is None or R == 0:
+        out = (np.zeros((R, W), bool), np.full((R, W), -1, np.int32))
+        p._reduction_tables = out
+        return out
+
+    pure_fs = {int(K.F_IDS[f]) for f in ("read",)
+               if f in K.F_IDS}
+    pure = p.active & np.isin(p.slot_f, list(pure_fs))
+
+    # Return row per slot occurrence: the row at which this slot's op
+    # returns; crashed ops get a sentinel past any row (they never chain).
+    NEVER = np.int32(R + 1)
+    ret_row_of_op = np.full(len(p.ops), NEVER, np.int64)
+    ret_row_of_op[np.asarray(p.ret_op)] = np.arange(R)
+    slot_ret = np.where(p.slot_op >= 0,
+                        ret_row_of_op[np.clip(p.slot_op, 0, None)], NEVER)
+
+    # Chainable = active, live (returns), not pure. Identical class key =
+    # (f, value words); inert slots get a unique sentinel class so they
+    # never match anything.
+    chainable = p.active & (slot_ret < NEVER) & ~pure
+    sent = -1 - np.arange(W, dtype=np.int64)          # unique per column
+    f_key = np.where(chainable, p.slot_f.astype(np.int64), sent[None, :])
+    v_keys = [p.slot_v[:, :, k].astype(np.int64)
+              for k in range(p.slot_v.shape[2])]
+
+    # Row-wise canonical order: sort slots by (class, return row); equal
+    # classes become adjacent runs ordered by return.
+    order = np.lexsort(tuple([slot_ret] + v_keys[::-1] + [f_key]), axis=1)
+    rows = np.arange(R)[:, None]
+    f_s = np.take_along_axis(f_key, order, axis=1)
+    same = f_s[:, 1:] == f_s[:, :-1]
+    for vk in v_keys:
+        v_s = np.take_along_axis(vk, order, axis=1)
+        same &= v_s[:, 1:] == v_s[:, :-1]
+    pred = np.full((R, W), -1, np.int32)
+    cols = order[:, 1:]
+    prev = order[:, :-1]
+    np.put_along_axis(
+        pred, cols, np.where(same, prev, -1).astype(np.int32), axis=1)
+    out = (pure, pred)
+    p._reduction_tables = out
+    return out
+
+
 # --- pure-python packed step (mirror of models.kernels, for the CPU
 # reference checker's inner loop and witness replay) -------------------------
 

@@ -89,14 +89,18 @@ def _global_dedup(bits, state, valid, cap_local, axis):
 
 @partial(jax.jit, static_argnames=("cap_local", "step_fn", "mesh",
                                    "axis"))
-def _search_sharded(ret_slot, active, slot_f, slot_v, init_state, *,
-                    cap_local, step_fn, mesh, axis="d"):
+def _search_sharded(ret_slot, active, slot_f, slot_v, pure, pred_mask,
+                    init_state, *, cap_local, step_fn, mesh, axis="d"):
     """shard_map-ped full search. Frontier sharded over `axis`; row tables
-    replicated. Returns replicated (ok, dead_row, overflow, total)."""
+    replicated — including the reduction tables (prepare.reduction_tables):
+    pure[R,W] slots saturate instead of branching, pred_mask[R,W] gates
+    canonical-chain expansion. Returns replicated
+    (ok, dead_row, overflow, total)."""
     R, W = active.shape
     S = init_state.shape[0]
 
-    def shard_body(ret_slot, active, slot_f, slot_v, init_state):
+    def shard_body(ret_slot, active, slot_f, slot_v, pure, pred_mask,
+                   init_state):
         d = lax.axis_index(axis)
         slot_bit = (jnp.uint32(1) << jnp.arange(W, dtype=jnp.uint32))
 
@@ -110,22 +114,37 @@ def _search_sharded(ret_slot, active, slot_f, slot_v, init_state, *,
             in_axes=(0, None, None))
 
         def closure_cond(c):
-            _, _, _, total, prev, ovf = c
-            return (total != prev) & ~ovf
+            _, _, _, _, changed, ovf = c
+            return changed & ~ovf
 
         def row_body(carry):
             r, bits, state, count, total, dead, ovf = carry
             act = active[r]
             f_row = slot_f[r]
             v_row = slot_v[r]
+            pure_row = pure[r]
+            pred_row = pred_mask[r]
             s = ret_slot[r]
 
             def closure_body(c):
-                bits, state, count, total, prev, ovf = c
+                bits_in, state, count, total, _, ovf = c
                 cfg_valid = jnp.arange(cap_local) < count
                 ok, new_state = step_cfg_slot(state, f_row, v_row)
-                already = (bits[:, None] & slot_bit[None, :]) != 0
-                legal = ok & act[None, :] & ~already & cfg_valid[:, None]
+                already = (bits_in[:, None] & slot_bit[None, :]) != 0
+                fresh = ok & act[None, :] & ~already & cfg_valid[:, None]
+                # Saturation: absorb legal pure bits in place (local —
+                # the config's slice assignment may move at dedup, but
+                # the global multiset is what matters). Statically
+                # unrolled OR, not a vector reduce (TPU-runtime hazard,
+                # see bfs.py).
+                sat = jnp.zeros_like(bits_in)
+                for j in range(W):
+                    sat = sat | jnp.where(fresh[:, j] & pure_row[j],
+                                          slot_bit[j], jnp.uint32(0))
+                bits = jnp.where(cfg_valid, bits_in | sat, bits_in)
+                chain_ok = (bits[:, None] & pred_row[None, :]) == \
+                    pred_row[None, :]
+                legal = fresh & ~pure_row[None, :] & chain_ok
                 new_bits = bits[:, None] | slot_bit[None, :]
 
                 cand_bits = jnp.concatenate([bits, new_bits.reshape(-1)])
@@ -135,9 +154,15 @@ def _search_sharded(ret_slot, active, slot_f, slot_v, init_state, *,
 
                 b2, s2, n2, tot2, o2 = _global_dedup(
                     cand_bits, cand_state, cand_valid, cap_local, axis)
-                return (b2, s2, n2, tot2, total, ovf | o2)
+                # Fixpoint test is against the pass INPUT (the stable set
+                # keeps both a config and its saturated twin; see
+                # bfs._search_chunk_keys.closure_body).
+                changed = jnp.any(b2 != bits_in) | jnp.any(s2 != state) | \
+                    (tot2 != total)
+                changed = lax.psum(changed.astype(jnp.int32), axis) > 0
+                return (b2, s2, n2, tot2, changed, ovf | o2)
 
-            init = (bits, state, count, total, jnp.int32(-1), ovf)
+            init = (bits, state, count, total, jnp.bool_(True), ovf)
             bits, state, count, total, _, ovf = lax.while_loop(
                 closure_cond, closure_body, init)
 
@@ -166,11 +191,11 @@ def _search_sharded(ret_slot, active, slot_f, slot_v, init_state, *,
     # frontier shard, via axis_index) with replicated control scalars
     # (total/dead/overflow from all_gather'ed reductions).
     fn = shard_map(shard_body, mesh=mesh,
-                   in_specs=(P(), P(), P(), P(), P()),
+                   in_specs=(P(), P(), P(), P(), P(), P(), P()),
                    out_specs=(P(axis), P(axis), P(axis), P(axis)),
                    check_vma=False)
     ok, dead_row, ovf, total = fn(ret_slot, active, slot_f, slot_v,
-                                  init_state)
+                                  pure, pred_mask, init_state)
     return ok[0], dead_row[0], ovf[0], total[0]
 
 
@@ -220,8 +245,17 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
     axis = mesh.axis_names[0]
 
     ret_slot_h, active_h, slot_f_h, slot_v_h = _pad_rows(p)
+    from jepsen_tpu.lin.bfs import reduction_bit_tables
+
+    pure_k, pred_bit_k = reduction_bit_tables(p, 1)
+    R, W = p.active.shape
+    pure_h = np.zeros(active_h.shape, bool)
+    pure_h[:R, :W] = pure_k
+    pred_mask_h = np.zeros(active_h.shape, np.uint32)
+    pred_mask_h[:R, :W] = pred_bit_k[:, :, 0]
     args = (jnp.asarray(ret_slot_h), jnp.asarray(active_h),
             jnp.asarray(slot_f_h), jnp.asarray(slot_v_h),
+            jnp.asarray(pure_h), jnp.asarray(pred_mask_h),
             jnp.asarray(p.init_state))
 
     for cap in cap_schedule:

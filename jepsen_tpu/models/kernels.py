@@ -55,6 +55,14 @@ NIL = np.int32(-(2 ** 31))
 # (lin/bfs.py). Keep the two engines' routing in sync via this constant.
 PACKED_STATE_KERNELS = ("cas-register", "register", "mutex")
 
+# Kernels whose F_READ legality is EXACTLY "v == NIL or v == state[0]"
+# (see _cas_register_step/_register_step). The sparse engine's pure-op
+# saturation fast path (lin/bfs.py _closure_pass_keys) bakes this
+# predicate into a per-state table; a kernel listed here with different
+# read semantics would make that path unsound — keep the definition next
+# to the step functions it mirrors.
+READ_VALUE_MATCH_KERNELS = ("cas-register", "register")
+
 # Max value words per op: cas carries [cur, new]; everything else uses v[0].
 VALUE_WIDTH = 2
 

@@ -60,11 +60,24 @@ class TestBasics:
         assert both(m.cas_register(), h, cap_schedule=(1, 4096))
 
     def test_overflow_returns_unknown(self):
+        # With the spike executor's caps also exhausted, overflow is an
+        # honest unknown (never a truncated-frontier verdict).
         h = synth.generate_register_history(30, concurrency=5, seed=1,
                                             crash_prob=0.3)
         p = prepare.prepare(m.cas_register(), h)
-        r = bfs.check_packed(p, cap_schedule=(1,))
+        r = bfs.check_packed(p, cap_schedule=(1,), spike_caps=(2,))
         assert r["valid?"] == "unknown"
+        assert "exceeded" in r["error"]
+
+    def test_overflow_spills_to_spike_executor(self):
+        # Chunked caps exhausted -> the host-driven spike executor picks
+        # the search up at bigger caps and still decides.
+        h = synth.generate_register_history(30, concurrency=5, seed=1,
+                                            crash_prob=0.3)
+        p = prepare.prepare(m.cas_register(), h)
+        want = cpu.check_packed(p)["valid?"]
+        r = bfs.check_packed(p, cap_schedule=(1,), spike_caps=(512, 4096))
+        assert r["valid?"] == want
 
 
 @pytest.mark.parametrize("seed", range(15))

@@ -1,0 +1,146 @@
+"""Search-space reduction tests: pure-op saturation + canonical chains.
+
+The reductions (prepare.reduction_tables; engines consume them via
+bfs.reduction_bit_tables) are EXACT: verdict and death row must match the
+plain search on every history. The plain CPU search is the spec; the
+reduced CPU search is fuzzed against it here, and the device engines
+(which always run reduced) are fuzzed against the reduced CPU oracle in
+their own test files. These reductions are what make the wide-window band
+(windows 21..64, e.g. cockroach's concurrency-30 registers,
+cockroachdb/src/jepsen/cockroach.clj:40-41) tractable where the
+reference's knossos search DNFs.
+"""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu.history import History, invoke_op, ok_op
+from jepsen_tpu.lin import bfs, cpu, prepare, synth
+from jepsen_tpu.models.kernels import F_READ
+
+
+def verdict(p, reduce):
+    init = (0, tuple(int(x) for x in p.init_state))
+    try:
+        cpu.search_rows(p, {init}, None, 0, p.R, reduce=reduce)
+        return (True, None)
+    except cpu.Dead as d:
+        return (False, d.r)
+
+
+class TestReductionTables:
+    def test_pure_marks_reads_only(self):
+        h = synth.generate_register_history(60, concurrency=4, seed=0,
+                                            value_range=3, crash_prob=0.1)
+        p = prepare.prepare(m.cas_register(), h)
+        pure, pred = prepare.reduction_tables(p)
+        assert pure.shape == p.active.shape
+        # Pure exactly where an active slot holds a read.
+        want = p.active & (p.slot_f == F_READ)
+        assert (pure == want).all()
+
+    def test_pred_chains_identical_live_ops_by_return(self):
+        h = synth.generate_register_history(80, concurrency=6, seed=3,
+                                            value_range=2, crash_prob=0.1)
+        p = prepare.prepare(m.cas_register(), h)
+        pure, pred = prepare.reduction_tables(p)
+        ret_row = {int(p.ret_op[r]): r for r in range(p.R)}
+        chained = 0
+        for r in range(p.R):
+            for j in range(p.window):
+                q = pred[r, j]
+                if q < 0:
+                    continue
+                chained += 1
+                # Both ends active, same (f, value), both live, and the
+                # predecessor returns strictly earlier.
+                assert p.active[r, j] and p.active[r, q]
+                assert p.slot_f[r, j] == p.slot_f[r, q]
+                assert (p.slot_v[r, j] == p.slot_v[r, q]).all()
+                oj, oq = int(p.slot_op[r, j]), int(p.slot_op[r, q])
+                assert oj in ret_row and oq in ret_row
+                assert ret_row[oq] < ret_row[oj]
+                # Neither end is pure or crashed.
+                assert not pure[r, j] and not pure[r, q]
+                assert not p.crashed[r, j] and not p.crashed[r, q]
+        assert chained > 0  # value_range=2 must produce identical ops
+
+    def test_crashed_ops_never_chain(self):
+        h = synth.generate_register_history(80, concurrency=5, seed=1,
+                                            value_range=1, crash_prob=0.3)
+        p = prepare.prepare(m.cas_register(), h)
+        _, pred = prepare.reduction_tables(p)
+        for r in range(p.R):
+            for j in range(p.window):
+                if pred[r, j] >= 0:
+                    assert not p.crashed[r, pred[r, j]]
+                    assert not p.crashed[r, j]
+
+    def test_cached_on_packed_history(self):
+        h = synth.generate_register_history(30, concurrency=3, seed=0)
+        p = prepare.prepare(m.cas_register(), h)
+        a = prepare.reduction_tables(p)
+        b = prepare.reduction_tables(p)
+        assert a[0] is b[0] and a[1] is b[1]
+
+
+class TestReducedCpuExactness:
+    """Verdict AND death row of the reduced search == plain search."""
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_register_fuzz(self, seed):
+        h = synth.generate_register_history(50, concurrency=5, seed=seed,
+                                            value_range=3, crash_prob=0.1)
+        for hh in (h, synth.corrupt_history(h, seed=seed)):
+            p = prepare.prepare(m.cas_register(), hh)
+            assert verdict(p, False) == verdict(p, True)
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_mutex_fuzz(self, seed):
+        h = synth.generate_mutex_history(40, concurrency=4, seed=seed,
+                                         crash_prob=0.1)
+        for hh in (h, synth.corrupt_history(h, seed=seed)):
+            p = prepare.prepare(m.mutex(), hh)
+            assert verdict(p, False) == verdict(p, True)
+
+    def test_read_saturation_filters_at_return(self):
+        # A read of a value never written must still die at its return.
+        h = History.of(
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(1, "read", None), ok_op(1, "read", 2))
+        p = prepare.prepare(m.cas_register(), h)
+        assert verdict(p, True) == (False, 1)
+
+    def test_witness_requires_unreduced(self):
+        h = synth.generate_register_history(20, concurrency=3, seed=0)
+        p = prepare.prepare(m.cas_register(), h)
+        init = (0, tuple(int(x) for x in p.init_state))
+        with pytest.raises(ValueError):
+            cpu.search_rows(p, {init}, {init: None}, 0, p.R, reduce=True)
+
+
+class TestWideWindowDevice:
+    """The reduction payoff: windows past the dense bound decide on
+    device where the plain frontier would drown the cap schedule."""
+
+    def test_concurrency_16_register_decides(self):
+        h = synth.generate_register_history(300, concurrency=16, seed=5,
+                                            value_range=4,
+                                            crash_prob=0.01, max_crashes=3)
+        p = prepare.prepare(m.cas_register(), h)
+        r = bfs.check_packed(p)
+        assert r["valid?"] is cpu.check_packed(p)["valid?"] is True
+
+    def test_spike_executor_death_row_matches_oracle(self):
+        h = synth.corrupt_history(
+            synth.generate_register_history(120, concurrency=8, seed=2,
+                                            value_range=3,
+                                            crash_prob=0.05), seed=2)
+        p = prepare.prepare(m.cas_register(), h)
+        want = cpu.check_packed(p)
+        got = bfs.check_packed(p, cap_schedule=(2,),
+                               spike_caps=(1024, 16384), spike_dropback=2)
+        assert got["valid?"] == want["valid?"]
+        if want["valid?"] is False:
+            assert got["op"] == want["op"]
