@@ -64,8 +64,44 @@ def _check_timed(history, n_ops):
         "verdict": r["valid?"], "analyzer": r.get("analyzer")}
 
 
+def _wide_window_probe(detail: dict) -> None:
+    """Secondary capability probe: a window-26 concurrency-30 register
+    history — the class where list-based searches (and the reference's
+    knossos, per BASELINE config 5's concurrency, cockroach.clj:40-41)
+    DNF outright. Decided by the sparse engine's exact reductions + the
+    spike executor. Never fails the bench; records timing or the error.
+    Skippable via JEPSEN_TPU_BENCH_WIDE=0."""
+    import os
+    import time
+    import traceback
+
+    if os.environ.get("JEPSEN_TPU_BENCH_WIDE", "1") == "0":
+        return
+    try:
+        from jepsen_tpu import models as m
+        from jepsen_tpu.lin import device_check_packed, prepare, synth
+
+        h = synth.generate_register_history(
+            500, concurrency=30, seed=7, value_range=5,
+            crash_prob=0.002, max_crashes=4)
+        p = prepare.prepare(m.cas_register(), h)
+        t0 = time.time()
+        r = device_check_packed(p)
+        detail["wide_window_c30"] = {
+            "n_ops": 500, "window": p.window,
+            "verdict": r.get("valid?"),
+            "analyzer": r.get("analyzer"),
+            "seconds": round(time.time() - t0, 1)}
+    except Exception:
+        detail["wide_window_c30"] = {
+            "error": traceback.format_exc(limit=2)}
+
+
 def main() -> None:
     from jepsen_tpu.lin import synth
+    from jepsen_tpu.util import enable_compile_cache
+
+    enable_compile_cache()
 
     target_rate = N_OPS / TARGET_SECONDS
     out = {"metric": "lin_check_ops_per_sec", "value": 0,
@@ -79,6 +115,7 @@ def main() -> None:
         out.update(value=round(rate, 1),
                    vs_baseline=round(rate / target_rate, 3),
                    detail=detail)
+        _wide_window_probe(detail)
     except Exception:
         err = traceback.format_exc(limit=3)
         # Partial signal: the crash-free 100k history on the same engine.

@@ -212,6 +212,9 @@ def analyze_cmd() -> dict:
 def run(commands, argv=None) -> int:
     """Dispatch subcommands (cli.clj:201-276). Returns the exit code; the
     `main` wrapper calls sys.exit with it."""
+    from jepsen_tpu.util import enable_compile_cache
+
+    enable_compile_cache()
     if isinstance(commands, dict) and "name" in commands:
         commands = [commands]
     parser = argparse.ArgumentParser(prog="jepsen-tpu")

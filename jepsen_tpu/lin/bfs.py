@@ -29,13 +29,16 @@ This engine is the wide-window fallback: histories whose window and state
 count fit the dense config-space bitmap (:mod:`jepsen_tpu.lin.dense`,
 window <= 20 and <= 32 states) are routed there instead
 (`jepsen_tpu.lin.device_check_packed`), which absorbs crash-heavy
-histories for free. Crash-heavy histories OUTSIDE the dense bounds —
-windows 21..64 or value-rich registers past 32 states — can legitimately
-grow the sparse frontier by 2^crashes; the cap schedule bounds that
-honestly ("unknown" at exhaustion, CPU fallback via competition) rather
-than pruning: the round-1 dominance-pruning join that targeted this slice
-kernel-faulted the TPU runtime on its own flagship workload and was
-removed.
+histories for free. For the band outside the dense bounds — windows
+21..64, value-rich registers, set/queue states — two EXACT search-space
+reductions keep the frontier tractable (prepare.reduction_tables:
+pure-op saturation and canonical chains; knossos has neither), and
+frontier spikes past the chunked engine's largest runtime-safe capacity
+hand off to a host-driven spike executor (_hostloop_rows /
+_hostloop_rows_mw) that runs each return event as one top-level device
+program with capacity up to ~1M configs. Only when even that overflows
+does the verdict become an honest "unknown" (competition then falls
+back to the host search).
 """
 
 from __future__ import annotations
@@ -187,13 +190,7 @@ def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v, pure, pred_bit,
             state_bits=state_bits, nil_id=nil_id,
             read_value_match=read_value_match)
     C, W = active.shape
-    S = state.shape[1]
     nw = bits.shape[1]
-
-    step_cfg_slot = jax.vmap(
-        jax.vmap(step_fn, in_axes=(None, 0, 0)),
-        in_axes=(0, None, None))
-    slot_bit = _slot_bits(W, nw)                       # [W, NW]
 
     def closure_cond(c):
         _, _, _, changed, ovf = c
@@ -206,61 +203,21 @@ def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v, pure, pred_bit,
         v_row = slot_v[r]
         pure_row = pure[r]                             # [W]
         pred_row = pred_bit[r]                         # [W, NW]
-        s = ret_slot[r]
 
         def closure_body(c):
             bits_in, state, count, _, ovf = c
-            cfg_valid = jnp.arange(cap) < count
-            ok, new_state = step_cfg_slot(state, f_row, v_row)
-            already = jnp.any(
-                (bits_in[:, None, :] & slot_bit[None, :, :]) != 0, axis=-1)
-            fresh = ok & act[None, :] & ~already & cfg_valid[:, None]
-            # Saturation: carried configs absorb every legal pure bit in
-            # place (new configs pick theirs up next pass, when carried).
-            # Statically unrolled OR per slot, not a vector reduce:
-            # axis-reductions inside the nested while loops kernel-fault
-            # this TPU runtime.
-            sat_w = [jnp.zeros(cap, jnp.uint32) for _ in range(nw)]
-            for j in range(W):
-                cond = fresh[:, j] & pure_row[j]
-                sat_w[j // 32] = sat_w[j // 32] | jnp.where(
-                    cond, jnp.uint32(1) << (j % 32), jnp.uint32(0))
-            sat = jnp.stack(sat_w, axis=1)             # [cap, NW]
-            bits = jnp.where(cfg_valid[:, None], bits_in | sat, bits_in)
-            # Expansion: non-pure slots only, gated by the canonical chain.
-            chain_ok = jnp.all(
-                (bits[:, None, :] & pred_row[None, :, :]) == pred_row,
-                axis=-1)
-            legal = fresh & ~pure_row[None, :] & chain_ok
-            new_bits = bits[:, None, :] | slot_bit[None, :, :]
-
-            cand_bits = jnp.concatenate(
-                [bits, new_bits.reshape(-1, nw)])
-            cand_state = jnp.concatenate(
-                [state, new_state.reshape(-1, S)], axis=0)
-            cand_valid = jnp.concatenate([cfg_valid, legal.reshape(-1)])
-
-            b2, s2, n2, o2 = _dedup(cand_bits, cand_state, cand_valid, cap)
-            # Fixpoint test is against the pass INPUT (the stable set
-            # keeps both a config and its saturated twin; see
-            # _search_chunk_keys.closure_body).
-            changed = jnp.any(b2 != bits_in) | jnp.any(s2 != state) | \
-                (n2 != count)
+            b2, s2, n2, changed, o2 = _closure_pass_mw(
+                bits_in, state, count, act, f_row, v_row, pure_row,
+                pred_row, cap=cap, W=W, nw=nw, step_fn=step_fn)
             return (b2, s2, n2, changed, ovf | o2)
 
         init = (bits, state, count, jnp.bool_(True), ovf)
         bits, state, count, _, ovf = lax.while_loop(
             closure_cond, closure_body, init)
 
-        # Filter: the returning op's linearization point must precede its
-        # return; then recycle its slot bit.
-        s_mask = slot_bit[s]                           # [NW]
-        cfg_valid = jnp.arange(cap) < count
-        keep = cfg_valid & jnp.any((bits & s_mask[None, :]) != 0, axis=-1)
-        bits = bits & ~s_mask[None, :]
-        bits, state, count, o2 = _dedup(bits, state, keep, cap)
-        dead = count == 0
-        return (r + 1, bits, state, count, dead, ovf | o2)
+        bits, state, count, dead = _filter_pass_mw(
+            bits, state, count, ret_slot[r], cap=cap, W=W, nw=nw)
+        return (r + 1, bits, state, count, dead, ovf)
 
     def row_cond(carry):
         r, _, _, _, dead, ovf = carry
@@ -270,6 +227,94 @@ def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v, pure, pred_bit,
         row_cond, row_body,
         (jnp.int32(0), bits, state, count, False, False))
     return bits, state, count, r, dead, ovf
+
+
+def _closure_pass_mw(bits_in, state, count, act, f_row, v_row, pure_row,
+                     pred_row, *, cap, W, nw, step_fn):
+    """ONE closure pass over multi-word configs (bits u32[cap,NW] +
+    state i32[cap,S]); the multiword twin of _closure_pass_keys, shared
+    by the chunked engine and the multiword spike executor.
+    Returns (bits, state, count, changed, overflow)."""
+    S = state.shape[1]
+    slot_bit = _slot_bits(W, nw)                       # [W, NW]
+    step_cfg_slot = jax.vmap(
+        jax.vmap(step_fn, in_axes=(None, 0, 0)),
+        in_axes=(0, None, None))
+
+    cfg_valid = jnp.arange(cap) < count
+    ok, new_state = step_cfg_slot(state, f_row, v_row)
+    already = jnp.any(
+        (bits_in[:, None, :] & slot_bit[None, :, :]) != 0, axis=-1)
+    fresh = ok & act[None, :] & ~already & cfg_valid[:, None]
+    # Saturation: carried configs absorb every legal pure bit in place
+    # (new configs pick theirs up next pass, when carried). Statically
+    # unrolled OR per slot, not a vector reduce: axis-reductions inside
+    # the nested while loops kernel-fault this TPU runtime.
+    sat_w = [jnp.zeros(cap, jnp.uint32) for _ in range(nw)]
+    for j in range(W):
+        cond = fresh[:, j] & pure_row[j]
+        sat_w[j // 32] = sat_w[j // 32] | jnp.where(
+            cond, jnp.uint32(1) << (j % 32), jnp.uint32(0))
+    sat = jnp.stack(sat_w, axis=1)                     # [cap, NW]
+    bits = jnp.where(cfg_valid[:, None], bits_in | sat, bits_in)
+    # Expansion: non-pure slots only, gated by the canonical chain.
+    chain_ok = jnp.all(
+        (bits[:, None, :] & pred_row[None, :, :]) == pred_row,
+        axis=-1)
+    legal = fresh & ~pure_row[None, :] & chain_ok
+    new_bits = bits[:, None, :] | slot_bit[None, :, :]
+
+    cand_bits = jnp.concatenate([bits, new_bits.reshape(-1, nw)])
+    cand_state = jnp.concatenate(
+        [state, new_state.reshape(-1, S)], axis=0)
+    cand_valid = jnp.concatenate([cfg_valid, legal.reshape(-1)])
+
+    b2, s2, n2, o2 = _dedup(cand_bits, cand_state, cand_valid, cap)
+    # Fixpoint test is against the pass INPUT (the stable set keeps both
+    # a config and its saturated twin; see _search_chunk_keys).
+    changed = jnp.any(b2 != bits_in) | jnp.any(s2 != state) | \
+        (n2 != count)
+    return b2, s2, n2, changed, o2
+
+
+def _filter_pass_mw(bits, state, count, s, *, cap, W, nw):
+    """Return-event filter over multi-word configs: keep configs holding
+    the returner's bit, then recycle it. Returns (bits, state, count,
+    dead)."""
+    slot_bit = _slot_bits(W, nw)
+    s_mask = slot_bit[s]                               # [NW]
+    cfg_valid = jnp.arange(cap) < count
+    keep = cfg_valid & jnp.any((bits & s_mask[None, :]) != 0, axis=-1)
+    bits = bits & ~s_mask[None, :]
+    bits, state, count, _ = _dedup(bits, state, keep, cap)
+    return bits, state, count, count == 0
+
+
+@partial(jax.jit, static_argnames=("cap", "W", "nw", "step_fn"))
+def _row_jit_mw(bits, state, count, act, f_row, v_row, pure_row,
+                pred_row, s, *, cap, W, nw, step_fn):
+    """One full return-event row (closure fixpoint + filter) over
+    multi-word configs as a single device program — the multiword twin of
+    _row_jit, for the spike executor. On overflow the outputs are clipped
+    garbage; the caller retries from its preserved entry frontier.
+    Returns (bits, state, count, dead, overflow)."""
+    def cond(c):
+        _, _, _, changed, ovf = c
+        return changed & ~ovf
+
+    def body(c):
+        bits_in, state, count, _, ovf = c
+        b2, s2, n2, changed, o2 = _closure_pass_mw(
+            bits_in, state, count, act, f_row, v_row, pure_row, pred_row,
+            cap=cap, W=W, nw=nw, step_fn=step_fn)
+        return (b2, s2, n2, changed, ovf | o2)
+
+    bits, state, count, _, ovf = lax.while_loop(
+        cond, body,
+        (bits, state, count, jnp.bool_(True), jnp.bool_(False)))
+    bits, state, count, dead = _filter_pass_mw(bits, state, count, s,
+                                               cap=cap, W=W, nw=nw)
+    return bits, state, count, dead, ovf
 
 
 def _closure_pass_keys(keys_in, count, act, f_row, v_row, pure_row,
@@ -283,18 +328,14 @@ def _closure_pass_keys(keys_in, count, act, f_row, v_row, pure_row,
     diverge. Returns (keys, count, changed, overflow)."""
     from jepsen_tpu.models.kernels import NIL
 
-    bmask = jnp.uint32((1 << b) - 1)
     slot_bit = (jnp.uint32(1) << jnp.arange(W, dtype=jnp.uint32))
     step_cfg_slot = jax.vmap(
         jax.vmap(step_fn, in_axes=(None, 0, 0)),
         in_axes=(0, None, None))
 
     cfg_valid = jnp.arange(cap) < count
-    cfg = jnp.where(cfg_valid, keys_in, 0)
-    bits1 = cfg >> b
-    sv = (cfg & bmask).astype(jnp.int32)
-    state = jnp.where(cfg_valid, jnp.where(sv == nil_id, NIL, sv),
-                      0)[:, None]
+    bits_w, state = _unpack_frontier_keys(keys_in, count, cap, b, nil_id)
+    bits1 = bits_w[:, 0]
     ok, new_state = step_cfg_slot(state, f_row, v_row)
     already = (bits1[:, None] & slot_bit[None, :]) != 0
     fresh = ok & act[None, :] & ~already & cfg_valid[:, None]
@@ -318,6 +359,8 @@ def _closure_pass_keys(keys_in, count, act, f_row, v_row, pure_row,
             m = (v_row[k, 0] == NIL) | (v_row[k, 0] == raw)
             sat_tbl = sat_tbl | jnp.where(
                 m & pure_row[k] & act[k], slot_bit[k], jnp.uint32(0))
+        sv = (jnp.where(cfg_valid, keys_in, 0)
+              & jnp.uint32((1 << b) - 1)).astype(jnp.int32)
         sat = jnp.zeros_like(keys_in)
         nsat = jnp.zeros(pns.shape, jnp.uint32)
         for s_id in range(1 << b):
@@ -411,22 +454,12 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
 
     C, W = active.shape
     b = state_bits
-    bmask = jnp.uint32((1 << b) - 1)
 
     def to_keys(bits, state, count):
-        sv = state[:, 0]
-        ps = jnp.where(sv == NIL, nil_id, sv).astype(jnp.uint32)
-        return jnp.where(jnp.arange(cap) < count,
-                         (bits[:, 0] << b) | ps, KEY_FILL)
+        return _pack_frontier_keys(bits, state, count, cap, b, nil_id)
 
     def from_keys(keys, count):
-        live = jnp.arange(cap) < count
-        cfg = jnp.where(live, keys, 0)
-        bits = cfg >> b
-        sv = (cfg & bmask).astype(jnp.int32)
-        state = jnp.where(sv == nil_id, NIL, sv)[:, None]
-        return (jnp.where(live, bits, 0)[:, None],
-                jnp.where(live[:, None], state, 0))
+        return _unpack_frontier_keys(keys, count, cap, b, nil_id)
 
     def row_body(carry):
         r, keys, count, dead, ovf = carry
@@ -532,9 +565,83 @@ def _hostloop_rows(p, r0, keys, count, *, tables_h, b, nil_id, step_fn,
     return keys, int(count), r, False, False, False, None
 
 
-def _entry_keys(bits, state, count, cap, b, nil_id):
-    """Pack a (bits, state) frontier into u32 keys padded to ``cap`` (for
-    handing a chunk-entry frontier to the spike executor)."""
+_MW_SPIKE_BUDGET_BYTES = 3 << 29   # ~1.5 GiB of sort operands per pass
+
+
+def _mw_spike_caps(W, nw, S, chunk_top, spike_caps):
+    """Memory-bounded spike-cap ladder for the multiword executor. Each
+    closure pass materializes ~3 copies of cap*(W+1) candidate rows of
+    (1 + nw + S) i32 words; wide windows and fat states (sets) get
+    smaller ladders. Takes the configured spike levels above the chunked
+    top cap that fit the budget; None when none do."""
+    per_cand = 4 * 3 * (W + 1) * (1 + nw + S)
+    max_cap = _MW_SPIKE_BUDGET_BYTES // max(per_cand, 1)
+    caps = tuple(sorted(c for c in spike_caps if chunk_top < c <= max_cap))
+    return caps or None
+
+
+def _hostloop_rows_mw(p, r0, bits, state, count, *, tables_h, step_fn,
+                      cancel, caps, dropback=HOSTLOOP_DROPBACK,
+                      min_rows=64):
+    """Multiword twin of _hostloop_rows: rows one at a time, each a
+    single top-level device program over (bits u32[cap,NW],
+    state i32[cap,S]) frontiers — covers set/queue kernels and windows
+    past the packed-key bound. Returns (bits, state, count_int,
+    next_row, dead, overflowed, cancelled, dead_entry); dead_entry is
+    ``(bits, state, count_int)`` at the dead row's entry, else None."""
+    ret_slot_h, active_h, slot_f_h, slot_v_h, pure_h, pred_bit_h = tables_h
+    W = active_h.shape[1]
+    nw = bits.shape[1]
+
+    def grow(b, s, to):
+        g = to - b.shape[0]
+        return (jnp.pad(b, ((0, g), (0, 0))),
+                jnp.pad(s, ((0, g), (0, 0))))
+
+    if bits.shape[0] < caps[0]:
+        bits, state = grow(bits, state, caps[0])
+    cap = bits.shape[0]
+    cap_idx = caps.index(cap) if cap in caps else 0
+    count = jnp.int32(count)
+    r = r0
+    while r < p.R:
+        if cancel is not None and cancel.is_set():
+            return bits, state, int(count), r, False, False, True, None
+        act = jnp.asarray(active_h[r])
+        f_row = jnp.asarray(slot_f_h[r])
+        v_row = jnp.asarray(slot_v_h[r])
+        pure_row = jnp.asarray(pure_h[r])
+        pred_row = jnp.asarray(pred_bit_h[r])
+        s = jnp.int32(int(ret_slot_h[r]))
+        entry_b, entry_s = bits, state
+        entry_count = int(count)
+        while True:
+            bits, state, count_d, dead, ovf = _row_jit_mw(
+                entry_b, entry_s, count, act, f_row, v_row, pure_row,
+                pred_row, s, cap=cap, W=W, nw=nw, step_fn=step_fn)
+            if not bool(ovf):
+                count = count_d
+                break
+            if cap_idx + 1 >= len(caps):
+                return (entry_b, entry_s, int(count), r, False, True,
+                        False, None)
+            cap_idx += 1
+            entry_b, entry_s = grow(entry_b, entry_s, caps[cap_idx])
+            cap = caps[cap_idx]
+        r += 1
+        if bool(dead):
+            return (bits, state, int(count), r, True, False, False,
+                    (entry_b, entry_s, entry_count))
+        if r - r0 >= min_rows and int(count) <= dropback:
+            return bits, state, int(count), r, False, False, False, None
+    return bits, state, int(count), r, False, False, False, None
+
+
+def _pack_frontier_keys(bits, state, count, cap, b, nil_id):
+    """THE packed-key encoding — ``bits << b | state-id`` with NIL
+    remapped to nil_id, KEY_FILL past count, padded/sliced to ``cap``.
+    Single definition shared by the chunked engine, the spike executor
+    handoff, and the resume path, so the layout cannot drift."""
     from jepsen_tpu.models.kernels import NIL
 
     n = bits.shape[0]
@@ -548,9 +655,9 @@ def _entry_keys(bits, state, count, cap, b, nil_id):
     return keys[:cap]
 
 
-def _keys_to_bits_state(keys, count, cap, b, nil_id):
-    """Unpack sorted spike-executor keys back into (bits[cap,1],
-    state[cap,1]) for the chunked engine (count must fit cap)."""
+def _unpack_frontier_keys(keys, count, cap, b, nil_id):
+    """Inverse of _pack_frontier_keys: (bits[cap,1], state[cap,1]),
+    zeroed past count (count must fit cap)."""
     from jepsen_tpu.models.kernels import NIL
 
     k = keys[:cap]
@@ -604,7 +711,8 @@ def _pad_rows(p: PackedHistory):
 def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                  chunk: int = CHUNK, cancel=None, explain: bool = False,
                  spike_caps=HOSTLOOP_CAP_SCHEDULE,
-                 spike_dropback: int = HOSTLOOP_DROPBACK) -> dict:
+                 spike_dropback: int = HOSTLOOP_DROPBACK,
+                 packed_keys: bool | None = None) -> dict:
     """Decide linearizability of a packed history on device.
 
     Host loop over CHUNK-row device dispatches; the frontier carries
@@ -646,8 +754,11 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
 
     from jepsen_tpu.models.kernels import READ_VALUE_MATCH_KERNELS
 
+    # ``packed_keys=False`` forces the multiword formulation (tests use
+    # it to cover the wide-window machinery on small histories).
     state_bits = nil_id = None
-    if S == 1 and p.kernel.name in PACKED_STATE_KERNELS:
+    if S == 1 and p.kernel.name in PACKED_STATE_KERNELS \
+            and packed_keys is not False:
         nid = max(len(p.unintern), 2)
         b = nid.bit_length()
         if p.window + b <= 31:
@@ -689,9 +800,21 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             if not bool(ovf):
                 break
             if level + 1 >= len(cap_schedule):
+                # Spike caps must strictly exceed the chunked top cap:
+                # the handoff packs the entry frontier (up to
+                # cap_schedule[-1] configs) into caps[0]-sized buffers,
+                # and a smaller cap would silently drop live configs —
+                # verdict-flipping (mirrors _mw_spike_caps's filter).
+                mw_caps = None
+                pk_caps = None
                 if state_bits is None:
-                    # Multi-word configs have no spike executor (yet):
-                    # honest unknown, competition falls back to the host.
+                    mw_caps = _mw_spike_caps(p.window, nw, S,
+                                             cap_schedule[-1], spike_caps)
+                else:
+                    pk_caps = tuple(sorted(
+                        c for c in spike_caps if c > cap_schedule[-1])) \
+                        or None
+                if mw_caps is None and pk_caps is None:
                     return {"valid?": "unknown", "analyzer": "tpu-bfs",
                             "error": ("frontier exceeded capacity "
                                       f"{cap_schedule[-1]}")}
@@ -710,20 +833,51 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                         bits, state, count = b2, s2, c2
                     else:
                         n_pre = 0  # extremely rare: spike at first row
-                spiked = _hostloop_rows(
-                    p, base + n_pre,
-                    _entry_keys(bits, state, count, spike_caps[0],
-                                state_bits, nil_id),
-                    count, tables_h=(ret_slot_h, active_h, slot_f_h,
-                                     slot_v_h, pure_h, pred_bit_h),
-                    b=state_bits, nil_id=nil_id, step_fn=step_fn,
-                    read_value_match=read_value_match, cancel=cancel,
-                    caps=spike_caps,
-                    # Clamped so the handed-back frontier always fits the
-                    # chunked engine's top cap — a larger count would be
-                    # silently truncated by _keys_to_bits_state and could
-                    # flip the verdict.
-                    dropback=min(spike_dropback, cap_schedule[-1]))
+                tables_h = (ret_slot_h, active_h, slot_f_h, slot_v_h,
+                            pure_h, pred_bit_h)
+                # Dropback clamped so the handed-back frontier always
+                # fits the chunked engine's top cap — a larger count
+                # would be silently truncated on resume and could flip
+                # the verdict.
+                dropback = min(spike_dropback, cap_schedule[-1])
+                if state_bits is not None:
+                    (keys, count_i, next_r, dead_h, ovf_h, cancelled,
+                     dead_entry) = _hostloop_rows(
+                        p, base + n_pre,
+                        _pack_frontier_keys(bits, state, count, pk_caps[0],
+                                    state_bits, nil_id),
+                        count, tables_h=tables_h, b=state_bits,
+                        nil_id=nil_id, step_fn=step_fn,
+                        read_value_match=read_value_match,
+                        cancel=cancel, caps=pk_caps,
+                        dropback=dropback)
+                    spike_top = pk_caps[-1]
+                    max_cap_used = max(max_cap_used, keys.shape[0])
+
+                    def resume_frontier(cap):
+                        return _unpack_frontier_keys(keys, count_i, cap,
+                                                     state_bits, nil_id)
+
+                    if dead_entry is not None:
+                        e_keys, e_count = dead_entry
+                        e_bits, e_state = _unpack_frontier_keys(
+                            e_keys, e_count, e_keys.shape[0],
+                            state_bits, nil_id)
+                        dead_entry = (e_bits, e_state, e_count)
+                else:
+                    (s_bits, s_state, count_i, next_r, dead_h, ovf_h,
+                     cancelled, dead_entry) = _hostloop_rows_mw(
+                        p, base + n_pre, bits, state, count,
+                        tables_h=tables_h, step_fn=step_fn,
+                        cancel=cancel, caps=mw_caps, dropback=dropback)
+                    spike_top = mw_caps[-1]
+                    max_cap_used = max(max_cap_used, s_bits.shape[0])
+
+                    def resume_frontier(cap):
+                        return s_bits[:cap], s_state[:cap]
+
+                spiked = (count_i, next_r, dead_h, ovf_h, cancelled,
+                          dead_entry, resume_frontier, spike_top)
                 break
             # Retry this chunk from its entry frontier at the next cap.
             level += 1
@@ -733,16 +887,15 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             bits = jnp.pad(bits, ((0, grow), (0, 0)))
             state = jnp.pad(state, ((0, grow), (0, 0)))
         if spiked is not None:
-            (keys, count_i, next_r, dead_h, ovf_h, cancelled,
-             dead_entry) = spiked
-            max_cap_used = max(max_cap_used, keys.shape[0])
+            (count_i, next_r, dead_h, ovf_h, cancelled, dead_entry,
+             resume_frontier, spike_top) = spiked
             if cancelled:
                 return {"valid?": "unknown", "analyzer": "tpu-bfs",
                         "error": "cancelled"}
             if ovf_h:
                 return {"valid?": "unknown", "analyzer": "tpu-bfs",
                         "error": ("frontier exceeded capacity "
-                                  f"{spike_caps[-1]}")}
+                                  f"{spike_top}")}
             if dead_h:
                 r_done = jnp.int32(next_r - base)
                 dead = True
@@ -751,10 +904,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                     # row's ENTRY frontier so the plain CPU replay is one
                     # row, not the whole spike region it could never
                     # traverse.
-                    e_keys, e_count = dead_entry
-                    e_bits, e_state = _keys_to_bits_state(
-                        e_keys, e_count, e_keys.shape[0], state_bits,
-                        nil_id)
+                    e_bits, e_state, e_count = dead_entry
                     snapshots[:] = [(next_r - 1, e_bits, e_state,
                                      e_count)]
             elif next_r >= p.R:
@@ -770,8 +920,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                 # drops the level back once chunks run clean.
                 level = len(cap_schedule) - 1
                 cap = cap_schedule[level]
-                bits, state = _keys_to_bits_state(
-                    keys, count_i, cap, state_bits, nil_id)
+                bits, state = resume_frontier(cap)
                 count = jnp.int32(count_i)
                 base = next_r
                 continue

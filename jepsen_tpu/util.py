@@ -198,3 +198,32 @@ def nemesis_intervals(history) -> list[tuple]:
     for s in starts:
         intervals.append((s, None))
     return intervals
+
+
+def enable_compile_cache(path: str | None = None) -> str | None:
+    """Enable JAX's persistent compilation cache rooted in the repo.
+
+    The linearizability engines compile one program per (cap, window,
+    state-bucket) shape; each costs tens of seconds of XLA time on first
+    use and is bit-identical across processes. The reference has no
+    analogue (the JVM JITs per run); here the cache turns every cold
+    start after the first into a warm one — bench, CLI, tests, and the
+    driver's compile checks all share it. Safe to call multiple times;
+    returns the cache dir, or None if the config is unavailable.
+    """
+    import os
+
+    import jax
+
+    if path is None:
+        path = os.environ.get("JEPSEN_TPU_JAX_CACHE") or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache")
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+    except Exception:  # pragma: no cover - older jax without the knobs
+        return None
+    return path

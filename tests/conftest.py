@@ -19,3 +19,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the engines' per-shape programs are
+# identical across test runs; caching cuts suite time dramatically.
+from jepsen_tpu.util import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()

@@ -104,6 +104,35 @@ class TestReducedCpuExactness:
             p = prepare.prepare(m.mutex(), hh)
             assert verdict(p, False) == verdict(p, True)
 
+    @pytest.mark.parametrize("seed", range(6))
+    def test_set_fuzz(self, seed):
+        # Set reads are pure too — the oracle runs reduced by default,
+        # so the reduction must be exact for the set kernel as well.
+        # (corrupt_history can't rewrite collection-valued reads, so the
+        # invalid side is a surgical wrong-membership read instead.)
+        h = list(synth.generate_set_history(50, concurrency=4, seed=seed))
+        p = prepare.prepare(m.set_model(), h)
+        if p.kernel is not None:
+            assert verdict(p, False) == verdict(p, True)
+        bad = list(h)
+        for i in range(len(bad) - 1, -1, -1):
+            op = bad[i]
+            if op.is_ok and op.f == "read" and op.value is not None:
+                bad[i] = op.replace(value=list(op.value) + [9999])
+                break
+        p = prepare.prepare(m.set_model(), bad)
+        if p.kernel is not None:
+            assert verdict(p, False) == verdict(p, True)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_queue_fuzz(self, seed):
+        h = synth.generate_queue_history(40, concurrency=4, seed=seed)
+        for hh in (h, synth.corrupt_history(h, seed=seed)):
+            p = prepare.prepare(m.unordered_queue(), hh)
+            if p.kernel is None:
+                continue
+            assert verdict(p, False) == verdict(p, True)
+
     def test_read_saturation_filters_at_return(self):
         # A read of a value never written must still die at its return.
         h = History.of(
@@ -120,6 +149,34 @@ class TestReducedCpuExactness:
             cpu.search_rows(p, {init}, {init: None}, 0, p.R, reduce=True)
 
 
+class TestBeyondDeviceWindow:
+    def test_window_past_64_falls_back_to_cpu(self):
+        # 70 concurrent identical writes: window 70 exceeds the device
+        # bitset, but analysis() re-packs wide and the reduced host
+        # search (canonical chains collapse the identical writes to
+        # prefixes) decides it instantly.
+        from jepsen_tpu.lin import analysis
+
+        evs = [invoke_op(pr, "write", 1) for pr in range(70)]
+        evs += [ok_op(pr, "write", 1) for pr in range(70)]
+        evs += [invoke_op(0, "read", None), ok_op(0, "read", 1)]
+        r = analysis(m.cas_register(), History.of(*evs))
+        assert r["valid?"] is True
+        assert r["analyzer"] == "cpu-jit"
+        bad = evs[:-1] + [ok_op(0, "read", 2)]
+        r = analysis(m.cas_register(), History.of(*bad))
+        assert r["valid?"] is False
+
+    def test_device_alone_still_reports_window_overflow(self):
+        from jepsen_tpu.lin import analysis
+
+        evs = [invoke_op(pr, "write", 1) for pr in range(70)]
+        evs += [ok_op(pr, "write", 1) for pr in range(70)]
+        r = analysis(m.cas_register(), History.of(*evs),
+                     algorithm="tpu")
+        assert r["valid?"] == "unknown"
+
+
 class TestWideWindowDevice:
     """The reduction payoff: windows past the dense bound decide on
     device where the plain frontier would drown the cap schedule."""
@@ -131,6 +188,29 @@ class TestWideWindowDevice:
         p = prepare.prepare(m.cas_register(), h)
         r = bfs.check_packed(p)
         assert r["valid?"] is cpu.check_packed(p)["valid?"] is True
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_multiword_spike_parity(self, seed):
+        # packed_keys=False forces the multiword formulation (the one
+        # wide windows and set/queue states use) through tiny chunked
+        # caps into the multiword spike executor.
+        h = synth.generate_register_history(80, concurrency=6, seed=seed,
+                                            value_range=3, crash_prob=0.1)
+        for hh in (h, synth.corrupt_history(h, seed=seed)):
+            p = prepare.prepare(m.cas_register(), hh)
+            want = cpu.check_packed(p)["valid?"]
+            r = bfs.check_packed(p, cap_schedule=(8,),
+                                 spike_caps=(1024, 16384),
+                                 spike_dropback=4, packed_keys=False)
+            assert r["valid?"] == want, (seed, r, want)
+
+    def test_multiword_spike_set_model(self):
+        h = synth.generate_set_history(60, concurrency=5, seed=1)
+        p = prepare.prepare(m.set_model(), h)
+        want = cpu.check_packed(p)["valid?"]
+        r = bfs.check_packed(p, cap_schedule=(8,),
+                             spike_caps=(1024, 16384), spike_dropback=4)
+        assert r["valid?"] == want
 
     def test_spike_executor_death_row_matches_oracle(self):
         h = synth.corrupt_history(
