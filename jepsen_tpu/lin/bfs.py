@@ -317,15 +317,16 @@ def _row_jit_mw(bits, state, count, act, f_row, v_row, pure_row,
     return bits, state, count, dead, ovf
 
 
-def _closure_pass_keys(keys_in, count, act, f_row, v_row, pure_row,
-                       pred_row, *, cap, W, b, nil_id, step_fn,
-                       read_value_match):
-    """ONE just-in-time closure pass over packed u32 keys
-    (bits << b | state id). Saturation ORs legal pure-slot bits into the
-    carried keys in place; expansion covers non-pure slots gated by the
-    canonical-chain pred mask. Shared verbatim by the nested-while chunk
-    engine and the host-driven spike executor so their semantics cannot
-    diverge. Returns (keys, count, changed, overflow)."""
+def _expand_keys(keys_in, count, act, f_row, v_row, pure_row, pred_row,
+                 *, cap, W, b, nil_id, step_fn, read_value_match):
+    """Candidate generation for ONE closure pass over packed u32 keys
+    (bits << b | state id): unpack, step, saturate (carried keys in
+    place; expansions against their post-transition state), gate
+    expansion by the canonical chain. THE single definition of the
+    packed-key pass semantics — the chunked engine, the spike executor,
+    and the sharded mesh engine all build their candidates here and
+    differ only in HOW they dedup (local sort vs collective).
+    Returns (cand[cap*(1+W)], cand_valid)."""
     from jepsen_tpu.models.kernels import NIL
 
     slot_bit = (jnp.uint32(1) << jnp.arange(W, dtype=jnp.uint32))
@@ -387,6 +388,20 @@ def _closure_pass_keys(keys_in, count, act, f_row, v_row, pure_row,
     cand = jnp.concatenate([jnp.where(cfg_valid, keys, 0),
                             new_keys.reshape(-1)])
     cand_valid = jnp.concatenate([cfg_valid, legal.reshape(-1)])
+    return cand, cand_valid
+
+
+def _closure_pass_keys(keys_in, count, act, f_row, v_row, pure_row,
+                       pred_row, *, cap, W, b, nil_id, step_fn,
+                       read_value_match):
+    """ONE just-in-time closure pass over packed u32 keys: _expand_keys
+    candidates + local sort-dedup. Shared verbatim by the nested-while
+    chunk engine and the host-driven spike executor so their semantics
+    cannot diverge. Returns (keys, count, changed, overflow)."""
+    cand, cand_valid = _expand_keys(
+        keys_in, count, act, f_row, v_row, pure_row, pred_row, cap=cap,
+        W=W, b=b, nil_id=nil_id, step_fn=step_fn,
+        read_value_match=read_value_match)
     k2, n2, o2 = _dedup_keys(cand, cand_valid, cap)
     # Fixpoint test is against the pass INPUT: the stable set contains
     # both a config and its saturated twin (expansion keeps regenerating
@@ -858,12 +873,16 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                         return _unpack_frontier_keys(keys, count_i, cap,
                                                      state_bits, nil_id)
 
-                    if dead_entry is not None:
+                    if dead_entry is not None and snapshots is not None:
+                        # Convert only when explain will consume it —
+                        # this materializes spike-cap-sized arrays.
                         e_keys, e_count = dead_entry
                         e_bits, e_state = _unpack_frontier_keys(
                             e_keys, e_count, e_keys.shape[0],
                             state_bits, nil_id)
                         dead_entry = (e_bits, e_state, e_count)
+                    else:
+                        dead_entry = None
                 else:
                     (s_bits, s_state, count_i, next_r, dead_h, ovf_h,
                      cancelled, dead_entry) = _hostloop_rows_mw(

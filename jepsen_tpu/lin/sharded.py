@@ -33,7 +33,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from jepsen_tpu.lin.bfs import _pad_rows
+from jepsen_tpu.lin.bfs import _expand_keys, _pad_rows
 
 # The sparse sharded frontier keeps single-word bitsets (the all_gather
 # dedup keys stay u32); wider windows fall back to the single-chip engine.
@@ -42,6 +42,40 @@ MAX_DEVICE_WINDOW = 32
 # path; the dense hypercube engine handles long histories chunked).
 MAX_SHARDED_ROWS = 8192
 from jepsen_tpu.lin.prepare import PackedHistory
+
+
+KEY_FILL = jnp.uint32(0xFFFFFFFF)
+
+
+def _global_dedup_keys(keys, valid, cap_local, axis):
+    """Packed-u32-key collective dedup: ONE all_gather of u32 keys over
+    the mesh axis (vs bits+state columns — this is the "bitset-hash
+    dedup allreduced over ICI" axis of the north star, at a fraction of
+    the collective bytes), a global sort, duplicate masking, and a
+    second sort for compaction (no scatter — `.at[idx].set` serializes
+    on TPU; no searchsorted — it kernel-faults this runtime at scale,
+    see bfs._dedup_keys). Every device keeps its deterministic slice.
+    Returns (keys[cap_local], count_local, total, overflow); total and
+    overflow are replicated."""
+    d = lax.axis_index(axis)
+    n_dev = lax.axis_size(axis)
+
+    key = keys | ((~valid).astype(jnp.uint32) << 31)
+    key_all = lax.all_gather(key, axis, tiled=True)
+    n = key_all.shape[0]
+    key_s = lax.sort(key_all)
+    inv_s = key_s >> 31
+
+    prev_differs = key_s != jnp.roll(key_s, 1)
+    first = jnp.arange(n) == 0
+    mask = (inv_s == 0) & (first | prev_differs)
+
+    total = jnp.sum(mask.astype(jnp.int32))
+    overflow = total > cap_local * n_dev
+    packed = lax.sort(jnp.where(mask, key_s, KEY_FILL))
+    mine = lax.dynamic_slice(packed, (d * cap_local,), (cap_local,))
+    count_local = jnp.clip(total - d * cap_local, 0, cap_local)
+    return mine, count_local, total, overflow
 
 
 def _global_dedup(bits, state, valid, cap_local, axis):
@@ -199,6 +233,91 @@ def _search_sharded(ret_slot, active, slot_f, slot_v, pure, pred_mask,
     return ok[0], dead_row[0], ovf[0], total[0]
 
 
+@partial(jax.jit, static_argnames=("cap_local", "step_fn", "mesh", "axis",
+                                   "b", "nil_id", "read_value_match"))
+def _search_sharded_keys(ret_slot, active, slot_f, slot_v, pure, pred_mask,
+                         init_state, *, cap_local, step_fn, mesh, b,
+                         nil_id, read_value_match, axis="d"):
+    """Packed-u32-key shard_map search: each device owns cap_local keys
+    (bits << b | state id, the bfs._pack_frontier_keys layout); dedup is
+    the single-array collective of _global_dedup_keys. The row loop is
+    the sharded twin of bfs._search_chunk_keys — saturation, canonical
+    chains, and the register-family inline-read fast table included.
+    Returns replicated (ok, dead_row, overflow, total)."""
+    from jepsen_tpu.models.kernels import NIL
+
+    R, W = active.shape
+
+    def shard_body(ret_slot, active, slot_f, slot_v, pure, pred_mask,
+                   init_state):
+        d = lax.axis_index(axis)
+        sv0 = init_state[0]
+        init_key = (jnp.where(sv0 == NIL, nil_id, sv0)
+                    .astype(jnp.uint32))
+        keys0 = jnp.full(cap_local, KEY_FILL, jnp.uint32)
+        keys0 = jnp.where((d == 0) & (jnp.arange(cap_local) == 0),
+                          init_key, keys0)
+        count0 = jnp.where(d == 0, jnp.int32(1), jnp.int32(0))
+
+        def closure_cond(c):
+            _, _, _, changed, ovf = c
+            return changed & ~ovf
+
+        def row_body(carry):
+            r, keys, count, total, dead, ovf = carry
+            act = active[r]
+            f_row = slot_f[r]
+            v_row = slot_v[r]
+            pure_row = pure[r]
+            pred_row = pred_mask[r]
+            s = ret_slot[r]
+
+            def closure_body(c):
+                keys_in, count, total, _, ovf = c
+                # Candidate generation is bfs._expand_keys — the single
+                # definition of the packed-key pass semantics; only the
+                # dedup differs (collective here, local on one chip).
+                cand, cand_valid = _expand_keys(
+                    keys_in, count, act, f_row, v_row, pure_row,
+                    pred_row, cap=cap_local, W=W, b=b, nil_id=nil_id,
+                    step_fn=step_fn, read_value_match=read_value_match)
+                k2, n2, tot2, o2 = _global_dedup_keys(
+                    cand, cand_valid, cap_local, axis)
+                changed = jnp.any(k2 != keys_in) | (tot2 != total)
+                changed = lax.psum(changed.astype(jnp.int32), axis) > 0
+                return (k2, n2, tot2, changed, ovf | o2)
+
+            init = (keys, count, total, jnp.bool_(True), ovf)
+            keys, count, total, _, ovf = lax.while_loop(
+                closure_cond, closure_body, init)
+
+            s_key_bit = jnp.uint32(1) << (b + s).astype(jnp.uint32)
+            cfg_valid = jnp.arange(cap_local) < count
+            keep = cfg_valid & ((keys & s_key_bit) != 0)
+            keys, count, total, o2 = _global_dedup_keys(
+                jnp.where(keep, keys & ~s_key_bit, KEY_FILL), keep,
+                cap_local, axis)
+            dead = total == 0
+            return (r + 1, keys, count, total, dead, ovf | o2)
+
+        def row_cond(carry):
+            r, _, _, _, dead, ovf = carry
+            return (r < R) & ~dead & ~ovf
+
+        r, keys, count, total, dead, ovf = lax.while_loop(
+            row_cond, row_body,
+            (jnp.int32(0), keys0, count0, jnp.int32(1), False, False))
+        return (~dead & ~ovf)[None], (r - 1)[None], ovf[None], total[None]
+
+    fn = jax.shard_map(shard_body, mesh=mesh,
+                       in_specs=(P(), P(), P(), P(), P(), P(), P()),
+                       out_specs=(P(axis), P(axis), P(axis), P(axis)),
+                       check_vma=False)
+    ok, dead_row, ovf, total = fn(ret_slot, active, slot_f, slot_v,
+                                  pure, pred_mask, init_state)
+    return ok[0], dead_row[0], ovf[0], total[0]
+
+
 DEFAULT_CAP_PER_DEVICE = (64, 1024, 16384)
 
 
@@ -246,6 +365,8 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
 
     ret_slot_h, active_h, slot_f_h, slot_v_h = _pad_rows(p)
     from jepsen_tpu.lin.bfs import reduction_bit_tables
+    from jepsen_tpu.models.kernels import (PACKED_STATE_KERNELS,
+                                           READ_VALUE_MATCH_KERNELS)
 
     pure_k, pred_bit_k = reduction_bit_tables(p, 1)
     R, W = p.active.shape
@@ -258,10 +379,29 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
             jnp.asarray(pure_h), jnp.asarray(pred_mask_h),
             jnp.asarray(p.init_state))
 
+    # Packed-u32 keys when the (padded) window plus state id fit 31
+    # bits: the collective dedup then all_gathers ONE u32 array instead
+    # of bits + state columns — far fewer ICI bytes per dedup.
+    state_bits = nil_id = None
+    if p.init_state.shape[0] == 1 \
+            and p.kernel.name in PACKED_STATE_KERNELS:
+        nid = max(len(p.unintern), 2)
+        bb = nid.bit_length()
+        if active_h.shape[1] + bb <= 31:
+            state_bits, nil_id = bb, nid
+    dedup_kind = "packed-keys" if state_bits is not None else "multiword"
+
     for cap in cap_schedule:
-        ok, dead_row, overflow, total = _search_sharded(
-            *args, cap_local=cap, step_fn=p.kernel.step, mesh=mesh,
-            axis=axis)
+        if state_bits is not None:
+            ok, dead_row, overflow, total = _search_sharded_keys(
+                *args, cap_local=cap, step_fn=p.kernel.step, mesh=mesh,
+                b=state_bits, nil_id=nil_id,
+                read_value_match=p.kernel.name in READ_VALUE_MATCH_KERNELS,
+                axis=axis)
+        else:
+            ok, dead_row, overflow, total = _search_sharded(
+                *args, cap_local=cap, step_fn=p.kernel.step, mesh=mesh,
+                axis=axis)
         if not bool(overflow):
             break
     if bool(overflow):
@@ -269,10 +409,11 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
                 "error": f"frontier exceeded {cap_schedule[-1]} per device"}
     if bool(ok):
         return {"valid?": True, "analyzer": "tpu-bfs-sharded",
-                "final-frontier-size": int(total)}
+                "dedup": dedup_kind, "final-frontier-size": int(total)}
     r = int(dead_row)
     ret = p.ops[int(p.ret_op[r])]
     return {"valid?": False, "analyzer": "tpu-bfs-sharded",
+            "dedup": dedup_kind,
             "op": {"process": ret.process, "f": ret.f, "value": ret.value,
                    "index": ret.op_index, "ok": ret.ok},
             "configs": [], "final-paths": []}

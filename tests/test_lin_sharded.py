@@ -81,3 +81,44 @@ def test_sparse_sharded_rejects_unchunked_long_history():
     r = sharded.check_packed(big, mesh=mesh(2), engine="sparse")
     assert r["valid?"] == "unknown"
     assert "exceeds" in r["error"]
+
+
+class TestPackedKeyDedup:
+    """The packed-u32-key collective dedup (one all_gather of keys over
+    ICI instead of bits+state columns). Register/mutex families route
+    packed; multiword states (sets) keep the column dedup."""
+
+    def test_register_routes_packed(self):
+        h = synth.generate_register_history(80, concurrency=5, seed=3,
+                                            value_range=3, crash_prob=0.1)
+        p = prepare.prepare(m.cas_register(), h)
+        r = sharded.check_packed(p, mesh=mesh(8), engine="sparse")
+        assert r["dedup"] == "packed-keys"
+        assert r["valid?"] is cpu.check_packed(p)["valid?"] is True
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_packed_parity_corrupted(self, seed):
+        h = synth.generate_register_history(70, concurrency=5, seed=seed,
+                                            value_range=3, crash_prob=0.1)
+        hb = synth.corrupt_history(h, seed=seed)
+        p = prepare.prepare(m.cas_register(), hb)
+        want = cpu.check_packed(p)
+        r = sharded.check_packed(p, mesh=mesh(8), engine="sparse")
+        assert r["valid?"] == want["valid?"]
+        if want["valid?"] is False:
+            assert r["op"] == want["op"]
+
+    def test_set_model_routes_multiword(self):
+        h = synth.generate_set_history(50, concurrency=4, seed=2)
+        p = prepare.prepare(m.set_model(), h)
+        r = sharded.check_packed(p, mesh=mesh(8), engine="sparse")
+        assert r["dedup"] == "multiword"
+        assert r["valid?"] is cpu.check_packed(p)["valid?"] is True
+
+    def test_mutex_packed_parity(self):
+        h = synth.generate_mutex_history(50, concurrency=4, seed=1,
+                                         crash_prob=0.1)
+        p = prepare.prepare(m.mutex(), h)
+        r = sharded.check_packed(p, mesh=mesh(4), engine="sparse")
+        assert r["dedup"] == "packed-keys"
+        assert r["valid?"] == cpu.check_packed(p)["valid?"]
