@@ -33,12 +33,12 @@ histories for free. For the band outside the dense bounds — windows
 21..64, value-rich registers, set/queue states — two EXACT search-space
 reductions keep the frontier tractable (prepare.reduction_tables:
 pure-op saturation and canonical chains; knossos has neither), and
-frontier spikes past the chunked engine's largest runtime-safe capacity
-hand off to a host-driven spike executor (_hostloop_rows /
-_hostloop_rows_mw) that runs each return event as one top-level device
-program with capacity up to ~1M configs. Only when even that overflows
-does the verdict become an honest "unknown" (competition then falls
-back to the host search).
+frontier spikes past the chunked engine's largest runtime-safe
+512-row-chunk capacity re-run as SPIKE_CHUNK-row mini-chunks of the
+same program at capacities up to ~1M configs (it is rows-times-capacity
+program complexity the runtime objects to, not capacity). Only when
+even that overflows does the verdict become an honest "unknown"
+(competition then falls back to the host search).
 """
 
 from __future__ import annotations
@@ -53,18 +53,21 @@ from jax import lax
 from jepsen_tpu.lin.prepare import PackedHistory
 
 # Caps for the nested-while chunked engine. 131072 is the largest level
-# that holds up on the axon TPU runtime: the same program at 262144
-# kernel-faults the worker (the components — sorts to 32M elements, the
-# vmapped step, the expansion algebra — are each fine standalone at that
-# scale; only the full nested-while program trips the runtime). Frontier
-# spikes past this cap switch to the host-driven per-pass executor
-# (_hostloop_rows), whose top-level dispatches stay on proven ground up to
-# HOSTLOOP_CAP_SCHEDULE[-1].
+# at which a full 512-row chunk program holds up on the axon TPU
+# runtime: the same program at 262144 kernel-faults the worker (the
+# components — sorts to 32M elements, the vmapped step, the expansion
+# algebra — are each fine standalone at that scale; it is the rows×cap
+# program COMPLEXITY that trips the runtime: 8/32/64-row chunks all run
+# clean at cap 2^20, 512 faults at 2^18). Frontier spikes past this cap
+# therefore switch to SPIKE_CHUNK-row mini-chunks of the same program
+# at the SPIKE_CAP_SCHEDULE capacities (32 keeps a 16x margin to the
+# known-bad 512 while amortizing dispatch overhead).
 DEFAULT_CAP_SCHEDULE = (256, 2048, 16384, 131072)
-HOSTLOOP_CAP_SCHEDULE = (262144, 1048576)
-# Frontier size at which the spike executor hands back to the chunked
-# engine (a row boundary with count at most this).
-HOSTLOOP_DROPBACK = 32768
+SPIKE_CAP_SCHEDULE = (262144, 1048576)
+SPIKE_CHUNK = 32
+# Frontier size at which spike mode hands back to full-size chunks (at
+# a mini-chunk boundary with count at most this).
+SPIKE_DROPBACK = 32768
 MAX_DEVICE_WINDOW = 64
 CHUNK = 512
 
@@ -290,33 +293,6 @@ def _filter_pass_mw(bits, state, count, s, *, cap, W, nw):
     return bits, state, count, count == 0
 
 
-@partial(jax.jit, static_argnames=("cap", "W", "nw", "step_fn"))
-def _row_jit_mw(bits, state, count, act, f_row, v_row, pure_row,
-                pred_row, s, *, cap, W, nw, step_fn):
-    """One full return-event row (closure fixpoint + filter) over
-    multi-word configs as a single device program — the multiword twin of
-    _row_jit, for the spike executor. On overflow the outputs are clipped
-    garbage; the caller retries from its preserved entry frontier.
-    Returns (bits, state, count, dead, overflow)."""
-    def cond(c):
-        _, _, _, changed, ovf = c
-        return changed & ~ovf
-
-    def body(c):
-        bits_in, state, count, _, ovf = c
-        b2, s2, n2, changed, o2 = _closure_pass_mw(
-            bits_in, state, count, act, f_row, v_row, pure_row, pred_row,
-            cap=cap, W=W, nw=nw, step_fn=step_fn)
-        return (b2, s2, n2, changed, ovf | o2)
-
-    bits, state, count, _, ovf = lax.while_loop(
-        cond, body,
-        (bits, state, count, jnp.bool_(True), jnp.bool_(False)))
-    bits, state, count, dead = _filter_pass_mw(bits, state, count, s,
-                                               cap=cap, W=W, nw=nw)
-    return bits, state, count, dead, ovf
-
-
 def _expand_keys(keys_in, count, act, f_row, v_row, pure_row, pred_row,
                  *, cap, W, b, nil_id, step_fn, read_value_match):
     """Candidate generation for ONE closure pass over packed u32 keys
@@ -423,42 +399,6 @@ def _filter_pass_keys(keys, count, s, *, cap, b):
     return keys, count, count == 0
 
 
-_closure_pass_jit = partial(jax.jit, static_argnames=(
-    "cap", "W", "b", "nil_id", "step_fn", "read_value_match"))(
-        _closure_pass_keys)
-_filter_pass_jit = partial(jax.jit, static_argnames=("cap", "b"))(
-    _filter_pass_keys)
-
-
-@partial(jax.jit, static_argnames=("cap", "W", "b", "nil_id", "step_fn",
-                                   "read_value_match"))
-def _row_jit(keys, count, act, f_row, v_row, pure_row, pred_row, s, *,
-             cap, W, b, nil_id, step_fn, read_value_match):
-    """One full return-event row (closure fixpoint + filter) as a single
-    device program for the spike executor: a SINGLE-level while_loop —
-    the two-level nested row×closure loop of _search_chunk_keys is what
-    kernel-faults the axon runtime at caps past 131072, while this shape
-    holds to HOSTLOOP_CAP_SCHEDULE[-1]. On overflow the output keys are
-    clipped garbage; the caller retries the row from its preserved entry
-    frontier at the next cap. Returns (keys, count, dead, overflow)."""
-    def cond(c):
-        _, _, changed, ovf = c
-        return changed & ~ovf
-
-    def body(c):
-        keys_in, count, _, ovf = c
-        k2, n2, changed, o2 = _closure_pass_keys(
-            keys_in, count, act, f_row, v_row, pure_row, pred_row,
-            cap=cap, W=W, b=b, nil_id=nil_id, step_fn=step_fn,
-            read_value_match=read_value_match)
-        return (k2, n2, changed, ovf | o2)
-
-    keys, count, _, ovf = lax.while_loop(
-        cond, body, (keys, count, jnp.bool_(True), jnp.bool_(False)))
-    keys, count, dead = _filter_pass_keys(keys, count, s, cap=cap, b=b)
-    return keys, count, dead, ovf
-
-
 def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
                        pure, pred_bit, bits, state, count, *, cap, step_fn,
                        state_bits, nil_id, read_value_match=False):
@@ -516,70 +456,6 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
     return out_bits, out_state, count, r, dead, ovf
 
 
-def _hostloop_rows(p, r0, keys, count, *, tables_h, b, nil_id, step_fn,
-                   read_value_match, cancel, caps=HOSTLOOP_CAP_SCHEDULE,
-                   dropback=HOSTLOOP_DROPBACK, min_rows=64):
-    """Host-driven spike executor: rows one at a time, each closure pass
-    ONE top-level device program. The nested-while chunk engine kernel-
-    faults this TPU runtime past cap 131072; the same pass logic
-    (_closure_pass_keys, shared) dispatched at top level is solid to
-    HOSTLOOP_CAP_SCHEDULE[-1], at the price of a few host syncs per row —
-    negligible against the sort cost at these frontier sizes, and this
-    path only runs while the frontier is actually spiking.
-
-    Processes rows from ``r0`` until death, cancel, overflow of the last
-    cap, history end, or — after at least ``min_rows`` rows, so dense
-    spike regions don't thrash between the two engines — the frontier
-    shrinking to ``dropback`` (hand back to the chunked engine at a row
-    boundary).
-    Returns (keys, count_int, next_row, dead, overflowed, cancelled,
-    dead_entry) — dead_entry is the dead row's ENTRY frontier
-    ``(keys, count_int)`` when dead (so a counterexample replay is
-    bounded to that single row), else None.
-    """
-    ret_slot_h, active_h, slot_f_h, slot_v_h, pure_h, pred_bit_h = tables_h
-    W = active_h.shape[1]
-    if keys.shape[0] < caps[0]:
-        keys = jnp.concatenate([keys, jnp.full(
-            caps[0] - keys.shape[0], KEY_FILL, jnp.uint32)])
-    cap = keys.shape[0]
-    cap_idx = caps.index(cap) if cap in caps else 0
-    count = jnp.int32(count)
-    r = r0
-    while r < p.R:
-        if cancel is not None and cancel.is_set():
-            return keys, int(count), r, False, False, True, None
-        act = jnp.asarray(active_h[r])
-        f_row = jnp.asarray(slot_f_h[r])
-        v_row = jnp.asarray(slot_v_h[r])
-        pure_row = jnp.asarray(pure_h[r])
-        pred_row = jnp.asarray(pred_bit_h[r, :, 0])
-        s = jnp.int32(int(ret_slot_h[r]))
-        entry = keys  # preserved: on overflow the row output is garbage
-        entry_count = int(count)
-        while True:
-            keys, count_d, dead, ovf = _row_jit(
-                entry, count, act, f_row, v_row, pure_row, pred_row, s,
-                cap=cap, W=W, b=b, nil_id=nil_id, step_fn=step_fn,
-                read_value_match=read_value_match)
-            if not bool(ovf):
-                count = count_d
-                break
-            if cap_idx + 1 >= len(caps):
-                return entry, int(count), r, False, True, False, None
-            cap_idx += 1
-            entry = jnp.concatenate([entry, jnp.full(
-                caps[cap_idx] - cap, KEY_FILL, jnp.uint32)])
-            cap = caps[cap_idx]
-        r += 1
-        if bool(dead):
-            return (keys, int(count), r, True, False, False,
-                    (entry, entry_count))
-        if r - r0 >= min_rows and int(count) <= dropback:
-            return keys, int(count), r, False, False, False, None
-    return keys, int(count), r, False, False, False, None
-
-
 _MW_SPIKE_BUDGET_BYTES = 3 << 29   # ~1.5 GiB of sort operands per pass
 
 
@@ -595,61 +471,74 @@ def _mw_spike_caps(W, nw, S, chunk_top, spike_caps):
     return caps or None
 
 
-def _hostloop_rows_mw(p, r0, bits, state, count, *, tables_h, step_fn,
-                      cancel, caps, dropback=HOSTLOOP_DROPBACK,
-                      min_rows=64):
-    """Multiword twin of _hostloop_rows: rows one at a time, each a
-    single top-level device program over (bits u32[cap,NW],
-    state i32[cap,S]) frontiers — covers set/queue kernels and windows
-    past the packed-key bound. Returns (bits, state, count_int,
-    next_row, dead, overflowed, cancelled, dead_entry); dead_entry is
-    ``(bits, state, count_int)`` at the dead row's entry, else None."""
-    ret_slot_h, active_h, slot_f_h, slot_v_h, pure_h, pred_bit_h = tables_h
-    W = active_h.shape[1]
-    nw = bits.shape[1]
+def _spike_rows(p, r0, bits, state, count, *, tables_h, caps, dropback,
+                step_fn, state_bits, nil_id, read_value_match, cancel,
+                snapshots, min_rows: int = 64):
+    """Spike mode: SPIKE_CHUNK-row mini-chunks of the SAME _search_chunk
+    program at the big spike capacities. The axon runtime faults on a
+    512-row chunk past cap 131072 but runs an 8-row chunk clean at 2^20
+    — it objects to rows*cap program complexity, not capacity — so
+    shrinking the chunk is all it takes to ride a frontier explosion
+    out, with identical semantics to normal chunks by construction.
 
-    def grow(b, s, to):
-        g = to - b.shape[0]
-        return (jnp.pad(b, ((0, g), (0, 0))),
-                jnp.pad(s, ((0, g), (0, 0))))
+    Processes mini-chunks from ``r0`` until death, cancel, overflow of
+    the last cap, history end, or (after at least ``min_rows`` rows, so
+    dense spike regions don't thrash between modes) the frontier
+    shrinking to ``dropback``. When ``snapshots`` is a list it receives
+    each mini-chunk's entry frontier, so an explain replay spans at most
+    SPIKE_CHUNK rows. Returns (bits, state, count_int, next_row, dead,
+    overflowed, cancelled, top_cap_used)."""
+    lvl = 0
+    top_used = caps[0]
 
-    if bits.shape[0] < caps[0]:
-        bits, state = grow(bits, state, caps[0])
-    cap = bits.shape[0]
-    cap_idx = caps.index(cap) if cap in caps else 0
-    count = jnp.int32(count)
+    def grow(b_, s_, to):
+        g = to - b_.shape[0]
+        return (jnp.pad(b_, ((0, g), (0, 0))),
+                jnp.pad(s_, ((0, g), (0, 0))))
+
+    bits, state = grow(bits, state, caps[0])
     r = r0
     while r < p.R:
         if cancel is not None and cancel.is_set():
-            return bits, state, int(count), r, False, False, True, None
-        act = jnp.asarray(active_h[r])
-        f_row = jnp.asarray(slot_f_h[r])
-        v_row = jnp.asarray(slot_v_h[r])
-        pure_row = jnp.asarray(pure_h[r])
-        pred_row = jnp.asarray(pred_bit_h[r])
-        s = jnp.int32(int(ret_slot_h[r]))
-        entry_b, entry_s = bits, state
-        entry_count = int(count)
+            return bits, state, int(count), r, False, False, True, top_used
+        if snapshots is not None:
+            snapshots[:] = [(r, bits, state, count)]
+        m_n = min(SPIKE_CHUNK, p.R - r)
+        sp_tables = tuple(jnp.asarray(_chunk_slice(t, r, SPIKE_CHUNK))
+                          for t in tables_h)
         while True:
-            bits, state, count_d, dead, ovf = _row_jit_mw(
-                entry_b, entry_s, count, act, f_row, v_row, pure_row,
-                pred_row, s, cap=cap, W=W, nw=nw, step_fn=step_fn)
+            b2, s2, c2, r_done, dead, ovf = _search_chunk(
+                jnp.int32(m_n), *sp_tables, bits, state, count,
+                cap=caps[lvl], step_fn=step_fn, state_bits=state_bits,
+                nil_id=nil_id, read_value_match=read_value_match)
             if not bool(ovf):
-                count = count_d
                 break
-            if cap_idx + 1 >= len(caps):
-                return (entry_b, entry_s, int(count), r, False, True,
-                        False, None)
-            cap_idx += 1
-            entry_b, entry_s = grow(entry_b, entry_s, caps[cap_idx])
-            cap = caps[cap_idx]
-        r += 1
+            if lvl + 1 >= len(caps):
+                return (bits, state, int(count), r, False, True, False,
+                        top_used)
+            lvl += 1
+            bits, state = grow(bits, state, caps[lvl])
+            top_used = caps[lvl]
         if bool(dead):
-            return (bits, state, int(count), r, True, False, False,
-                    (entry_b, entry_s, entry_count))
+            if snapshots is not None and int(r_done) > 1:
+                # Re-anchor the explain snapshot at the dead ROW's entry
+                # (one cheap re-run of the mini-chunk's surviving rows),
+                # so the capacity-unbounded CPU replay spans ONE row of
+                # this spike-sized frontier, not up to SPIKE_CHUNK.
+                b3, s3, c3, _, _, o3 = _search_chunk(
+                    jnp.int32(int(r_done) - 1), *sp_tables, bits, state,
+                    count, cap=caps[lvl], step_fn=step_fn,
+                    state_bits=state_bits, nil_id=nil_id,
+                    read_value_match=read_value_match)
+                if not bool(o3):
+                    snapshots[:] = [(r + int(r_done) - 1, b3, s3, c3)]
+            return (b2, s2, int(c2), r + int(r_done), True, False, False,
+                    top_used)
+        bits, state, count = b2, s2, c2
+        r += m_n
         if r - r0 >= min_rows and int(count) <= dropback:
-            return bits, state, int(count), r, False, False, False, None
-    return bits, state, int(count), r, False, False, False, None
+            break
+    return bits, state, int(count), r, False, False, False, top_used
 
 
 def _pack_frontier_keys(bits, state, count, cap, b, nil_id):
@@ -725,8 +614,8 @@ def _pad_rows(p: PackedHistory):
 
 def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                  chunk: int = CHUNK, cancel=None, explain: bool = False,
-                 spike_caps=HOSTLOOP_CAP_SCHEDULE,
-                 spike_dropback: int = HOSTLOOP_DROPBACK,
+                 spike_caps=SPIKE_CAP_SCHEDULE,
+                 spike_dropback: int = SPIKE_DROPBACK,
                  packed_keys: bool | None = None) -> dict:
     """Decide linearizability of a packed history on device.
 
@@ -816,27 +705,24 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                 break
             if level + 1 >= len(cap_schedule):
                 # Spike caps must strictly exceed the chunked top cap:
-                # the handoff packs the entry frontier (up to
-                # cap_schedule[-1] configs) into caps[0]-sized buffers,
-                # and a smaller cap would silently drop live configs —
-                # verdict-flipping (mirrors _mw_spike_caps's filter).
-                mw_caps = None
-                pk_caps = None
+                # a smaller cap would silently drop live frontier
+                # configs — verdict-flipping. The multiword ladder is
+                # additionally memory-bounded (fat states).
                 if state_bits is None:
-                    mw_caps = _mw_spike_caps(p.window, nw, S,
+                    sp_caps = _mw_spike_caps(p.window, nw, S,
                                              cap_schedule[-1], spike_caps)
                 else:
-                    pk_caps = tuple(sorted(
+                    sp_caps = tuple(sorted(
                         c for c in spike_caps if c > cap_schedule[-1])) \
                         or None
-                if mw_caps is None and pk_caps is None:
+                if sp_caps is None:
                     return {"valid?": "unknown", "analyzer": "tpu-bfs",
                             "error": ("frontier exceeded capacity "
                                       f"{cap_schedule[-1]}")}
                 # Recover the frontier just before the spike row with ONE
                 # re-run of the rows that did fit (the failed run's
-                # r_done-1), so the spike executor starts at the spike,
-                # not at chunk entry.
+                # r_done-1), so spike mode starts at the spike, not at
+                # chunk entry.
                 n_pre = int(r_done) - 1
                 if n_pre > 0:
                     b2, s2, c2, _, _, o_pre = _search_chunk(
@@ -848,55 +734,18 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                         bits, state, count = b2, s2, c2
                     else:
                         n_pre = 0  # extremely rare: spike at first row
-                tables_h = (ret_slot_h, active_h, slot_f_h, slot_v_h,
-                            pure_h, pred_bit_h)
                 # Dropback clamped so the handed-back frontier always
-                # fits the chunked engine's top cap — a larger count
-                # would be silently truncated on resume and could flip
-                # the verdict.
-                dropback = min(spike_dropback, cap_schedule[-1])
-                if state_bits is not None:
-                    (keys, count_i, next_r, dead_h, ovf_h, cancelled,
-                     dead_entry) = _hostloop_rows(
-                        p, base + n_pre,
-                        _pack_frontier_keys(bits, state, count, pk_caps[0],
-                                    state_bits, nil_id),
-                        count, tables_h=tables_h, b=state_bits,
-                        nil_id=nil_id, step_fn=step_fn,
-                        read_value_match=read_value_match,
-                        cancel=cancel, caps=pk_caps,
-                        dropback=dropback)
-                    spike_top = pk_caps[-1]
-                    max_cap_used = max(max_cap_used, keys.shape[0])
-
-                    def resume_frontier(cap):
-                        return _unpack_frontier_keys(keys, count_i, cap,
-                                                     state_bits, nil_id)
-
-                    if dead_entry is not None and snapshots is not None:
-                        # Convert only when explain will consume it —
-                        # this materializes spike-cap-sized arrays.
-                        e_keys, e_count = dead_entry
-                        e_bits, e_state = _unpack_frontier_keys(
-                            e_keys, e_count, e_keys.shape[0],
-                            state_bits, nil_id)
-                        dead_entry = (e_bits, e_state, e_count)
-                    else:
-                        dead_entry = None
-                else:
-                    (s_bits, s_state, count_i, next_r, dead_h, ovf_h,
-                     cancelled, dead_entry) = _hostloop_rows_mw(
-                        p, base + n_pre, bits, state, count,
-                        tables_h=tables_h, step_fn=step_fn,
-                        cancel=cancel, caps=mw_caps, dropback=dropback)
-                    spike_top = mw_caps[-1]
-                    max_cap_used = max(max_cap_used, s_bits.shape[0])
-
-                    def resume_frontier(cap):
-                        return s_bits[:cap], s_state[:cap]
-
-                spiked = (count_i, next_r, dead_h, ovf_h, cancelled,
-                          dead_entry, resume_frontier, spike_top)
+                # fits the chunked engine's top cap.
+                spiked = _spike_rows(
+                    p, base + n_pre, bits, state, count,
+                    tables_h=(ret_slot_h, active_h, slot_f_h, slot_v_h,
+                              pure_h, pred_bit_h),
+                    caps=sp_caps,
+                    dropback=min(spike_dropback, cap_schedule[-1]),
+                    step_fn=step_fn, state_bits=state_bits,
+                    nil_id=nil_id, read_value_match=read_value_match,
+                    cancel=cancel, snapshots=snapshots)
+                spike_top = sp_caps[-1]
                 break
             # Retry this chunk from its entry frontier at the next cap.
             level += 1
@@ -906,8 +755,9 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             bits = jnp.pad(bits, ((0, grow), (0, 0)))
             state = jnp.pad(state, ((0, grow), (0, 0)))
         if spiked is not None:
-            (count_i, next_r, dead_h, ovf_h, cancelled, dead_entry,
-             resume_frontier, spike_top) = spiked
+            (s_bits, s_state, count_i, next_r, dead_h, ovf_h, cancelled,
+             top_used) = spiked
+            max_cap_used = max(max_cap_used, top_used)
             if cancelled:
                 return {"valid?": "unknown", "analyzer": "tpu-bfs",
                         "error": "cancelled"}
@@ -916,30 +766,25 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                         "error": ("frontier exceeded capacity "
                                   f"{spike_top}")}
             if dead_h:
+                # Snapshots were re-anchored at the dead row's entry by
+                # _spike_rows (one row of CPU replay for explain).
                 r_done = jnp.int32(next_r - base)
                 dead = True
-                if snapshots is not None and dead_entry is not None:
-                    # Re-anchor the counterexample replay at the dead
-                    # row's ENTRY frontier so the plain CPU replay is one
-                    # row, not the whole spike region it could never
-                    # traverse.
-                    e_bits, e_state, e_count = dead_entry
-                    snapshots[:] = [(next_r - 1, e_bits, e_state,
-                                     e_count)]
             elif next_r >= p.R:
                 return {"valid?": True, "analyzer": "tpu-bfs",
                         "configs": [], "final-frontier-size": count_i,
                         "max-cap": max_cap_used}
             else:
-                # Resume the chunked engine at the hand-back row with the
-                # (shrunken) spike frontier — at the TOP chunked level:
-                # the neighbourhood of a spike tends to spike again, and
-                # re-climbing the whole cap ladder there costs far more
-                # than one over-provisioned chunk. The shrink logic below
-                # drops the level back once chunks run clean.
+                # Resume full-size chunks at the hand-back row — at the
+                # TOP chunked level: the neighbourhood of a spike tends
+                # to spike again, and re-climbing the whole cap ladder
+                # there costs far more than one over-provisioned chunk.
+                # The shrink logic below drops the level back once
+                # chunks run clean.
                 level = len(cap_schedule) - 1
                 cap = cap_schedule[level]
-                bits, state = resume_frontier(cap)
+                bits = s_bits[:cap]
+                state = s_state[:cap]
                 count = jnp.int32(count_i)
                 base = next_r
                 continue

@@ -33,7 +33,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from jepsen_tpu.lin.bfs import _expand_keys, _pad_rows
+from jepsen_tpu.lin.bfs import KEY_FILL, _expand_keys, _pad_rows
 
 # The sparse sharded frontier keeps single-word bitsets (the all_gather
 # dedup keys stay u32); wider windows fall back to the single-chip engine.
@@ -42,9 +42,6 @@ MAX_DEVICE_WINDOW = 32
 # path; the dense hypercube engine handles long histories chunked).
 MAX_SHARDED_ROWS = 8192
 from jepsen_tpu.lin.prepare import PackedHistory
-
-
-KEY_FILL = jnp.uint32(0xFFFFFFFF)
 
 
 def _global_dedup_keys(keys, valid, cap_local, axis):

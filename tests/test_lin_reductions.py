@@ -212,6 +212,24 @@ class TestWideWindowDevice:
                              spike_caps=(1024, 16384), spike_dropback=4)
         assert r["valid?"] == want
 
+    def test_explain_through_spike_death(self):
+        # A death decided inside spike mode must still produce
+        # final-paths, via the dead ROW's entry snapshot (bounded
+        # one-row CPU replay).
+        h = synth.corrupt_history(
+            synth.generate_register_history(120, concurrency=8, seed=4,
+                                            value_range=3,
+                                            crash_prob=0.05), seed=4)
+        p = prepare.prepare(m.cas_register(), h)
+        want = cpu.check_packed(p)
+        got = bfs.check_packed(p, cap_schedule=(2,),
+                               spike_caps=(1024, 16384),
+                               spike_dropback=2, explain=True)
+        assert got["valid?"] == want["valid?"]
+        if want["valid?"] is False:
+            assert got["op"] == want["op"]
+            assert got["final-paths"], got
+
     def test_spike_executor_death_row_matches_oracle(self):
         h = synth.corrupt_history(
             synth.generate_register_history(120, concurrency=8, seed=2,
