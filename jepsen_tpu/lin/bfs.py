@@ -63,7 +63,7 @@ from jepsen_tpu.lin.prepare import PackedHistory
 # at the SPIKE_CAP_SCHEDULE capacities (32 keeps a 16x margin to the
 # known-bad 512 while amortizing dispatch overhead).
 DEFAULT_CAP_SCHEDULE = (256, 2048, 16384, 131072)
-SPIKE_CAP_SCHEDULE = (262144, 1048576)
+SPIKE_CAP_SCHEDULE = (262144, 524288, 1048576)
 SPIKE_CHUNK = 32
 # Frontier size at which spike mode hands back to full-size chunks (at
 # a mini-chunk boundary with count at most this).
@@ -456,7 +456,10 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
     return out_bits, out_state, count, r, dead, ovf
 
 
-_MW_SPIKE_BUDGET_BYTES = 3 << 29   # ~1.5 GiB of sort operands per pass
+# Multi-operand sorts at the 1M-cap multiword shape (34M rows x 4
+# columns) kill the TPU worker; ~1.5 GiB of sort operands per pass is
+# the measured-safe budget (the 524288 tier for window-33 registers).
+_MW_SPIKE_BUDGET_BYTES = 3 << 29
 
 
 def _mw_spike_caps(W, nw, S, chunk_top, spike_caps):

@@ -38,8 +38,9 @@ from jepsen_tpu.lin.bfs import KEY_FILL, _expand_keys, _pad_rows
 # The sparse sharded frontier keeps single-word bitsets (the all_gather
 # dedup keys stay u32); wider windows fall back to the single-chip engine.
 MAX_DEVICE_WINDOW = 32
-# Whole-history single-program bound (no chunking in the sparse mesh
-# path; the dense hypercube engine handles long histories chunked).
+# Whole-history single-program bound for the MULTIWORD mesh path (no
+# chunking there). The packed-key mesh path chunks like bfs.check_packed
+# and has no length bound; the dense hypercube engine likewise.
 MAX_SHARDED_ROWS = 8192
 from jepsen_tpu.lin.prepare import PackedHistory
 
@@ -233,28 +234,25 @@ def _search_sharded(ret_slot, active, slot_f, slot_v, pure, pred_mask,
 @partial(jax.jit, static_argnames=("cap_local", "step_fn", "mesh", "axis",
                                    "b", "nil_id", "read_value_match"))
 def _search_sharded_keys(ret_slot, active, slot_f, slot_v, pure, pred_mask,
-                         init_state, *, cap_local, step_fn, mesh, b,
-                         nil_id, read_value_match, axis="d"):
-    """Packed-u32-key shard_map search: each device owns cap_local keys
-    (bits << b | state id, the bfs._pack_frontier_keys layout); dedup is
-    the single-array collective of _global_dedup_keys. The row loop is
-    the sharded twin of bfs._search_chunk_keys — saturation, canonical
-    chains, and the register-family inline-read fast table included.
-    Returns replicated (ok, dead_row, overflow, total)."""
-    from jepsen_tpu.models.kernels import NIL
+                         keys, counts, n_rows, *, cap_local, step_fn,
+                         mesh, b, nil_id, read_value_match, axis="d"):
+    """ONE chunk of the packed-u32-key mesh search: each device owns
+    cap_local keys (bits << b | state id, the bfs._pack_frontier_keys
+    layout) of the globally [n_dev*cap_local]-shaped ``keys``; ``counts``
+    is the per-device live count [n_dev]. Dedup is the single-array
+    collective of _global_dedup_keys; candidate generation is
+    bfs._expand_keys, so the pass semantics (saturation, canonical
+    chains, the register read fast table) are byte-identical to the
+    single-chip engine. The frontier carries between chunk dispatches
+    exactly like bfs.check_packed, so history length is unbounded.
+    Returns (keys', counts', rows_done, dead, overflow, total) — the
+    last four replicated scalars."""
+    C, W = active.shape
 
-    R, W = active.shape
-
-    def shard_body(ret_slot, active, slot_f, slot_v, pure, pred_mask,
-                   init_state):
-        d = lax.axis_index(axis)
-        sv0 = init_state[0]
-        init_key = (jnp.where(sv0 == NIL, nil_id, sv0)
-                    .astype(jnp.uint32))
-        keys0 = jnp.full(cap_local, KEY_FILL, jnp.uint32)
-        keys0 = jnp.where((d == 0) & (jnp.arange(cap_local) == 0),
-                          init_key, keys0)
-        count0 = jnp.where(d == 0, jnp.int32(1), jnp.int32(0))
+    def shard_body(n_rows, ret_slot, active, slot_f, slot_v, pure,
+                   pred_mask, keys, counts):
+        count = counts[0]
+        total0 = lax.psum(count, axis)
 
         def closure_cond(c):
             _, _, _, changed, ovf = c
@@ -271,9 +269,6 @@ def _search_sharded_keys(ret_slot, active, slot_f, slot_v, pure, pred_mask,
 
             def closure_body(c):
                 keys_in, count, total, _, ovf = c
-                # Candidate generation is bfs._expand_keys — the single
-                # definition of the packed-key pass semantics; only the
-                # dedup differs (collective here, local on one chip).
                 cand, cand_valid = _expand_keys(
                     keys_in, count, act, f_row, v_row, pure_row,
                     pred_row, cap=cap_local, W=W, b=b, nil_id=nil_id,
@@ -299,20 +294,24 @@ def _search_sharded_keys(ret_slot, active, slot_f, slot_v, pure, pred_mask,
 
         def row_cond(carry):
             r, _, _, _, dead, ovf = carry
-            return (r < R) & ~dead & ~ovf
+            return (r < n_rows) & ~dead & ~ovf
 
         r, keys, count, total, dead, ovf = lax.while_loop(
             row_cond, row_body,
-            (jnp.int32(0), keys0, count0, jnp.int32(1), False, False))
-        return (~dead & ~ovf)[None], (r - 1)[None], ovf[None], total[None]
+            (jnp.int32(0), keys, count, total0, False, False))
+        return (keys, count[None], r[None], dead[None], ovf[None],
+                total[None])
 
     fn = jax.shard_map(shard_body, mesh=mesh,
-                       in_specs=(P(), P(), P(), P(), P(), P(), P()),
-                       out_specs=(P(axis), P(axis), P(axis), P(axis)),
+                       in_specs=(P(), P(), P(), P(), P(), P(), P(),
+                                 P(axis), P(axis)),
+                       out_specs=(P(axis), P(axis), P(axis), P(axis),
+                                  P(axis), P(axis)),
                        check_vma=False)
-    ok, dead_row, ovf, total = fn(ret_slot, active, slot_f, slot_v,
-                                  pure, pred_mask, init_state)
-    return ok[0], dead_row[0], ovf[0], total[0]
+    keys, counts, r, dead, ovf, total = fn(
+        n_rows, ret_slot, active, slot_f, slot_v, pure, pred_mask,
+        keys, counts)
+    return keys, counts, r[0], dead[0], ovf[0], total[0]
 
 
 DEFAULT_CAP_PER_DEVICE = (64, 1024, 16384)
@@ -349,22 +348,39 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
                 "error": f"window {p.window} exceeds device bitset"}
     if p.R == 0:
         return {"valid?": True, "analyzer": "tpu-bfs-sharded"}
-    if p.R > MAX_SHARDED_ROWS:
-        # The sparse sharded search runs the whole history as ONE device
-        # program (no chunking); past this bound a single dispatch risks
-        # watchdog kills. Dense-shardable histories never get here.
-        return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
-                "error": f"history length {p.R} exceeds the unchunked "
-                         f"sparse-sharded bound {MAX_SHARDED_ROWS}; "
-                         f"use the single-chip engine"}
 
     axis = mesh.axis_names[0]
 
-    ret_slot_h, active_h, slot_f_h, slot_v_h = _pad_rows(p)
     from jepsen_tpu.lin.bfs import reduction_bit_tables
     from jepsen_tpu.models.kernels import (PACKED_STATE_KERNELS,
                                            READ_VALUE_MATCH_KERNELS)
 
+    # Packed-u32 keys when the window plus state id fit 31 bits: the
+    # collective dedup then all_gathers ONE u32 array instead of bits +
+    # state columns — far fewer ICI bytes per dedup. The packed path
+    # chunks (static 512-row table slices), so it needs neither the
+    # R-bucketing identity rows nor the pad slot of _pad_rows and runs
+    # exactly p.R rows on the raw tables.
+    state_bits = nil_id = None
+    if p.init_state.shape[0] == 1 \
+            and p.kernel.name in PACKED_STATE_KERNELS:
+        nid = max(len(p.unintern), 2)
+        bb = nid.bit_length()
+        if p.window + bb <= 31:
+            state_bits, nil_id = bb, nid
+    dedup_kind = "packed-keys" if state_bits is not None else "multiword"
+
+    if state_bits is not None:
+        pure_k, pred_bit_k = reduction_bit_tables(p, 1)
+        tables_h = (np.asarray(p.ret_slot), np.asarray(p.active),
+                    np.asarray(p.slot_f), np.asarray(p.slot_v),
+                    pure_k, pred_bit_k[:, :, 0])
+        return _run_packed_chunks(
+            p, mesh, axis, tables_h, cap_schedule,
+            b=state_bits, nil_id=nil_id,
+            read_value_match=p.kernel.name in READ_VALUE_MATCH_KERNELS)
+
+    ret_slot_h, active_h, slot_f_h, slot_v_h = _pad_rows(p)
     pure_k, pred_bit_k = reduction_bit_tables(p, 1)
     R, W = p.active.shape
     pure_h = np.zeros(active_h.shape, bool)
@@ -376,29 +392,17 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
             jnp.asarray(pure_h), jnp.asarray(pred_mask_h),
             jnp.asarray(p.init_state))
 
-    # Packed-u32 keys when the (padded) window plus state id fit 31
-    # bits: the collective dedup then all_gathers ONE u32 array instead
-    # of bits + state columns — far fewer ICI bytes per dedup.
-    state_bits = nil_id = None
-    if p.init_state.shape[0] == 1 \
-            and p.kernel.name in PACKED_STATE_KERNELS:
-        nid = max(len(p.unintern), 2)
-        bb = nid.bit_length()
-        if active_h.shape[1] + bb <= 31:
-            state_bits, nil_id = bb, nid
-    dedup_kind = "packed-keys" if state_bits is not None else "multiword"
-
+    # Multiword mesh path: the whole history is ONE device program (no
+    # chunking); past this bound a single dispatch risks watchdog kills.
+    if p.R > MAX_SHARDED_ROWS:
+        return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
+                "error": f"history length {p.R} exceeds the unchunked "
+                         f"multiword mesh bound {MAX_SHARDED_ROWS}; "
+                         f"use the single-chip engine"}
     for cap in cap_schedule:
-        if state_bits is not None:
-            ok, dead_row, overflow, total = _search_sharded_keys(
-                *args, cap_local=cap, step_fn=p.kernel.step, mesh=mesh,
-                b=state_bits, nil_id=nil_id,
-                read_value_match=p.kernel.name in READ_VALUE_MATCH_KERNELS,
-                axis=axis)
-        else:
-            ok, dead_row, overflow, total = _search_sharded(
-                *args, cap_local=cap, step_fn=p.kernel.step, mesh=mesh,
-                axis=axis)
+        ok, dead_row, overflow, total = _search_sharded(
+            *args, cap_local=cap, step_fn=p.kernel.step, mesh=mesh,
+            axis=axis)
         if not bool(overflow):
             break
     if bool(overflow):
@@ -414,3 +418,76 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
             "op": {"process": ret.process, "f": ret.f, "value": ret.value,
                    "index": ret.op_index, "ok": ret.ok},
             "configs": [], "final-paths": []}
+
+
+SHARDED_CHUNK = 512
+
+
+def _run_packed_chunks(p, mesh, axis, tables_h, cap_schedule, *, b,
+                       nil_id, read_value_match):
+    """Host loop over SHARDED_CHUNK-row dispatches of the packed-key
+    mesh search: the frontier (global [n_dev*cap] keys + per-device
+    counts) carries device-resident between chunks, so history length is
+    unbounded — the mesh twin of bfs.check_packed's chunk loop, with
+    per-chunk capacity escalation from the chunk-entry snapshot."""
+    from jepsen_tpu.lin.bfs import _chunk_slice
+    from jepsen_tpu.models.kernels import NIL
+
+    n_dev = int(np.prod(mesh.devices.shape))
+    step_fn = p.kernel.step
+
+    sv0 = int(p.init_state[0])
+    init_key = np.uint32(nil_id if sv0 == int(NIL) else sv0)
+    level = 0
+    cap = cap_schedule[level]
+    keys = jnp.full(n_dev * cap, KEY_FILL, jnp.uint32).at[0].set(init_key)
+    counts = jnp.zeros(n_dev, jnp.int32).at[0].set(1)
+
+    def resize(keys, old_cap, new_cap):
+        k = keys.reshape(n_dev, old_cap)
+        k = jnp.pad(k, ((0, 0), (0, new_cap - old_cap)),
+                    constant_values=KEY_FILL)
+        return k.reshape(-1)
+
+    base = 0
+    while base < p.R:
+        n = min(SHARDED_CHUNK, p.R - base)
+        tbl = tuple(jnp.asarray(_chunk_slice(a, base, SHARDED_CHUNK))
+                    for a in tables_h)
+        while True:
+            k2, c2, r_done, dead, ovf, total = _search_sharded_keys(
+                *tbl, keys, counts, jnp.int32(n),
+                cap_local=cap_schedule[level], step_fn=step_fn,
+                mesh=mesh, b=b, nil_id=nil_id,
+                read_value_match=read_value_match, axis=axis)
+            if not bool(ovf):
+                break
+            if level + 1 >= len(cap_schedule):
+                return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
+                        "error": (f"frontier exceeded {cap_schedule[-1]} "
+                                  f"per device")}
+            # Retry this chunk from its entry frontier at the next cap.
+            level += 1
+            keys = resize(keys, cap, cap_schedule[level])
+            cap = cap_schedule[level]
+        if bool(dead):
+            r = base + int(r_done) - 1
+            ret = p.ops[int(p.ret_op[r])]
+            return {"valid?": False, "analyzer": "tpu-bfs-sharded",
+                    "dedup": "packed-keys",
+                    "op": {"process": ret.process, "f": ret.f,
+                           "value": ret.value, "index": ret.op_index,
+                           "ok": ret.ok},
+                    "configs": [], "final-paths": []}
+        keys, counts = k2, c2
+        base += n
+        # Shrink back to a smaller (faster) program when the global
+        # frontier has room to spare; survivors are globally packed to
+        # the front, so slicing each device's prefix keeps them all.
+        while level > 0 and int(total) * 4 <= cap_schedule[level - 1]:
+            new_cap = cap_schedule[level - 1]
+            keys = keys.reshape(n_dev, cap)[:, :new_cap].reshape(-1)
+            level -= 1
+            cap = new_cap
+    return {"valid?": True, "analyzer": "tpu-bfs-sharded",
+            "dedup": "packed-keys", "final-frontier-size": int(total)}

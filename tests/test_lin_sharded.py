@@ -67,20 +67,38 @@ def test_mutex_sharded():
     assert sharded.check_packed(p, mesh=mesh(2))["valid?"] is False
 
 
-def test_sparse_sharded_rejects_unchunked_long_history():
-    # the sparse mesh path runs the whole history as one program; past
-    # the bound it must refuse rather than risk a watchdog kill
+def test_multiword_mesh_rejects_unchunked_long_history():
+    # the MULTIWORD mesh path runs the whole history as one program;
+    # past the bound it must refuse rather than risk a watchdog kill.
+    # (The packed-key mesh path chunks and has no length bound.)
     from jepsen_tpu.lin import sharded
 
-    p = prepare.prepare(m.cas_register(), synth.generate_register_history(
+    # set kernel is outside PACKED_STATE_KERNELS => multiword mesh path
+    p = prepare.prepare(m.set_model(), synth.generate_set_history(
         30, concurrency=3, seed=1))
-    # simulate a long history by patching R past the bound
     import dataclasses
 
     big = dataclasses.replace(p, R=sharded.MAX_SHARDED_ROWS + 1)
     r = sharded.check_packed(big, mesh=mesh(2), engine="sparse")
     assert r["valid?"] == "unknown"
     assert "exceeds" in r["error"]
+
+
+def test_packed_mesh_chunks_long_history():
+    # ~1.3k return events at chunk 512: three carried-frontier chunk
+    # dispatches on the mesh, parity with the oracle.
+    h = synth.generate_register_history(2600, concurrency=4, seed=6,
+                                        value_range=3, crash_prob=0.02,
+                                        max_crashes=3)
+    p = prepare.prepare(m.cas_register(), h)
+    want = cpu.check_packed(p)["valid?"]
+    r = sharded.check_packed(p, mesh=mesh(8), engine="sparse")
+    assert r["dedup"] == "packed-keys"
+    assert r["valid?"] == want
+    hb = synth.corrupt_history(h, seed=6)
+    pb = prepare.prepare(m.cas_register(), hb)
+    rb = sharded.check_packed(pb, mesh=mesh(8), engine="sparse")
+    assert rb["valid?"] == cpu.check_packed(pb)["valid?"]
 
 
 class TestPackedKeyDedup:
