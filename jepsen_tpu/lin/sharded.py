@@ -319,7 +319,8 @@ DEFAULT_CAP_PER_DEVICE = (64, 1024, 16384)
 
 def check_packed(p: PackedHistory, mesh: Mesh | None = None,
                  cap_schedule=DEFAULT_CAP_PER_DEVICE,
-                 engine: str = "auto") -> dict:
+                 engine: str = "auto", cancel=None,
+                 explain: bool = False) -> dict:
     """Decide linearizability with the frontier sharded over a mesh. With
     no mesh, shards over all visible devices on axis 'd'.
 
@@ -338,7 +339,8 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
 
         n_dev = int(np.prod(mesh.devices.shape))
         if sharded_dense.plan(p, n_dev) is not None:
-            return sharded_dense.check_packed(p, mesh=mesh)
+            return sharded_dense.check_packed(p, mesh=mesh, cancel=cancel,
+                                              explain=explain)
 
     if p.kernel is None:
         return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
@@ -378,7 +380,8 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
         return _run_packed_chunks(
             p, mesh, axis, tables_h, cap_schedule,
             b=state_bits, nil_id=nil_id,
-            read_value_match=p.kernel.name in READ_VALUE_MATCH_KERNELS)
+            read_value_match=p.kernel.name in READ_VALUE_MATCH_KERNELS,
+            cancel=cancel, explain=explain)
 
     ret_slot_h, active_h, slot_f_h, slot_v_h = _pad_rows(p)
     pure_k, pred_bit_k = reduction_bit_tables(p, 1)
@@ -400,6 +403,9 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
                          f"multiword mesh bound {MAX_SHARDED_ROWS}; "
                          f"use the single-chip engine"}
     for cap in cap_schedule:
+        if cancel is not None and cancel.is_set():
+            return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
+                    "error": "cancelled"}
         ok, dead_row, overflow, total = _search_sharded(
             *args, cap_local=cap, step_fn=p.kernel.step, mesh=mesh,
             axis=axis)
@@ -413,18 +419,28 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
                 "dedup": dedup_kind, "final-frontier-size": int(total)}
     r = int(dead_row)
     ret = p.ops[int(p.ret_op[r])]
-    return {"valid?": False, "analyzer": "tpu-bfs-sharded",
-            "dedup": dedup_kind,
-            "op": {"process": ret.process, "f": ret.f, "value": ret.value,
-                   "index": ret.op_index, "ok": ret.ok},
-            "configs": [], "final-paths": []}
+    out = {"valid?": False, "analyzer": "tpu-bfs-sharded",
+           "dedup": dedup_kind,
+           "op": {"process": ret.process, "f": ret.f, "value": ret.value,
+                  "index": ret.op_index, "ok": ret.ok},
+           "configs": [], "final-paths": []}
+    if explain:
+        # The multiword mesh search runs the whole (<= MAX_SHARDED_ROWS)
+        # history as one program, so there is no chunk snapshot: replay
+        # from the initial config.
+        from jepsen_tpu.lin import witness
+
+        init = (0, tuple(int(x) for x in p.init_state))
+        out.update(witness.replay_configs(p, {init}, 0, r, cancel=cancel))
+    return out
 
 
 SHARDED_CHUNK = 512
 
 
 def _run_packed_chunks(p, mesh, axis, tables_h, cap_schedule, *, b,
-                       nil_id, read_value_match):
+                       nil_id, read_value_match, cancel=None,
+                       explain=False):
     """Host loop over SHARDED_CHUNK-row dispatches of the packed-key
     mesh search: the frontier (global [n_dev*cap] keys + per-device
     counts) carries device-resident between chunks, so history length is
@@ -449,8 +465,16 @@ def _run_packed_chunks(p, mesh, axis, tables_h, cap_schedule, *, b,
                     constant_values=KEY_FILL)
         return k.reshape(-1)
 
+    snapshots = [] if explain else None
     base = 0
     while base < p.R:
+        if cancel is not None and cancel.is_set():
+            return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
+                    "error": "cancelled"}
+        if snapshots is not None:
+            # Only the last snapshot is replayed (the dead row is inside
+            # the current chunk).
+            snapshots[:] = [(base, keys, counts)]
         n = min(SHARDED_CHUNK, p.R - base)
         tbl = tuple(jnp.asarray(_chunk_slice(a, base, SHARDED_CHUNK))
                     for a in tables_h)
@@ -473,12 +497,26 @@ def _run_packed_chunks(p, mesh, axis, tables_h, cap_schedule, *, b,
         if bool(dead):
             r = base + int(r_done) - 1
             ret = p.ops[int(p.ret_op[r])]
-            return {"valid?": False, "analyzer": "tpu-bfs-sharded",
-                    "dedup": "packed-keys",
-                    "op": {"process": ret.process, "f": ret.f,
-                           "value": ret.value, "index": ret.op_index,
-                           "ok": ret.ok},
-                    "configs": [], "final-paths": []}
+            out = {"valid?": False, "analyzer": "tpu-bfs-sharded",
+                   "dedup": "packed-keys",
+                   "op": {"process": ret.process, "f": ret.f,
+                          "value": ret.value, "index": ret.op_index,
+                          "ok": ret.ok},
+                   "configs": [], "final-paths": []}
+            if snapshots:
+                # Global keys are front-packed in global index order, so
+                # the single-chip unpack applies to the gathered array.
+                from jepsen_tpu.lin import witness
+                from jepsen_tpu.lin.bfs import _unpack_frontier_keys
+
+                s_base, s_keys, s_counts = snapshots[-1]
+                tot = int(np.asarray(s_counts).sum())
+                kb, ks = _unpack_frontier_keys(
+                    jnp.asarray(np.asarray(s_keys)), tot,
+                    s_keys.shape[0], b, nil_id)
+                out.update(witness.tail_replay_sparse(
+                    p, [(s_base, kb, ks, tot)], r, cancel=cancel))
+            return out
         keys, counts = k2, c2
         base += n
         # Shrink back to a smaller (faster) program when the global

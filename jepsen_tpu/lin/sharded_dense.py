@@ -180,10 +180,13 @@ def _chunk_sharded(F_local, n_rows, nil_id, ret_slot, active, slot_f,
 
 
 def check_packed(p: PackedHistory, mesh: Mesh, chunk: int = CHUNK,
-                 cancel=None) -> dict:
+                 cancel=None, explain: bool = False) -> dict:
     """Decide linearizability with the config space sharded over ``mesh``
     (first axis). Same zero-host-sync chunk chaining as the single-chip
-    dense engine."""
+    dense engine. ``explain=True`` retains every chunk-entry bitmap (the
+    chunks pipeline without host syncs, so the dead chunk is only known
+    at the end) and, on an invalid verdict, replays the failing tail on
+    the CPU oracle for knossos-style configs + final-paths."""
     n_devices = int(np.prod(mesh.devices.shape))
     pl = plan(p, n_devices)
     if pl is None:
@@ -214,12 +217,15 @@ def check_packed(p: PackedHistory, mesh: Mesh, chunk: int = CHUNK,
         pad[1] = (0, w - a.shape[1])
         return np.pad(a, pad)
 
+    snapshots = [] if explain else None
     results = []
     base = 0
     while base < p.R:
         if cancel is not None and cancel.is_set():
             return {"valid?": "unknown", "analyzer": "tpu-dense-sharded",
                     "error": "cancelled"}
+        if snapshots is not None:
+            snapshots.append((base, F))
         n = min(chunk, p.R - base)
         F, r_done, dead = _chunk_sharded(
             F, jnp.int32(n), jnp.int32(nil_id),
@@ -235,12 +241,23 @@ def check_packed(p: PackedHistory, mesh: Mesh, chunk: int = CHUNK,
         if bool(dead[0]):
             r = base + int(r_done[0]) - 1
             ret = p.ops[int(p.ret_op[r])]
-            return {"valid?": False, "analyzer": "tpu-dense-sharded",
-                    "dead-row": r,
-                    "op": {"process": ret.process, "f": ret.f,
-                           "value": ret.value, "index": ret.op_index,
-                           "ok": ret.ok},
-                    "configs": [], "final-paths": []}
+            out = {"valid?": False, "analyzer": "tpu-dense-sharded",
+                   "dead-row": r,
+                   "op": {"process": ret.process, "f": ret.f,
+                          "value": ret.value, "index": ret.op_index,
+                          "ok": ret.ok},
+                   "configs": [], "final-paths": []}
+            if snapshots:
+                from jepsen_tpu.lin import witness
+
+                # Gather only the last snapshot at or before the dead
+                # row — the replay uses exactly one entry bitmap.
+                usable = [sn for sn in snapshots if sn[0] <= r]
+                flat = [(b0, np.asarray(f0).reshape(-1))
+                        for b0, f0 in usable[-1:]]
+                out.update(witness.tail_replay(p, nil_id, flat, r,
+                                               cancel=cancel))
+            return out
     return {"valid?": True, "analyzer": "tpu-dense-sharded",
             "final-frontier-popcount": int(
                 jnp.sum(lax.population_count(F))),

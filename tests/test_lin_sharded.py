@@ -140,3 +140,33 @@ class TestPackedKeyDedup:
         r = sharded.check_packed(p, mesh=mesh(4), engine="sparse")
         assert r["dedup"] == "packed-keys"
         assert r["valid?"] == cpu.check_packed(p)["valid?"]
+
+
+def test_mesh_explain_final_paths():
+    # Both mesh engines must explain device-decided violations like the
+    # single-chip engines: configs + final-paths from a CPU tail replay.
+    h = synth.corrupt_history(
+        synth.generate_register_history(60, concurrency=4, seed=5,
+                                        value_range=3, crash_prob=0.1),
+        seed=5)
+    p = prepare.prepare(m.cas_register(), h)
+    want = cpu.check_packed(p)
+    assert want["valid?"] is False  # keep this test's coverage honest
+    r = sharded.check_packed(p, mesh=mesh(8), explain=True)
+    assert r["valid?"] is False
+    assert r["op"] == want["op"]
+    assert r["final-paths"], r
+    rs = sharded.check_packed(p, mesh=mesh(8), engine="sparse",
+                              explain=True)
+    assert rs["valid?"] is False and rs["final-paths"], rs
+    # multiword mesh path explains too (replay from the initial config)
+    hs = list(synth.generate_set_history(30, concurrency=3, seed=2))
+    for i in range(len(hs) - 1, -1, -1):
+        if hs[i].is_ok and hs[i].f == "read" and hs[i].value is not None:
+            hs[i] = hs[i].replace(value=list(hs[i].value) + [9999])
+            break
+    ps = prepare.prepare(m.set_model(), hs)
+    rm = sharded.check_packed(ps, mesh=mesh(8), engine="sparse",
+                              explain=True)
+    assert rm["valid?"] is False and rm["dedup"] == "multiword"
+    assert rm["final-paths"], rm
