@@ -425,13 +425,23 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
                   "index": ret.op_index, "ok": ret.ok},
            "configs": [], "final-paths": []}
     if explain:
-        # The multiword mesh search runs the whole (<= MAX_SHARDED_ROWS)
-        # history as one program, so there is no chunk snapshot: replay
-        # from the initial config.
         from jepsen_tpu.lin import witness
 
-        init = (0, tuple(int(x) for x in p.init_state))
-        out.update(witness.replay_configs(p, {init}, 0, r, cancel=cancel))
+        if r < SHARDED_CHUNK:
+            # The multiword mesh search runs the whole history as one
+            # program, so there is no chunk snapshot. Replay from the
+            # initial config ONLY within the bounded-replay contract
+            # (witness.py: one chunk of return events); past that the
+            # host replay of a device-scale frontier could DNF.
+            init = (0, tuple(int(x) for x in p.init_state))
+            out.update(witness.replay_configs(p, {init}, 0, r,
+                                              cancel=cancel))
+        else:
+            out["explain-error"] = (
+                f"dead row {r} is beyond the bounded replay window "
+                f"({SHARDED_CHUNK} rows); the unchunked multiword mesh "
+                f"path keeps no chunk snapshots — re-check on the "
+                f"single-chip engine for a counterexample")
     return out
 
 

@@ -225,7 +225,9 @@ def check_packed(p: PackedHistory, mesh: Mesh, chunk: int = CHUNK,
             return {"valid?": "unknown", "analyzer": "tpu-dense-sharded",
                     "error": "cancelled"}
         if snapshots is not None:
-            snapshots.append((base, F))
+            # Only the last snapshot is ever replayed; the per-chunk
+            # dead fetch below keeps it the right one and HBM flat.
+            snapshots[:] = [(base, F)]
         n = min(chunk, p.R - base)
         F, r_done, dead = _chunk_sharded(
             F, jnp.int32(n), jnp.int32(nil_id),
@@ -236,6 +238,11 @@ def check_packed(p: PackedHistory, mesh: Mesh, chunk: int = CHUNK,
             w=w, ns=ns, k=k, step_fn=step_fn, mesh=mesh, axis=axis)
         results.append((base, r_done, dead))
         base += n
+        # In explain mode trade the zero-host-sync pipelining for one
+        # dead-flag fetch per chunk: early exit at the death keeps the
+        # retained snapshot the dead chunk's entry (dense.py's pattern).
+        if snapshots is not None and bool(dead[0]):
+            break
 
     for base, r_done, dead in results:
         if bool(dead[0]):
