@@ -208,15 +208,17 @@ def checker(inner: checker_ns.Checker,
             from jepsen_tpu.lin import batched as batched_mod
 
             batched = batched_mod.try_check_batch(model, subs)
-        if batched is not None:
-            results = batched
-        else:
-            for k in ks:
-                sub_opts = {**opts,
-                            "subdirectory": _subdir(opts, k),
-                            "history-key": k}
-                results[k] = checker_ns.check_safe(
-                    inner, test, model, subs[k], sub_opts)
+        # The batch may cover a subset (homogeneous groups batch; odd
+        # keys fall back per key below).
+        results = dict(batched or {})
+        for k in ks:
+            if k in results:
+                continue
+            sub_opts = {**opts,
+                        "subdirectory": _subdir(opts, k),
+                        "history-key": k}
+            results[k] = checker_ns.check_safe(
+                inner, test, model, subs[k], sub_opts)
 
         _write_artifacts(test, opts, subs, results)
         failures = [k for k in ks
@@ -231,6 +233,7 @@ def checker(inner: checker_ns.Checker,
                 # engaged or the per-key fallback ran (round-1 review:
                 # the silent fallback was unmeasurable).
                 "batch-engaged": batched is not None,
+                "batch-keys": len(batched or {}),
                 "n-keys": len(ks)}
 
     return checker_ns.FnChecker(check)

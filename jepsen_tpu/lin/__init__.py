@@ -49,7 +49,7 @@ def analysis(model, history, algorithm: str = "competition", **kw) -> dict:
     try:
         packed = _prepare_mod.prepare(model, history)
     except UnsupportedHistory as e:
-        if "concurrency window" in str(e) and algorithm != "tpu":
+        if getattr(e, "kind", None) == "window" and algorithm != "tpu":
             # Past the device bitset (window > 64) the host search still
             # applies — Python int bitsets have no width limit. knossos
             # would grind on such histories too; grinding honestly beats

@@ -136,13 +136,13 @@ def _pad_to(p: PackedHistory, r_pad: int, w_pad: int, nw: int):
 
 
 def try_check_batch(model, subs: dict) -> dict | None:
-    """Check every key's subhistory in one vmapped device search. Returns
-    {key: result} or None when the batch can't run on device (no kernel,
-    window overflow, or frontier overflow at max capacity) — caller falls
-    back to per-key host checking."""
-    import jax
-    import jax.numpy as jnp
-
+    """Check keys' subhistories in vmapped device searches. Keys are
+    GROUPED by (step function, state shape) — one stacked batch must be
+    homogeneous, but history-sized kernels (set/queue widths differ per
+    key) used to de-batch the whole key set on the first mismatch; now
+    each homogeneous group batches independently. Returns {key: result}
+    covering every key that batched (possibly a subset — the caller
+    checks leftovers per key), or None when nothing could batch."""
     if not subs:
         return {}
     packed: dict = {}
@@ -150,19 +150,31 @@ def try_check_batch(model, subs: dict) -> dict | None:
         try:
             p = prepare.prepare(model, sub)
         except prepare.UnsupportedHistory:
-            return None
+            continue
         if p.kernel is None:
-            return None
+            continue
         packed[k] = p
 
-    # Every key must share one step function (and thus state/value widths)
-    # for the stacked batch to be well-formed; history-sized kernels
-    # (set/queue) can differ per key, in which case fall back to per-key.
-    steps = {p.kernel.step for p in packed.values()}
-    if len(steps) > 1:
-        return None
-    if len({tuple(p.init_state.shape) for p in packed.values()}) > 1:
-        return None
+    groups: dict = {}
+    for k, p in packed.items():
+        sig = (p.kernel.step, tuple(p.init_state.shape))
+        groups.setdefault(sig, {})[k] = p
+
+    results: dict = {}
+    for group in groups.values():
+        r = _check_group(group)
+        if r is not None:
+            results.update(r)
+    return results or None
+
+
+def _check_group(packed: dict) -> dict | None:
+    """One homogeneous (shared step fn + state shape) key group through
+    the dense batch, then the sparse batch. None when the group can't
+    run on device (window overflow, resource ceilings, or frontier
+    overflow at max capacity)."""
+    import jax
+    import jax.numpy as jnp
 
     dense_res = _try_dense_batch(packed)
     if dense_res is not None:

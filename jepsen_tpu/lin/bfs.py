@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from jepsen_tpu.lin import psort
 from jepsen_tpu.lin.prepare import PackedHistory
 
 # Caps for the nested-while chunked engine. 131072 is the largest level
@@ -63,6 +64,15 @@ from jepsen_tpu.lin.prepare import PackedHistory
 # at the SPIKE_CAP_SCHEDULE capacities (32 keeps a 16x margin to the
 # known-bad 512 while amortizing dispatch overhead).
 DEFAULT_CAP_SCHEDULE = (256, 2048, 16384, 131072)
+# The compact packed-key register band adapts INSIDE the program (see
+# ROW_TIERS: per-row count-tiered prefixes), so its chunk-level ladder
+# only needs a small level (cheap compile, covers most histories and
+# the CPU test mesh) and the runtime-safe top. The COMPACT program
+# shape (M expansion columns, psort dedup, tier branches) holds up at
+# 262144 x 512 rows on the axon runtime — measured, unlike the round-2
+# full-window shape that faulted past 131072 — so transient mid-closure
+# spikes to ~250k configs never leave the chunked engine.
+PACKED_CAP_SCHEDULE = (16384, 262144)
 SPIKE_CAP_SCHEDULE = (262144, 524288, 1048576)
 SPIKE_CHUNK = 32
 # Frontier size at which spike mode hands back to full-size chunks (at
@@ -75,15 +85,23 @@ CHUNK = 512
 KEY_FILL = jnp.uint32(0xFFFFFFFF)  # pad beyond count; sorts after any config
 
 
-def _dedup_keys(key, valid, cap):
+def _dedup_keys(key, valid, cap, use_psort: bool = False):
     """Single-u32-key sort-dedup (invalid flag in bit 31), compacted by a
     SECOND sort: survivors keep their key, duplicates/invalid become
     KEY_FILL, so sorting packs survivors (still ascending) to the front.
     Two plain sorts, no searchsorted and no big gather — both of which
     kernel-fault the axon TPU runtime past ~2^17-row frontiers, while
-    lax.sort is proven safe standalone to 32M elements here. Returns
-    (keys[cap] ascending + KEY_FILL padding, count, overflow)."""
+    lax.sort is proven safe standalone to 32M elements here.
+
+    With ``use_psort`` (and a size within the in-VMEM bound) both sorts
+    plus the masking run as ONE pallas kernel with the keys resident in
+    VMEM (:mod:`jepsen_tpu.lin.psort`) — 3-30x faster than the
+    stage-overhead-bound lax.sort at frontier sizes.
+
+    Returns (keys[cap] ascending + KEY_FILL padding, count, overflow)."""
     n = key.shape[0]
+    if use_psort and psort.available(n):
+        return psort.dedup_keys(key, valid, cap)
     key = key | ((~valid).astype(jnp.uint32) << 31)
     key_s = lax.sort(key)
     inv_s = key_s >> 31
@@ -97,6 +115,28 @@ def _dedup_keys(key, valid, cap):
     out = lax.sort(jnp.where(mask, key_s, KEY_FILL))[:cap]
     count = jnp.minimum(total, cap)
     return out, count, overflow
+
+
+def _dedup_keys2(hi, lo, valid, cap, use_psort: bool = False):
+    """Pair-key twin of _dedup_keys for 64-bit packed configs (hi, lo
+    u32 words, lexicographic order, invalid flag in hi bit 31). Routes
+    to the in-VMEM pallas pair kernel when sized for it, else two
+    two-operand lax.sorts. Returns (hi[cap], lo[cap], count,
+    overflow)."""
+    n = hi.shape[0]
+    if use_psort and psort.available(n):
+        return psort.dedup_keys2(hi, lo, valid, cap)
+    hi = hi | ((~valid).astype(jnp.uint32) << 31)
+    hi_s, lo_s = lax.sort((hi, lo), num_keys=2)
+    dup = (hi_s == jnp.roll(hi_s, 1)) & (lo_s == jnp.roll(lo_s, 1))
+    first = jnp.arange(n) == 0
+    mask = (hi_s >> 31 == 0) & (first | ~dup)
+    total = jnp.sum(mask.astype(jnp.int32))
+    overflow = total > cap
+    hi2 = jnp.where(mask, hi_s, KEY_FILL)
+    lo2 = jnp.where(mask, lo_s, KEY_FILL)
+    hi_o, lo_o = lax.sort((hi2, lo2), num_keys=2)
+    return hi_o[:cap], lo_o[:cap], jnp.minimum(total, cap), overflow
 
 
 def _dedup(bits, state, valid, cap):
@@ -145,6 +185,91 @@ def _slot_bits(W: int, nw: int):
     return jnp.asarray(tbl)
 
 
+# Expansion-column buckets: the compact tables are padded to the next
+# bucket so one program serves a range of mutator widths.
+_M_BUCKETS = (4, 8, 16, 32)
+
+
+def _key_bit_words(pos):
+    """(lo, hi) u32 masks for KEY-space bit position(s) ``pos`` (numpy
+    int array; negative = no bit)."""
+    pos = np.asarray(pos)
+    live = pos >= 0
+    lo = np.where(live & (pos < 32),
+                  np.uint32(1) << (np.clip(pos, 0, 31).astype(np.uint32)),
+                  np.uint32(0))
+    hi = np.where(live & (pos >= 32),
+                  np.uint32(1) << (np.clip(pos - 32, 0, 31)
+                                   .astype(np.uint32)),
+                  np.uint32(0))
+    return lo, hi
+
+
+def expansion_tables(p: PackedHistory, b: int):
+    """Host-side mutator-compacted expansion tables for the packed-key
+    register band, in KEY space (config key = bitset << b | state-id,
+    held as one u32 for window+b <= 31 or an (hi, lo) u32 pair up to
+    60 — slot j lives at key bit b+j).
+
+    Only active non-pure slots can branch the search (pure slots are
+    absorbed by saturation, prepare.reduction_tables), yet the generic
+    closure pass evaluates candidates for the full window — at
+    cockroach-class concurrency (window ~26-30, half of it reads,
+    cockroach.clj:40-41) more than half the candidate array and the
+    model-step evaluation is dead weight. These tables gather each row's
+    mutator slots into M <= window compact columns (M bucketed so one
+    compiled program serves the history):
+
+    exp_lo/exp_hi[R, M]      u32  slot key-bit (0 = padding)
+    exp_f[R, M]              i32  function id
+    exp_v[R, M, VW]          i32  interned value words
+    exp_act[R, M]            bool column live
+    exp_pred_lo/_hi[R, M]    u32  canonical-chain predecessor key-bit
+
+    Cached on the PackedHistory after first computation.
+    """
+    cached = getattr(p, "_expansion_tables", None)
+    if cached is not None and cached[0] == b:
+        return cached[1]
+
+    from jepsen_tpu.lin.prepare import reduction_tables
+
+    pure, pred = reduction_tables(p)
+    act = np.asarray(p.active)
+    slot_f = np.asarray(p.slot_f)
+    slot_v = np.asarray(p.slot_v)
+    R, W = act.shape
+    vw = slot_v.shape[2]
+    mut = act & ~pure
+    counts = mut.sum(axis=1)
+    need = max(1, int(counts.max()) if R else 1)
+    M = next((bk for bk in _M_BUCKETS if bk >= need), W)
+
+    exp_lo = np.zeros((R, M), np.uint32)
+    exp_hi = np.zeros((R, M), np.uint32)
+    exp_f = np.zeros((R, M), np.int32)
+    exp_v = np.zeros((R, M, vw), np.int32)
+    exp_act = np.zeros((R, M), bool)
+    exp_pred_lo = np.zeros((R, M), np.uint32)
+    exp_pred_hi = np.zeros((R, M), np.uint32)
+
+    rr, jj = np.nonzero(mut)
+    mm = (mut.cumsum(axis=1) - 1)[rr, jj]
+    exp_lo[rr, mm], exp_hi[rr, mm] = _key_bit_words(b + jj)
+    exp_f[rr, mm] = slot_f[rr, jj]
+    exp_v[rr, mm] = slot_v[rr, jj]
+    exp_act[rr, mm] = True
+    pj = pred[rr, jj]
+    pl_, ph_ = _key_bit_words(np.where(pj >= 0, b + pj, -1))
+    exp_pred_lo[rr, mm] = pl_
+    exp_pred_hi[rr, mm] = ph_
+
+    out = (exp_lo, exp_hi, exp_f, exp_v, exp_act, exp_pred_lo,
+           exp_pred_hi)
+    p._expansion_tables = (b, out)
+    return out
+
+
 def reduction_bit_tables(p: PackedHistory, nw: int):
     """Host-side (pure[R,W], pred_bit[R,W,nw]) from
     prepare.reduction_tables: pred slot indices become per-word bitmasks
@@ -161,10 +286,12 @@ def reduction_bit_tables(p: PackedHistory, nw: int):
 
 
 @partial(jax.jit, static_argnames=("cap", "step_fn", "state_bits",
-                                   "nil_id", "read_value_match"))
+                                   "nil_id", "read_value_match",
+                                   "use_psort", "row_tiers", "key_hi"))
 def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v, pure, pred_bit,
-                  bits, state, count, *, cap, step_fn,
-                  state_bits=None, nil_id=None, read_value_match=False):
+                  bits, state, count, exp_tables=None, *, cap, step_fn,
+                  state_bits=None, nil_id=None, read_value_match=False,
+                  use_psort=False, row_tiers=True, key_hi=False):
     """Process up to n_rows return events (tables are CHUNK-row static
     shapes; rows past n_rows are ignored) starting from a carried frontier.
 
@@ -189,9 +316,10 @@ def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v, pure, pred_bit,
     if state_bits is not None:
         return _search_chunk_keys(
             n_rows, ret_slot, active, slot_f, slot_v, pure, pred_bit,
-            bits, state, count, cap=cap, step_fn=step_fn,
+            bits, state, count, exp_tables, cap=cap, step_fn=step_fn,
             state_bits=state_bits, nil_id=nil_id,
-            read_value_match=read_value_match)
+            read_value_match=read_value_match, use_psort=use_psort,
+            row_tiers=row_tiers, key_hi=key_hi)
     C, W = active.shape
     nw = bits.shape[1]
 
@@ -369,7 +497,7 @@ def _expand_keys(keys_in, count, act, f_row, v_row, pure_row, pred_row,
 
 def _closure_pass_keys(keys_in, count, act, f_row, v_row, pure_row,
                        pred_row, *, cap, W, b, nil_id, step_fn,
-                       read_value_match):
+                       read_value_match, use_psort=False):
     """ONE just-in-time closure pass over packed u32 keys: _expand_keys
     candidates + local sort-dedup. Shared verbatim by the nested-while
     chunk engine and the host-driven spike executor so their semantics
@@ -378,7 +506,7 @@ def _closure_pass_keys(keys_in, count, act, f_row, v_row, pure_row,
         keys_in, count, act, f_row, v_row, pure_row, pred_row, cap=cap,
         W=W, b=b, nil_id=nil_id, step_fn=step_fn,
         read_value_match=read_value_match)
-    k2, n2, o2 = _dedup_keys(cand, cand_valid, cap)
+    k2, n2, o2 = _dedup_keys(cand, cand_valid, cap, use_psort=use_psort)
     # Fixpoint test is against the pass INPUT: the stable set contains
     # both a config and its saturated twin (expansion keeps regenerating
     # the unsaturated parent), so comparing against the in-place-saturated
@@ -387,7 +515,106 @@ def _closure_pass_keys(keys_in, count, act, f_row, v_row, pure_row,
     return k2, n2, changed, o2
 
 
-def _filter_pass_keys(keys, count, s, *, cap, b):
+def _closure_pass_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
+                               exp, *, cap, W, b, nil_id, step_fn,
+                               use_psort=False):
+    """ONE closure pass over packed key configs with mutator-compacted
+    expansion columns (bfs.expansion_tables): semantically identical to
+    _closure_pass_keys for the read-value-match register family (fuzzed
+    in tests/test_lin_psort.py and the engine parity suites), but the
+    model step runs over M mutator columns instead of the full window,
+    and the candidate array is cap*(1+M) instead of cap*(1+W).
+    Carried-key saturation needs no step evaluation at all here: read
+    legality is a pure state-id match, so the per-row saturation table
+    (the rvm branch of _expand_keys) covers it.
+
+    Keys are KEY-space words: ``lo`` u32 (bits << b | state), plus
+    ``hi`` u32 for windows past 31-b bits (None otherwise — the
+    cockroach-class concurrency-30 band lives there). Returns
+    (lo, hi, count, changed, overflow)."""
+    from jepsen_tpu.models.kernels import NIL
+
+    exp_lo, exp_hi, exp_f, exp_v, exp_act, exp_pred_lo, exp_pred_hi = exp
+    pair = hi_in is not None
+    kbit_lo, kbit_hi = _key_bit_words(b + np.arange(W))
+    step_cfg_slot = jax.vmap(
+        jax.vmap(step_fn, in_axes=(None, 0, 0)),
+        in_axes=(0, None, None))
+
+    cfg_valid = jnp.arange(cap) < count
+    state_mask = jnp.uint32((1 << b) - 1)
+    sv = (jnp.where(cfg_valid, lo_in, 0) & state_mask).astype(jnp.int32)
+    state = jnp.where(cfg_valid, jnp.where(sv == nil_id, NIL, sv),
+                      0)[:, None]
+
+    # Saturation tables: pure-slot legality is a plain value match, so
+    # the mask depends only on the state id (see _expand_keys).
+    sid = jnp.arange(1 << b, dtype=jnp.int32)
+    raw = jnp.where(sid == nil_id, NIL, sid)
+    sat_tbl_lo = jnp.zeros(1 << b, jnp.uint32)
+    sat_tbl_hi = jnp.zeros(1 << b, jnp.uint32)
+    for k in range(W):
+        m = (v_row[k, 0] == NIL) | (v_row[k, 0] == raw)
+        cond = m & pure_row[k] & act[k]
+        if int(kbit_lo[k]):
+            sat_tbl_lo = sat_tbl_lo | jnp.where(
+                cond, jnp.uint32(int(kbit_lo[k])), jnp.uint32(0))
+        else:
+            sat_tbl_hi = sat_tbl_hi | jnp.where(
+                cond, jnp.uint32(int(kbit_hi[k])), jnp.uint32(0))
+
+    # Expansion over the M mutator columns only.
+    ok, new_state = step_cfg_slot(state, exp_f, exp_v)
+    nsv = new_state[..., 0]
+    pns = jnp.where(nsv == NIL, nil_id, nsv).astype(jnp.uint32)
+    sat_lo = jnp.zeros_like(lo_in)
+    sat_hi = jnp.zeros_like(lo_in)
+    nsat_lo = jnp.zeros(pns.shape, jnp.uint32)
+    nsat_hi = jnp.zeros(pns.shape, jnp.uint32)
+    for s_id in range(1 << b):
+        sel = sv == s_id
+        nsel = pns == jnp.uint32(s_id)
+        sat_lo = sat_lo | jnp.where(sel, sat_tbl_lo[s_id], jnp.uint32(0))
+        nsat_lo = nsat_lo | jnp.where(nsel, sat_tbl_lo[s_id],
+                                      jnp.uint32(0))
+        if pair:
+            sat_hi = sat_hi | jnp.where(sel, sat_tbl_hi[s_id],
+                                        jnp.uint32(0))
+            nsat_hi = nsat_hi | jnp.where(nsel, sat_tbl_hi[s_id],
+                                          jnp.uint32(0))
+    lo1 = jnp.where(cfg_valid, lo_in | sat_lo, lo_in)
+    hi1 = jnp.where(cfg_valid, hi_in | sat_hi, hi_in) if pair else None
+
+    already = (lo1[:, None] & exp_lo[None, :]) != 0
+    chain_ok = (lo1[:, None] & exp_pred_lo[None, :]) == \
+        exp_pred_lo[None, :]
+    if pair:
+        already = already | ((hi1[:, None] & exp_hi[None, :]) != 0)
+        chain_ok = chain_ok & (
+            (hi1[:, None] & exp_pred_hi[None, :]) == exp_pred_hi[None, :])
+    fresh = ok & exp_act[None, :] & ~already & cfg_valid[:, None]
+    legal = fresh & chain_ok
+    new_lo = (lo1[:, None] & ~state_mask) | exp_lo[None, :] | nsat_lo \
+        | pns
+    cand_lo = jnp.concatenate([jnp.where(cfg_valid, lo1, 0),
+                               new_lo.reshape(-1)])
+    cand_valid = jnp.concatenate([cfg_valid, legal.reshape(-1)])
+    if pair:
+        new_hi = hi1[:, None] | exp_hi[None, :] | nsat_hi
+        cand_hi = jnp.concatenate([jnp.where(cfg_valid, hi1, 0),
+                                   new_hi.reshape(-1)])
+        h2, l2, n2, o2 = _dedup_keys2(cand_hi, cand_lo, cand_valid, cap,
+                                      use_psort=use_psort)
+        changed = jnp.any(l2 != lo_in) | jnp.any(h2 != hi_in) | \
+            (n2 != count)
+        return l2, h2, n2, changed, o2
+    l2, n2, o2 = _dedup_keys(cand_lo, cand_valid, cap,
+                             use_psort=use_psort)
+    changed = jnp.any(l2 != lo_in) | (n2 != count)
+    return l2, None, n2, changed, o2
+
+
+def _filter_pass_keys(keys, count, s, *, cap, b, use_psort=False):
     """Return-event filter over packed keys: the returner's linearization
     point must precede its return; survivors drop its (recycled) bit.
     Returns (keys, count, dead)."""
@@ -395,64 +622,170 @@ def _filter_pass_keys(keys, count, s, *, cap, b):
     cfg_valid = jnp.arange(cap) < count
     keep = cfg_valid & ((keys & s_key_bit) != 0)
     keys, count, _ = _dedup_keys(
-        jnp.where(keep, keys & ~s_key_bit, 0), keep, cap)
+        jnp.where(keep, keys & ~s_key_bit, 0), keep, cap,
+        use_psort=use_psort)
     return keys, count, count == 0
 
 
+def _filter_pass_keys2(lo, hi, count, s, *, cap, b, use_psort=False):
+    """Pair-key return-event filter: the returner's key bit (b + s) may
+    live in either word. Returns (lo, hi, count, dead)."""
+    pos = (b + s).astype(jnp.uint32)
+    in_lo = pos < 32
+    bit_lo = jnp.where(in_lo, jnp.uint32(1) << (pos & 31), jnp.uint32(0))
+    bit_hi = jnp.where(in_lo, jnp.uint32(0),
+                       jnp.uint32(1) << (pos & 31))
+    cfg_valid = jnp.arange(cap) < count
+    keep = cfg_valid & (((lo & bit_lo) | (hi & bit_hi)) != 0)
+    h2, l2, count, _ = _dedup_keys2(
+        jnp.where(keep, hi & ~bit_hi, 0),
+        jnp.where(keep, lo & ~bit_lo, 0), keep, cap,
+        use_psort=use_psort)
+    return l2, h2, count, count == 0
+
+
+# Row tiers for the packed-key engine: a row whose frontier is small
+# runs its whole closure + filter on a static PREFIX of the (compacted)
+# frontier array, so sort sizes track the live count instead of the
+# capacity — the frontier trajectory of real wide-window histories is
+# spiky (median a few hundred configs, brief 10-50k bursts), and
+# without tiers every row pays for the burst capacity. A tier whose
+# dedup overflows retries the row at the full cap (one lax.cond).
+ROW_TIERS = (2048, 8192, 32768, 131072)
+# Tier selection margin: the chosen tier must hold margin x the live
+# count, since mid-closure frontiers (config + saturated twin +
+# expansions, pre-filter) overshoot the settled count.
+TIER_MARGIN = 4
+
+
 def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
-                       pure, pred_bit, bits, state, count, *, cap, step_fn,
-                       state_bits, nil_id, read_value_match=False):
-    """Packed-u32-key row loop (see _search_chunk): each config is ONE
-    uint32 (bits << state_bits | state id), so dedup is a single payload-
-    free sort and compaction a second sort."""
+                       pure, pred_bit, bits, state, count,
+                       exp_tables=None, *, cap, step_fn,
+                       state_bits, nil_id, read_value_match=False,
+                       use_psort=False, row_tiers=True, key_hi=False):
+    """Packed-key row loop (see _search_chunk): each config is ONE
+    uint32 (bits << state_bits | state id) — or an (lo, hi) u32 pair
+    when ``key_hi`` (windows up to 60+state bits; the cockroach-class
+    concurrency-30 band) — so dedup is a payload-free sort and
+    compaction a second sort. With ``exp_tables`` (the chunk slice of
+    bfs.expansion_tables) the closure pass runs with mutator-compacted
+    expansion columns, and rows are count-TIERED (see ROW_TIERS)."""
     from jepsen_tpu.models.kernels import NIL
 
     C, W = active.shape
     b = state_bits
+    nw = bits.shape[1]
+    if key_hi:
+        assert exp_tables is not None, "pair keys require compact tables"
+    # Spike-cap programs (row_tiers=False) process known-big frontiers,
+    # so tier branches there are compile-time dead weight.
+    tiered = exp_tables is not None and row_tiers
+    tiers = tuple(t for t in ROW_TIERS if t < cap) + (cap,) \
+        if tiered else (cap,)
 
-    def to_keys(bits, state, count):
-        return _pack_frontier_keys(bits, state, count, cap, b, nil_id)
-
-    def from_keys(keys, count):
-        return _unpack_frontier_keys(keys, count, cap, b, nil_id)
-
-    def row_body(carry):
-        r, keys, count, dead, ovf = carry
+    def row_at_tier(tier, r, lo, hi, count):
+        """One full row (closure fixpoint + return filter) on the first
+        ``tier`` entries of the frontier (live entries are a prefix:
+        dedup compacts and count <= tier/TIER_MARGIN at selection, or
+        this is the escalation/top tier with count <= cap). Returns
+        (lo[cap], hi[cap]|None, count, dead, overflow)."""
         act = active[r]
         f_row = slot_f[r]
         v_row = slot_v[r]
         pure_row = pure[r]                              # [W]
         pred_row = pred_bit[r, :, 0]                    # [W] slot-space
+        l_t = lo[:tier] if tier < cap else lo
+        h_t = (hi[:tier] if tier < cap else hi) if key_hi else None
 
         def closure_cond(c):
-            _, _, changed, ovf = c
-            return changed & ~ovf
+            return c[-2] & ~c[-1]
 
         def closure_body(c):
-            keys_in, count, _, ovf = c
-            k2, n2, changed, o2 = _closure_pass_keys(
-                keys_in, count, act, f_row, v_row, pure_row, pred_row,
-                cap=cap, W=W, b=b, nil_id=nil_id, step_fn=step_fn,
-                read_value_match=read_value_match)
-            return (k2, n2, changed, ovf | o2)
+            if key_hi:
+                lo_in, hi_in, count, _, ovf = c
+            else:
+                lo_in, count, _, ovf = c
+                hi_in = None
+            if exp_tables is not None:
+                exp_r = tuple(t[r] for t in exp_tables)
+                l2, h2, n2, changed, o2 = _closure_pass_keys_compact(
+                    lo_in, hi_in, count, act, v_row, pure_row, exp_r,
+                    cap=tier, W=W, b=b, nil_id=nil_id, step_fn=step_fn,
+                    use_psort=use_psort)
+            else:
+                l2, n2, changed, o2 = _closure_pass_keys(
+                    lo_in, count, act, f_row, v_row, pure_row, pred_row,
+                    cap=tier, W=W, b=b, nil_id=nil_id, step_fn=step_fn,
+                    read_value_match=read_value_match,
+                    use_psort=use_psort)
+                h2 = None
+            if key_hi:
+                return (l2, h2, n2, changed, ovf | o2)
+            return (l2, n2, changed, ovf | o2)
 
-        init = (keys, count, jnp.bool_(True), ovf)
-        keys, count, _, ovf = lax.while_loop(
-            closure_cond, closure_body, init)
+        if key_hi:
+            init = (l_t, h_t, count, jnp.bool_(True), jnp.bool_(False))
+            l_t, h_t, count, _, ovf = lax.while_loop(
+                closure_cond, closure_body, init)
+            l_t, h_t, count, dead = _filter_pass_keys2(
+                l_t, h_t, count, ret_slot[r], cap=tier, b=b,
+                use_psort=use_psort)
+        else:
+            init = (l_t, count, jnp.bool_(True), jnp.bool_(False))
+            l_t, count, _, ovf = lax.while_loop(
+                closure_cond, closure_body, init)
+            l_t, count, dead = _filter_pass_keys(
+                l_t, count, ret_slot[r], cap=tier, b=b,
+                use_psort=use_psort)
+        if tier < cap:
+            fill = jnp.full(cap - tier, KEY_FILL, jnp.uint32)
+            l_t = jnp.concatenate([l_t, fill])
+            if key_hi:
+                h_t = jnp.concatenate([h_t, fill])
+        if not key_hi:
+            h_t = lo[:0]  # zero-size placeholder keeps carries uniform
+        return l_t, h_t, count, dead, ovf
 
-        keys, count, dead = _filter_pass_keys(keys, count, ret_slot[r],
-                                              cap=cap, b=b)
-        return (r + 1, keys, count, dead, ovf)
+    def row_body(carry):
+        r, lo, hi, count, dead, ovf = carry
+        if len(tiers) == 1:
+            l2, h2, n2, dead, o2 = row_at_tier(cap, r, lo, hi, count)
+        else:
+            # Smallest tier holding TIER_MARGIN x the live count; a
+            # mid-row overflow escalates straight to the top tier (the
+            # row is functional, so the retry is exact).
+            idx = jnp.int32(0)
+            for t in tiers[:-1]:
+                idx = idx + (count * TIER_MARGIN > t).astype(jnp.int32)
+            l2, h2, n2, dead, o2 = lax.switch(
+                idx, [partial(row_at_tier, t) for t in tiers],
+                r, lo, hi, count)
+            need_top = o2 & (idx < len(tiers) - 1)
+            l2, h2, n2, dead, o2 = lax.cond(
+                need_top,
+                lambda: row_at_tier(cap, r, lo, hi, count),
+                lambda: (l2, h2, n2, dead, o2))
+        return (r + 1, l2, h2, n2, dead, ovf | o2)
 
     def row_cond(carry):
-        r, _, _, dead, ovf = carry
+        r, _, _, _, dead, ovf = carry
         return (r < n_rows) & ~dead & ~ovf
 
-    keys0 = to_keys(bits, state, count)
-    r, keys, count, dead, ovf = lax.while_loop(
+    if key_hi:
+        lo0, hi0 = _pack_frontier_keys2(bits, state, count, cap, b,
+                                        nil_id)
+    else:
+        lo0 = _pack_frontier_keys(bits, state, count, cap, b, nil_id)
+        hi0 = lo0[:0]
+    r, lo, hi, count, dead, ovf = lax.while_loop(
         row_cond, row_body,
-        (jnp.int32(0), keys0, count, False, False))
-    out_bits, out_state = from_keys(keys, count)
+        (jnp.int32(0), lo0, hi0, count, False, False))
+    if key_hi:
+        out_bits, out_state = _unpack_frontier_keys2(
+            lo, hi, count, cap, b, nil_id, nw)
+    else:
+        out_bits, out_state = _unpack_frontier_keys(lo, count, cap, b,
+                                                    nil_id)
     return out_bits, out_state, count, r, dead, ovf
 
 
@@ -476,7 +809,8 @@ def _mw_spike_caps(W, nw, S, chunk_top, spike_caps):
 
 def _spike_rows(p, r0, bits, state, count, *, tables_h, caps, dropback,
                 step_fn, state_bits, nil_id, read_value_match, cancel,
-                snapshots, min_rows: int = 64):
+                snapshots, min_rows: int = 64, use_psort: bool = False,
+                exp_h=None, key_hi: bool = False):
     """Spike mode: SPIKE_CHUNK-row mini-chunks of the SAME _search_chunk
     program at the big spike capacities. The axon runtime faults on a
     512-row chunk past cap 131072 but runs an 8-row chunk clean at 2^20
@@ -509,11 +843,14 @@ def _spike_rows(p, r0, bits, state, count, *, tables_h, caps, dropback,
         m_n = min(SPIKE_CHUNK, p.R - r)
         sp_tables = tuple(jnp.asarray(_chunk_slice(t, r, SPIKE_CHUNK))
                           for t in tables_h)
+        sp_exp = None if exp_h is None else tuple(
+            jnp.asarray(_chunk_slice(t, r, SPIKE_CHUNK)) for t in exp_h)
         while True:
             b2, s2, c2, r_done, dead, ovf = _search_chunk(
-                jnp.int32(m_n), *sp_tables, bits, state, count,
+                jnp.int32(m_n), *sp_tables, bits, state, count, sp_exp,
                 cap=caps[lvl], step_fn=step_fn, state_bits=state_bits,
-                nil_id=nil_id, read_value_match=read_value_match)
+                nil_id=nil_id, read_value_match=read_value_match,
+                use_psort=use_psort, row_tiers=False, key_hi=key_hi)
             if not bool(ovf):
                 break
             if lvl + 1 >= len(caps):
@@ -530,9 +867,10 @@ def _spike_rows(p, r0, bits, state, count, *, tables_h, caps, dropback,
                 # this spike-sized frontier, not up to SPIKE_CHUNK.
                 b3, s3, c3, _, _, o3 = _search_chunk(
                     jnp.int32(int(r_done) - 1), *sp_tables, bits, state,
-                    count, cap=caps[lvl], step_fn=step_fn,
+                    count, sp_exp, cap=caps[lvl], step_fn=step_fn,
                     state_bits=state_bits, nil_id=nil_id,
-                    read_value_match=read_value_match)
+                    read_value_match=read_value_match,
+                    use_psort=use_psort, row_tiers=False, key_hi=key_hi)
                 if not bool(o3):
                     snapshots[:] = [(r + int(r_done) - 1, b3, s3, c3)]
             return (b2, s2, int(c2), r + int(r_done), True, False, False,
@@ -573,6 +911,48 @@ def _unpack_frontier_keys(keys, count, cap, b, nil_id):
     sv = (cfg & jnp.uint32((1 << b) - 1)).astype(jnp.int32)
     state = jnp.where(live, jnp.where(sv == nil_id, NIL, sv), 0)
     return (cfg >> b)[:, None], state[:, None]
+
+
+def _pack_frontier_keys2(bits, state, count, cap, b, nil_id):
+    """Pair-key encoding for windows past 31-b bits: the 64-bit config
+    ``bitset << b | state-id`` split into (lo, hi) u32 words. Inverse:
+    _unpack_frontier_keys2."""
+    from jepsen_tpu.models.kernels import NIL
+
+    n = bits.shape[0]
+    sv = state[:, 0]
+    ps = jnp.where(sv == NIL, nil_id, sv).astype(jnp.uint32)
+    b0 = bits[:, 0]
+    b1 = bits[:, 1] if bits.shape[1] > 1 else jnp.zeros_like(b0)
+    lo = (b0 << b) | ps
+    hi = (b0 >> (32 - b)) | (b1 << b)
+    live = jnp.arange(n) < count
+    lo = jnp.where(live, lo, KEY_FILL)
+    hi = jnp.where(live, hi, KEY_FILL)
+    if cap > n:
+        pad = jnp.full(cap - n, KEY_FILL, jnp.uint32)
+        lo = jnp.concatenate([lo, pad])
+        hi = jnp.concatenate([hi, pad])
+    return lo[:cap], hi[:cap]
+
+
+def _unpack_frontier_keys2(lo, hi, count, cap, b, nil_id, nw):
+    """Inverse of _pack_frontier_keys2: (bits[cap,nw], state[cap,1])."""
+    from jepsen_tpu.models.kernels import NIL
+
+    live = jnp.arange(cap) < count
+    lo = jnp.where(live, lo[:cap], 0)
+    hi = jnp.where(live, hi[:cap], 0)
+    sv = (lo & jnp.uint32((1 << b) - 1)).astype(jnp.int32)
+    state = jnp.where(live, jnp.where(sv == nil_id, NIL, sv), 0)
+    b0 = (lo >> b) | ((hi & jnp.uint32((1 << b) - 1)) << (32 - b))
+    cols = [b0]
+    if nw > 1:
+        cols.append(hi >> b)
+    bits = jnp.stack(cols, axis=1)
+    if nw > len(cols):
+        bits = jnp.pad(bits, ((0, 0), (0, nw - len(cols))))
+    return bits, state[:, None]
 
 
 def _chunk_slice(a: np.ndarray, base: int, chunk: int) -> np.ndarray:
@@ -663,15 +1043,39 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
 
     # ``packed_keys=False`` forces the multiword formulation (tests use
     # it to cover the wide-window machinery on small histories).
+    read_value_match = p.kernel.name in READ_VALUE_MATCH_KERNELS
     state_bits = nil_id = None
+    key_hi = False
     if S == 1 and p.kernel.name in PACKED_STATE_KERNELS \
             and packed_keys is not False:
         nid = max(len(p.unintern), 2)
         b = nid.bit_length()
         if p.window + b <= 31:
             state_bits, nil_id = b, nid
-    read_value_match = p.kernel.name in READ_VALUE_MATCH_KERNELS
-
+        elif read_value_match and b <= 6 and p.window + b <= 60:
+            # Pair keys: the 64-bit config (bits << b | state) as two
+            # u32 words — covers the cockroach-class concurrency-30
+            # band (windows 29+, cockroach.clj:40-41) that round 2
+            # left to the slow multiword formulation.
+            state_bits, nil_id, key_hi = b, nid, True
+    # In-VMEM pallas sort-dedup for the packed-key path (platform/env
+    # gate here; each dedup additionally size-gates — see psort).
+    use_psort = state_bits is not None and psort.backend_ok()
+    # Mutator-compacted expansion columns: the read-value-match register
+    # band (the sat-table branch, b <= 6) never needs the full-window
+    # step evaluation — see expansion_tables.
+    exp_h = None
+    if state_bits is not None and read_value_match and state_bits <= 6:
+        exp_h = expansion_tables(p, state_bits)
+        if cap_schedule is DEFAULT_CAP_SCHEDULE:
+            # Row tiers make small frontiers cheap at ANY cap, so on the
+            # real chip the band runs top-cap from the start — no chunk
+            # re-runs on escalation. The CPU test mesh keeps a small
+            # first level (compile cost).
+            if jax.devices()[0].platform == "tpu":
+                cap_schedule = PACKED_CAP_SCHEDULE[-1:]
+            else:
+                cap_schedule = PACKED_CAP_SCHEDULE
     level = 0
     cap = cap_schedule[level]
     bits = jnp.zeros((cap, nw), jnp.uint32)
@@ -697,13 +1101,16 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                   jnp.asarray(_chunk_slice(slot_v_h, base, chunk)),
                   jnp.asarray(_chunk_slice(pure_h, base, chunk)),
                   jnp.asarray(_chunk_slice(pred_bit_h, base, chunk)))
+        exp_c = None if exp_h is None else tuple(
+            jnp.asarray(_chunk_slice(t, base, chunk)) for t in exp_h)
         spiked = None
         while True:
             b2, s2, c2, r_done, dead, ovf = _search_chunk(
-                jnp.int32(n), *tables, bits, state, count,
+                jnp.int32(n), *tables, bits, state, count, exp_c,
                 cap=cap_schedule[level], step_fn=step_fn,
                 state_bits=state_bits, nil_id=nil_id,
-                read_value_match=read_value_match)
+                read_value_match=read_value_match, use_psort=use_psort,
+                key_hi=key_hi)
             if not bool(ovf):
                 break
             if level + 1 >= len(cap_schedule):
@@ -730,9 +1137,10 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                 if n_pre > 0:
                     b2, s2, c2, _, _, o_pre = _search_chunk(
                         jnp.int32(n_pre), *tables, bits, state, count,
-                        cap=cap_schedule[level], step_fn=step_fn,
+                        exp_c, cap=cap_schedule[level], step_fn=step_fn,
                         state_bits=state_bits, nil_id=nil_id,
-                        read_value_match=read_value_match)
+                        read_value_match=read_value_match,
+                        use_psort=use_psort, key_hi=key_hi)
                     if not bool(o_pre):
                         bits, state, count = b2, s2, c2
                     else:
@@ -747,7 +1155,8 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                     dropback=min(spike_dropback, cap_schedule[-1]),
                     step_fn=step_fn, state_bits=state_bits,
                     nil_id=nil_id, read_value_match=read_value_match,
-                    cancel=cancel, snapshots=snapshots)
+                    cancel=cancel, snapshots=snapshots,
+                    use_psort=use_psort, exp_h=exp_h, key_hi=key_hi)
                 spike_top = sp_caps[-1]
                 break
             # Retry this chunk from its entry frontier at the next cap.

@@ -218,16 +218,23 @@ def check_packed(p: PackedHistory, witness: bool = False,
     discovery of a config wins) and, on an invalid verdict, emits
     knossos-style final-paths. ``cancel`` (a threading.Event) stops the
     search between rows — set by a competition race once the other racer
-    has decided."""
+    has decided.
+
+    Without ``witness`` the search runs REDUCED (pure-op saturation +
+    canonical chains, see search_rows): verdict and death row are exact,
+    but the reported ``configs`` are canonical/saturated representatives
+    of the reduced frontier, not the plain frontier knossos would list —
+    the result carries ``"reduced": True`` to flag that."""
     if p.kernel is None:
         return check_generic(p, witness=witness)
 
     init = (0, tuple(int(x) for x in p.init_state))
     configs = {init}
     order: dict | None = {init: None} if witness else None
+    reduce = not witness
     try:
         configs, order = search_rows(p, configs, order, 0, p.R,
-                                     cancel=cancel, reduce=not witness)
+                                     cancel=cancel, reduce=reduce)
     except Cancelled:
         return {"valid?": "unknown", "analyzer": "cpu-jit",
                 "error": "cancelled"}
@@ -235,11 +242,12 @@ def check_packed(p: PackedHistory, witness: bool = False,
         ret = p.ops[int(p.ret_op[d.r])]
         return {"valid?": False,
                 "analyzer": "cpu-jit",
+                "reduced": reduce,
                 "op": _op_dict(ret),
                 "configs": _decode_configs(p, d.seen, d.r),
                 "final-paths": _final_paths(p, d.seen, d.order)}
 
-    out = {"valid?": True, "analyzer": "cpu-jit",
+    out = {"valid?": True, "analyzer": "cpu-jit", "reduced": reduce,
            "configs": _decode_configs(p, configs, None)}
     if order is not None and configs:
         some = next(iter(configs))

@@ -42,7 +42,16 @@ from jepsen_tpu.models.kernels import (F_IDS, NIL, VALUE_WIDTH, KernelModel,
 
 class UnsupportedHistory(Exception):
     """Raised when a history cannot be packed (unknown f, window overflow
-    beyond the configured maximum, un-internable values)."""
+    beyond the configured maximum, un-internable values).
+
+    ``kind`` is a stable machine-readable tag ("window" for concurrency-
+    window overflow, "other" otherwise) — callers branch on it, never on
+    the message text (jepsen_tpu.lin.analysis routes window overflows to
+    the unbounded host search)."""
+
+    def __init__(self, message: str, kind: str = "other"):
+        super().__init__(message)
+        self.kind = kind
 
 
 @dataclass
@@ -336,7 +345,7 @@ def _pack_events_native(invoke_pos, return_pos, op_f, op_v, max_window,
     except native_ext.WindowOverflow as e:
         raise UnsupportedHistory(
             f"concurrency window exceeds {max_window} pending ops "
-            f"at history position {e.pos}") from None
+            f"at history position {e.pos}", kind="window") from None
     return out
 
 
@@ -372,7 +381,7 @@ def _pack_events_py(invoke_pos, return_pos, op_f, op_v, max_window,
             if not free:
                 raise UnsupportedHistory(
                     f"concurrency window exceeds {max_window} pending ops "
-                    f"at history position {pos}")
+                    f"at history position {pos}", kind="window")
             s = free.pop()
             slot_of[i] = s
             cur_active[s] = i
@@ -499,25 +508,44 @@ def reduction_tables(p: PackedHistory) -> tuple[np.ndarray, np.ndarray]:
     pure = p.active & np.isin(p.slot_f, list(pure_fs))
 
     # Return row per slot occurrence: the row at which this slot's op
-    # returns; crashed ops get a sentinel past any row (they never chain).
+    # returns; crashed ops get a sentinel past any row.
     NEVER = np.int32(R + 1)
     ret_row_of_op = np.full(len(p.ops), NEVER, np.int64)
     ret_row_of_op[np.asarray(p.ret_op)] = np.arange(R)
     slot_ret = np.where(p.slot_op >= 0,
                         ret_row_of_op[np.clip(p.slot_op, 0, None)], NEVER)
 
-    # Chainable = active, live (returns), not pure. Identical class key =
-    # (f, value words); inert slots get a unique sentinel class so they
-    # never match anything.
-    chainable = p.active & (slot_ret < NEVER) & ~pure
+    # Chainable = active, not pure. Identical LIVE ops chain in return
+    # order (the earlier-returning interval is the binding one). Identical
+    # CRASHED ops (:info, never return — their windows extend to the end
+    # of history) chain in INVOKE order: any linearization using a later
+    # chain member maps to one using the invoke-order prefix at the same
+    # points (each point lies past the later member's invoke, hence past
+    # every earlier member's), so WLOG the prefix linearizes first. The
+    # two families never cross (a crashed op cannot stand in for a live
+    # one whose window ends at its return): the class key carries a
+    # crashed flag. This collapses the 2^k subset blowup of k identical
+    # crashed mutators — the partitioned-nemesis history shape
+    # (BASELINE config 5) — to the k+1 prefixes.
+    invoke_of_op = np.fromiter((o.invoke_pos for o in p.ops), np.int64,
+                               len(p.ops))
+    slot_inv = np.where(p.slot_op >= 0,
+                        invoke_of_op[np.clip(p.slot_op, 0, None)], 0)
+    is_crashed = slot_ret >= NEVER
+    ordkey = np.where(is_crashed, np.int64(R + 2) + slot_inv, slot_ret)
+
+    chainable = p.active & ~pure & (p.slot_op >= 0)
     sent = -1 - np.arange(W, dtype=np.int64)          # unique per column
-    f_key = np.where(chainable, p.slot_f.astype(np.int64), sent[None, :])
+    f_key = np.where(
+        chainable,
+        (p.slot_f.astype(np.int64) << 1) | is_crashed,
+        sent[None, :])
     v_keys = [p.slot_v[:, :, k].astype(np.int64)
               for k in range(p.slot_v.shape[2])]
 
-    # Row-wise canonical order: sort slots by (class, return row); equal
-    # classes become adjacent runs ordered by return.
-    order = np.lexsort(tuple([slot_ret] + v_keys[::-1] + [f_key]), axis=1)
+    # Row-wise canonical order: sort slots by (class, return row | invoke
+    # position); equal classes become adjacent runs in canonical order.
+    order = np.lexsort(tuple([ordkey] + v_keys[::-1] + [f_key]), axis=1)
     rows = np.arange(R)[:, None]
     f_s = np.take_along_axis(f_key, order, axis=1)
     same = f_s[:, 1:] == f_s[:, :-1]

@@ -1,0 +1,270 @@
+"""Pallas in-VMEM bitonic sort-dedup for the sparse engine's packed keys.
+
+``lax.sort`` on this TPU is stage-overhead-bound: ~2.4 ms for 64k
+elements and ~2.5 ms up to ~2M — each of its O(log^2 n) compare-exchange
+stages is a separate HBM-round-tripping HLO. The sparse frontier engine
+(:mod:`jepsen_tpu.lin.bfs`) pays 4-6 such sorts per return event, which
+made the wide-window band (windows 21..64, e.g. cockroach's
+concurrency-30 registers) cost tens of ms per event.
+
+This module runs the whole dedup — bitonic sort, adjacent-duplicate
+masking, and the compaction re-sort — as ONE pallas kernel with the key
+array resident in VMEM, so the ~200 stages are VPU register/VMEM ops
+with no per-stage dispatch. Measured on the v5e chip (u32 keys):
+
+=========  ==========  ============
+elements   lax.sort    this kernel
+=========  ==========  ============
+2^16       2.4 ms      0.07 ms
+2^17       2.5 ms      0.28 ms
+2^18       2.6 ms      0.72 ms
+2^19       2.4 ms      1.86 ms
+2^20       2.6 ms      3.9 ms (lax wins past here)
+=========  ==========  ============
+
+The kernel is the semantics twin of ``bfs._dedup_keys`` (invalid flag in
+bit 31, first-of-run survives, KEY_FILL padding/compaction) and is
+fuzz-tested against it in ``tests/test_lin_psort.py``. Arrays larger
+than :data:`PSORT_MAX_N` (or histories on non-TPU backends, unless
+interpret mode is forced for tests) keep the lax.sort path.
+
+Layout: keys reshaped ``[n/128, 128]`` u32; flat index = row*128 + lane.
+Bitonic partner ``i ^ j`` for power-of-two j is a lane roll (j < 128)
+or a sublane roll (j >= 128) selected by bit j of the flat index — both
+native VPU data movements (``pltpu.roll`` with dynamic shifts), driven
+by a fori_loop over stages so VMEM holds only ~4 live copies.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+MIN_N = 1024              # (8, 128) u32 tiling minimum
+PSORT_MAX_N = 1 << 19     # above this lax.sort is faster (see table)
+KEY_FILL = 0xFFFFFFFF     # plain int: used inside kernels as a literal
+
+
+def pad_size(n: int) -> int:
+    """The kernel size for an n-element dedup: next power of two, at
+    least the tiling minimum."""
+    return max(MIN_N, 1 << (n - 1).bit_length())
+
+
+def backend_ok() -> bool:
+    """True when this backend should use the in-VMEM kernel at all.
+    Decided host-side and passed into the engine programs as a static
+    arg, so jit cache keys reflect the routing. ``JEPSEN_TPU_PSORT=0``
+    forces the lax path, ``=interpret`` forces the kernel in
+    interpreter mode (CPU parity tests)."""
+    mode = os.environ.get("JEPSEN_TPU_PSORT", "1")
+    if mode == "0":
+        return False
+    return mode == "interpret" or _on_tpu()
+
+
+def available(n: int) -> bool:
+    """Size gate: the kernel handles n-element dedups up to
+    :data:`PSORT_MAX_N` (padded); lax.sort is faster beyond."""
+    return pad_size(n) <= PSORT_MAX_N
+
+
+def _interpret() -> bool:
+    return os.environ.get("JEPSEN_TPU_PSORT") == "interpret" or \
+        not _on_tpu()
+
+
+def _on_tpu() -> bool:
+    return jax.devices()[0].platform == "tpu"
+
+
+def _bitonic_sort(x, flat, lane_iota, *, S, K):
+    """Full bitonic sort of x ([S, 128] u32, ascending in flat order).
+    fori_loop over the K(K+1)/2 stages; partner exchange via dynamic
+    lane/sublane rolls."""
+    del lane_iota
+
+    def stage(x, k, jj):
+        j = jnp.uint32(1) << jj
+        jl = jnp.where(jj < 7, j, 0).astype(jnp.int32)
+        js = jnp.where(jj < 7, 0, j >> 7).astype(jnp.int32)
+        upper = (flat & j) != 0
+        p = jnp.where(
+            upper,
+            pltpu.roll(pltpu.roll(x, jl, 1), js, 0),
+            pltpu.roll(pltpu.roll(x, (LANE - jl) % LANE, 1),
+                       (S - js) % S, 0))
+        desc = ((flat >> (k + 1)) & 1) == 1
+        # keep x iff (x is the smaller) == (this position wants smaller)
+        keep = (x < p) == (upper == desc)
+        return jnp.where(keep | (x == p), x, p)
+
+    def outer(k, x):
+        def inner(t, x):
+            return stage(x, jnp.uint32(k), jnp.uint32(k - t))
+        return lax.fori_loop(0, k + 1, inner, x)
+
+    return lax.fori_loop(0, K, outer, x)
+
+
+def _dedup_body(key_ref, out_ref, total_ref, *, S, K):
+    x = key_ref[:]
+    lane = lax.broadcasted_iota(jnp.uint32, x.shape, 1)
+    row = lax.broadcasted_iota(jnp.uint32, x.shape, 0)
+    flat = row * LANE + lane
+
+    x = _bitonic_sort(x, flat, lane, S=S, K=K)
+
+    # prev[i] = x[i-1]: lane roll +1, wrapping lane 0 to the previous
+    # row's lane 127 via a sublane roll.
+    a = pltpu.roll(x, 1, 1)
+    prev = jnp.where(lane == 0, pltpu.roll(a, 1, 0), a)
+    keep = (x >> 31 == 0) & ((flat == 0) | (x != prev))
+    total_ref[0] = jnp.sum(keep.astype(jnp.int32))
+    x = jnp.where(keep, x, jnp.uint32(KEY_FILL))
+
+    out_ref[:] = _bitonic_sort(x, flat, lane, S=S, K=K)
+
+
+@partial(jax.jit, static_argnames=("n_pad",))
+def _dedup_call(keys, n_pad):
+    S = n_pad // LANE
+    K = n_pad.bit_length() - 1
+    out, total = pl.pallas_call(
+        partial(_dedup_body, S=S, K=K),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_shape=[jax.ShapeDtypeStruct((S, LANE), jnp.uint32),
+                   jax.ShapeDtypeStruct((1,), jnp.int32)],
+        input_output_aliases={0: 0},
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )(keys.reshape(S, LANE))
+    return out.reshape(-1), total[0]
+
+
+def _bitonic_sort2(hi, lo, flat, *, S, K):
+    """Bitonic sort of (hi, lo) u32 pairs, ascending by the 64-bit
+    lexicographic key. Same stage structure as _bitonic_sort with a
+    pair compare-exchange."""
+    def stage(hi, lo, k, jj):
+        j = jnp.uint32(1) << jj
+        jl = jnp.where(jj < 7, j, 0).astype(jnp.int32)
+        js = jnp.where(jj < 7, 0, j >> 7).astype(jnp.int32)
+        upper = (flat & j) != 0
+
+        def partner(x):
+            return jnp.where(
+                upper,
+                pltpu.roll(pltpu.roll(x, jl, 1), js, 0),
+                pltpu.roll(pltpu.roll(x, (LANE - jl) % LANE, 1),
+                           (S - js) % S, 0))
+
+        p_hi = partner(hi)
+        p_lo = partner(lo)
+        desc = ((flat >> (k + 1)) & 1) == 1
+        lt = (hi < p_hi) | ((hi == p_hi) & (lo < p_lo))
+        eq = (hi == p_hi) & (lo == p_lo)
+        keep = (lt == (upper == desc)) | eq
+        return (jnp.where(keep, hi, p_hi), jnp.where(keep, lo, p_lo))
+
+    def outer(k, c):
+        def inner(t, c):
+            return stage(*c, jnp.uint32(k), jnp.uint32(k - t))
+        return lax.fori_loop(0, k + 1, inner, c)
+
+    return lax.fori_loop(0, K, outer, (hi, lo))
+
+
+def _dedup2_body(hi_ref, lo_ref, out_hi_ref, out_lo_ref, total_ref,
+                 *, S, K):
+    hi = hi_ref[:]
+    lo = lo_ref[:]
+    lane = lax.broadcasted_iota(jnp.uint32, hi.shape, 1)
+    row = lax.broadcasted_iota(jnp.uint32, hi.shape, 0)
+    flat = row * LANE + lane
+
+    hi, lo = _bitonic_sort2(hi, lo, flat, S=S, K=K)
+
+    def prev(x):
+        a = pltpu.roll(x, 1, 1)
+        return jnp.where(lane == 0, pltpu.roll(a, 1, 0), a)
+
+    dup = (hi == prev(hi)) & (lo == prev(lo))
+    keep = (hi >> 31 == 0) & ((flat == 0) | ~dup)
+    total_ref[0] = jnp.sum(keep.astype(jnp.int32))
+    hi = jnp.where(keep, hi, jnp.uint32(KEY_FILL))
+    lo = jnp.where(keep, lo, jnp.uint32(KEY_FILL))
+
+    out_hi_ref[:], out_lo_ref[:] = _bitonic_sort2(hi, lo, flat, S=S, K=K)
+
+
+@partial(jax.jit, static_argnames=("n_pad",))
+def _dedup2_call(hi, lo, n_pad):
+    S = n_pad // LANE
+    K = n_pad.bit_length() - 1
+    out_hi, out_lo, total = pl.pallas_call(
+        partial(_dedup2_body, S=S, K=K),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_shape=[jax.ShapeDtypeStruct((S, LANE), jnp.uint32),
+                   jax.ShapeDtypeStruct((S, LANE), jnp.uint32),
+                   jax.ShapeDtypeStruct((1,), jnp.int32)],
+        input_output_aliases={0: 0, 1: 1},
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )(hi.reshape(S, LANE), lo.reshape(S, LANE))
+    return out_hi.reshape(-1), out_lo.reshape(-1), total[0]
+
+
+def dedup_keys2(hi, lo, valid, cap):
+    """Pair-key twin of :func:`dedup_keys` for 64-bit packed configs
+    (hi, lo u32; invalid flag goes into hi bit 31, so hi's payload must
+    stay below 2^31). Returns (hi[cap], lo[cap], count, overflow) with
+    survivors ascending by (hi, lo) and KEY_FILL padding."""
+    n = hi.shape[0]
+    n_pad = pad_size(n)
+    hi = hi | ((~valid).astype(jnp.uint32) << 31)
+    if n_pad > n:
+        pad = jnp.full(n_pad - n, KEY_FILL, jnp.uint32)
+        hi = jnp.concatenate([hi, pad])
+        lo = jnp.concatenate([lo, pad])
+    out_hi, out_lo, total = _dedup2_call(hi, lo, n_pad)
+    if out_hi.shape[0] > cap:
+        out_hi = out_hi[:cap]
+        out_lo = out_lo[:cap]
+    overflow = total > cap
+    count = jnp.minimum(total, cap)
+    return out_hi, out_lo, count, overflow
+
+
+def dedup_keys(key, valid, cap):
+    """In-VMEM twin of ``bfs._dedup_keys``: single-u32-key sort-dedup
+    (invalid flag in bit 31) with sort-based compaction, in one pallas
+    kernel. Returns (keys[cap] ascending + KEY_FILL padding, count,
+    overflow). Caller must have checked :func:`available`."""
+    n = key.shape[0]
+    n_pad = pad_size(n)
+    key = key | ((~valid).astype(jnp.uint32) << 31)
+    if n_pad > n:
+        key = jnp.concatenate(
+            [key, jnp.full(n_pad - n, KEY_FILL, jnp.uint32)])
+    out, total = _dedup_call(key, n_pad)
+    if out.shape[0] > cap:
+        out = out[:cap]
+    overflow = total > cap
+    count = jnp.minimum(total, cap)
+    return out, count, overflow

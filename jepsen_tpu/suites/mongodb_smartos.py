@@ -7,7 +7,8 @@ Workloads: per-document CAS register checked linearizable
 (document_cas.clj, core.clj:390-392 — the reference defines a custom
 knossos Model inline at core.clj:34,198-205; here the stock
 cas-register device kernel covers it) and the bank transfer
-(transfer.clj). Mongo wire protocol gated.
+(transfer.clj). The Mongo wire protocol (OP_MSG + BSON) is spoken from
+scratch by jepsen_tpu.suites.mongowire.
 """
 
 from __future__ import annotations

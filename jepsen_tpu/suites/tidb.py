@@ -5,8 +5,9 @@ The DB layer sequences the three-daemon bring-up (pd → tikv → tidb,
 tidb/db.clj): placement drivers first on all nodes, then the KV stores,
 then the SQL layer. Workloads: per-key register checked linearizable
 (register.clj:68-74), the bank invariant (bank.clj), and sets
-(sets.clj:53-55). TiDB fronts MySQL's wire protocol, which needs a
-driver; clients are gated and fakes cover no-cluster runs.
+(sets.clj:53-55). TiDB fronts MySQL's wire protocol, spoken from
+scratch by jepsen_tpu.suites.mysql_clients (mysqlwire handshake +
+text-protocol queries); fakes cover no-cluster runs.
 """
 
 from __future__ import annotations

@@ -215,8 +215,10 @@ class TestDecodeAndBatch:
         r = cpu.check_packed(p)
         assert r["configs"][0]["model"] == (3,)
 
-    def test_batch_mixed_kernel_sizes_falls_back(self):
-        # per-key FIFO kernels sized differently -> no common step fn
+    def test_batch_mixed_kernel_sizes_groups(self):
+        # per-key FIFO kernels sized differently -> no common step fn;
+        # each key batches in its own homogeneous group (the old
+        # behavior de-batched everything on the first mismatch).
         subs = {
             1: History.of(invoke_op(0, "enqueue", 1),
                           ok_op(0, "enqueue", 1)),
@@ -225,7 +227,9 @@ class TestDecodeAndBatch:
                           invoke_op(0, "enqueue", 2),
                           ok_op(0, "enqueue", 2)),
         }
-        assert batched.try_check_batch(m.fifo_queue(), subs) is None
+        r = batched.try_check_batch(m.fifo_queue(), subs)
+        assert r is not None and set(r) == {1, 2}
+        assert all(v["valid?"] is True for v in r.values())
 
     def test_batch_same_sized_queue_keys(self):
         subs = {

@@ -50,7 +50,7 @@ class TestReductionTables:
         for r in range(p.R):
             for j in range(p.window):
                 q = pred[r, j]
-                if q < 0:
+                if q < 0 or p.crashed[r, j]:
                     continue
                 chained += 1
                 # Both ends active, same (f, value), both live, and the
@@ -66,16 +66,30 @@ class TestReductionTables:
                 assert not p.crashed[r, j] and not p.crashed[r, q]
         assert chained > 0  # value_range=2 must produce identical ops
 
-    def test_crashed_ops_never_chain(self):
-        h = synth.generate_register_history(80, concurrency=5, seed=1,
-                                            value_range=1, crash_prob=0.3)
+    def test_crashed_ops_chain_among_crashed_by_invoke(self):
+        """Identical crashed ops chain in invoke order — among
+        themselves only, never to/from live ops."""
+        h = synth.generate_register_history(
+            120, concurrency=6, seed=1, value_range=1, crash_prob=0.3,
+            fs=("write",))
         p = prepare.prepare(m.cas_register(), h)
         _, pred = prepare.reduction_tables(p)
+        invoke_of = {i: o.invoke_pos for i, o in enumerate(p.ops)}
+        crashed_chains = 0
         for r in range(p.R):
             for j in range(p.window):
-                if pred[r, j] >= 0:
-                    assert not p.crashed[r, pred[r, j]]
-                    assert not p.crashed[r, j]
+                q = pred[r, j]
+                if q < 0:
+                    continue
+                # Chain families never cross.
+                assert bool(p.crashed[r, j]) == bool(p.crashed[r, q])
+                if p.crashed[r, j]:
+                    crashed_chains += 1
+                    assert p.slot_f[r, j] == p.slot_f[r, q]
+                    assert (p.slot_v[r, j] == p.slot_v[r, q]).all()
+                    oj, oq = int(p.slot_op[r, j]), int(p.slot_op[r, q])
+                    assert invoke_of[oq] < invoke_of[oj]
+        assert crashed_chains > 0  # value_range=1 writes must collide
 
     def test_cached_on_packed_history(self):
         h = synth.generate_register_history(30, concurrency=3, seed=0)
@@ -92,6 +106,18 @@ class TestReducedCpuExactness:
     def test_register_fuzz(self, seed):
         h = synth.generate_register_history(50, concurrency=5, seed=seed,
                                             value_range=3, crash_prob=0.1)
+        for hh in (h, synth.corrupt_history(h, seed=seed)):
+            p = prepare.prepare(m.cas_register(), hh)
+            assert verdict(p, False) == verdict(p, True)
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_crash_heavy_register_fuzz(self, seed):
+        """The crashed-chain reduction's home turf: many identical
+        crashed mutators (partition-shaped histories, BASELINE
+        config 5)."""
+        h = synth.generate_register_history(
+            40, concurrency=5, seed=seed, value_range=2, crash_prob=0.35,
+            max_crashes=12)
         for hh in (h, synth.corrupt_history(h, seed=seed)):
             p = prepare.prepare(m.cas_register(), hh)
             assert verdict(p, False) == verdict(p, True)

@@ -49,7 +49,10 @@ class FakeZkServer:
             buf += chunk
         (n,) = struct.unpack(">i", bytes(buf[:4]))
         while len(buf) < 4 + n:
-            buf += conn.recv(65536)
+            chunk = conn.recv(65536)
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
         out = bytes(buf[4:4 + n])
         del buf[:4 + n]
         return out
@@ -119,7 +122,10 @@ class FakeZkServer:
                     return
                 else:
                     reply(-6)                    # unimplemented
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError, struct.error):
+            # struct.error: the client hung up mid-frame (normal at
+            # test teardown) — swallow it so a green run stays free of
+            # PytestUnhandledThreadExceptionWarnings.
             return
         finally:
             conn.close()
