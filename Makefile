@@ -1,0 +1,26 @@
+# jepsen_tpu development targets.
+
+.PHONY: test integration integration-local bench
+
+# Unit + parity suite on the virtual 8-device CPU mesh (no cluster).
+test:
+	python -m pytest tests/ -q
+
+# Cluster integration matrix against the dockerized 1-control + 5-node
+# environment: brings the compose cluster up, then runs the per-suite
+# register matrix (tests/test_integration_matrix.py — the analogue of
+# the reference's deftest grids, cockroach_test.clj:17-52) from the
+# control container. Requires docker compose on the host.
+integration:
+	cd docker && ./up.sh --daemon
+	docker exec -e JEPSEN_NODES=n1,n2,n3,n4,n5 jepsen-tpu-control \
+		python -m pytest /jepsen_tpu/tests/test_integration_matrix.py -v
+	cd docker && docker compose down
+
+# Same matrix against nodes you already have (set JEPSEN_NODES).
+integration-local:
+	python -m pytest tests/test_integration_matrix.py -v
+
+# Headline benchmark on the real TPU chip (exclusive).
+bench:
+	python bench.py

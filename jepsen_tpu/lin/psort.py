@@ -251,6 +251,96 @@ def dedup_keys2(hi, lo, valid, cap):
     return out_hi, out_lo, count, overflow
 
 
+def _flat_prev(x, d, S):
+    """Value at flat index i-d (power-of-two d), clamped cyclically —
+    callers mask position-0 effects via their start flags."""
+    if d < LANE:
+        a = pltpu.roll(x, d, 1)
+        lane = lax.broadcasted_iota(jnp.uint32, x.shape, 1)
+        return jnp.where(lane < d, pltpu.roll(a, 1, 0), a)
+    return pltpu.roll(x, d // LANE, 0)
+
+
+def _dedup_dom_body(masks_ref, a_ref, w_ref, out_ref, total_ref,
+                    *, S, K):
+    """Sort (group-part, dominance-word) pairs, drop duplicates and
+    dominated entries (see bfs._dedup_keys_dom: the word packs crashed
+    bits as-is and read bits complemented, so dominance is a single
+    subset test), emit the recombined full keys ascending. a carries
+    the invalid flag in bit 31; masks_ref = (cmask, rmask)."""
+    a = a_ref[:]
+    w = w_ref[:]
+    cmask = masks_ref[0]
+    rmask = masks_ref[1]
+    lane = lax.broadcasted_iota(jnp.uint32, a.shape, 1)
+    row = lax.broadcasted_iota(jnp.uint32, a.shape, 0)
+    flat = row * LANE + lane
+
+    a, w = _bitonic_sort2(a, w, flat, S=S, K=K)
+
+    first = flat == 0
+    pa = _flat_prev(a, 1, S)
+    dup = (a == pa) & (w == _flat_prev(w, 1, S)) & ~first
+    start = first | (a != pa)
+    # Segmented broadcast of each group's representative word (the scan
+    # runs on u32 flags: bool-vector rolls don't reliably lower).
+    f = w
+    done = start.astype(jnp.uint32)
+    d = 1
+    while d < (1 << K):
+        f = jnp.where(done != 0, f, _flat_prev(f, d, S))
+        done = done | _flat_prev(done, d, S)
+        d <<= 1
+    dominated = ((f & ~w) == 0) & (w != f)
+    keep = (a >> 31 == 0) & ~dup & ~dominated
+    total_ref[0] = jnp.sum(keep.astype(jnp.int32))
+    full = jnp.where(
+        keep,
+        (a & jnp.uint32(0x7FFFFFFF)) | (w & cmask) | ((~w) & rmask),
+        jnp.uint32(KEY_FILL))
+    out_ref[:] = _bitonic_sort(full, flat, lane, S=S, K=K)
+
+
+@partial(jax.jit, static_argnames=("n_pad",))
+def _dedup_dom_call(a, w, cmask, rmask, n_pad):
+    S = n_pad // LANE
+    K = n_pad.bit_length() - 1
+    masks = jnp.stack([cmask, rmask]).astype(jnp.uint32)
+    out, total = pl.pallas_call(
+        partial(_dedup_dom_body, S=S, K=K),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_shape=[jax.ShapeDtypeStruct((S, LANE), jnp.uint32),
+                   jax.ShapeDtypeStruct((1,), jnp.int32)],
+        input_output_aliases={1: 0},
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )(masks, a.reshape(S, LANE), w.reshape(S, LANE))
+    return out.reshape(-1), total[0]
+
+
+def dedup_keys_dom(a, w, cmask, rmask, cap):
+    """In-VMEM twin of the lax path in ``bfs._dedup_keys_dom``. ``a`` is
+    the group part (mutator bits + state) with the invalid flag already
+    in bit 31; ``w`` the packed dominance word (crashed bits | inverted
+    read bits); ``cmask``/``rmask`` u32 scalars for recombination.
+    Returns (keys[cap] full-key ascending, count, overflow)."""
+    n = a.shape[0]
+    n_pad = pad_size(n)
+    if n_pad > n:
+        pad = jnp.full(n_pad - n, KEY_FILL, jnp.uint32)
+        a = jnp.concatenate([a, pad])
+        w = jnp.concatenate([w, jnp.zeros(n_pad - n, jnp.uint32)])
+    out, total = _dedup_dom_call(a, w, cmask, rmask, n_pad)
+    if out.shape[0] > cap:
+        out = out[:cap]
+    return out, jnp.minimum(total, cap), total > cap
+
+
 def dedup_keys(key, valid, cap):
     """In-VMEM twin of ``bfs._dedup_keys``: single-u32-key sort-dedup
     (invalid flag in bit 31) with sort-based compaction, in one pallas

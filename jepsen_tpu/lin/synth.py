@@ -113,6 +113,7 @@ def generate_partitioned_register_history(
         partition_len: int = 300,
         max_crashes: int = 24,
         fs: tuple = ("read", "write", "cas"),
+        invoke_bias: float = 0.6,
 ) -> History:
     """A linearizable-by-construction register history under a partition
     nemesis — the shape BASELINE config 5 names (100k-op
@@ -129,6 +130,13 @@ def generate_partitioned_register_history(
     Crashed processes re-incarnate (core.clj:185-217). Total crashes are
     capped so the concurrency window stays inside the device band
     (window <= concurrency + max_crashes).
+
+    ``invoke_bias`` sets how saturated the schedule runs: the default
+    0.6 keeps nearly all 30 processes pending at once (the adversarial
+    ceiling); lower values model the reference's staggered generators
+    (e.g. etcd.clj:167-179 staggers invocations, so typical in-flight
+    depth sits well below the process count, spiking only when a
+    partition stalls completions).
 
     This is the history class the reference cannot check at all
     (independent.clj:2-7 exists because knossos DNFs on it): the crashed
@@ -155,7 +163,7 @@ def generate_partitioned_register_history(
     while invoked < n_ops or pending:
         cut = partitioned_at(invoked)
         can_invoke = invoked < n_ops and len(pending) < concurrency
-        if can_invoke and (not pending or rng.random() < 0.6):
+        if can_invoke and (not pending or rng.random() < invoke_bias):
             free = [p for p in procs if p not in pending]
             if cut:
                 free = [p for p in free if node_of(p) not in minority] \

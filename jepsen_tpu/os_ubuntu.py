@@ -1,0 +1,41 @@
+"""Ubuntu OS provisioning — the CockroachDB boxes' variant.
+
+Re-design of `cockroachdb/src/jepsen/os/ubuntu.clj` (40 LoC): the same
+apt machinery as :mod:`jepsen_tpu.os_debian` with the cockroach-box
+package list (tcpdump for the suite's packet capture, faketime/ntpdate
+for the clock nemeses), NTP stopped so the clock nemesis owns the
+clock, and the network healed on the way in.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import control as c
+from jepsen_tpu import os_ as os_ns
+from jepsen_tpu import os_debian
+
+PACKAGES = ["wget", "curl", "vim", "man-db", "faketime", "unzip",
+            "ntpdate", "iptables", "iputils-ping", "rsyslog", "tcpdump",
+            "logrotate"]
+
+
+class UbuntuOS(os_ns.OS):
+    """Ubuntu setup: hostfile, packages, stop ntp, heal the net
+    (os/ubuntu.clj:13-39)."""
+
+    def setup(self, test, node):
+        os_debian.setup_hostfile(test, node)
+        os_debian.install(PACKAGES)
+        with c.su():
+            c.exec_("service", "ntp", "stop", may_fail=True)
+        net = test.get("net") if isinstance(test, dict) else None
+        if net is not None:
+            try:
+                net.heal(test)
+            except Exception:  # noqa: BLE001 - heal is best-effort here
+                pass
+
+    def teardown(self, test, node):
+        pass
+
+
+os = UbuntuOS()

@@ -26,9 +26,13 @@ import time
 
 from jepsen_tpu import checker as checker_ns
 from jepsen_tpu import client as client_ns
+from jepsen_tpu import control
+from jepsen_tpu import db as db_ns
 from jepsen_tpu import generator as gen
 from jepsen_tpu import nemesis as nemesis_ns
+from jepsen_tpu import os_debian
 from jepsen_tpu.checker import FnChecker
+from jepsen_tpu.control import util as cu
 from jepsen_tpu.history import Op
 from jepsen_tpu.suites import common
 
@@ -176,6 +180,128 @@ class FakeChronosClient(client_ns.Client):
         return op.replace(type="fail", error=f"unknown f {op.f}")
 
 
+# --- cluster provisioning (mesosphere.clj + chronos.clj db layers) ----------
+
+MASTER_COUNT = 3                       # mesosphere.clj:17
+MASTER_PIDFILE = "/var/run/mesos/master.pid"
+AGENT_PIDFILE = "/var/run/mesos/slave.pid"
+MASTER_DIR = "/var/lib/mesos/master"
+AGENT_DIR = "/var/lib/mesos/slave"
+MESOS_LOG_DIR = "/var/log/mesos"
+JOB_DIR = "/tmp/chronos-test"
+
+
+def zk_uri(test) -> str:
+    """zk://n1:2181,...,n5:2181/mesos (mesosphere.clj:38-46)."""
+    hosts = ",".join(f"{n}:2181" for n in test["nodes"])
+    return f"zk://{hosts}/mesos"
+
+
+def masters(test) -> list:
+    """The first MASTER_COUNT nodes (sorted) run mesos-master; the rest
+    run agents (mesosphere.clj:60-68)."""
+    return sorted(test["nodes"])[:MASTER_COUNT]
+
+
+class MesosDB(db_ns.DB, db_ns.LogFiles):
+    """ZooKeeper + Mesos master/agent bring-up (mesosphere.clj:26-159:
+    repo + package install, /etc/mesos/zk + quorum config, masters on
+    the first three sorted nodes via start-stop-daemon, agents on the
+    rest)."""
+
+    def __init__(self, version: str = "1.11.0"):
+        self.version = version
+        from jepsen_tpu.suites.zookeeper import ZookeeperDB
+
+        self.zk = ZookeeperDB()
+
+    def setup(self, test, node) -> None:
+        self.zk.setup(test, node)
+        with control.su():
+            os_debian.add_repo(
+                "mesosphere",
+                "deb http://repos.mesosphere.io/debian wheezy main",
+                keyserver="keyserver.ubuntu.com", key="E56151BF")
+            os_debian.install([f"mesos={self.version}"])
+            control.exec_("mkdir", "-p", "/var/run/mesos", MASTER_DIR,
+                          AGENT_DIR, MESOS_LOG_DIR)
+            control.exec_("tee", "/etc/mesos/zk", stdin=zk_uri(test))
+            control.exec_("tee", "/etc/mesos-master/quorum",
+                          stdin=str(MASTER_COUNT // 2 + 1))
+            if node in masters(test):
+                cu.start_daemon(
+                    "/usr/sbin/mesos-master",
+                    f"--hostname={node}",
+                    f"--log_dir={MESOS_LOG_DIR}",
+                    f"--quorum={MASTER_COUNT // 2 + 1}",
+                    "--registry_fetch_timeout=120secs",
+                    "--registry_store_timeout=5secs",
+                    f"--work_dir={MASTER_DIR}",
+                    "--offer_timeout=30secs",
+                    f"--zk={zk_uri(test)}",
+                    logfile=f"{MESOS_LOG_DIR}/master.stdout",
+                    pidfile=MASTER_PIDFILE, chdir=MASTER_DIR,
+                    env={"GLOG_v": "1"})
+            else:
+                cu.start_daemon(
+                    "/usr/sbin/mesos-slave",
+                    f"--hostname={node}",
+                    f"--log_dir={MESOS_LOG_DIR}",
+                    "--recovery_timeout=30secs",
+                    f"--work_dir={AGENT_DIR}",
+                    f"--master={zk_uri(test)}",
+                    logfile=f"{MESOS_LOG_DIR}/slave.stdout",
+                    pidfile=AGENT_PIDFILE, chdir=AGENT_DIR)
+
+    def teardown(self, test, node) -> None:
+        with control.su():
+            cu.grepkill("mesos-slave")
+            cu.grepkill("mesos-master")
+            control.exec_("rm", "-rf", MASTER_PIDFILE, AGENT_PIDFILE,
+                          may_fail=True)
+            control.exec_(control.Lit(
+                f"rm -rf {MASTER_DIR}/* {AGENT_DIR}/* "
+                f"{MESOS_LOG_DIR}/*"), may_fail=True)
+        self.zk.teardown(test, node)
+
+    def log_files(self, test, node) -> list[str]:
+        return self.zk.log_files(test, node) + [
+            f"{MESOS_LOG_DIR}/master.stdout",
+            f"{MESOS_LOG_DIR}/slave.stdout"]
+
+
+class ChronosDB(db_ns.DB, db_ns.LogFiles):
+    """Chronos on top of Mesos (chronos.clj:57-83: package install,
+    schedule-horizon config, service start; teardown stops the service
+    and clears the job dir)."""
+
+    def __init__(self, mesos_version: str = "1.11.0",
+                 chronos_version: str = "3.0.2"):
+        self.version = chronos_version
+        self.mesos = MesosDB(mesos_version)
+
+    def setup(self, test, node) -> None:
+        self.mesos.setup(test, node)
+        with control.su():
+            os_debian.install([f"chronos={self.version}"])
+            control.exec_("mkdir", "-p", "/etc/chronos/conf", JOB_DIR)
+            # Lower the scheduler horizon or frequent jobs are skipped
+            # (chronos.clj:40-45).
+            control.exec_("tee", "/etc/chronos/conf/schedule_horizon",
+                          stdin="1")
+            control.exec_("service", "chronos", "start", may_fail=True)
+
+    def teardown(self, test, node) -> None:
+        with control.su():
+            control.exec_("service", "chronos", "stop", may_fail=True)
+            cu.grepkill("/usr/bin/chronos")
+            control.exec_("rm", "-rf", JOB_DIR, may_fail=True)
+        self.mesos.teardown(test, node)
+
+    def log_files(self, test, node) -> list[str]:
+        return self.mesos.log_files(test, node) + ["/var/log/messages"]
+
+
 class ChronosClient(client_ns.Client):
     """Job submission over Chronos's HTTP API (chronos.clj:120-170);
     reading runs back requires the reference's remote run-log scrape."""
@@ -241,10 +367,13 @@ def workload(n_jobs: int = 10, horizon: float = 10.0) -> dict:
 
 
 def test(opts: dict | None = None) -> dict:
-    """The chronos test map (chronos.clj:240-280)."""
+    """The chronos test map (chronos.clj:240-280): a real-cluster run
+    provisions ZooKeeper + Mesos masters/agents + Chronos via
+    ChronosDB; ``--fake`` runs the in-process scheduler instead."""
     return common.suite_test(
         "chronos", opts,
         workload=workload(),
+        db=ChronosDB(),
         client=ChronosClient(),
         nemesis=nemesis_ns.partition_random_halves(),
         nemesis_gen=common.standard_nemesis_gen(30, 30))

@@ -33,16 +33,37 @@ VERSION = "v1.0"
 PORT = 26257
 
 
+PCAP_LOG = "/opt/cockroach/trace.pcap"
+TCPDUMP_PIDFILE = "/var/run/jepsen-tcpdump.pid"
+
+
+def control_addr() -> str:
+    """The control node's address as seen from a DB node, recovered from
+    the SSH session's environment (auto.clj:56-66)."""
+    import re
+
+    out = control.exec_("env", may_fail=False)
+    m = re.search(r"SSH_CLIENT=(\S+)", out)
+    if not m:
+        raise RuntimeError(f"no SSH_CLIENT in node env: {out[:200]!r}")
+    return m.group(1)
+
+
 class CockroachDB(common.TarballDB):
-    """Tarball install + cockroach start --join (cockroach/auto.clj)."""
+    """Tarball install + cockroach start --join (cockroach/auto.clj).
+
+    With ``tcpdump=True`` the node also runs a packet capture of its
+    control-node <-> db-port traffic for the length of the test
+    (auto.clj:67-75); the pcap rides home with the log files."""
 
     name = "cockroach"
     dir = "/opt/cockroach"
     binary = "cockroach"
 
-    def __init__(self, version: str = VERSION):
+    def __init__(self, version: str = VERSION, tcpdump: bool = False):
         self.url = (f"https://binaries.cockroachdb.com/"
                     f"cockroach-{version}.linux-amd64.tgz")
+        self.tcpdump = tcpdump
 
     def start_args(self, test, node) -> list:
         join = ",".join(f"{n}:26258" for n in test["nodes"])
@@ -51,6 +72,39 @@ class CockroachDB(common.TarballDB):
                 f"--port={PORT}", "--http-port=8081",
                 f"--join={join}",
                 f"--store=path={self.dir}/data"]
+
+    def packet_capture(self, node) -> None:
+        """Start tcpdump on control-node traffic (auto.clj:67-75)."""
+        from jepsen_tpu.control import util as cu
+
+        addr = control_addr()
+        with control.su():
+            cu.start_daemon(
+                "/usr/sbin/tcpdump",
+                "-w", PCAP_LOG, "host", addr, "and", "port", str(PORT),
+                logfile="/dev/null", pidfile=TCPDUMP_PIDFILE)
+
+    def stop_packet_capture(self) -> None:
+        from jepsen_tpu.control import util as cu
+
+        with control.su():
+            cu.stop_daemon(TCPDUMP_PIDFILE, binary="tcpdump")
+
+    def setup(self, test, node) -> None:
+        super().setup(test, node)
+        if self.tcpdump:
+            self.packet_capture(node)
+
+    def teardown(self, test, node) -> None:
+        if self.tcpdump:
+            self.stop_packet_capture()
+        super().teardown(test, node)
+
+    def log_files(self, test, node) -> list[str]:
+        files = super().log_files(test, node)
+        if self.tcpdump:
+            files = files + [PCAP_LOG]
+        return files
 
 
 # --- SQL clients over pgwire -------------------------------------------------
@@ -160,6 +214,92 @@ class BankClient(client_ns.Client):
                         f"balance >= {t['amount']}",
                         f"UPDATE {self.TABLE} SET balance = balance + "
                         f"{t['amount']} WHERE id = {t['to']}"])
+                    return op.replace(type="ok")
+                except PgError:
+                    return op.replace(type="fail")
+        except (OSError, ConnectionError) as e:
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()
+
+
+class MultiBankClient(client_ns.Client):
+    """Bank with one table per account (bank.clj:168-249): transfers
+    read both single-row tables, reject a negative result, and update
+    both inside one transaction; reads select every table in one txn."""
+
+    def __init__(self, conn: PgClient | None = None, n: int = 5,
+                 total: int = 50):
+        self.conn = conn
+        self.n = n
+        self.total = total
+
+    def _table(self, i) -> str:
+        return f"jepsen_accounts{int(i)}"
+
+    def open(self, test, node):
+        return MultiBankClient(PgClient(node, port=PORT, user="root",
+                                        database="jepsen"),
+                               self.n, self.total)
+
+    def setup(self, test) -> None:
+        conn = PgClient(test["nodes"][0], port=PORT, user="root",
+                        database="system")
+        try:
+            conn.query("CREATE DATABASE IF NOT EXISTS jepsen")
+            for i in range(self.n):
+                t = self._table(i)
+                conn.query(f"CREATE TABLE IF NOT EXISTS jepsen.{t} "
+                           f"(balance INT NOT NULL)")
+                rows = conn.query(f"SELECT count(*) FROM jepsen.{t}")
+                if not rows or int(rows[0][0]) == 0:
+                    conn.query(f"INSERT INTO jepsen.{t} VALUES "
+                               f"({self.total // self.n})")
+        finally:
+            conn.close()
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                stmts = [f"SELECT balance FROM {self._table(i)}"
+                         for i in range(self.n)]
+                rows = self.conn.txn(stmts)
+                return op.replace(
+                    type="ok",
+                    value=[int(r[0][0]) for r in rows])
+            if op.f == "transfer":
+                t = op.value
+                src, dst = self._table(t["from"]), self._table(t["to"])
+                amt = int(t["amount"])
+                try:
+                    # Read-check-update inside one transaction
+                    # (bank.clj:193-225): the credit must not happen
+                    # when the debit would go negative.
+                    self.conn.query("BEGIN")
+                    try:
+                        rows = self.conn.query(
+                            f"SELECT balance FROM {src}")
+                        if not rows or int(rows[0][0]) < amt:
+                            self.conn.query("ROLLBACK")
+                            return op.replace(type="fail",
+                                              error="negative")
+                        self.conn.query(
+                            f"UPDATE {src} SET balance = "
+                            f"balance - {amt}")
+                        self.conn.query(
+                            f"UPDATE {dst} SET balance = "
+                            f"balance + {amt}")
+                        self.conn.query("COMMIT")
+                    except PgError:
+                        try:
+                            self.conn.query("ROLLBACK")
+                        except (PgError, OSError):
+                            pass
+                        raise
                     return op.replace(type="ok")
                 except PgError:
                     return op.replace(type="fail")
@@ -358,12 +498,25 @@ def test(opts: dict | None = None) -> dict:
     if wname == "register" and opts.get("concurrency", 0) < 5:
         opts["concurrency"] = 5
     client = {"register": RegisterClient,
-              "bank": BankClient}.get(wname)
+              "bank": BankClient,
+              "bank-multitable": MultiBankClient}.get(wname)
+    os_name = opts.pop("os", "ubuntu")
+    if os_name == "ubuntu":
+        from jepsen_tpu import os_ubuntu
+
+        os_obj = os_ubuntu.os
+    elif os_name == "debian":
+        from jepsen_tpu import os_debian
+
+        os_obj = os_debian.os
+    else:
+        raise ValueError(f"unknown os {os_name!r}; 'ubuntu' or 'debian'")
     return common.suite_test(
         f"cockroachdb {wname} {nem['name']}", opts,
         workload=table[wname](),
-        db=CockroachDB(),
+        db=CockroachDB(tcpdump=bool(opts.pop("tcpdump", False))),
         client=client() if client else None,
+        os=os_obj,
         nemesis=nem["nemesis"],
         nemesis_gen=nem["gen"])
 
@@ -378,6 +531,13 @@ def main(argv=None) -> None:
                        choices=sorted(nemeses()))
         p.add_argument("--nemesis2", default=None,
                        choices=sorted(nemeses()))
+        p.add_argument("--os", default="ubuntu",
+                       choices=["ubuntu", "debian"],
+                       help="node OS provisioning (os/ubuntu.clj is the "
+                            "reference's cockroach default)")
+        p.add_argument("--tcpdump", action="store_true",
+                       help="capture control<->db packets per node "
+                            "(auto.clj:67-75)")
 
     cli.main(cli.suite_commands(test, opt_spec=opt_spec), argv)
 

@@ -110,6 +110,38 @@ class TestReducedCpuExactness:
             p = prepare.prepare(m.cas_register(), hh)
             assert verdict(p, False) == verdict(p, True)
 
+    @pytest.mark.parametrize("seed", range(8))
+    def test_crash_dominance_device_parity(self, seed):
+        """The device engine's crashed-subset dominance prune
+        (bfs._dedup_keys_dom) must preserve verdict and death row
+        against the (unpruned) CPU oracle — crash-heavy histories with
+        DISTINCT crashed values, where chains alone can't collapse the
+        2^crashes blowup."""
+        h = synth.generate_register_history(
+            60, concurrency=6, seed=seed, value_range=5, crash_prob=0.3,
+            max_crashes=8)
+        for hh in (h, synth.corrupt_history(h, seed=seed)):
+            p = prepare.prepare(m.cas_register(), hh)
+            want = cpu.check_packed(p)
+            got = bfs.check_packed(p)
+            assert got["valid?"] == want["valid?"], (seed, got, want)
+            if want["valid?"] is False:
+                assert got["op"] == want["op"]
+
+    @pytest.mark.parametrize("seed", range(2))
+    def test_crash_dominance_pair_band_parity(self, seed):
+        """Same, through the pair-key band (window past 31-b bits) —
+        partition-shaped histories land there. Sizes are small: the
+        unpruned Python oracle pays the full 2^crashes blowup that the
+        device prune removes."""
+        h = synth.generate_partitioned_register_history(
+            100, concurrency=30, seed=seed, partition_every=50,
+            partition_len=15, max_crashes=4)
+        p = prepare.prepare(m.cas_register(), h)
+        want = cpu.check_packed(p)
+        got = bfs.check_packed(p)
+        assert got["valid?"] == want["valid?"] is True, (seed, got)
+
     @pytest.mark.parametrize("seed", range(10))
     def test_crash_heavy_register_fuzz(self, seed):
         """The crashed-chain reduction's home turf: many identical

@@ -185,6 +185,49 @@ class TestChronos:
         sol = chronos.job_solution(30.0, job, [0.5])  # missed t=10
         assert sol["valid?"] is False
 
+    def test_chronos_db_setup_over_dummy_transport(self):
+        """ChronosDB's real-cluster bring-up sequences ZK -> Mesos ->
+        Chronos (mesosphere.clj + chronos.clj db layers), verified by
+        the commands it issues over the dummy transport."""
+        from jepsen_tpu import control as c
+        from jepsen_tpu.suites import chronos
+
+        nodes = ["n1", "n2", "n3", "n4", "n5"]
+        test = {"nodes": nodes}
+        db = chronos.ChronosDB()
+        t = c.DummyTransport()
+        for node, master in (("n1", True), ("n5", False)):
+            t.log.clear()
+            with c.with_session(t.connect(node, {})):
+                db.setup(test, node)
+            cmds = " ;; ".join(cmd for _, cmd in t.log)
+            assert "zookeeper" in cmds              # ZK layer first
+            assert "mesosphere" in cmds             # repo added
+            assert "/etc/mesos/zk" in cmds          # zk URI configured
+            assert "/etc/mesos-master/quorum" in cmds
+            daemon = "mesos-master" if master else "mesos-slave"
+            assert daemon in cmds, (node, cmds)
+            assert "schedule_horizon" in cmds       # chronos config
+            assert "chronos start" in cmds
+        # teardown stops everything and clears state
+        t.log.clear()
+        with c.with_session(t.connect("n1", {})):
+            db.teardown(test, "n1")
+        cmds = " ;; ".join(cmd for _, cmd in t.log)
+        assert "chronos stop" in cmds
+        assert "mesos-master" in cmds
+        lf = db.log_files(test, "n1")
+        assert any("zookeeper" in f for f in lf)
+        assert any("mesos" in f for f in lf)
+
+    def test_chronos_test_map_has_db_layer(self):
+        from jepsen_tpu.suites import chronos
+
+        t = chronos.test({"fake": False})
+        assert isinstance(t["db"], chronos.ChronosDB)
+        t_fake = chronos.test({"fake": True})
+        assert t_fake["transport"] == "dummy"
+
     def test_fake_scheduler_end_to_end(self):
         import time
 
@@ -275,3 +318,138 @@ class TestPgWire:
         e = PgError({"C": "40001", "M": "restart transaction"})
         assert e.retryable
         assert not PgError({"C": "23505", "M": "dup"}).retryable
+
+
+class TestCockroachDepth:
+    """Round-3 additions: multitable bank client, tcpdump DB hook, and
+    the ubuntu OS variant (bank.clj:160-249, auto.clj:67-75,
+    os/ubuntu.clj)."""
+
+    class StubConn:
+        def __init__(self, txn_results=None, balances=None):
+            self.stmts = []
+            self.queries = []
+            self.txn_results = txn_results
+            self.balances = balances or {}
+
+        def txn(self, stmts):
+            self.stmts.append(list(stmts))
+            if isinstance(self.txn_results, Exception):
+                raise self.txn_results
+            if self.txn_results is not None:
+                return self.txn_results
+            return [[] for _ in stmts]
+
+        def query(self, sql):
+            self.queries.append(sql)
+            if isinstance(self.txn_results, Exception) and \
+                    sql.startswith("UPDATE"):
+                raise self.txn_results
+            if sql.startswith("SELECT"):
+                for tbl, bal in self.balances.items():
+                    if tbl in sql:
+                        return [(bal,)]
+                return [(10,)]
+            return []
+
+        def close(self):
+            pass
+
+    def test_multibank_read_spans_all_tables_in_one_txn(self):
+        from jepsen_tpu.history import invoke_op
+        from jepsen_tpu.suites.cockroachdb import MultiBankClient
+
+        conn = self.StubConn(txn_results=[[(10,)], [(7,)], [(13,)],
+                                          [(10,)], [(10,)]])
+        cl = MultiBankClient(conn, n=5, total=50)
+        out = cl.invoke({}, invoke_op(0, "read", None))
+        assert out.type == "ok" and out.value == [10, 7, 13, 10, 10]
+        (stmts,) = conn.stmts
+        assert len(stmts) == 5
+        assert all(f"jepsen_accounts{i}" in stmts[i] for i in range(5))
+
+    def test_multibank_transfer_reads_checks_updates(self):
+        from jepsen_tpu.history import invoke_op
+        from jepsen_tpu.suites.cockroachdb import MultiBankClient
+
+        conn = self.StubConn(balances={"jepsen_accounts1": 10})
+        cl = MultiBankClient(conn, n=5, total=50)
+        out = cl.invoke({}, invoke_op(
+            0, "transfer", {"from": 1, "to": 3, "amount": 4}))
+        assert out.type == "ok"
+        q = conn.queries
+        assert q[0] == "BEGIN" and q[-1] == "COMMIT"
+        assert any("SELECT" in s and "jepsen_accounts1" in s for s in q)
+        assert any("jepsen_accounts1" in s and "balance - 4" in s
+                   for s in q)
+        assert any("jepsen_accounts3" in s and "balance + 4" in s
+                   for s in q)
+
+    def test_multibank_transfer_insufficient_funds_fails_clean(self):
+        """The credit must NOT happen when the debit would go negative
+        (bank.clj:193-225) — a conjured credit would make the checker
+        blame a correct database."""
+        from jepsen_tpu.history import invoke_op
+        from jepsen_tpu.suites.cockroachdb import MultiBankClient
+
+        conn = self.StubConn(balances={"jepsen_accounts1": 3})
+        cl = MultiBankClient(conn, n=5, total=50)
+        out = cl.invoke({}, invoke_op(
+            0, "transfer", {"from": 1, "to": 3, "amount": 4}))
+        assert out.type == "fail"
+        assert not any(s.startswith("UPDATE") for s in conn.queries)
+        assert conn.queries[-1] == "ROLLBACK"
+
+    def test_multibank_txn_error_fails_transfer(self):
+        from jepsen_tpu.history import invoke_op
+        from jepsen_tpu.suites.cockroachdb import MultiBankClient
+        from jepsen_tpu.suites.pgwire import PgError
+
+        conn = self.StubConn(
+            txn_results=PgError({"C": "40001", "M": "restart"}),
+            balances={"jepsen_accounts0": 10})
+        cl = MultiBankClient(conn, n=5, total=50)
+        out = cl.invoke({}, invoke_op(
+            0, "transfer", {"from": 0, "to": 1, "amount": 1}))
+        assert out.type == "fail"
+
+    def test_tcpdump_hook_commands(self):
+        from jepsen_tpu import control as c
+        from jepsen_tpu.suites import cockroachdb as cr
+
+        t = c.DummyTransport(
+            results={"env": "HOME=/root\nSSH_CLIENT=10.0.0.9 51022 22"})
+        with c.with_session(t.connect("n1", {})):
+            db = cr.CockroachDB(tcpdump=True)
+            db.packet_capture("n1")
+            db.stop_packet_capture()
+        cmds = " ;; ".join(cmd for _, cmd in t.log)
+        assert "tcpdump" in cmds
+        assert "10.0.0.9" in cmds           # filters on the control addr
+        assert str(cr.PORT) in cmds
+        assert cr.PCAP_LOG in db.log_files({}, "n1")
+
+    def test_registry_and_os_wiring(self):
+        from jepsen_tpu import os_ubuntu
+        from jepsen_tpu.suites import cockroachdb as cr
+
+        t = cr.test({"fake": False, "workload": "bank-multitable",
+                     "tcpdump": True})
+        assert isinstance(t["client"], cr.MultiBankClient)
+        assert t["db"].tcpdump is True
+        assert isinstance(t["os"], os_ubuntu.UbuntuOS)
+        t2 = cr.test({"fake": False, "os": "debian"})
+        from jepsen_tpu import os_debian
+
+        assert isinstance(t2["os"], os_debian.DebianOS)
+
+    def test_ubuntu_os_setup_over_dummy(self):
+        from jepsen_tpu import control as c
+        from jepsen_tpu import os_ubuntu
+
+        t = c.DummyTransport()
+        with c.with_session(t.connect("n2", {})):
+            os_ubuntu.os.setup({"nodes": ["n1", "n2"]}, "n2")
+        cmds = " ;; ".join(cmd for _, cmd in t.log)
+        assert "tcpdump" in cmds            # package list
+        assert "ntp stop" in cmds
