@@ -14,8 +14,8 @@ test:
 integration:
 	cd docker && ./up.sh --daemon
 	docker exec -e JEPSEN_NODES=n1,n2,n3,n4,n5 jepsen-tpu-control \
-		python -m pytest /jepsen_tpu/tests/test_integration_matrix.py -v
-	cd docker && docker compose down
+		python -m pytest /jepsen_tpu/tests/test_integration_matrix.py -v; \
+	rc=$$?; cd docker && docker compose down; exit $$rc
 
 # Same matrix against nodes you already have (set JEPSEN_NODES).
 integration-local:

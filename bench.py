@@ -79,7 +79,8 @@ def _check_timed(history, n_ops):
         "verdict": r["valid?"], "analyzer": r.get("analyzer")}
 
 
-def _probe(detail: dict, key: str, make_history, n_ops: int) -> None:
+def _probe(detail: dict, key: str, make_history, n_ops: int,
+           model=None) -> None:
     """Run one secondary capability probe: warm once (compile), then
     time. Never fails the bench; records timing or the error."""
     import traceback
@@ -89,7 +90,8 @@ def _probe(detail: dict, key: str, make_history, n_ops: int) -> None:
         from jepsen_tpu.lin import device_check_packed, prepare
 
         h = make_history()
-        p = prepare.prepare(m.cas_register(), h)
+        p = prepare.prepare(model if model is not None
+                            else m.cas_register(), h)
         r = device_check_packed(p)          # warm/compile
         t0 = time.time()
         r = device_check_packed(p)
@@ -123,6 +125,15 @@ def _wide_probes(detail: dict) -> None:
     _probe(detail, "partitioned_c30",
            lambda: synth.generate_partitioned_register_history(
                5000, seed=7, invoke_bias=0.45), 5000)
+    # BASELINE config 3: lock (Mutex) histories at the same concurrency
+    # (hazelcast.clj:379-386 / zookeeper locks). Contention serializes
+    # the window, so the dense engine absorbs these.
+    from jepsen_tpu import models as m
+
+    _probe(detail, "mutex_c30",
+           lambda: synth.generate_mutex_history(
+               5000, concurrency=30, seed=7, crash_prob=0.002,
+               max_crashes=4), 5000, model=m.mutex())
 
 
 def main() -> None:

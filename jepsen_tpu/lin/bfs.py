@@ -819,8 +819,10 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
     if key_hi:
         assert exp_tables is not None, "pair keys require compact tables"
     # Spike-cap programs (row_tiers=False) process known-big frontiers,
-    # so tier branches there are compile-time dead weight.
-    tiered = exp_tables is not None and row_tiers
+    # so tier branches there are compile-time dead weight. The compact
+    # register band and the generic packed band (mutex — BASELINE
+    # config 3's lock histories) both tier.
+    tiered = row_tiers
     tiers = tuple(t for t in ROW_TIERS if t < cap) + (cap,) \
         if tiered else (cap,)
 

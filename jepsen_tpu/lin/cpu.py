@@ -124,11 +124,12 @@ def search_rows(p: PackedHistory, configs, order, r0: int, r1: int,
     ``reduce=True`` applies the exact search-space reductions of
     :func:`jepsen_tpu.lin.prepare.reduction_tables` (pure-op saturation +
     canonical chains). Verdict and death row are provably identical to the
-    plain search (and parity-fuzzed so); the surviving config SETS differ
-    (reduced keeps canonical representatives), so witness tracking
-    requires ``reduce=False``."""
-    if reduce and order is not None:
-        raise ValueError("witness tracking requires the unreduced search")
+    plain search (and parity-fuzzed so); the surviving config SETS are
+    canonical/saturated representatives. Witness tracking works in both
+    modes: a saturated read's absorption point IS a valid linearization
+    point (the read is pending and its value matches there), so absorbed
+    ops join the path as they are folded in — the reduced witness is a
+    genuine linearization order, just a canonical one."""
     step = py_step_fn(p.kernel.name)
     window = p.window
     if reduce:
@@ -149,14 +150,26 @@ def search_rows(p: PackedHistory, configs, order, r0: int, r1: int,
                 if pure_r[j]:
                     pure_mask |= 1 << j
 
-            def saturate(bits, st):
+            track = order is not None
+
+            def saturate(bits, st, path=None):
                 for j in range(window):
                     if (pure_mask >> j) & 1 and not (bits >> j) & 1 \
                             and step(st, f_ints[j], v_tups[j])[0]:
                         bits |= 1 << j
-                return bits
+                        if track:
+                            path = (int(p.slot_op[r, j]), path)
+                return bits, path
 
-            configs = {(saturate(b, st), st) for b, st in configs}
+            if order is None:
+                configs = {(saturate(b, st)[0], st) for b, st in configs}
+            else:
+                sat: dict = {}
+                for b, st in configs:
+                    b2, path2 = saturate(b, st, order[(b, st)])
+                    sat.setdefault((b2, st), path2)
+                configs = set(sat)
+                order.update(sat)
         seen = set(configs)
         frontier = list(configs)
         while frontier:
@@ -179,15 +192,16 @@ def search_rows(p: PackedHistory, configs, order, r0: int, r1: int,
                         ok, st2 = step(st, f_ints[j], v_tups[j])
                         if ok:
                             b2 = bits | (1 << j)
+                            path = None if order is None else \
+                                (int(p.slot_op[r, j]), order[cfg])
                             if reduce:
-                                b2 = saturate(b2, st2)
+                                b2, path = saturate(b2, st2, path)
                             c2 = (b2, st2)
                             if c2 not in seen:
                                 seen.add(c2)
                                 new.append(c2)
                                 if order is not None:
-                                    order[c2] = (int(p.slot_op[r, j]),
-                                                 order[cfg])
+                                    order[c2] = path
             frontier = new
         s = int(p.ret_slot[r])
         mask = 1 << s
@@ -220,18 +234,21 @@ def check_packed(p: PackedHistory, witness: bool = False,
     search between rows — set by a competition race once the other racer
     has decided.
 
-    Without ``witness`` the search runs REDUCED (pure-op saturation +
-    canonical chains, see search_rows): verdict and death row are exact,
-    but the reported ``configs`` are canonical/saturated representatives
-    of the reduced frontier, not the plain frontier knossos would list —
-    the result carries ``"reduced": True`` to flag that."""
+    The search always runs REDUCED (pure-op saturation + canonical
+    chains, see search_rows): verdict and death row are exact, but the
+    reported ``configs`` — and the witness order, which threads through
+    saturation points — are canonical/saturated representatives of the
+    reduced frontier, not the plain frontier knossos would list; the
+    result carries ``"reduced": True`` to flag that. (Round 2 forced
+    the unreduced search under ``witness``, which made the competition's
+    CPU racer grind wide windows for nothing.)"""
     if p.kernel is None:
         return check_generic(p, witness=witness)
 
     init = (0, tuple(int(x) for x in p.init_state))
     configs = {init}
     order: dict | None = {init: None} if witness else None
-    reduce = not witness
+    reduce = True
     try:
         configs, order = search_rows(p, configs, order, 0, p.R,
                                      cancel=cancel, reduce=reduce)

@@ -433,9 +433,12 @@ def prepare(model, history, max_window: int = MAX_WINDOW) -> PackedHistory:
 
     crashed = [o for o in ops if o.return_pos is None]
 
-    # Per-slot crashed mask — diagnostics/reporting only; no engine
-    # consumes it on device (the dominance pruning that did was removed in
-    # favor of the dense bitmap engine, which needs no pruning).
+    # Per-slot crashed mask. CONSUMED BY THE DEVICE ENGINES: the
+    # crashed-op canonical chains (reduction_tables) and the sparse
+    # engine's crashed-subset dominance prune (bfs.expansion_tables
+    # builds its key-space crash masks from this; bfs.check_packed
+    # gates the prune on it) — its semantics ("this active slot's op
+    # never returns") are exactness-critical, not just reporting.
     crashed_tbl = np.zeros_like(active)
     live = active & (slot_op >= 0)
     crashed_tbl[live] = return_pos[slot_op[live]] < 0

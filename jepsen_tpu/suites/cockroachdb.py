@@ -275,34 +275,40 @@ class MultiBankClient(client_ns.Client):
                 t = op.value
                 src, dst = self._table(t["from"]), self._table(t["to"])
                 amt = int(t["amount"])
-                try:
-                    # Read-check-update inside one transaction
-                    # (bank.clj:193-225): the credit must not happen
-                    # when the debit would go negative.
-                    self.conn.query("BEGIN")
+                # Read-check-update inside one transaction
+                # (bank.clj:193-225): the credit must not happen when
+                # the debit would go negative. Serialization aborts
+                # (40001) retry like PgClient.txn's with-txn-retry —
+                # without it, contention on single-row tables would
+                # degenerate the workload to mostly-failed transfers.
+                for attempt in range(5):
                     try:
-                        rows = self.conn.query(
-                            f"SELECT balance FROM {src}")
-                        if not rows or int(rows[0][0]) < amt:
-                            self.conn.query("ROLLBACK")
-                            return op.replace(type="fail",
-                                              error="negative")
-                        self.conn.query(
-                            f"UPDATE {src} SET balance = "
-                            f"balance - {amt}")
-                        self.conn.query(
-                            f"UPDATE {dst} SET balance = "
-                            f"balance + {amt}")
-                        self.conn.query("COMMIT")
-                    except PgError:
+                        self.conn.query("BEGIN")
                         try:
-                            self.conn.query("ROLLBACK")
-                        except (PgError, OSError):
-                            pass
-                        raise
-                    return op.replace(type="ok")
-                except PgError:
-                    return op.replace(type="fail")
+                            rows = self.conn.query(
+                                f"SELECT balance FROM {src}")
+                            if not rows or int(rows[0][0]) < amt:
+                                self.conn.query("ROLLBACK")
+                                return op.replace(type="fail",
+                                                  error="negative")
+                            self.conn.query(
+                                f"UPDATE {src} SET balance = "
+                                f"balance - {amt}")
+                            self.conn.query(
+                                f"UPDATE {dst} SET balance = "
+                                f"balance + {amt}")
+                            self.conn.query("COMMIT")
+                        except PgError:
+                            try:
+                                self.conn.query("ROLLBACK")
+                            except (PgError, OSError):
+                                pass
+                            raise
+                        return op.replace(type="ok")
+                    except PgError as e:
+                        if not (getattr(e, "retryable", False)
+                                and attempt < 4):
+                            return op.replace(type="fail")
         except (OSError, ConnectionError) as e:
             return op.replace(type="fail" if op.f == "read" else "info",
                               error=repr(e))
