@@ -13,10 +13,14 @@ is decided by a breadth-first frontier over
 - :mod:`jepsen_tpu.lin.cpu`     — host reference implementation of the
   just-in-time linearization closure (semantic spec + fallback for models
   without device kernels; analogue of knossos.linear).
-- :mod:`jepsen_tpu.lin.bfs`     — the device kernel: frontier in HBM as
-  packed uint32 bitsets + model-state ints, expansion vmapped over
-  (config x candidate op), dedup via lexicographic sort, `lax.scan` over
-  return events (analogue of knossos.wgl, but data-parallel).
+- :mod:`jepsen_tpu.lin.bfs`     — the sparse device kernel: frontier in
+  HBM as packed u32 keys (single word to window 31-b, (hi, lo) pairs to
+  60), mutator-compacted expansion, per-row count tiers, canonical
+  chains + dominance pruning over crashed/read bits.
+- :mod:`jepsen_tpu.lin.dense`   — the dense config-space bitmap engine
+  (windows <= 20): the frontier as its characteristic function.
+- :mod:`jepsen_tpu.lin.psort`   — in-VMEM pallas bitonic sort-dedup
+  kernels backing the sparse engine's per-pass dedup.
 - :mod:`jepsen_tpu.lin.sharded` — pjit/shard_map multi-chip frontier with
   collective dedup over ICI.
 - :mod:`jepsen_tpu.lin.brute`   — tiny exhaustive search used to test the

@@ -472,16 +472,22 @@ def prepare(model, history, max_window: int = MAX_WINDOW) -> PackedHistory:
 #    plain survivor maps to a greedy survivor of the same row and vice
 #    versa. This removes pure ops from the exponential branching entirely.
 #
-# 2. **Canonical chains.** Two concurrently-pending identical live ops
+# 2. **Canonical chains.** Two concurrently-pending identical ops
 #    (same f, same value — e.g. two pending write(3)s, two mutex acquires)
 #    are exchangeable: swapping their linearization points yields another
-#    valid linearization (both intervals cover both points while both are
-#    pending, and the earlier-returning op's interval is the binding one).
-#    So the search may WLOG linearize them in return order: slot j with an
-#    active unlinearized identical sibling that returns earlier is blocked
-#    until the sibling's bit is set. Crashed ops never chain (they have no
-#    return to order by, and chaining them to live ops would force
-#    linearizing an op that may never have happened).
+#    valid linearization. LIVE ops chain by return order (both intervals
+#    cover both points while both are pending, and the earlier-returning
+#    interval is the binding one); CRASHED ops chain among themselves by
+#    invoke order (their windows never close, so any point past the later
+#    invoke lies in every earlier sibling's window). The two families
+#    never cross — a crashed op cannot stand in for a live one whose
+#    window ends at its return. Slot j with an unlinearized canonical
+#    predecessor is blocked until the predecessor's bit is set.
+#
+# (A third reduction — dominance pruning over crashed-op subsets and
+# read bits — lives in the device engine's dedup, jepsen_tpu.lin.bfs
+# ._dedup_keys_dom, since it prunes between configs rather than gating
+# transitions.)
 #
 # Config counts on a 2k-op concurrency-30 register history (window 28):
 # plain search >170k configs by row 40 (DNF); with both reductions the
