@@ -72,7 +72,8 @@ def unbridled_optimism() -> Checker:
     return FnChecker(lambda test, model, history, opts: {VALID: True})
 
 
-def linearizable(algorithm: str = "competition", **kw) -> Checker:
+def linearizable(algorithm: str = "competition",
+                 time_budget: float | None = None, **kw) -> Checker:
     """Validates linearizability (checker.clj:82-107).
 
     ``algorithm`` is one of:
@@ -81,11 +82,20 @@ def linearizable(algorithm: str = "competition", **kw) -> Checker:
     - ``"cpu"``  — the host reference search (:mod:`jepsen_tpu.lin.cpu`)
     - ``"competition"`` — race both, first verdict wins (knossos.competition)
 
+    ``time_budget`` (seconds) caps the search: when it fires, the host
+    and device searches are cancelled between rows/chunks and the result
+    is an honest ``"unknown"`` with the reason — a hostile wide-window
+    history in a suite run degrades to "unknown" instead of hanging the
+    analysis phase (knossos truncates output for the same reason,
+    checker.clj:104-107).
+
     Like the reference, the analysis result is truncated (writing full
     configs "can take *hours*", checker.clj:104-107).
     """
 
     def check(test, model, history, opts):
+        import threading
+
         from jepsen_tpu import lin
 
         # Counterexample paths by default, like knossos: the host racer
@@ -96,8 +106,33 @@ def linearizable(algorithm: str = "competition", **kw) -> Checker:
             kw2.setdefault("witness", True)
         if algorithm in ("tpu", "competition"):
             kw2.setdefault("explain", True)
-        a = lin.analysis(model, history, algorithm=algorithm, **kw2)
+        timer = None
+        timed_out = None
+        if time_budget is not None:
+            cancel = kw2.setdefault("cancel", threading.Event())
+            timed_out = threading.Event()
+
+            def fire():
+                # Separate flag: the competition race also sets the
+                # shared cancel event to stop the losing racer, which
+                # must not read as a budget overrun.
+                timed_out.set()
+                cancel.set()
+
+            timer = threading.Timer(time_budget, fire)
+            timer.daemon = True
+            timer.start()
+        try:
+            a = lin.analysis(model, history, algorithm=algorithm, **kw2)
+        finally:
+            if timer is not None:
+                timer.cancel()
         a = dict(a)
+        if timed_out is not None and timed_out.is_set() \
+                and a.get(VALID) not in (True, False):
+            a[VALID] = "unknown"
+            a["error"] = (f"time budget {time_budget}s exceeded: "
+                          f"{a.get('error', 'search cancelled')}")
         if not a.get(VALID, False):
             try:
                 from jepsen_tpu.lin import report as lin_report

@@ -100,11 +100,13 @@ def device_check_packed(packed: PackedHistory, cancel=None, **kw) -> dict:
     return bfs.check_packed(packed, cancel=cancel, **kw)
 
 
-def _competition(packed: PackedHistory, **kw) -> dict:
+def _competition(packed: PackedHistory, cancel=None, **kw) -> dict:
     """Race the device and host searches; the first *definite* verdict wins
     (knossos.competition/analysis semantics). A racer returning "unknown"
     (e.g. no device kernel for this model) does not end the race — only
-    when both racers fail to decide is "unknown" returned."""
+    when both racers fail to decide is "unknown" returned. An external
+    ``cancel`` event (e.g. a checker time budget) aborts both racers;
+    the race also sets it internally to stop the loser."""
     from jepsen_tpu.lin import cpu
 
     cpu_kw = {k: v for k, v in kw.items() if k in ("witness",)}
@@ -113,7 +115,7 @@ def _competition(packed: PackedHistory, **kw) -> dict:
     lock = threading.Lock()
     state: dict = {"result": None, "finished": 0}
     done = threading.Event()
-    cancel = threading.Event()
+    cancel = cancel if cancel is not None else threading.Event()
 
     def run(fn, name, fkw):
         try:
@@ -146,4 +148,8 @@ def _competition(packed: PackedHistory, **kw) -> dict:
     for t in threads:
         t.join()
     with lock:
+        if state["result"] is None:
+            # Both racers were cancelled before deciding (e.g. an
+            # external time budget fired): honest unknown.
+            return {"valid?": "unknown", "error": "cancelled"}
         return dict(state["result"])

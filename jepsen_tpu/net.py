@@ -18,7 +18,7 @@ class Net:
     def heal(self, test) -> None:
         """End all traffic drops and restore network (net.clj:12-13)."""
 
-    def slow(self, test) -> None:
+    def slow(self, test, mean_ms: float = 50, sigma_ms: float = 10) -> None:
         """Delay all packets (net.clj:14-15)."""
 
     def flaky(self, test) -> None:
@@ -53,12 +53,12 @@ class IptablesNet(Net):
                 c.exec_("iptables", "-X", "-w")
         c.on_nodes(test, go)
 
-    def slow(self, test):
+    def slow(self, test, mean_ms: float = 50, sigma_ms: float = 10):
         def go(test_, node):
             with c.su():
                 c.exec_("tc", "qdisc", "add", "dev", "eth0", "root",
-                        "netem", "delay", "50ms", "10ms",
-                        "distribution", "normal")
+                        "netem", "delay", f"{mean_ms:g}ms",
+                        f"{sigma_ms:g}ms", "distribution", "normal")
         c.on_nodes(test, go)
 
     def flaky(self, test):

@@ -207,14 +207,47 @@ class BankClient(client_ns.Client):
                                   value=[int(r[0]) for r in rows])
             if op.f == "transfer":
                 t = op.value
+                amt = int(t["amount"])
                 try:
-                    self.conn.txn([
-                        f"UPDATE {self.TABLE} SET balance = balance - "
-                        f"{t['amount']} WHERE id = {t['from']} AND "
-                        f"balance >= {t['amount']}",
-                        f"UPDATE {self.TABLE} SET balance = balance + "
-                        f"{t['amount']} WHERE id = {t['to']}"])
-                    return op.replace(type="ok")
+                    # Read-check-update in one txn (bank.clj:112-143):
+                    # the credit must not run when the debit would go
+                    # negative — a guarded-debit + unconditional-credit
+                    # pair would mint money on a failed guard.
+                    for attempt in range(5):
+                        try:
+                            self.conn.query("BEGIN")
+                            try:
+                                rows = self.conn.query(
+                                    f"SELECT balance FROM {self.TABLE} "
+                                    f"WHERE id = {int(t['from'])}")
+                                if not rows or int(rows[0][0]) < amt:
+                                    self.conn.query("ROLLBACK")
+                                    return op.replace(type="fail",
+                                                      error="negative")
+                                self.conn.query(
+                                    f"UPDATE {self.TABLE} SET balance = "
+                                    f"balance - {amt} "
+                                    f"WHERE id = {int(t['from'])}")
+                                self.conn.query(
+                                    f"UPDATE {self.TABLE} SET balance = "
+                                    f"balance + {amt} "
+                                    f"WHERE id = {int(t['to'])}")
+                                self.conn.query("COMMIT")
+                            except PgError:
+                                try:
+                                    self.conn.query("ROLLBACK")
+                                except (PgError, OSError):
+                                    pass
+                                raise
+                            return op.replace(type="ok")
+                        except PgError as e:
+                            if e.ambiguous:
+                                # COMMIT outcome unknown: may have
+                                # applied (client.clj:183-230).
+                                return op.replace(type="info",
+                                                  error=str(e))
+                            if not (e.retryable and attempt < 4):
+                                raise
                 except PgError:
                     return op.replace(type="fail")
         except (OSError, ConnectionError) as e:
@@ -306,6 +339,10 @@ class MultiBankClient(client_ns.Client):
                             raise
                         return op.replace(type="ok")
                     except PgError as e:
+                        if getattr(e, "ambiguous", False):
+                            # COMMIT outcome unknown: the transfer may
+                            # have applied (client.clj:183-230).
+                            return op.replace(type="info", error=str(e))
                         if not (getattr(e, "retryable", False)
                                 and attempt < 4):
                             return op.replace(type="fail")
@@ -322,9 +359,12 @@ class MultiBankClient(client_ns.Client):
 # --- nemesis registry (cockroach/nemesis.clj) -------------------------------
 
 
-def _skew(name: str, dt_s: float) -> dict:
+def _skew(name: str, dt_s: float, slow_dt_s: float | None = None) -> dict:
     """Clock-bump nemesis at one magnitude (nemesis.clj:233-272): :start
-    bumps randomly-selected nodes by dt seconds, :stop resets clocks."""
+    bumps randomly-selected nodes by dt seconds, :stop resets clocks.
+    Wrapped in :class:`Restarting` like the reference's bump-time
+    (nemesis.clj:237), and — for the big/huge magnitudes — additionally
+    in :class:`Slowing` (nemesis.clj:269-272)."""
 
     class Skew(nemesis_ns.Nemesis):
         def invoke(self, test, op):
@@ -345,7 +385,10 @@ def _skew(name: str, dt_s: float) -> dict:
                 return op.replace(type="info", value="clocks-reset")
             return op.replace(type="info")
 
-    return {"name": name, "nemesis": Skew(), "clocks": True,
+    nem: nemesis_ns.Nemesis = Restarting(Skew())
+    if slow_dt_s is not None:
+        nem = Slowing(nem, slow_dt_s)
+    return {"name": name, "nemesis": nem, "clocks": True,
             "gen": common.standard_nemesis_gen(5, 5)}
 
 
@@ -367,8 +410,78 @@ def _strobe() -> dict:
                 return op.replace(type="info", value="clocks-reset")
             return op.replace(type="info")
 
-    return {"name": "strobe-skews", "nemesis": Strobe(), "clocks": True,
-            "gen": common.standard_nemesis_gen(0, 0)}
+    return {"name": "strobe-skews", "nemesis": Restarting(Strobe()),
+            "clocks": True, "gen": common.standard_nemesis_gen(0, 0)}
+
+
+class Slowing(nemesis_ns.Nemesis):
+    """Wraps a nemesis: before the underlying nemesis starts, slow the
+    network by ``dt`` seconds of mean delay; when it resolves, restore
+    network speed (cockroach/nemesis.clj:153-176)."""
+
+    def __init__(self, nem: nemesis_ns.Nemesis, dt_s: float):
+        self.nem = nem
+        self.dt_s = dt_s
+
+    def _net(self, test):
+        from jepsen_tpu import net as net_ns
+
+        return test.get("net") or net_ns.noop
+
+    def setup(self, test):
+        self._net(test).fast(test)
+        self.nem = self.nem.setup(test) or self.nem
+        return self
+
+    def invoke(self, test, op):
+        if op.f == "start":
+            self._net(test).slow(test, mean_ms=self.dt_s * 1000,
+                                 sigma_ms=1)
+            return self.nem.invoke(test, op)
+        if op.f == "stop":
+            try:
+                return self.nem.invoke(test, op)
+            finally:
+                self._net(test).fast(test)
+        return self.nem.invoke(test, op)
+
+    def teardown(self, test):
+        self._net(test).fast(test)
+        self.nem.teardown(test)
+
+
+class Restarting(nemesis_ns.Nemesis):
+    """Wraps a nemesis: after the underlying nemesis completes :stop,
+    restart the cockroach daemon on every node — skews/strobes can stop
+    it (cockroach/nemesis.clj:178-200; used by bump-time :237 and
+    strobe-time :207)."""
+
+    def __init__(self, nem: nemesis_ns.Nemesis, db=None):
+        self.nem = nem
+        self.db = db or CockroachDB()
+
+    def setup(self, test):
+        self.nem = self.nem.setup(test) or self.nem
+        return self
+
+    def invoke(self, test, op):
+        from jepsen_tpu.control import on_nodes
+
+        op2 = self.nem.invoke(test, op)
+        if op.f == "stop":
+            def restart(test_, node):
+                try:
+                    self.db.start(test_, node)
+                    return "started"
+                except Exception as e:  # noqa: BLE001 - per-node status
+                    return str(e)
+
+            stat = on_nodes(test, restart)
+            return op2.replace(value=[op2.value, stat])
+        return op2
+
+    def teardown(self, test):
+        self.nem.teardown(test)
 
 
 def _startstop(n: int) -> dict:
@@ -451,8 +564,8 @@ def nemeses() -> dict:
         "small-skews": _skew("small-skews", 0.100),
         "subcritical-skews": _skew("subcritical-skews", 0.200),
         "critical-skews": _skew("critical-skews", 0.250),
-        "big-skews": _skew("big-skews", 0.5),
-        "huge-skews": _skew("huge-skews", 5),
+        "big-skews": _skew("big-skews", 0.5, slow_dt_s=0.5),
+        "huge-skews": _skew("huge-skews", 5, slow_dt_s=5),
         "strobe-skews": _strobe(),
         "split": _split(),
         "start-stop-2": _startstop(2),

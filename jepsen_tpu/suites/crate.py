@@ -97,6 +97,231 @@ class CrateSetClient(client_ns.Client):
         return op.replace(type="fail", error=f"unknown f {op.f}")
 
 
+class CrateLostUpdatesClient(client_ns.Client):
+    """Real lost-updates client over ``/_sql``: read-modify-write of a
+    JSON element list guarded by CrateDB's ``_version`` optimistic CAS
+    (lost_updates.clj:32-99)."""
+
+    TABLE = "jepsen_sets"
+    KEY = 0
+
+    def __init__(self, node: str | None = None):
+        self.node = node
+
+    def open(self, test, node):
+        return CrateLostUpdatesClient(node)
+
+    def setup(self, test) -> None:
+        sql(test["nodes"][0],
+            f"CREATE TABLE IF NOT EXISTS {self.TABLE} "
+            f"(id integer PRIMARY KEY, elements string)")
+
+    def invoke(self, test, op: Op) -> Op:
+        import json as _json
+
+        try:
+            if op.f == "read":
+                status, body = sql(
+                    self.node, f"REFRESH TABLE {self.TABLE}", timeout=30)
+                if status != 200:
+                    # A stale (unrefreshed) read could report acknowledged
+                    # updates as lost — never ack it.
+                    return op.replace(type="fail", error=body)
+                status, body = sql(
+                    self.node,
+                    f"SELECT elements FROM {self.TABLE} WHERE id = ?",
+                    [self.KEY], timeout=30)
+                if status != 200:
+                    return op.replace(type="fail", error=body)
+                rows = body.get("rows") or []
+                els = _json.loads(rows[0][0]) if rows else []
+                return op.replace(type="ok", value=sorted(els))
+            if op.f == "update":
+                status, body = sql(
+                    self.node,
+                    f"SELECT elements, \"_version\" FROM {self.TABLE} "
+                    f"WHERE id = ?", [self.KEY])
+                if status != 200:
+                    return op.replace(type="info", error=body)
+                rows = body.get("rows") or []
+                if rows:
+                    els = _json.loads(rows[0][0])
+                    els.append(op.value)
+                    status, body = sql(
+                        self.node,
+                        f"UPDATE {self.TABLE} SET elements = ? "
+                        f"WHERE id = ? AND \"_version\" = ?",
+                        [_json.dumps(els), self.KEY, rows[0][1]])
+                    if status != 200:
+                        return op.replace(type="info", error=body)
+                    n = body.get("rowcount", 0)
+                    # rowcount 0 = version conflict: definitely not
+                    # applied (lost_updates.clj:85-87).
+                    return op.replace(type="ok" if n == 1 else "fail")
+                status, body = sql(
+                    self.node,
+                    f"INSERT INTO {self.TABLE} (id, elements) "
+                    f"VALUES (?, ?)",
+                    [self.KEY, _json.dumps([op.value])])
+                if status == 200:
+                    return op.replace(type="ok")
+                if "Duplicate" in str(body):
+                    return op.replace(type="fail", error="duplicate")
+                return op.replace(type="info", error=body)
+        except OSError as e:
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+class CrateVersionDivergenceClient(client_ns.Client):
+    """Real version-divergence client (version_divergence.clj:29-88):
+    upsert unique values into one row, read back (value, _version) —
+    each observed _version must name a single value."""
+
+    TABLE = "jepsen_registers"
+
+    def __init__(self, node: str | None = None):
+        self.node = node
+
+    def open(self, test, node):
+        return CrateVersionDivergenceClient(node)
+
+    def setup(self, test) -> None:
+        sql(test["nodes"][0],
+            f"CREATE TABLE IF NOT EXISTS {self.TABLE} "
+            f"(id integer PRIMARY KEY, value integer)")
+
+    def invoke(self, test, op: Op) -> Op:
+        from jepsen_tpu import independent
+
+        tup = independent.is_tuple(op.value)
+        k, v = op.value if tup else (0, op.value)
+
+        def join(val):
+            return independent.tuple_(k, val) if tup else val
+
+        try:
+            if op.f == "read":
+                status, body = sql(
+                    self.node,
+                    f"SELECT value, \"_version\" FROM {self.TABLE} "
+                    f"WHERE id = ?", [int(k)])
+                if status != 200:
+                    return op.replace(type="fail", error=body)
+                rows = body.get("rows") or []
+                val = list(rows[0]) if rows else None
+                return op.replace(type="ok", value=join(val))
+            if op.f == "write":
+                status, body = sql(
+                    self.node,
+                    f"INSERT INTO {self.TABLE} (id, value) VALUES (?, ?) "
+                    f"ON DUPLICATE KEY UPDATE value = VALUES(value)",
+                    [int(k), int(v)])
+                if status == 200:
+                    return op.replace(type="ok")
+                return op.replace(type="info", error=body)
+        except OSError as e:
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+class CrateDirtyReadClient(client_ns.Client):
+    """Real dirty-read client (dirty_read.clj:30-88): point reads by
+    primary key are realtime in CrateDB (can observe unreplicated
+    writes); table scans only see refreshed rows — the asymmetry the
+    workload probes."""
+
+    TABLE = "jepsen_dirty_read"
+
+    def __init__(self, node: str | None = None):
+        self.node = node
+
+    def open(self, test, node):
+        return CrateDirtyReadClient(node)
+
+    def setup(self, test) -> None:
+        sql(test["nodes"][0],
+            f"CREATE TABLE IF NOT EXISTS {self.TABLE} "
+            f"(id integer PRIMARY KEY)")
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                status, body = sql(
+                    self.node,
+                    f"SELECT id FROM {self.TABLE} WHERE id = ?",
+                    [int(op.value)])
+                if status != 200:
+                    return op.replace(type="fail", error=body)
+                found = bool(body.get("rows"))
+                return op.replace(type="ok" if found else "fail")
+            if op.f == "refresh":
+                status, body = sql(self.node,
+                                   f"REFRESH TABLE {self.TABLE}",
+                                   timeout=60)
+                return op.replace(type="ok" if status == 200 else "fail",
+                                  error=None if status == 200 else body)
+            if op.f == "strong-read":
+                status, body = sql(
+                    self.node,
+                    f"SELECT id FROM {self.TABLE} LIMIT 1000000",
+                    timeout=30)
+                if status != 200:
+                    return op.replace(type="fail", error=body)
+                return op.replace(
+                    type="ok",
+                    value=sorted(r[0] for r in body["rows"]))
+            if op.f == "write":
+                status, body = sql(
+                    self.node,
+                    f"INSERT INTO {self.TABLE} (id) VALUES (?)",
+                    [int(op.value)])
+                if status == 200:
+                    return op.replace(type="ok")
+                return op.replace(type="info", error=body)
+        except OSError as e:
+            t = "fail" if op.f in ("read", "strong-read") else "info"
+            return op.replace(type=t, error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+def multiversion_checker() -> FnChecker:
+    """Each observed ``_version`` of a row must name a single value
+    (version_divergence.clj:91-106). Read values are ``[value,
+    version]`` pairs (optionally independent-keyed)."""
+
+    def check(test, model, history, opts):
+        from collections import defaultdict
+
+        from jepsen_tpu import independent
+
+        seen = defaultdict(set)          # (key, version) -> values
+        for op in history:
+            if not (op.is_ok and op.f == "read") or op.value is None:
+                continue
+            k, payload = (op.value if independent.is_tuple(op.value)
+                          else (0, op.value))
+            if payload is None:
+                continue
+            val, version = payload
+            seen[(k, version)].add(val)
+        multis = {f"{k}@v{ver}": sorted(vs)
+                  for (k, ver), vs in seen.items() if len(vs) > 1}
+        return {"valid?": not multis, "multis": multis,
+                "versions-seen": len(seen)}
+
+    return FnChecker(check)
+
+
+def crate_dirty_read_checker():
+    """The reference's dirty-read classification (dirty_read.clj:150-198)
+    — the shared strong-read classifier (also used by the elasticsearch
+    probe, whose reference checker is the same code)."""
+    return workloads.strong_read_classification_checker()
+
+
 def lost_updates_checker() -> FnChecker:
     """Every acknowledged update must appear in the final value
     (lost_updates.clj:141): value is a collected list per key."""
@@ -172,21 +397,169 @@ def lost_updates_workload(n: int = 100, faulty=None) -> dict:
     }
 
 
+def version_divergence_workload(n: int = 200, faulty=None) -> dict:
+    """Unique-int upserts + (value, _version) reads under partitions
+    (version_divergence.clj:108-136). The fake-mode client is an
+    in-process versioned row store; real runs drive
+    :class:`CrateVersionDivergenceClient`."""
+    state = {"n": 0}
+    lock = threading.Lock()
+
+    class Store:
+        def __init__(self):
+            self.row = None            # (value, version)
+            self.lock = threading.Lock()
+            self._writes = 0
+
+        def write(self, v):
+            with self.lock:
+                self._writes += 1
+                ver = (self.row[1] + 1) if self.row else 1
+                if faulty == "divergence" and self._writes % 5 == 0 \
+                        and self.row is not None:
+                    ver = self.row[1]  # same version, new value
+                self.row = (v, ver)
+
+        def read(self):
+            with self.lock:
+                return list(self.row) if self.row else None
+
+    store = Store()
+
+    class FakeClient(client_ns.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op: Op) -> Op:
+            if op.f == "write":
+                store.write(op.value)
+                return op.replace(type="ok")
+            if op.f == "read":
+                return op.replace(type="ok", value=store.read())
+            return op.replace(type="fail")
+
+    def write(test, process):
+        with lock:
+            v = state["n"]
+            state["n"] += 1
+        return {"type": "invoke", "f": "write", "value": v}
+
+    r = {"type": "invoke", "f": "read", "value": None}
+    return {
+        "generator": gen.limit(n, gen.stagger(
+            1 / 20, gen.mix([gen.gen(write), r]))),
+        "client": FakeClient(),
+        "checker": multiversion_checker(),
+        "model": None,
+    }
+
+
+def crate_dirty_read_workload(n: int = 200, faulty=None) -> dict:
+    """The crate dirty-read probe (dirty_read.clj:188-257): writers add
+    sequential ids, readers probe recently written ids, and after the
+    nemesis heals every worker takes a strong read (preceded by a
+    refresh)."""
+    state = {"n": 0, "in_flight": []}
+    lock = threading.Lock()
+
+    class Store:
+        """Fake-mode double with CrateDB's visibility split: point reads
+        are realtime, scans see only refreshed rows."""
+
+        def __init__(self):
+            self.rows: set = set()
+            self.refreshed: set = set()
+            self.lock = threading.Lock()
+
+        def write(self, v):
+            with self.lock:
+                self.rows.add(v)
+
+        def read(self, v):
+            with self.lock:
+                if faulty == "dirty-read" and v not in self.rows \
+                        and v % 13 == 0:
+                    return True
+                return v in self.rows
+
+        def refresh(self):
+            with self.lock:
+                self.refreshed = set(self.rows)
+
+        def strong_read(self):
+            with self.lock:
+                return sorted(self.refreshed)
+
+    store = Store()
+
+    class FakeClient(client_ns.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op: Op) -> Op:
+            if op.f == "write":
+                store.write(op.value)
+                return op.replace(type="ok")
+            if op.f == "read":
+                return op.replace(
+                    type="ok" if store.read(op.value) else "fail")
+            if op.f == "refresh":
+                store.refresh()
+                return op.replace(type="ok")
+            if op.f == "strong-read":
+                return op.replace(type="ok", value=store.strong_read())
+            return op.replace(type="fail")
+
+    def rw(test, process):
+        import random as _random
+
+        with lock:
+            if not state["in_flight"] or _random.random() < 0.5:
+                v = state["n"]
+                state["n"] += 1
+                state["in_flight"].append(v)
+                del state["in_flight"][:-10]
+                return {"type": "invoke", "f": "write", "value": v}
+            v = _random.choice(state["in_flight"])
+            return {"type": "invoke", "f": "read", "value": v}
+
+    return {
+        "generator": gen.limit(n, gen.stagger(1 / 50, gen.gen(rw))),
+        "final_generator": gen.phases(
+            gen.singlethreaded(gen.once(
+                {"type": "invoke", "f": "refresh", "value": None})),
+            gen.each(lambda: gen.once(
+                {"type": "invoke", "f": "strong-read", "value": None}))),
+        "client": FakeClient(),
+        "checker": crate_dirty_read_checker(),
+        "model": None,
+    }
+
+
 def test(opts: dict | None = None) -> dict:
     """The crate test map (core.clj:100-140 + runner.clj). ``workload``
-    picks set (default) / dirty-read / lost-updates."""
+    picks set (default) / dirty-read / lost-updates /
+    version-divergence — all four drive real CrateDB SQL over ``/_sql``
+    on non-fake runs."""
     opts = dict(opts or {})
     name = opts.pop("workload", None) or "set"
-    table = {"set": lambda: workloads.set_workload(),
-             "dirty-read": lambda: workloads.dirty_read_workload(),
-             "lost-updates": lambda: lost_updates_workload()}
+    table = {
+        "set": (lambda: workloads.set_workload(), CrateSetClient()),
+        "dirty-read": (lambda: crate_dirty_read_workload(),
+                       CrateDirtyReadClient()),
+        "lost-updates": (lambda: lost_updates_workload(),
+                         CrateLostUpdatesClient()),
+        "version-divergence": (lambda: version_divergence_workload(),
+                               CrateVersionDivergenceClient()),
+    }
     if name not in table:
         raise ValueError(f"unknown workload {name!r}")
+    wl, real_client = table[name]
     return common.suite_test(
         f"crate {name}", opts,
-        workload=table[name](),
+        workload=wl(),
         db=CrateDB(),
-        client=CrateSetClient() if name == "set" else None,
+        client=real_client,
         nemesis=nemesis_ns.partition_random_halves(),
         nemesis_gen=common.standard_nemesis_gen(10, 10))
 
@@ -196,7 +569,8 @@ def main(argv=None) -> None:
 
     def opt_spec(p):
         p.add_argument("--workload", default="set",
-                       choices=["set", "dirty-read", "lost-updates"])
+                       choices=["set", "dirty-read", "lost-updates",
+                                "version-divergence"])
 
     cli.main(cli.suite_commands(test, opt_spec=opt_spec), argv)
 

@@ -80,7 +80,7 @@ class EsSetClient(client_ns.Client):
                                  timeout=30)
                 status, body = common.http_json(
                     "POST", f"{self._base()}/{INDEX}/_search",
-                    {"size": 10 ** 6,
+                    {"size": 10000,  # ES 5.x index.max_result_window cap
                      "query": {"match_all": {}}}, timeout=30)
                 if status != 200:
                     return op.replace(type="fail", error=body)
@@ -93,18 +93,184 @@ class EsSetClient(client_ns.Client):
         return op.replace(type="fail", error=f"unknown f {op.f}")
 
 
+class EsDirtyReadClient(client_ns.Client):
+    """Dirty-read probe client (dirty_read.clj:30-105): GET by id is
+    realtime (can observe in-flight writes), ``_search`` only sees
+    refreshed docs — write / read / refresh / strong-read."""
+
+    def __init__(self, node: str | None = None):
+        self.node = node
+
+    def open(self, test, node):
+        return EsDirtyReadClient(node)
+
+    def _base(self) -> str:
+        return f"http://{self.node}:{PORT}"
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "write":
+                status, body = common.http_json(
+                    "PUT", f"{self._base()}/{INDEX}/doc/{int(op.value)}",
+                    {"value": int(op.value)}, timeout=10)
+                if status in (200, 201):
+                    return op.replace(type="ok")
+                return op.replace(type="info", error=body)
+            if op.f == "read":
+                status, body = common.http_json(
+                    "GET", f"{self._base()}/{INDEX}/doc/{int(op.value)}",
+                    timeout=10)
+                if status == 200 and body.get("found", False):
+                    return op.replace(type="ok")
+                if status in (200, 404):
+                    return op.replace(type="fail")
+                return op.replace(type="fail", error=body)
+            if op.f == "refresh":
+                status, body = common.http_json(
+                    "POST", f"{self._base()}/{INDEX}/_refresh",
+                    timeout=60)
+                return op.replace(type="ok" if status == 200 else "fail",
+                                  error=None if status == 200 else body)
+            if op.f == "strong-read":
+                status, body = common.http_json(
+                    "POST", f"{self._base()}/{INDEX}/_search",
+                    {"size": 10000,  # ES 5.x index.max_result_window cap
+                     "query": {"match_all": {}}}, timeout=30)
+                if status != 200:
+                    return op.replace(type="fail", error=body)
+                vals = sorted(h["_source"]["value"]
+                              for h in body["hits"]["hits"])
+                return op.replace(type="ok", value=vals)
+        except OSError as e:
+            t = "fail" if op.f in ("read", "strong-read") else "info"
+            return op.replace(type=t, error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+
+def dirty_read_checker():
+    """The reference's dirty/lost/stale classification
+    (dirty_read.clj:106-157) — the shared strong-read classifier."""
+    return workloads.strong_read_classification_checker()
+
+
+def dirty_read_workload(n: int = 300, writers: int = 2,
+                        faulty=None) -> dict:
+    """The rw-gen schedule (dirty_read.clj:159-189): writer threads
+    index sequential ids, recording the in-flight write per node;
+    readers probe the most recent in-flight id on their node. After the
+    nemesis heals, every worker refreshes and takes a strong read."""
+    import random as _random
+    import threading
+
+    from jepsen_tpu import generator as gen
+
+    state = {"n": 0, "in_flight": {}}
+    lock = threading.Lock()
+
+    class Store:
+        """Fake-mode double with ES visibility: GETs realtime, search
+        sees refreshed docs only. faulty="dirty-read" makes some writes
+        visible to point reads but never durable (the anomaly the
+        reference hunts); faulty="lost" silently drops indexed docs."""
+
+        def __init__(self):
+            self.docs: set = set()
+            self.dirty: set = set()
+            self.refreshed: set = set()
+            self.lock = threading.Lock()
+
+        def write(self, v):
+            with self.lock:
+                if faulty == "dirty-read" and v % 7 == 3:
+                    self.dirty.add(v)  # GET-visible, never durable
+                    return
+                if faulty == "lost" and v % 11 == 5:
+                    return  # acked, never anywhere
+                self.docs.add(v)
+
+        def read(self, v):
+            with self.lock:
+                return v in self.docs or v in self.dirty
+
+        def refresh(self):
+            with self.lock:
+                self.refreshed = set(self.docs)
+
+        def strong_read(self):
+            with self.lock:
+                return sorted(self.refreshed)
+
+    store = Store()
+
+    class FakeClient(client_ns.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op: Op) -> Op:
+            if op.f == "write":
+                store.write(op.value)
+                return op.replace(type="ok")
+            if op.f == "read":
+                return op.replace(
+                    type="ok" if store.read(op.value) else "fail")
+            if op.f == "refresh":
+                store.refresh()
+                return op.replace(type="ok")
+            if op.f == "strong-read":
+                return op.replace(type="ok", value=store.strong_read())
+            return op.replace(type="fail")
+
+    def rw(test, process):
+        if not isinstance(process, int):
+            return None          # nemesis thread asks when no nemesis gen
+        nodes = test.get("nodes") or ["n1"]
+        node = nodes[process % len(nodes)]
+        with lock:
+            if process % max(1, test.get("concurrency", 5)) < writers \
+                    or not state["in_flight"]:
+                v = state["n"]
+                state["n"] += 1
+                state["in_flight"][node] = v
+                return {"type": "invoke", "f": "write", "value": v}
+            v = state["in_flight"].get(
+                node, _random.choice(list(state["in_flight"].values())))
+            return {"type": "invoke", "f": "read", "value": v}
+
+    return {
+        "generator": gen.limit(n, gen.stagger(1 / 10, gen.gen(rw))),
+        "final_generator": gen.phases(
+            gen.each(lambda: gen.once(
+                {"type": "invoke", "f": "refresh", "value": None})),
+            gen.each(lambda: gen.once(
+                {"type": "invoke", "f": "strong-read", "value": None}))),
+        "client": FakeClient(),
+        "checker": dirty_read_checker(),
+        "model": None,
+    }
+
+
 def test(opts: dict | None = None) -> dict:
-    """The elasticsearch set test map (core.clj:170-226). ``nemesis``
-    opt picks "hammer-time" (default) or "bridge" (core.clj:219,259)."""
+    """The elasticsearch test map (core.clj:170-226). ``workload``
+    picks "set" (default) or "dirty-read" (dirty_read.clj:191-220);
+    ``nemesis`` picks "hammer-time" (default) or "bridge"
+    (core.clj:219,259)."""
     opts = dict(opts or {})
+    wl_name = opts.pop("workload", None) or "set"
     nem = opts.pop("nemesis", None) or "hammer-time"
     nemesis = (nemesis_ns.hammer_time("java") if nem == "hammer-time"
                else nemesis_ns.partitioner(nemesis_ns.bridge))
+    table = {"set": (lambda: workloads.set_workload(), EsSetClient()),
+             "dirty-read": (lambda: dirty_read_workload(),
+                            EsDirtyReadClient())}
+    if wl_name not in table:
+        raise ValueError(f"unknown workload {wl_name!r}")
+    wl, real_client = table[wl_name]
     return common.suite_test(
-        "elasticsearch", opts,
-        workload=workloads.set_workload(),
+        f"elasticsearch {wl_name}" if wl_name != "set"
+        else "elasticsearch", opts,
+        workload=wl(),
         db=ElasticsearchDB(),
-        client=EsSetClient(),
+        client=real_client,
         nemesis=nemesis,
         nemesis_gen=common.standard_nemesis_gen(10, 10))
 
@@ -113,6 +279,8 @@ def main(argv=None) -> None:
     from jepsen_tpu import cli
 
     def opt_spec(p):
+        p.add_argument("--workload", default="set",
+                       choices=["set", "dirty-read"])
         p.add_argument("--nemesis", default="hammer-time",
                        choices=["hammer-time", "bridge"])
 

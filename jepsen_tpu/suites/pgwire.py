@@ -39,6 +39,15 @@ class PgError(Exception):
         # loop of cockroach/client.clj wraps exactly these.
         return self.code in ("40001", "40P01", "CR000")
 
+    @property
+    def ambiguous(self) -> bool:
+        """The statement (typically COMMIT) may or may not have applied:
+        40003 statement_completion_unknown, XXA00 CockroachDB ambiguous
+        result. Clients must complete mutating ops as :info on these —
+        never :fail — matching the reference's exception->op defaulting
+        to :info for non-idempotent ops (cockroach/client.clj:183-230)."""
+        return self.code in ("40003", "XXA00")
+
 
 class PgClient:
     def __init__(self, host: str, port: int = 5432, user: str = "root",

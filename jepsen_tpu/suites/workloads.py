@@ -246,6 +246,53 @@ def bank_workload(n_accounts: int = 5, total: int = 50, n: int = 200,
 
 # --- dirty reads (galera/dirty_reads.clj:77, percona, crate) ----------------
 
+def strong_read_classification_checker() -> checker_ns.Checker:
+    """The strong-read classification shared by the crate and
+    elasticsearch dirty-read probes (crate/dirty_read.clj:150-198,
+    elasticsearch/dirty_read.clj:106-157): a read must never observe an
+    element absent from every final strong read (dirty), every
+    acknowledged write must appear in some strong read (lost;
+    ``some-lost`` counts writes missing from at least one node), and
+    all nodes' strong reads must agree."""
+
+    def check(test, model, history, opts):
+        writes, reads, strong = set(), set(), []
+        for op in history:
+            if not op.is_ok:
+                continue
+            if op.f == "write":
+                writes.add(op.value)
+            elif op.f == "read":
+                reads.add(op.value)
+            elif op.f == "strong-read" and op.value is not None:
+                strong.append(set(op.value))
+        if not strong:
+            return {VALID: "unknown", "error": "no strong reads"}
+        on_all = set.intersection(*strong)
+        on_some = set.union(*strong)
+        not_on_all = on_some - on_all
+        unchecked = on_some - reads
+        dirty = reads - on_some
+        lost = writes - on_some
+        some_lost = writes - on_all
+        nodes_agree = on_all == on_some
+        return {VALID: nodes_agree and not dirty and not lost,
+                "nodes-agree?": nodes_agree,
+                "read-count": len(reads),
+                "on-all-count": len(on_all),
+                "on-some-count": len(on_some),
+                "unchecked-count": len(unchecked),
+                "not-on-all-count": len(not_on_all),
+                "not-on-all": sorted(not_on_all)[:10],
+                "dirty-count": len(dirty), "dirty": sorted(dirty)[:10],
+                "lost-count": len(lost), "lost": sorted(lost)[:10],
+                "some-lost-count": len(some_lost),
+                "some-lost": sorted(some_lost)[:10],
+                "strong-read-count": len(strong)}
+
+    return FnChecker(check)
+
+
 def dirty_read_checker() -> checker_ns.Checker:
     """No read may observe a row whose insert aborted (or was never
     acknowledged): reads ∩ (writes - committed-writes) must be empty."""

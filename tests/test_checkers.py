@@ -221,3 +221,32 @@ class TestCompose:
 
         with pytest.raises(ValueError):
             c.merge_valid([True, "nope"])
+
+
+class TestTimeBudget:
+    def test_wide_window_returns_within_budget(self):
+        """A pathological window-80 history (past the device bitset, so
+        the unbounded host search would grind) must come back "unknown"
+        within the checker's time budget instead of hanging the analysis
+        phase (knossos truncation rationale, checker.clj:104-107)."""
+        import time
+
+        from jepsen_tpu.lin import synth
+
+        h = synth.generate_register_history(
+            400, concurrency=80, seed=3, value_range=5)
+        ck = c.linearizable(algorithm="cpu", time_budget=2.0)
+        t0 = time.time()
+        r = ck.check(None, m.cas_register(), h, {})
+        dt = time.time() - t0
+        assert dt < 30, f"budget did not interrupt the search ({dt:.0f}s)"
+        assert r["valid?"] == "unknown"
+        assert "time budget" in r["error"]
+
+    def test_budget_does_not_fire_on_fast_histories(self):
+        from jepsen_tpu.lin import synth
+
+        h = synth.generate_register_history(60, concurrency=3, seed=1)
+        ck = c.linearizable(algorithm="cpu", time_budget=60.0)
+        r = ck.check(None, m.cas_register(), h, {})
+        assert r["valid?"] is True

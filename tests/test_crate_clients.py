@@ -1,0 +1,284 @@
+"""Crate real-client tests against an in-process fake CrateDB `/_sql`
+server (the house pattern for wire clients: every real client gets a
+fake-SERVER test exercising real store semantics — here `_version`
+optimistic CAS and the realtime-point-read vs refreshed-scan split)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import crate
+
+
+class FakeCrate:
+    """Tiny CrateDB: tables of rows with `_version`, dup-key errors,
+    refresh-gated scans. Knobs: drop_cas (silently lose UPDATEs),
+    stale_version (serve stale versions on upsert — divergence)."""
+
+    def __init__(self, drop_cas: bool = False,
+                 stale_version: bool = False):
+        self.tables: dict = {}
+        self.refreshed: dict = {}
+        self.lock = threading.Lock()
+        self.drop_cas = drop_cas
+        self.stale_version = stale_version
+        self._casn = 0
+
+    def execute(self, stmt: str, args):
+        s = " ".join(stmt.split())
+        with self.lock:
+            if s.startswith("CREATE TABLE IF NOT EXISTS"):
+                t = s.split()[5]
+                self.tables.setdefault(t, {})
+                self.refreshed.setdefault(t, {})
+                return {"rows": [], "rowcount": 1}
+            if s.startswith("REFRESH TABLE"):
+                t = s.split()[2]
+                self.refreshed[t] = {k: dict(v) for k, v in
+                                     self.tables.get(t, {}).items()}
+                return {"rows": [], "rowcount": 1}
+            if s.startswith("SELECT"):
+                return self._select(s, args)
+            if s.startswith("INSERT INTO"):
+                return self._insert(s, args)
+            if s.startswith("UPDATE"):
+                return self._update(s, args)
+        raise ValueError(f"unhandled stmt {s!r}")
+
+    def _cols(self, s):
+        return [c.strip().strip('"') for c in
+                s[len("SELECT "):s.index(" FROM")].split(",")]
+
+    def _select(self, s, args):
+        t = s.split(" FROM ")[1].split()[0]
+        rows = self.tables.get(t, {})
+        cols = self._cols(s)
+        if " WHERE id = ?" in s:
+            row = rows.get(args[0])
+            if row is None:
+                return {"rows": [], "rowcount": 0}
+            return {"rows": [[row[c] for c in cols]], "rowcount": 1}
+        # scan: refreshed snapshot only
+        snap = self.refreshed.get(t, {})
+        out = [[r[c] for c in cols] for r in snap.values()]
+        return {"rows": out, "rowcount": len(out)}
+
+    def _insert(self, s, args):
+        t = s.split(" INTO ")[1].split()[0]
+        cols = s[s.index("(") + 1:s.index(")")].replace(" ", "").split(",")
+        rows = self.tables.setdefault(t, {})
+        key = args[cols.index("id")]
+        upsert = "ON DUPLICATE KEY" in s
+        if key in rows and not upsert:
+            raise KeyError("DuplicateKeyException")
+        if key in rows:
+            row = rows[key]
+            if not self.stale_version:
+                row["_version"] += 1
+            for c, v in zip(cols, args):
+                if c != "id":
+                    row[c] = v
+        else:
+            row = {c: v for c, v in zip(cols, args)}
+            row["_version"] = 1
+            rows[key] = row
+        return {"rows": [], "rowcount": 1}
+
+    def _update(self, s, args):
+        t = s.split()[1]
+        rows = self.tables.get(t, {})
+        # UPDATE t SET col = ? WHERE id = ? AND "_version" = ?
+        col = s.split(" SET ")[1].split()[0]
+        val, key, version = args
+        row = rows.get(key)
+        if row is None or row["_version"] != version:
+            return {"rows": [], "rowcount": 0}
+        self._casn += 1
+        if self.drop_cas and self._casn % 4 == 0:
+            # acked but silently lost (version bumps, write vanishes)
+            row["_version"] += 1
+            return {"rows": [], "rowcount": 1}
+        row[col] = val
+        row["_version"] += 1
+        return {"rows": [], "rowcount": 1}
+
+
+@pytest.fixture()
+def fake_crate():
+    made = []
+
+    def start(**knobs):
+        store = FakeCrate(**knobs)
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n))
+                try:
+                    out = store.execute(body["stmt"],
+                                        body.get("args", []))
+                    code = 200
+                except KeyError as e:
+                    out = {"error": {"message": str(e)}}
+                    code = 409
+                except Exception as e:  # noqa: BLE001
+                    out = {"error": {"message": repr(e)}}
+                    code = 400
+                data = json.dumps(out).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *a):
+                pass
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        made.append(srv)
+        node = f"127.0.0.1:{srv.server_port}"
+        return store, node
+
+    yield start
+    for srv in made:
+        srv.shutdown()
+
+
+def _patch_port(monkeypatch, node):
+    # sql() builds http://{node}:{PORT}; the fake node carries its own
+    # port, so neutralize the suite PORT suffix via a passthrough node.
+    host, port = node.rsplit(":", 1)
+    monkeypatch.setattr(crate, "PORT", int(port))
+    return host
+
+
+class TestLostUpdatesClient:
+    def test_round_trip_and_cas(self, fake_crate, monkeypatch):
+        store, node = fake_crate()
+        host = _patch_port(monkeypatch, node)
+        c = crate.CrateLostUpdatesClient(host)
+        c.setup({"nodes": [host]})
+        for v in range(6):
+            r = c.invoke({}, Op("invoke", "update", v, 0))
+            assert r.type == "ok", r
+        r = c.invoke({}, Op("invoke", "read", None, 0))
+        assert r.type == "ok" and r.value == list(range(6))
+
+    def test_version_conflict_is_fail(self, fake_crate, monkeypatch):
+        store, node = fake_crate()
+        host = _patch_port(monkeypatch, node)
+        c = crate.CrateLostUpdatesClient(host)
+        c.setup({"nodes": [host]})
+        assert c.invoke({}, Op("invoke", "update", 0, 0)).type == "ok"
+        # bump the version behind the client's back mid-read: simulate by
+        # racing another writer between SELECT and UPDATE
+        orig = store._update
+
+        def racing(s, args):
+            row = store.tables["jepsen_sets"][0]
+            row["_version"] += 1  # concurrent writer won
+            store._update = orig
+            return orig(s, args)
+
+        store._update = racing
+        r = c.invoke({}, Op("invoke", "update", 1, 0))
+        assert r.type == "fail"
+
+    def test_lost_updates_detected_through_real_client(
+            self, fake_crate, monkeypatch):
+        store, node = fake_crate(drop_cas=True)
+        host = _patch_port(monkeypatch, node)
+        c = crate.CrateLostUpdatesClient(host)
+        c.setup({"nodes": [host]})
+        h = []
+        for v in range(12):
+            r = c.invoke({}, Op("invoke", "update", v, 0))
+            if r.type == "ok":
+                h.append(Op("ok", "update", v, 0))
+        h.append(c.invoke({}, Op("invoke", "read", None, 0)))
+        res = crate.lost_updates_checker().check({}, None, h, {})
+        assert res["valid?"] is False and res["lost-count"] > 0
+
+
+class TestVersionDivergenceClient:
+    def test_round_trip(self, fake_crate, monkeypatch):
+        store, node = fake_crate()
+        host = _patch_port(monkeypatch, node)
+        c = crate.CrateVersionDivergenceClient(host)
+        c.setup({"nodes": [host]})
+        h = []
+        for v in range(5):
+            r = c.invoke({}, Op("invoke", "write", v, 0))
+            assert r.type == "ok"
+            h.append(c.invoke({}, Op("invoke", "read", None, 0)))
+        assert h[-1].value == [4, 5]  # value 4, fifth version
+        res = crate.multiversion_checker().check({}, None, h, {})
+        assert res["valid?"] is True
+
+    def test_divergence_detected(self, fake_crate, monkeypatch):
+        store, node = fake_crate(stale_version=True)
+        host = _patch_port(monkeypatch, node)
+        c = crate.CrateVersionDivergenceClient(host)
+        c.setup({"nodes": [host]})
+        h = []
+        for v in range(4):
+            c.invoke({}, Op("invoke", "write", v, 0))
+            h.append(c.invoke({}, Op("invoke", "read", None, 0)))
+        res = crate.multiversion_checker().check({}, None, h, {})
+        assert res["valid?"] is False and res["multis"]
+
+
+class TestDirtyReadClient:
+    def test_visibility_split(self, fake_crate, monkeypatch):
+        store, node = fake_crate()
+        host = _patch_port(monkeypatch, node)
+        c = crate.CrateDirtyReadClient(host)
+        c.setup({"nodes": [host]})
+        assert c.invoke({}, Op("invoke", "write", 1, 0)).type == "ok"
+        # point read realtime, scan empty until refresh
+        assert c.invoke({}, Op("invoke", "read", 1, 0)).type == "ok"
+        r = c.invoke({}, Op("invoke", "strong-read", None, 0))
+        assert r.type == "ok" and r.value == []
+        assert c.invoke({}, Op("invoke", "refresh", None, 0)).type == "ok"
+        r = c.invoke({}, Op("invoke", "strong-read", None, 0))
+        assert r.value == [1]
+
+    def test_checker_classifies_dirty_and_lost(self):
+        h = [Op("ok", "write", 1, 0), Op("ok", "write", 2, 0),
+             Op("ok", "read", 3, 1),          # dirty: never durable
+             Op("ok", "strong-read", [1], 2)]  # write 2 lost
+        res = crate.crate_dirty_read_checker().check({}, None, h, {})
+        assert res["valid?"] is False
+        assert res["dirty"] == [3] and res["lost"] == [2]
+
+    def test_checker_nodes_disagree(self):
+        h = [Op("ok", "write", 1, 0),
+             Op("ok", "strong-read", [1], 1),
+             Op("ok", "strong-read", [], 2)]
+        res = crate.crate_dirty_read_checker().check({}, None, h, {})
+        assert res["valid?"] is False and res["nodes-agree?"] is False
+
+    def test_checker_valid(self):
+        h = [Op("ok", "write", 1, 0), Op("ok", "read", 1, 1),
+             Op("ok", "strong-read", [1], 2),
+             Op("ok", "strong-read", [1], 3)]
+        res = crate.crate_dirty_read_checker().check({}, None, h, {})
+        assert res["valid?"] is True
+
+
+class TestWorkloadRegistry:
+    def test_four_cells_all_real_clients(self):
+        for wl, cls in (("set", crate.CrateSetClient),
+                        ("dirty-read", crate.CrateDirtyReadClient),
+                        ("lost-updates", crate.CrateLostUpdatesClient),
+                        ("version-divergence",
+                         crate.CrateVersionDivergenceClient)):
+            t = crate.test({"fake": False, "workload": wl})
+            assert isinstance(t["client"], cls), wl
+            t2 = crate.test({"fake": True, "workload": wl,
+                             "time-limit": 1})
+            assert t2["transport"] == "dummy"

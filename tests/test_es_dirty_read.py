@@ -1,0 +1,153 @@
+"""Elasticsearch dirty-read workload tests: the classification checker
+on hand-built histories, the real HTTP client against an in-process
+fake ES server, and fault detection through the fake-mode workload."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import elasticsearch as es
+
+
+class TestChecker:
+    def test_valid(self):
+        h = [Op("ok", "write", 1, 0), Op("ok", "read", 1, 1),
+             Op("ok", "strong-read", [1], 2),
+             Op("ok", "strong-read", [1], 3)]
+        r = es.dirty_read_checker().check({}, None, h, {})
+        assert r["valid?"] is True and r["nodes-agree?"] is True
+
+    def test_dirty_read_classified(self):
+        h = [Op("ok", "write", 1, 0),
+             Op("ok", "read", 7, 1),           # observed, never durable
+             Op("ok", "strong-read", [1], 2)]
+        r = es.dirty_read_checker().check({}, None, h, {})
+        assert r["valid?"] is False
+        assert r["dirty"] == [7] and r["dirty-count"] == 1
+
+    def test_lost_write_classified(self):
+        h = [Op("ok", "write", 1, 0), Op("ok", "write", 2, 0),
+             Op("ok", "strong-read", [1], 2),
+             Op("ok", "strong-read", [1], 3)]
+        r = es.dirty_read_checker().check({}, None, h, {})
+        assert r["valid?"] is False
+        assert r["lost"] == [2] and r["some-lost"] == [2]
+
+    def test_stale_node_classified(self):
+        """A node whose strong read misses an element others have:
+        nodes disagree; the element is some-lost but not lost."""
+        h = [Op("ok", "write", 1, 0), Op("ok", "write", 2, 0),
+             Op("ok", "strong-read", [1, 2], 2),
+             Op("ok", "strong-read", [1], 3)]
+        r = es.dirty_read_checker().check({}, None, h, {})
+        assert r["valid?"] is False and r["nodes-agree?"] is False
+        assert r["not-on-all"] == [2]
+        assert r["lost-count"] == 0 and r["some-lost"] == [2]
+
+    def test_no_strong_reads_unknown(self):
+        r = es.dirty_read_checker().check(
+            {}, None, [Op("ok", "write", 1, 0)], {})
+        assert r["valid?"] == "unknown"
+
+
+@pytest.fixture()
+def fake_es():
+    docs: dict = {}
+    refreshed: dict = {}
+    lock = threading.Lock()
+
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code, obj):
+            data = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_PUT(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n))
+            doc_id = self.path.split("?")[0].rsplit("/", 1)[1]
+            with lock:
+                docs[doc_id] = body
+            self._send(201, {"result": "created"})
+
+        def do_GET(self):
+            doc_id = self.path.rsplit("/", 1)[1]
+            with lock:
+                found = doc_id in docs
+            self._send(200 if found else 404,
+                       {"found": found,
+                        "_source": docs.get(doc_id, {})})
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            with lock:
+                if self.path.endswith("/_refresh"):
+                    refreshed.clear()
+                    refreshed.update(docs)
+                    self._send(200, {"ok": True})
+                    return
+                hits = [{"_source": s} for s in refreshed.values()]
+            self._send(200, {"hits": {"hits": hits}})
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv.server_port
+    srv.shutdown()
+
+
+class TestEsDirtyReadClient:
+    def test_visibility_split(self, fake_es, monkeypatch):
+        monkeypatch.setattr(es, "PORT", fake_es)
+        c = es.EsDirtyReadClient("127.0.0.1")
+        assert c.invoke({}, Op("invoke", "write", 3, 0)).type == "ok"
+        # realtime GET sees it; search doesn't until refresh
+        assert c.invoke({}, Op("invoke", "read", 3, 0)).type == "ok"
+        assert c.invoke({}, Op("invoke", "read", 9, 0)).type == "fail"
+        r = c.invoke({}, Op("invoke", "strong-read", None, 0))
+        assert r.type == "ok" and r.value == []
+        assert c.invoke({}, Op("invoke", "refresh", None, 0)).type == "ok"
+        r = c.invoke({}, Op("invoke", "strong-read", None, 0))
+        assert r.value == [3]
+
+
+class TestWorkload:
+    def _run(self, faulty):
+        from jepsen_tpu import core
+        from jepsen_tpu.suites import common
+
+        wl = es.dirty_read_workload(n=120, faulty=faulty)
+        t = common.suite_test(
+            "es-dirty-read", {"time-limit": 10, "concurrency": 5,
+                              "fake": True},
+            workload=wl)
+        t["name"] = None
+        res = core.run(t).get("results", {})
+        return res.get("workload", res)
+
+    def test_clean_run_valid(self):
+        assert self._run(None)["valid?"] is True
+
+    def test_dirty_read_detected(self):
+        r = self._run("dirty-read")
+        assert r["valid?"] is False and r["dirty-count"] > 0
+
+    def test_lost_write_detected(self):
+        r = self._run("lost")
+        assert r["valid?"] is False and r["lost-count"] > 0
+
+    def test_registry_cell(self):
+        t = es.test({"fake": False, "workload": "dirty-read"})
+        assert isinstance(t["client"], es.EsDirtyReadClient)
+        t2 = es.test({"fake": True, "workload": "dirty-read",
+                      "time-limit": 1})
+        assert t2["transport"] == "dummy"

@@ -151,3 +151,68 @@ class TestNodeStartStopper:
         r = nem.invoke(test, Op("info", "stop", None))
         assert r.value == {"n1": "stopped"}
         assert events == [("start", "n1"), ("stop", "n1")]
+
+
+class TestCockroachWrappers:
+    """cockroach/nemesis.clj:153-200 slowing/restarting wrappers."""
+
+    def _fixtures(self):
+        from jepsen_tpu.suites import cockroachdb as cr
+
+        calls = []
+
+        class FakeNet(net.Net):
+            def slow(self, test, mean_ms=50, sigma_ms=10):
+                calls.append(("slow", mean_ms))
+
+            def fast(self, test):
+                calls.append(("fast",))
+
+        class Inner(n.Nemesis):
+            def invoke(self, test, op):
+                return op.replace(type="info", value="inner")
+
+        class FakeDB:
+            def start(self, test, node):
+                calls.append(("restart", node))
+
+        test = ts.noop_test(transport=c.DummyTransport())
+        test["net"] = FakeNet()
+        return cr, calls, Inner, FakeDB, test
+
+    def test_slowing_wraps_start_stop(self):
+        cr, calls, Inner, FakeDB, test = self._fixtures()
+        nem = cr.Slowing(Inner(), 0.5).setup(test)
+        assert calls == [("fast",)]          # setup restores speed first
+        r = nem.invoke(test, Op("info", "start", None))
+        assert r.value == "inner"
+        assert ("slow", 500.0) in calls
+        r = nem.invoke(test, Op("info", "stop", None))
+        assert calls[-1] == ("fast",)
+        nem.teardown(test)
+        assert calls[-1] == ("fast",)
+
+    def test_restarting_restarts_on_stop(self):
+        cr, calls, Inner, FakeDB, test = self._fixtures()
+        nem = cr.Restarting(Inner(), db=FakeDB()).setup(test)
+        r = nem.invoke(test, Op("info", "start", None))
+        assert r.value == "inner"            # start passes through
+        r = nem.invoke(test, Op("info", "stop", None))
+        inner_val, stat = r.value
+        assert inner_val == "inner"
+        assert set(stat) == set(test["nodes"])
+        assert all(v == "started" for v in stat.values())
+        assert {c2[1] for c2 in calls if c2[0] == "restart"} \
+            == set(test["nodes"])
+
+    def test_registry_wires_wrappers(self):
+        from jepsen_tpu.suites import cockroachdb as cr
+
+        reg = cr.nemeses()
+        assert isinstance(reg["big-skews"]["nemesis"], cr.Slowing)
+        assert isinstance(reg["big-skews"]["nemesis"].nem, cr.Restarting)
+        assert isinstance(reg["huge-skews"]["nemesis"], cr.Slowing)
+        assert isinstance(reg["small-skews"]["nemesis"], cr.Restarting)
+        assert isinstance(reg["strobe-skews"]["nemesis"], cr.Restarting)
+        combined = cr.combine_nemeses(reg["big-skews"], reg["parts"])
+        assert combined["clocks"] is True
