@@ -276,5 +276,12 @@ def _demo_test_fn(options: dict) -> dict:
     }
 
 
+def main_default(argv=None) -> None:
+    """The bare `jepsen-tpu` console script (pyproject entry point):
+    demo test + serve + analyze, like `python -m jepsen_tpu.cli`."""
+    main([single_test_cmd(_demo_test_fn), serve_cmd(), analyze_cmd()],
+         argv)
+
+
 if __name__ == "__main__":
-    main([single_test_cmd(_demo_test_fn), serve_cmd(), analyze_cmd()])
+    main_default()

@@ -356,6 +356,342 @@ class MultiBankClient(client_ns.Client):
             self.conn.close()
 
 
+class MonotonicClient(client_ns.Client):
+    """Real monotonic client (monotonic.clj:60-140): each insert runs
+    one txn that reads max(val) over the tables, reads the cluster's
+    logical timestamp, and inserts (max+1, sts, node, process, tb);
+    the op completes with (val, sts) so the checker can compare
+    insertion order against timestamp order."""
+
+    PREFIX = "jepsen_mono"
+
+    def __init__(self, conn: PgClient | None = None, tables: int = 1,
+                 node_num: int = 0):
+        self.conn = conn
+        self.tables = tables
+        self.node_num = node_num
+
+    def _table(self, i) -> str:
+        return f"{self.PREFIX}{int(i)}"
+
+    def open(self, test, node):
+        return MonotonicClient(
+            PgClient(node, port=PORT, user="root", database="jepsen"),
+            self.tables, list(test["nodes"]).index(node)
+            if node in test.get("nodes", []) else 0)
+
+    def setup(self, test) -> None:
+        conn = PgClient(test["nodes"][0], port=PORT, user="root",
+                        database="system")
+        try:
+            conn.query("CREATE DATABASE IF NOT EXISTS jepsen")
+            for i in range(self.tables):
+                conn.query(
+                    f"CREATE TABLE IF NOT EXISTS jepsen.{self._table(i)} "
+                    f"(val INT PRIMARY KEY, sts DECIMAL, node INT, "
+                    f"process INT, tb INT)")
+        finally:
+            conn.close()
+
+    def invoke(self, test, op: Op) -> Op:
+        from decimal import Decimal
+
+        try:
+            if op.f == "insert":
+                for attempt in range(5):
+                    try:
+                        self.conn.query("BEGIN")
+                        try:
+                            cur_max = 0
+                            for i in range(self.tables):
+                                rows = self.conn.query(
+                                    f"SELECT max(val) FROM "
+                                    f"{self._table(i)}")
+                                if rows and rows[0][0] is not None:
+                                    cur_max = max(cur_max,
+                                                  int(rows[0][0]))
+                            ts_rows = self.conn.query(
+                                "SELECT cluster_logical_timestamp()")
+                            sts = int(Decimal(ts_rows[0][0]) * 10 ** 10)
+                            t = self._table(random.randrange(self.tables))
+                            self.conn.query(
+                                f"INSERT INTO {t} (val, sts, node, "
+                                f"process, tb) VALUES ({cur_max + 1}, "
+                                f"{sts}, {self.node_num}, "
+                                f"{int(op.process or 0)}, 0)")
+                            self.conn.query("COMMIT")
+                        except PgError:
+                            try:
+                                self.conn.query("ROLLBACK")
+                            except (PgError, OSError):
+                                pass
+                            raise
+                        return op.replace(type="ok",
+                                          value=(cur_max + 1, sts))
+                    except PgError as e:
+                        if e.ambiguous:
+                            return op.replace(type="info", error=str(e))
+                        if not (e.retryable and attempt < 4):
+                            return op.replace(type="fail", error=str(e))
+        except (OSError, ConnectionError) as e:
+            return op.replace(type="info", error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()
+
+
+class CrdbSetsClient(client_ns.Client):
+    """Real sets client (sets.clj:60-127): add = INSERT into one table,
+    final read = full SELECT."""
+
+    TABLE = "jepsen_set"
+
+    def __init__(self, conn: PgClient | None = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return CrdbSetsClient(PgClient(node, port=PORT, user="root",
+                                       database="jepsen"))
+
+    def setup(self, test) -> None:
+        conn = PgClient(test["nodes"][0], port=PORT, user="root",
+                        database="system")
+        try:
+            conn.query("CREATE DATABASE IF NOT EXISTS jepsen")
+            conn.query(f"CREATE TABLE IF NOT EXISTS jepsen.{self.TABLE} "
+                       f"(val INT PRIMARY KEY)")
+        finally:
+            conn.close()
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add":
+                self.conn.txn([f"INSERT INTO {self.TABLE} (val) "
+                               f"VALUES ({int(op.value)})"])
+                return op.replace(type="ok")
+            if op.f == "read":
+                rows = self.conn.query(
+                    f"SELECT val FROM {self.TABLE}")
+                return op.replace(type="ok",
+                                  value=sorted(int(r[0]) for r in rows))
+        except PgError as e:
+            if op.f == "read":
+                return op.replace(type="fail", error=str(e))
+            return op.replace(
+                type="info" if e.ambiguous else "fail", error=str(e))
+        except (OSError, ConnectionError) as e:
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()
+
+
+class SequentialClient(client_ns.Client):
+    """Real sequential client (sequential.clj:51-105, adapted to the
+    workload's single global key sequence): write = one txn reading
+    max(key) and inserting max+1 (serializability keeps the sequence
+    gap-free; anomalies surface as non-prefix reads), read = ordered
+    SELECT of all keys."""
+
+    TABLE = "jepsen_seq"
+
+    def __init__(self, conn: PgClient | None = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return SequentialClient(PgClient(node, port=PORT, user="root",
+                                         database="jepsen"))
+
+    def setup(self, test) -> None:
+        conn = PgClient(test["nodes"][0], port=PORT, user="root",
+                        database="system")
+        try:
+            conn.query("CREATE DATABASE IF NOT EXISTS jepsen")
+            conn.query(f"CREATE TABLE IF NOT EXISTS jepsen.{self.TABLE} "
+                       f"(key INT PRIMARY KEY)")
+        finally:
+            conn.close()
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "write":
+                for attempt in range(5):
+                    try:
+                        self.conn.query("BEGIN")
+                        try:
+                            rows = self.conn.query(
+                                f"SELECT max(key) FROM {self.TABLE}")
+                            nxt = (int(rows[0][0]) + 1
+                                   if rows and rows[0][0] is not None
+                                   else 0)
+                            self.conn.query(
+                                f"INSERT INTO {self.TABLE} (key) "
+                                f"VALUES ({nxt})")
+                            self.conn.query("COMMIT")
+                        except PgError:
+                            try:
+                                self.conn.query("ROLLBACK")
+                            except (PgError, OSError):
+                                pass
+                            raise
+                        return op.replace(type="ok", value=nxt)
+                    except PgError as e:
+                        if e.ambiguous:
+                            return op.replace(type="info", error=str(e))
+                        if not (e.retryable and attempt < 4):
+                            return op.replace(type="fail", error=str(e))
+            if op.f == "read":
+                rows = self.conn.query(
+                    f"SELECT key FROM {self.TABLE} ORDER BY key")
+                return op.replace(type="ok",
+                                  value=[int(r[0]) for r in rows])
+        except (OSError, ConnectionError) as e:
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()
+
+
+class CommentsClient(client_ns.Client):
+    """Real comments client (comments.clj:42-86): inserts shard over
+    ``tables`` by id hash; reads run one txn selecting every table, so
+    an insert acked before the read began must be visible."""
+
+    PREFIX = "jepsen_comments"
+
+    def __init__(self, conn: PgClient | None = None, tables: int = 2):
+        self.conn = conn
+        self.tables = tables
+
+    def _table(self, i) -> str:
+        return f"{self.PREFIX}{int(i)}"
+
+    def open(self, test, node):
+        return CommentsClient(PgClient(node, port=PORT, user="root",
+                                       database="jepsen"), self.tables)
+
+    def setup(self, test) -> None:
+        conn = PgClient(test["nodes"][0], port=PORT, user="root",
+                        database="system")
+        try:
+            conn.query("CREATE DATABASE IF NOT EXISTS jepsen")
+            for i in range(self.tables):
+                conn.query(
+                    f"CREATE TABLE IF NOT EXISTS jepsen.{self._table(i)} "
+                    f"(id INT PRIMARY KEY)")
+        finally:
+            conn.close()
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "insert":
+                v = int(op.value)
+                t = self._table(v % self.tables)
+                self.conn.query(f"INSERT INTO {t} (id) VALUES ({v})")
+                return op.replace(type="ok")
+            if op.f == "read":
+                stmts = [f"SELECT id FROM {self._table(i)}"
+                         for i in range(self.tables)]
+                per_table = self.conn.txn(stmts)
+                vals = sorted(int(r[0]) for rows in per_table
+                              for r in rows)
+                return op.replace(type="ok", value=vals)
+        except PgError as e:
+            if op.f == "read":
+                return op.replace(type="fail", error=str(e))
+            return op.replace(
+                type="info" if e.ambiguous else "fail", error=str(e))
+        except (OSError, ConnectionError) as e:
+            return op.replace(type="fail" if op.f == "read" else "info",
+                              error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()
+
+
+class G2Client(client_ns.Client):
+    """Real G2 anti-dependency client (adya.clj:24-83): each insert
+    transaction checks BOTH tables for a committed row of its key
+    (value % 3 = 0 predicate reads) and inserts into its own side only
+    when none exists — under serializability at most one of the paired
+    inserts may commit."""
+
+    def __init__(self, conn: PgClient | None = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return G2Client(PgClient(node, port=PORT, user="root",
+                                 database="jepsen"))
+
+    def setup(self, test) -> None:
+        conn = PgClient(test["nodes"][0], port=PORT, user="root",
+                        database="system")
+        try:
+            conn.query("CREATE DATABASE IF NOT EXISTS jepsen")
+            for t in ("jepsen_g2_a", "jepsen_g2_b"):
+                conn.query(f"CREATE TABLE IF NOT EXISTS jepsen.{t} "
+                           f"(id INT PRIMARY KEY, key INT, value INT)")
+        finally:
+            conn.close()
+
+    def invoke(self, test, op: Op) -> Op:
+        from jepsen_tpu import independent
+
+        v = op.value
+        k, payload = (v[0], v[1]) if independent.is_tuple(v) \
+            else (0, v)
+        side = int(payload["id"])
+        try:
+            if op.f == "insert":
+                try:
+                    self.conn.query("BEGIN")
+                    try:
+                        hits = []
+                        for t in ("jepsen_g2_a", "jepsen_g2_b"):
+                            hits += self.conn.query(
+                                f"SELECT id FROM {t} WHERE key = "
+                                f"{int(k)} AND value % 3 = 0")
+                        if hits:
+                            self.conn.query("ROLLBACK")
+                            return op.replace(type="fail",
+                                              error="too-late")
+                        t = "jepsen_g2_a" if side == 0 else "jepsen_g2_b"
+                        self.conn.query(
+                            f"INSERT INTO {t} (id, key, value) VALUES "
+                            f"({int(k)}, {int(k)}, 30)")
+                        self.conn.query("COMMIT")
+                    except PgError:
+                        try:
+                            self.conn.query("ROLLBACK")
+                        except (PgError, OSError):
+                            pass
+                        raise
+                    return op.replace(type="ok")
+                except PgError as e:
+                    if e.ambiguous:
+                        return op.replace(type="info", error=str(e))
+                    # serialization aborts mean NOT applied — exactly
+                    # the G2-prevention the workload hopes to see.
+                    return op.replace(type="fail", error=str(e))
+        except (OSError, ConnectionError) as e:
+            return op.replace(type="info", error=repr(e))
+        return op.replace(type="fail", error=f"unknown f {op.f}")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()
+
+
 # --- nemesis registry (cockroach/nemesis.clj) -------------------------------
 
 
@@ -616,9 +952,18 @@ def test(opts: dict | None = None) -> dict:
     nem = reg[n1] if n2 is None else combine_nemeses(reg[n1], reg[n2])
     if wname == "register" and opts.get("concurrency", 0) < 5:
         opts["concurrency"] = 5
-    client = {"register": RegisterClient,
-              "bank": BankClient,
-              "bank-multitable": MultiBankClient}.get(wname)
+    client_factories = {
+        "register": RegisterClient,
+        "bank": BankClient,
+        "bank-multitable": MultiBankClient,
+        "monotonic": MonotonicClient,
+        "monotonic-multitable": lambda: MonotonicClient(tables=2),
+        "sets": CrdbSetsClient,
+        "sequential": SequentialClient,
+        "comments": CommentsClient,
+        "g2": G2Client,
+    }
+    client = client_factories.get(wname)
     os_name = opts.pop("os", "ubuntu")
     if os_name == "ubuntu":
         from jepsen_tpu import os_ubuntu

@@ -84,8 +84,17 @@ class EsSetClient(client_ns.Client):
                      "query": {"match_all": {}}}, timeout=30)
                 if status != 200:
                     return op.replace(type="fail", error=body)
-                vals = sorted(h["_source"]["value"]
-                              for h in body["hits"]["hits"])
+                hits = body["hits"]["hits"]
+                total = body["hits"].get("total", len(hits))
+                if isinstance(total, dict):   # ES 7+ shape
+                    total = total.get("value", len(hits))
+                if total > len(hits):
+                    # Truncated read: acking it would misclassify the
+                    # missing acknowledged writes as lost.
+                    return op.replace(type="fail",
+                                      error=f"truncated: {len(hits)}"
+                                            f"/{total}")
+                vals = sorted(h["_source"]["value"] for h in hits)
                 return op.replace(type="ok", value=vals)
         except OSError as e:
             t = "fail" if op.f == "read" else "info"
@@ -138,8 +147,17 @@ class EsDirtyReadClient(client_ns.Client):
                      "query": {"match_all": {}}}, timeout=30)
                 if status != 200:
                     return op.replace(type="fail", error=body)
-                vals = sorted(h["_source"]["value"]
-                              for h in body["hits"]["hits"])
+                hits = body["hits"]["hits"]
+                total = body["hits"].get("total", len(hits))
+                if isinstance(total, dict):   # ES 7+ shape
+                    total = total.get("value", len(hits))
+                if total > len(hits):
+                    # Truncated read: acking it would misclassify the
+                    # missing acknowledged writes as lost.
+                    return op.replace(type="fail",
+                                      error=f"truncated: {len(hits)}"
+                                            f"/{total}")
+                vals = sorted(h["_source"]["value"] for h in hits)
                 return op.replace(type="ok", value=vals)
         except OSError as e:
             t = "fail" if op.f in ("read", "strong-read") else "info"

@@ -49,30 +49,44 @@ def _opts(**kw) -> dict:
     return base
 
 
-# The matrix: (suite module, extra opts) — etcd and zookeeper registers
-# are the canonical cells (etcd.clj is the reference's template suite;
-# zookeeper.clj its tutorial target), each with the partition nemesis
-# live and with it replaced by the noop (the generator still schedules
-# start/stop ops; with test["nemesis"]=None they no-op in the runner).
+# The matrix: (cell id, suite module, suite opts, nemesis-off) — the
+# analogue of cockroach_test.clj:17-52's workload x nemesis grid. etcd
+# and zookeeper registers are the canonical cells (etcd.clj is the
+# reference's template suite; zookeeper.clj its tutorial target);
+# cockroach register+bank, hazelcast lock, rabbitmq queue, and galera
+# (mysql-family) bank cover the registry breadth. Each workload runs
+# with its suite's nemesis live and replaced by the noop (the generator
+# still schedules start/stop ops; with test["nemesis"]=None they no-op
+# in the runner).
 MATRIX = [
-    ("etcd", {}),
-    ("etcd", {"nemesis-off": True}),
-    ("zookeeper", {}),
-    ("zookeeper", {"nemesis-off": True}),
+    ("etcd", "etcd", {}, False),
+    ("etcd-calm", "etcd", {}, True),
+    ("zookeeper", "zookeeper", {}, False),
+    ("zookeeper-calm", "zookeeper", {}, True),
+    ("cockroach-register", "cockroachdb", {"workload": "register"}, False),
+    ("cockroach-register-calm", "cockroachdb",
+     {"workload": "register"}, True),
+    ("cockroach-bank", "cockroachdb", {"workload": "bank"}, False),
+    ("cockroach-bank-calm", "cockroachdb", {"workload": "bank"}, True),
+    ("hazelcast-lock", "hazelcast", {"workload": "lock"}, False),
+    ("hazelcast-lock-calm", "hazelcast", {"workload": "lock"}, True),
+    ("rabbitmq-queue", "rabbitmq", {}, False),
+    ("rabbitmq-queue-calm", "rabbitmq", {}, True),
+    ("galera-bank", "galera", {}, False),
+    ("galera-bank-calm", "galera", {}, True),
 ]
 
 
-@pytest.mark.parametrize("suite_name,extra", MATRIX,
-                         ids=[f"{s}{'-calm' if e else ''}"
-                              for s, e in MATRIX])
-def test_register_matrix(suite_name, extra):
+@pytest.mark.parametrize("cell,suite_name,extra,calm", MATRIX,
+                         ids=[m[0] for m in MATRIX])
+def test_matrix(cell, suite_name, extra, calm):
     import importlib
 
     suite = importlib.import_module(f"jepsen_tpu.suites.{suite_name}")
-    opts = _opts()
-    if extra.get("nemesis-off"):
-        opts["nemesis"] = None
+    opts = _opts(**extra)
     t = suite.test(opts)
+    if calm:
+        t["nemesis"] = None
     result = _run(t)
     analysis = result.get("results") or {}
     assert analysis.get("valid?") is not False, analysis
