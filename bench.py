@@ -59,16 +59,22 @@ def _check_timed(history, n_ops):
         raise RuntimeError(f"unexpected verdict {r}")
 
     # Best of three: the shared-chip tunnel occasionally stalls a run.
-    check_s = float("inf")
+    # ALL three run times are recorded (check_seconds_runs) so tunnel
+    # variance is visible in the artifact — BENCH_r03's 87.7k "regression"
+    # against r02's 120k was chip contention, not code (re-measured
+    # 0.824 s on the same commit).
+    runs = []
     for _ in range(3):
         t0 = time.time()
         r = device_check_packed(p, **kw)
-        check_s = min(check_s, time.time() - t0)
+        runs.append(round(time.time() - t0, 3))
         if r["valid?"] is not True:
             raise RuntimeError(f"unexpected verdict {r}")
+    check_s = min(runs)
 
     return n_ops / check_s, {
         "n_ops": n_ops, "check_seconds": round(check_s, 3),
+        "check_seconds_runs": runs,
         "prepare_seconds": round(prep_s, 2),
         # Honest end-to-end rate: host packing + device check. The
         # device-only number is the headline (prepare is amortizable:
@@ -119,12 +125,18 @@ def _wide_probes(detail: dict) -> None:
            lambda: synth.generate_register_history(
                500, concurrency=30, seed=7, value_range=5,
                crash_prob=0.002, max_crashes=4), 500)
+    if "error" not in detail.get("wide_window_c30", {}):
+        detail["wide_window_c30"]["note"] = (
+            "adversarial ceiling: fully saturated window-26 schedule, "
+            "denser than the config-5 pacing partitioned_c30 measures")
     # The literal config-5 shape at the reference's staggered pacing
     # (etcd.clj:167-179 staggers invocations; invoke_bias=0.45 models
-    # that): 30 processes, partition crashes, ~6-13 ops in flight.
+    # that): 30 processes, partition crashes, ~6-13 live ops in flight,
+    # 24 crashed mutators accumulating over ~50 partition cycles
+    # (window 49) — at the LITERAL 100k-op size of BASELINE config 5.
     _probe(detail, "partitioned_c30",
            lambda: synth.generate_partitioned_register_history(
-               5000, seed=7, invoke_bias=0.45), 5000)
+               100_000, seed=7, invoke_bias=0.45), 100_000)
     # BASELINE config 3: lock (Mutex) histories at the same concurrency
     # (hazelcast.clj:379-386 / zookeeper locks). Contention serializes
     # the window, so the dense engine absorbs these.

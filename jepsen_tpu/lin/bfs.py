@@ -67,14 +67,26 @@ DEFAULT_CAP_SCHEDULE = (256, 2048, 16384, 131072)
 # The compact packed-key register band adapts INSIDE the program (see
 # ROW_TIERS: per-row count-tiered prefixes), so its chunk-level ladder
 # only needs a small level (cheap compile, covers most histories and
-# the CPU test mesh) and the runtime-safe top. The COMPACT program
-# shape (M expansion columns, psort dedup, tier branches) holds up at
-# 262144 x 512 rows on the axon runtime — measured, unlike the round-2
-# full-window shape that faulted past 131072 — so transient mid-closure
-# spikes to ~250k configs never leave the chunked engine.
-PACKED_CAP_SCHEDULE = (16384, 262144)
+# the CPU test mesh) and a top level chosen so that even the TOP
+# tier's grouped dedups stay inside the windowed dominance bound
+# (131072 * (1 + Mg=1) = 2^18 = psort.DOM_WINDOW_MAX_N): partition
+# histories' crashed-subset waves (BASELINE config 5) are only held
+# down by the windowed prune, so every capacity must carry it. Spikes
+# past 131072 go to the (grouped, unwindowed) spike executor.
+PACKED_CAP_SCHEDULE = (16384, 131072)
 SPIKE_CAP_SCHEDULE = (262144, 524288, 1048576)
 SPIKE_CHUNK = 32
+# Chunks dispatched between host flag syncs on the optimistic fast
+# path: each device->host flag fetch pays the ~100 ms tunnel round
+# trip, so checking every chunk costs more than the 512 rows of
+# compute it gates. Flags are fetched for SYNC_CHUNKS chunks in one
+# transfer; a tripped flag rewinds to the batch entry and replays
+# chunk-by-chunk (escalation/spike/dead handling live there).
+# 2, not more: queueing 8 unsynced chunk programs on the axon worker
+# kernel-faulted it on the 100k partitioned history (the same chunks
+# run clean when synced individually — the runtime objects to the
+# dispatch queue depth, not the programs).
+SYNC_CHUNKS = 2
 # Frontier size at which spike mode hands back to full-size chunks (at
 # a mini-chunk boundary with count at most this).
 SPIKE_DROPBACK = 32768
@@ -194,6 +206,16 @@ def _dedup_keys_dom(key, valid, cap, cmask, rmask,
     start = first | (a_s != jnp.roll(a_s, 1))
     f = _seg_first(w_s, start)
     dominated = ((f & ~w_s) == 0) & (w_s != f)
+    # Windowed pairwise (psort.DOM_WINDOW): a subset sorts earlier, so
+    # predecessors at small offsets catch the chain parents the group
+    # representative misses.
+    idx = jnp.arange(n)
+    for dd in psort.dom_window(n):
+        a_d = jnp.roll(a_s, dd)
+        w_d = jnp.roll(w_s, dd)
+        dominated = dominated | (
+            (idx >= dd) & (a_d == a_s) & ((w_d & ~w_s) == 0)
+            & (w_d != w_s))
     keep = (a_s >> 31 == 0) & ~dup & ~dominated
     total = jnp.sum(keep.astype(jnp.int32))
     overflow = total > cap
@@ -203,11 +225,12 @@ def _dedup_keys_dom(key, valid, cap, cmask, rmask,
 
 
 def _dedup_keys2_dom(hi, lo, valid, cap, cmask_hi, cmask_lo,
-                     rmask_hi, rmask_lo):
-    """Pair-key twin of _dedup_keys_dom (see there): 6-operand sort by
-    (group, crashed, ~reads) parts, group-representative dominance
-    prune, full-key-ascending compaction. Returns (hi[cap], lo[cap],
-    count, overflow)."""
+                     rmask_hi, rmask_lo, use_psort: bool = False):
+    """Pair-key twin of _dedup_keys_dom (see there): 4-operand sort by
+    (group, dominance-word) pairs, group-representative dominance
+    prune, full-key-ascending compaction. Routes to the in-VMEM pallas
+    quad kernel when sized for it. Returns (hi[cap], lo[cap], count,
+    overflow)."""
     n = hi.shape[0]
     g_hi = ~(cmask_hi | rmask_hi)
     g_lo = ~(cmask_lo | rmask_lo)
@@ -215,6 +238,9 @@ def _dedup_keys2_dom(hi, lo, valid, cap, cmask_hi, cmask_lo,
     a_lo = lo & g_lo
     w_hi = (hi & cmask_hi) | ((~hi) & rmask_hi)
     w_lo = (lo & cmask_lo) | ((~lo) & rmask_lo)
+    if use_psort and psort.available(n):
+        return psort.dedup_keys2_dom(a_hi, a_lo, w_hi, w_lo, cmask_hi,
+                                     cmask_lo, rmask_hi, rmask_lo, cap)
     ah, al, wh, wl = lax.sort((a_hi, a_lo, w_hi, w_lo), num_keys=4)
     first = jnp.arange(n) == 0
 
@@ -227,6 +253,16 @@ def _dedup_keys2_dom(hi, lo, valid, cap, cmask_hi, cmask_lo,
     fl = _seg_first(wl, start)
     dominated = ((fh & ~wh) == 0) & ((fl & ~wl) == 0) & \
         ~((wh == fh) & (wl == fl))
+    idx = jnp.arange(n)
+    for dd in psort.dom_window(n):
+        ah_d = jnp.roll(ah, dd)
+        al_d = jnp.roll(al, dd)
+        wh_d = jnp.roll(wh, dd)
+        wl_d = jnp.roll(wl, dd)
+        dominated = dominated | (
+            (idx >= dd) & (ah_d == ah) & (al_d == al)
+            & ((wh_d & ~wh) == 0) & ((wl_d & ~wl) == 0)
+            & ~((wh_d == wh) & (wl_d == wl)))
     keep = (ah >> 31 == 0) & ~dup & ~dominated
     total = jnp.sum(keep.astype(jnp.int32))
     overflow = total > cap
@@ -305,7 +341,7 @@ def _key_bit_words(pos):
     return lo, hi
 
 
-def expansion_tables(p: PackedHistory, b: int):
+def expansion_tables(p: PackedHistory, b: int, lazy: bool = True):
     """Host-side mutator-compacted expansion tables for the packed-key
     register band, in KEY space (config key = bitset << b | state-id,
     held as one u32 for window+b <= 31 or an (hi, lo) u32 pair up to
@@ -328,14 +364,38 @@ def expansion_tables(p: PackedHistory, b: int):
     crash_lo/crash_hi[R]     u32  key-space mask of crashed slots
     read_lo/read_hi[R]       u32  key-space mask of pure (read) slots
                                   (both for the dominance prune)
+    exp_jit[R, M]            bool column statically useful (see below)
+    exp_rv_lo/_hi[R, M]      u32  key-space mask of active reads whose
+                                  value equals the column's post-state
+
+    ``exp_jit``/``exp_rv`` carry the JUST-IN-TIME linearization
+    reduction (Lowe's JIT canonicalization, the idea behind
+    knossos.linear): a mutator need only linearize when it (a) is the
+    returner, (b) feeds the returner's precondition chain, or (c) makes
+    a pending unheld read legal. Any valid linearization rewrites into
+    this canonical form — a mutator linearized at a point satisfying
+    none of (a)-(c) either moves to its first such point (its window
+    extends there: live ops force (a) at their return row, crashed ops
+    never close) or, if its effect is overwritten unobserved, drops
+    from the sequence (the config without it dominates). (a)+(b) are
+    static per row: ``exp_jit[r,k]`` = k is the returner or post(k)
+    lies in the fixpoint P = {pre(returner)} growing by pre(m) for
+    every mutator m with post(m) in P or read-observed. (c) is
+    per-config: post(k) must match a read the config hasn't absorbed —
+    ``exp_rv`` masks against the config's unheld read bits. Without
+    this gate, the closure at a return row materializes the full
+    reachability wave over pending-mutator subsets — measured >10^6
+    transient configs on the 100k partitioned history (24 permanently
+    pending crashed mutators) whose boundary frontiers are ~30 configs.
 
     Cached on the PackedHistory after first computation.
     """
     cached = getattr(p, "_expansion_tables", None)
-    if cached is not None and cached[0] == b:
+    if cached is not None and cached[0] == (b, lazy):
         return cached[1]
 
     from jepsen_tpu.lin.prepare import reduction_tables
+    from jepsen_tpu.models.kernels import F_CAS, F_WRITE, NIL
 
     pure, pred = reduction_tables(p)
     act = np.asarray(p.active)
@@ -355,6 +415,7 @@ def expansion_tables(p: PackedHistory, b: int):
     exp_act = np.zeros((R, M), bool)
     exp_pred_lo = np.zeros((R, M), np.uint32)
     exp_pred_hi = np.zeros((R, M), np.uint32)
+    exp_slot = np.full((R, M), -1, np.int64)
 
     rr, jj = np.nonzero(mut)
     mm = (mut.cumsum(axis=1) - 1)[rr, jj]
@@ -362,6 +423,7 @@ def expansion_tables(p: PackedHistory, b: int):
     exp_f[rr, mm] = slot_f[rr, jj]
     exp_v[rr, mm] = slot_v[rr, jj]
     exp_act[rr, mm] = True
+    exp_slot[rr, mm] = jj
     pj = pred[rr, jj]
     pl_, ph_ = _key_bit_words(np.where(pj >= 0, b + pj, -1))
     exp_pred_lo[rr, mm] = pl_
@@ -380,9 +442,84 @@ def expansion_tables(p: PackedHistory, b: int):
     np.bitwise_or.at(read_lo, pr_, rl_)
     np.bitwise_or.at(read_hi, pr_, rh_)
 
+    # --- JIT-linearization gating tables (see docstring) ----------------
+    exp_jit = np.ones((R, M), bool)
+    exp_rv_lo = np.zeros((R, M), np.uint32)
+    exp_rv_hi = np.zeros((R, M), np.uint32)
+    if lazy and R:
+        V = 1 << b
+        # Post-state and precondition per column, as value-bitmasks over
+        # interned ids (registers: write v -> v[0]; cas [cur,new] ->
+        # pre cur, post new). Ids are < 2^b <= 64 by the packed-key
+        # bound, so one u64 mask per row suffices.
+        # NIL-valued words map to the nil state id (the register's nil
+        # state is a real, reachable state: cas(None, x) runs from it
+        # and write(None) re-enters it).
+        nil_sid = max(len(p.unintern), 2)
+
+        def as_sid(w):
+            return np.where(w == NIL, nil_sid, w)
+
+        is_cas = exp_f == F_CAS
+        is_wr = exp_f == F_WRITE
+        post = np.where(is_cas, as_sid(exp_v[:, :, 1]),
+                        as_sid(exp_v[:, :, 0]))
+        post = np.where(exp_act & (is_cas | is_wr), post, -1)
+        pre_v = np.where(exp_act & is_cas, as_sid(exp_v[:, :, 0]), -1)
+
+        def vbit(ids):
+            ok = (ids >= 0) & (ids < V)
+            return np.where(ok, np.uint64(1) << np.clip(ids, 0, V - 1)
+                            .astype(np.uint64), np.uint64(0))
+
+        post_bit = vbit(post)
+        pre_bit = vbit(pre_v)
+        # Read-observed values per row (NIL-valued reads match any state
+        # and saturate unconditionally — they gate nothing).
+        rv = np.where(pure & act & (slot_v[:, :, 0] != NIL)
+                      & (slot_v[:, :, 0] >= 0),
+                      slot_v[:, :, 0], -1)
+        read_mask = np.bitwise_or.reduce(vbit(rv), axis=1)
+        # Returner: its own column is always expandable; a cas returner
+        # seeds the precondition fixpoint.
+        ret = np.asarray(p.ret_slot)
+        is_ret_col = exp_slot == ret[:, None]
+        ret_f = slot_f[np.arange(R), ret]
+        ret_pre = np.where(ret_f == F_CAS,
+                           as_sid(slot_v[np.arange(R), ret, 0]), -1)
+        P = vbit(ret_pre)
+        # Fixpoint: pre(m) joins P for every mutator m whose post-state
+        # is in P or read-observed (chain hops toward an observation).
+        for _ in range(V):
+            useful = (post_bit & (P | read_mask)[:, None]) != 0
+            P2 = P | np.bitwise_or.reduce(
+                np.where(useful, pre_bit, np.uint64(0)), axis=1)
+            if np.array_equal(P2, P):
+                break
+            P = P2
+        exp_jit = is_ret_col | ((post_bit & P[:, None]) != 0)
+        # Per-value read masks in key space, gathered per column by its
+        # post-state: rv_val[r, v] = OR of key bits of active reads of v.
+        rv_lo_v = np.zeros((R, V), np.uint32)
+        rv_hi_v = np.zeros((R, V), np.uint32)
+        rr2, jj2 = np.nonzero((rv >= 0) & (rv < V))
+        vv2 = rv[rr2, jj2]
+        kl_, kh_ = _key_bit_words(b + jj2)
+        np.bitwise_or.at(rv_lo_v, (rr2, vv2), kl_)
+        np.bitwise_or.at(rv_hi_v, (rr2, vv2), kh_)
+        pcl = np.clip(post, 0, V - 1)
+        has_post = (post >= 0) & (post < V)
+        exp_rv_lo = np.where(
+            has_post, np.take_along_axis(rv_lo_v, pcl, axis=1), 0) \
+            .astype(np.uint32)
+        exp_rv_hi = np.where(
+            has_post, np.take_along_axis(rv_hi_v, pcl, axis=1), 0) \
+            .astype(np.uint32)
+
     out = (exp_lo, exp_hi, exp_f, exp_v, exp_act, exp_pred_lo,
-           exp_pred_hi, crash_lo, crash_hi, read_lo, read_hi)
-    p._expansion_tables = (b, out)
+           exp_pred_hi, crash_lo, crash_hi, read_lo, read_hi,
+           exp_jit, exp_rv_lo, exp_rv_hi)
+    p._expansion_tables = ((b, lazy), out)
     return out
 
 
@@ -653,7 +790,8 @@ def _closure_pass_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
     from jepsen_tpu.models.kernels import NIL
 
     (exp_lo, exp_hi, exp_f, exp_v, exp_act, exp_pred_lo, exp_pred_hi,
-     crash_lo, crash_hi, read_lo, read_hi) = exp
+     crash_lo, crash_hi, read_lo, read_hi, exp_jit, exp_rv_lo,
+     exp_rv_hi) = exp
     pair = hi_in is not None
     kbit_lo, kbit_hi = _key_bit_words(b + np.arange(W))
     step_cfg_slot = jax.vmap(
@@ -707,12 +845,18 @@ def _closure_pass_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
     already = (lo1[:, None] & exp_lo[None, :]) != 0
     chain_ok = (lo1[:, None] & exp_pred_lo[None, :]) == \
         exp_pred_lo[None, :]
+    # JIT-linearization gate (expansion_tables): a column expands only
+    # when statically useful (returner / precondition chain) or when its
+    # post-state absorbs a read this config hasn't (unheld rv bits).
+    jit_ok = exp_jit[None, :] | \
+        ((exp_rv_lo[None, :] & ~lo1[:, None]) != 0)
     if pair:
         already = already | ((hi1[:, None] & exp_hi[None, :]) != 0)
         chain_ok = chain_ok & (
             (hi1[:, None] & exp_pred_hi[None, :]) == exp_pred_hi[None, :])
+        jit_ok = jit_ok | ((exp_rv_hi[None, :] & ~hi1[:, None]) != 0)
     fresh = ok & exp_act[None, :] & ~already & cfg_valid[:, None]
-    legal = fresh & chain_ok
+    legal = fresh & chain_ok & jit_ok
     new_lo = (lo1[:, None] & ~state_mask) | exp_lo[None, :] | nsat_lo \
         | pns
     cand_lo = jnp.concatenate([jnp.where(cfg_valid, lo1, 0),
@@ -725,7 +869,7 @@ def _closure_pass_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
         if crash_dom:
             h2, l2, n2, o2 = _dedup_keys2_dom(
                 cand_hi, cand_lo, cand_valid, cap, crash_hi, crash_lo,
-                read_hi, read_lo)
+                read_hi, read_lo, use_psort=use_psort)
         else:
             h2, l2, n2, o2 = _dedup_keys2(cand_hi, cand_lo, cand_valid,
                                           cap, use_psort=use_psort)
@@ -742,29 +886,41 @@ def _closure_pass_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
     return l2, None, n2, changed, o2
 
 
-def _filter_pass_keys(keys, count, s, *, cap, b, use_psort=False,
-                      crash_dom=False, cmask=None, rmask=None):
+def _filter_pass_keys(keys, count, s, *, cap, b, use_psort=False):
     """Return-event filter over packed keys: the returner's linearization
     point must precede its return; survivors drop its (recycled) bit.
+
+    The filter never creates duplicates — every survivor held the SAME
+    bit, so dropping it is injective — and dropping a common bit is
+    monotone, so survivor order is preserved. When nothing is dropped
+    the whole pass is one bit-clear; otherwise dropped entries become
+    KEY_FILL and ONE sort compacts (no dedup machinery). Dominance
+    pruning is deliberately absent here: it is an optimization, not a
+    soundness requirement, and the next closure pass's dedup prunes.
     Returns (keys, count, dead)."""
     s_key_bit = jnp.uint32(1) << (b + s).astype(jnp.uint32)
     cfg_valid = jnp.arange(cap) < count
     keep = cfg_valid & ((keys & s_key_bit) != 0)
-    dropped = jnp.where(keep, keys & ~s_key_bit, 0)
-    if crash_dom:
-        keys, count, _ = _dedup_keys_dom(dropped, keep, cap, cmask,
-                                         rmask, use_psort=use_psort)
-    else:
-        keys, count, _ = _dedup_keys(dropped, keep, cap,
-                                     use_psort=use_psort)
+    n_keep = jnp.sum(keep.astype(jnp.int32))
+
+    def clear_only():
+        return jnp.where(cfg_valid, keys & ~s_key_bit, keys), count
+
+    def compacting():
+        dropped = jnp.where(keep, keys & ~s_key_bit, KEY_FILL)
+        if use_psort and psort.available(cap):
+            return psort.compact_keys(dropped, cap)
+        return lax.sort(dropped), n_keep
+
+    keys, count = lax.cond(n_keep == count, clear_only, compacting)
     return keys, count, count == 0
 
 
-def _filter_pass_keys2(lo, hi, count, s, *, cap, b, use_psort=False,
-                       crash_dom=False, cmask_lo=None, cmask_hi=None,
-                       rmask_lo=None, rmask_hi=None):
-    """Pair-key return-event filter: the returner's key bit (b + s) may
-    live in either word. Returns (lo, hi, count, dead)."""
+def _filter_pass_keys2(lo, hi, count, s, *, cap, b, use_psort=False):
+    """Pair-key return-event filter (see _filter_pass_keys: injective
+    bit-drop, clear-only fast path, one compacting sort otherwise). The
+    returner's key bit (b + s) may live in either word. Returns
+    (lo, hi, count, dead)."""
     pos = (b + s).astype(jnp.uint32)
     in_lo = pos < 32
     bit_lo = jnp.where(in_lo, jnp.uint32(1) << (pos & 31), jnp.uint32(0))
@@ -772,16 +928,24 @@ def _filter_pass_keys2(lo, hi, count, s, *, cap, b, use_psort=False,
                        jnp.uint32(1) << (pos & 31))
     cfg_valid = jnp.arange(cap) < count
     keep = cfg_valid & (((lo & bit_lo) | (hi & bit_hi)) != 0)
-    d_hi = jnp.where(keep, hi & ~bit_hi, 0)
-    d_lo = jnp.where(keep, lo & ~bit_lo, 0)
-    if crash_dom:
-        h2, l2, count, _ = _dedup_keys2_dom(d_hi, d_lo, keep, cap,
-                                            cmask_hi, cmask_lo,
-                                            rmask_hi, rmask_lo)
-    else:
-        h2, l2, count, _ = _dedup_keys2(d_hi, d_lo, keep, cap,
-                                        use_psort=use_psort)
-    return l2, h2, count, count == 0
+    n_keep = jnp.sum(keep.astype(jnp.int32))
+
+    def clear_only():
+        return (jnp.where(cfg_valid, lo & ~bit_lo, lo),
+                jnp.where(cfg_valid, hi & ~bit_hi, hi), count)
+
+    def compacting():
+        d_hi = jnp.where(keep, hi & ~bit_hi, KEY_FILL)
+        d_lo = jnp.where(keep, lo & ~bit_lo, KEY_FILL)
+        if use_psort and psort.available(cap):
+            h2, l2, n2 = psort.compact_keys2(d_hi, d_lo, cap)
+        else:
+            h2, l2 = lax.sort((d_hi, d_lo), num_keys=2)
+            n2 = n_keep
+        return l2, h2, n2
+
+    lo, hi, count = lax.cond(n_keep == count, clear_only, compacting)
+    return lo, hi, count, count == 0
 
 
 # Row tiers for the packed-key engine: a row whose frontier is small
@@ -791,7 +955,11 @@ def _filter_pass_keys2(lo, hi, count, s, *, cap, b, use_psort=False,
 # spiky (median a few hundred configs, brief 10-50k bursts), and
 # without tiers every row pays for the burst capacity. A tier whose
 # dedup overflows retries the row at the full cap (one lax.cond).
-ROW_TIERS = (2048, 8192, 32768, 131072)
+# The ladder is geometric x4 from 256: partitioned cockroach-class
+# histories (BASELINE config 5) sit at counts 4-1000 for most rows, and
+# the sort cost of a row tracks tier*(1+M), so the bottom tiers carry
+# the throughput.
+ROW_TIERS = (256, 1024, 4096, 16384, 65536)
 # Tier selection margin: the chosen tier must hold margin x the live
 # count, since mid-closure frontiers (config + saturated twin +
 # expansions, pre-filter) overshoot the settled count.
@@ -831,7 +999,17 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
         ``tier`` entries of the frontier (live entries are a prefix:
         dedup compacts and count <= tier/TIER_MARGIN at selection, or
         this is the escalation/top tier with count <= cap). Returns
-        (lo[cap], hi[cap]|None, count, dead, overflow)."""
+        (lo[cap], hi[cap]|None, count, dead, overflow).
+
+        The compact-table closure runs GROUPED: expansion columns are
+        processed Mg at a time so every dedup stays within the windowed
+        dominance bound (tier*(1+Mg) <= psort.DOM_WINDOW_MAX_N) — the
+        crashed-subset wave of partition histories must meet the
+        windowed prune at EVERY capacity, or a single row's transient
+        blowup (measured 389k configs from a 26-config entry) rides an
+        unwindowed big-tier dedup into overflow. The fixpoint ends
+        after G consecutive unchanged subpasses (one full group
+        cycle)."""
         act = active[r]
         f_row = slot_f[r]
         v_row = slot_v[r]
@@ -840,21 +1018,40 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
         l_t = lo[:tier] if tier < cap else lo
         h_t = (hi[:tier] if tier < cap else hi) if key_hi else None
 
+        if exp_tables is not None:
+            M_cols = exp_tables[0].shape[-1]
+            Mg = max(1, psort.DOM_WINDOW_MAX_N // tier - 1)
+            G = -(-M_cols // Mg) if Mg < M_cols else 1
+            Mg = min(Mg, M_cols)
+        else:
+            G = 1
+
         def closure_cond(c):
-            return c[-2] & ~c[-1]
+            return (c[-2] < G) & ~c[-1]
 
         def closure_body(c):
             if key_hi:
-                lo_in, hi_in, count, _, ovf = c
+                lo_in, hi_in, count, g, since, ovf = c
             else:
-                lo_in, count, _, ovf = c
+                lo_in, count, g, since, ovf = c
                 hi_in = None
             if exp_tables is not None:
-                exp_r = tuple(t[r] for t in exp_tables)
+                exp_r = []
+                for t in exp_tables:
+                    tr = t[r]
+                    if tr.ndim >= 1 and G > 1:
+                        pad = G * Mg - M_cols
+                        if pad:
+                            tr = jnp.pad(
+                                tr, ((0, pad),) + ((0, 0),)
+                                * (tr.ndim - 1))
+                        tr = lax.dynamic_slice_in_dim(tr, g * Mg, Mg, 0)
+                    exp_r.append(tr)
                 l2, h2, n2, changed, o2 = _closure_pass_keys_compact(
-                    lo_in, hi_in, count, act, v_row, pure_row, exp_r,
-                    cap=tier, W=W, b=b, nil_id=nil_id, step_fn=step_fn,
-                    use_psort=use_psort, crash_dom=crash_dom)
+                    lo_in, hi_in, count, act, v_row, pure_row,
+                    tuple(exp_r), cap=tier, W=W, b=b, nil_id=nil_id,
+                    step_fn=step_fn, use_psort=use_psort,
+                    crash_dom=crash_dom)
             else:
                 l2, n2, changed, o2 = _closure_pass_keys(
                     lo_in, count, act, f_row, v_row, pure_row, pred_row,
@@ -862,30 +1059,28 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
                     read_value_match=read_value_match,
                     use_psort=use_psort)
                 h2 = None
+            g2 = jnp.where(g + 1 >= G, 0, g + 1)
+            since2 = jnp.where(changed, jnp.int32(0), since + 1)
             if key_hi:
-                return (l2, h2, n2, changed, ovf | o2)
-            return (l2, n2, changed, ovf | o2)
+                return (l2, h2, n2, g2, since2, ovf | o2)
+            return (l2, n2, g2, since2, ovf | o2)
 
         if key_hi:
-            init = (l_t, h_t, count, jnp.bool_(True), jnp.bool_(False))
-            l_t, h_t, count, _, ovf = lax.while_loop(
+            init = (l_t, h_t, count, jnp.int32(0), jnp.int32(0),
+                    jnp.bool_(False))
+            l_t, h_t, count, _, _, ovf = lax.while_loop(
                 closure_cond, closure_body, init)
             l_t, h_t, count, dead = _filter_pass_keys2(
                 l_t, h_t, count, ret_slot[r], cap=tier, b=b,
-                use_psort=use_psort, crash_dom=crash_dom,
-                cmask_lo=exp_tables[7][r] if crash_dom else None,
-                cmask_hi=exp_tables[8][r] if crash_dom else None,
-                rmask_lo=exp_tables[9][r] if crash_dom else None,
-                rmask_hi=exp_tables[10][r] if crash_dom else None)
+                use_psort=use_psort)
         else:
-            init = (l_t, count, jnp.bool_(True), jnp.bool_(False))
-            l_t, count, _, ovf = lax.while_loop(
+            init = (l_t, count, jnp.int32(0), jnp.int32(0),
+                    jnp.bool_(False))
+            l_t, count, _, _, ovf = lax.while_loop(
                 closure_cond, closure_body, init)
             l_t, count, dead = _filter_pass_keys(
                 l_t, count, ret_slot[r], cap=tier, b=b,
-                use_psort=use_psort, crash_dom=crash_dom,
-                cmask=exp_tables[7][r] if crash_dom else None,
-                rmask=exp_tables[9][r] if crash_dom else None)
+                use_psort=use_psort)
         if tier < cap:
             fill = jnp.full(cap - tier, KEY_FILL, jnp.uint32)
             l_t = jnp.concatenate([l_t, fill])
@@ -1151,7 +1346,8 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                  chunk: int = CHUNK, cancel=None, explain: bool = False,
                  spike_caps=SPIKE_CAP_SCHEDULE,
                  spike_dropback: int = SPIKE_DROPBACK,
-                 packed_keys: bool | None = None) -> dict:
+                 packed_keys: bool | None = None,
+                 lazy: bool = True) -> dict:
     """Decide linearizability of a packed history on device.
 
     Host loop over CHUNK-row device dispatches; the frontier carries
@@ -1219,7 +1415,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     exp_h = None
     crash_dom = False
     if state_bits is not None and read_value_match and state_bits <= 6:
-        exp_h = expansion_tables(p, state_bits)
+        exp_h = expansion_tables(p, state_bits, lazy=lazy)
         # Crashed-subset dominance: only engage when crashed mutators
         # exist (the masks are all-zero otherwise and the pruning sort
         # would be pure overhead).
@@ -1242,16 +1438,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     max_cap_used = cap
     snapshots: list | None = [] if explain else None
 
-    base = 0
-    while base < p.R:
-        if snapshots is not None:
-            # only the last snapshot is ever replayed (the dead row is
-            # always inside the current chunk): keep HBM flat
-            snapshots[:] = [(base, bits, state, count)]
-        if cancel is not None and cancel.is_set():
-            return {"valid?": "unknown", "analyzer": "tpu-bfs",
-                    "error": "cancelled"}
-        n = min(chunk, p.R - base)
+    def chunk_tables(base):
         tables = (jnp.asarray(_chunk_slice(ret_slot_h, base, chunk)),
                   jnp.asarray(_chunk_slice(active_h, base, chunk)),
                   jnp.asarray(_chunk_slice(slot_f_h, base, chunk)),
@@ -1260,6 +1447,62 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                   jnp.asarray(_chunk_slice(pred_bit_h, base, chunk)))
         exp_c = None if exp_h is None else tuple(
             jnp.asarray(_chunk_slice(t, base, chunk)) for t in exp_h)
+        return tables, exp_c
+
+    base = 0
+    deferred = snapshots is None
+    classic_until = -1
+    while base < p.R:
+        if deferred and base >= classic_until:
+            # Optimistic fast path: dispatch a batch of chunks without
+            # host syncs, then fetch every chunk's (ovf, dead) flags in
+            # ONE transfer. Clean batches (the overwhelmingly common
+            # case) pay one round trip per SYNC_CHUNKS chunks; a
+            # tripped flag rewinds to the batch entry (frontier arrays
+            # are immutable device values) and replays chunk-by-chunk
+            # through the classic path below, which owns escalation,
+            # spike mode, and dead-row reporting.
+            entry = (bits, state, count, level, base)
+            flags = []
+            while base < p.R and len(flags) < SYNC_CHUNKS:
+                if cancel is not None and cancel.is_set():
+                    return {"valid?": "unknown", "analyzer": "tpu-bfs",
+                            "error": "cancelled"}
+                n = min(chunk, p.R - base)
+                tables, exp_c = chunk_tables(base)
+                b2, s2, c2, r_done, dead, ovf = _search_chunk(
+                    jnp.int32(n), *tables, bits, state, count, exp_c,
+                    cap=cap_schedule[level], step_fn=step_fn,
+                    state_bits=state_bits, nil_id=nil_id,
+                    read_value_match=read_value_match,
+                    use_psort=use_psort, key_hi=key_hi,
+                    crash_dom=crash_dom)
+                flags.append(jnp.stack((ovf.astype(jnp.int32),
+                                        dead.astype(jnp.int32), c2)))
+                bits, state, count = b2, s2, c2
+                base += n
+            fl = np.asarray(jnp.stack(flags))   # ONE transfer per batch
+            if not fl[:, :2].any():
+                cnt = int(fl[-1, 2])
+                while level > 0 and \
+                        cnt * 4 <= cap_schedule[level - 1]:
+                    level -= 1
+                    cap = cap_schedule[level]
+                    bits = bits[:cap]
+                    state = state[:cap]
+                continue
+            classic_until = base
+            bits, state, count, level, base = entry
+            cap = cap_schedule[level]
+        if snapshots is not None:
+            # only the last snapshot is ever replayed (the dead row is
+            # always inside the current chunk): keep HBM flat
+            snapshots[:] = [(base, bits, state, count)]
+        if cancel is not None and cancel.is_set():
+            return {"valid?": "unknown", "analyzer": "tpu-bfs",
+                    "error": "cancelled"}
+        n = min(chunk, p.R - base)
+        tables, exp_c = chunk_tables(base)
         spiked = None
         while True:
             b2, s2, c2, r_done, dead, ovf = _search_chunk(

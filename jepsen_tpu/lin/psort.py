@@ -50,6 +50,45 @@ LANE = 128
 MIN_N = 1024              # (8, 128) u32 tiling minimum
 PSORT_MAX_N = 1 << 19     # above this lax.sort is faster (see table)
 KEY_FILL = 0xFFFFFFFF     # plain int: used inside kernels as a literal
+# Windowed-pairwise dominance distances (shared by the lax and pallas
+# dom dedups so their semantics match exactly): after the (group, word)
+# sort, every entry is tested against the predecessors at these offsets
+# in addition to the group representative. A strict subset sorts
+# numerically earlier, so predecessors are the only candidates; the
+# window makes the prune near-pairwise ITERATIVELY — each closure pass
+# re-dedups, and measured on the 100k partitioned history's blowup row
+# the rep-only prune left 389k configs (antichain 9.3k) while rep +
+# this window converges to 9.9k within a few passes. The window is
+# SIZE-GATED (DOM_WINDOW_MAX_N, padded size): the roll chains at
+# multi-million-cell lax dedups inside the nested-while chunk program
+# kernel-faulted the axon TPU worker on the 100k partitioned history,
+# while the pallas kernels with the window ran clean to 2^18. Pruning
+# less at the rare big-tier dedups is sound; the small-tier dedups
+# that run every pass keep the frontier collapsed.
+# Two distances, not more: 4+ distances at pad 2^16+ kernel-fault the
+# axon worker inside the chunk program (probed on the 100k partitioned
+# history's wave chunk; 2 distances at 2^18 run clean), and offline
+# simulation of the wave shows iterated (1,2)+rep converges to 14.4k
+# configs vs 9.9k for 8 distances — the extra distances buy little.
+DOM_WINDOW = (1, 2)
+DOM_WINDOW_MAX_N = 1 << 18
+
+
+def dom_window(n: int) -> tuple:
+    """The dominance window for an n-element dedup (empty past the
+    size gate — see DOM_WINDOW). ``JEPSEN_TPU_DOM_WINDOW`` overrides:
+    ``0`` disables the window entirely (the fault-triage escape
+    hatch), any other integer replaces the max-pad EXPONENT (default
+    log2(DOM_WINDOW_MAX_N))."""
+    env = os.environ.get("JEPSEN_TPU_DOM_WINDOW", "")
+    if env == "0":
+        return ()
+    k = len(DOM_WINDOW)
+    if ":" in env:
+        env, k = env.split(":")
+        k = int(k)
+    max_n = (1 << int(env)) if env else DOM_WINDOW_MAX_N
+    return DOM_WINDOW[:k] if pad_size(n) <= max_n else ()
 
 
 def pad_size(n: int) -> int:
@@ -152,6 +191,18 @@ def _dedup_call(keys, n_pad):
     return out.reshape(-1), total[0]
 
 
+def _assert_cap_contract(n: int, cap: int) -> None:
+    """The dedup entry points promise ``keys[cap]`` outputs; that holds
+    only when the padded kernel size covers cap (all engine call sites
+    pass n >= cap — candidate arrays are cap*(1+M)). Enforce it so a
+    future caller cannot silently break the fixed-shape lax.while_loop
+    carries in bfs."""
+    if pad_size(n) < cap:
+        raise ValueError(
+            f"psort dedup contract: pad_size({n})={pad_size(n)} < cap "
+            f"{cap}; the output could not fill keys[cap]")
+
+
 def _bitonic_sort2(hi, lo, flat, *, S, K):
     """Bitonic sort of (hi, lo) u32 pairs, ascending by the 64-bit
     lexicographic key. Same stage structure as _bitonic_sort with a
@@ -236,6 +287,7 @@ def dedup_keys2(hi, lo, valid, cap):
     stay below 2^31). Returns (hi[cap], lo[cap], count, overflow) with
     survivors ascending by (hi, lo) and KEY_FILL padding."""
     n = hi.shape[0]
+    _assert_cap_contract(n, cap)
     n_pad = pad_size(n)
     hi = hi | ((~valid).astype(jnp.uint32) << 31)
     if n_pad > n:
@@ -292,6 +344,11 @@ def _dedup_dom_body(masks_ref, a_ref, w_ref, out_ref, total_ref,
         done = done | _flat_prev(done, d, S)
         d <<= 1
     dominated = ((f & ~w) == 0) & (w != f)
+    for dd in dom_window(S * LANE):
+        a_d = _flat_prev(a, dd, S)
+        w_d = _flat_prev(w, dd, S)
+        dominated = dominated | (
+            (flat >= dd) & (a_d == a) & ((w_d & ~w) == 0) & (w_d != w))
     keep = (a >> 31 == 0) & ~dup & ~dominated
     total_ref[0] = jnp.sum(keep.astype(jnp.int32))
     full = jnp.where(
@@ -330,6 +387,7 @@ def dedup_keys_dom(a, w, cmask, rmask, cap):
     read bits); ``cmask``/``rmask`` u32 scalars for recombination.
     Returns (keys[cap] full-key ascending, count, overflow)."""
     n = a.shape[0]
+    _assert_cap_contract(n, cap)
     n_pad = pad_size(n)
     if n_pad > n:
         pad = jnp.full(n_pad - n, KEY_FILL, jnp.uint32)
@@ -341,12 +399,253 @@ def dedup_keys_dom(a, w, cmask, rmask, cap):
     return out, jnp.minimum(total, cap), total > cap
 
 
+def _bitonic_sort4(a, b, c, d, flat, *, S, K):
+    """Bitonic sort of (a, b, c, d) u32 quads, ascending by the 128-bit
+    lexicographic key. Same stage structure as _bitonic_sort2 with a
+    4-word compare-exchange."""
+    def stage(a, b, c, d, k, jj):
+        j = jnp.uint32(1) << jj
+        jl = jnp.where(jj < 7, j, 0).astype(jnp.int32)
+        js = jnp.where(jj < 7, 0, j >> 7).astype(jnp.int32)
+        upper = (flat & j) != 0
+
+        def partner(x):
+            return jnp.where(
+                upper,
+                pltpu.roll(pltpu.roll(x, jl, 1), js, 0),
+                pltpu.roll(pltpu.roll(x, (LANE - jl) % LANE, 1),
+                           (S - js) % S, 0))
+
+        pa, pb, pc, pd = partner(a), partner(b), partner(c), partner(d)
+        desc = ((flat >> (k + 1)) & 1) == 1
+        lt = (a < pa) | ((a == pa) & (
+            (b < pb) | ((b == pb) & (
+                (c < pc) | ((c == pc) & (d < pd))))))
+        eq = (a == pa) & (b == pb) & (c == pc) & (d == pd)
+        keep = (lt == (upper == desc)) | eq
+        return (jnp.where(keep, a, pa), jnp.where(keep, b, pb),
+                jnp.where(keep, c, pc), jnp.where(keep, d, pd))
+
+    def outer(k, q):
+        def inner(t, q):
+            return stage(*q, jnp.uint32(k), jnp.uint32(k - t))
+        return lax.fori_loop(0, k + 1, inner, q)
+
+    return lax.fori_loop(0, K, outer, (a, b, c, d))
+
+
+def _dedup2_dom_body(masks_ref, a_hi_ref, a_lo_ref, w_hi_ref, w_lo_ref,
+                     out_hi_ref, out_lo_ref, total_ref, *, S, K):
+    """Pair-key twin of _dedup_dom_body (see bfs._dedup_keys2_dom): sort
+    by (group pair, dominance-word pair), drop duplicates and dominated
+    entries, emit recombined full keys ascending by (hi, lo). masks_ref
+    = (cmask_hi, cmask_lo, rmask_hi, rmask_lo)."""
+    a_hi = a_hi_ref[:]
+    a_lo = a_lo_ref[:]
+    w_hi = w_hi_ref[:]
+    w_lo = w_lo_ref[:]
+    cmask_hi = masks_ref[0]
+    cmask_lo = masks_ref[1]
+    rmask_hi = masks_ref[2]
+    rmask_lo = masks_ref[3]
+    lane = lax.broadcasted_iota(jnp.uint32, a_hi.shape, 1)
+    row = lax.broadcasted_iota(jnp.uint32, a_hi.shape, 0)
+    flat = row * LANE + lane
+
+    a_hi, a_lo, w_hi, w_lo = _bitonic_sort4(a_hi, a_lo, w_hi, w_lo,
+                                            flat, S=S, K=K)
+
+    first = flat == 0
+    pah = _flat_prev(a_hi, 1, S)
+    pal = _flat_prev(a_lo, 1, S)
+    same_a = (a_hi == pah) & (a_lo == pal)
+    dup = same_a & (w_hi == _flat_prev(w_hi, 1, S)) & \
+        (w_lo == _flat_prev(w_lo, 1, S)) & ~first
+    start = first | ~same_a
+    fh = w_hi
+    fl = w_lo
+    done = start.astype(jnp.uint32)
+    d = 1
+    while d < (1 << K):
+        fh = jnp.where(done != 0, fh, _flat_prev(fh, d, S))
+        fl = jnp.where(done != 0, fl, _flat_prev(fl, d, S))
+        done = done | _flat_prev(done, d, S)
+        d <<= 1
+    dominated = ((fh & ~w_hi) == 0) & ((fl & ~w_lo) == 0) & \
+        ~((w_hi == fh) & (w_lo == fl))
+    for dd in dom_window(S * LANE):
+        ah_d = _flat_prev(a_hi, dd, S)
+        al_d = _flat_prev(a_lo, dd, S)
+        wh_d = _flat_prev(w_hi, dd, S)
+        wl_d = _flat_prev(w_lo, dd, S)
+        dominated = dominated | (
+            (flat >= dd) & (ah_d == a_hi) & (al_d == a_lo)
+            & ((wh_d & ~w_hi) == 0) & ((wl_d & ~w_lo) == 0)
+            & ~((wh_d == w_hi) & (wl_d == w_lo)))
+    keep = (a_hi >> 31 == 0) & ~dup & ~dominated
+    total_ref[0] = jnp.sum(keep.astype(jnp.int32))
+    full_hi = jnp.where(
+        keep,
+        (a_hi & jnp.uint32(0x7FFFFFFF)) | (w_hi & cmask_hi)
+        | ((~w_hi) & rmask_hi),
+        jnp.uint32(KEY_FILL))
+    full_lo = jnp.where(
+        keep, a_lo | (w_lo & cmask_lo) | ((~w_lo) & rmask_lo),
+        jnp.uint32(KEY_FILL))
+    out_hi_ref[:], out_lo_ref[:] = _bitonic_sort2(full_hi, full_lo,
+                                                  flat, S=S, K=K)
+
+
+@partial(jax.jit, static_argnames=("n_pad",))
+def _dedup2_dom_call(a_hi, a_lo, w_hi, w_lo, masks, n_pad):
+    S = n_pad // LANE
+    K = n_pad.bit_length() - 1
+    out_hi, out_lo, total = pl.pallas_call(
+        partial(_dedup2_dom_body, S=S, K=K),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 4,
+        out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_shape=[jax.ShapeDtypeStruct((S, LANE), jnp.uint32),
+                   jax.ShapeDtypeStruct((S, LANE), jnp.uint32),
+                   jax.ShapeDtypeStruct((1,), jnp.int32)],
+        input_output_aliases={1: 0, 2: 1},
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )(masks, a_hi.reshape(S, LANE), a_lo.reshape(S, LANE),
+      w_hi.reshape(S, LANE), w_lo.reshape(S, LANE))
+    return out_hi.reshape(-1), out_lo.reshape(-1), total[0]
+
+
+def dedup_keys2_dom(a_hi, a_lo, w_hi, w_lo, cmask_hi, cmask_lo,
+                    rmask_hi, rmask_lo, cap):
+    """In-VMEM twin of the lax path in ``bfs._dedup_keys2_dom``. ``a``
+    pair carries group bits (invalid flag already in a_hi bit 31), ``w``
+    pair the packed dominance words. Returns (hi[cap], lo[cap], count,
+    overflow), survivors full-key ascending by (hi, lo)."""
+    n = a_hi.shape[0]
+    _assert_cap_contract(n, cap)
+    n_pad = pad_size(n)
+    if n_pad > n:
+        pad = jnp.full(n_pad - n, KEY_FILL, jnp.uint32)
+        zpad = jnp.zeros(n_pad - n, jnp.uint32)
+        a_hi = jnp.concatenate([a_hi, pad])
+        a_lo = jnp.concatenate([a_lo, pad])
+        w_hi = jnp.concatenate([w_hi, zpad])
+        w_lo = jnp.concatenate([w_lo, zpad])
+    masks = jnp.stack([cmask_hi, cmask_lo, rmask_hi, rmask_lo]) \
+        .astype(jnp.uint32)
+    out_hi, out_lo, total = _dedup2_dom_call(a_hi, a_lo, w_hi, w_lo,
+                                             masks, n_pad)
+    if out_hi.shape[0] > cap:
+        out_hi = out_hi[:cap]
+        out_lo = out_lo[:cap]
+    return out_hi, out_lo, jnp.minimum(total, cap), total > cap
+
+
+def _compact_body(key_ref, out_ref, total_ref, *, S, K):
+    """Compaction-only kernel: callers have already masked dropped
+    entries to KEY_FILL and guarantee survivors are DISTINCT (the
+    return-event filter drops the same held bit from every survivor —
+    injective), so one bitonic sort packs survivors ascending."""
+    x = key_ref[:]
+    lane = lax.broadcasted_iota(jnp.uint32, x.shape, 1)
+    row = lax.broadcasted_iota(jnp.uint32, x.shape, 0)
+    flat = row * LANE + lane
+    total_ref[0] = jnp.sum((x != jnp.uint32(KEY_FILL)).astype(jnp.int32))
+    out_ref[:] = _bitonic_sort(x, flat, lane, S=S, K=K)
+
+
+@partial(jax.jit, static_argnames=("n_pad",))
+def _compact_call(keys, n_pad):
+    S = n_pad // LANE
+    K = n_pad.bit_length() - 1
+    out, total = pl.pallas_call(
+        partial(_compact_body, S=S, K=K),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_shape=[jax.ShapeDtypeStruct((S, LANE), jnp.uint32),
+                   jax.ShapeDtypeStruct((1,), jnp.int32)],
+        input_output_aliases={0: 0},
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )(keys.reshape(S, LANE))
+    return out.reshape(-1), total[0]
+
+
+def compact_keys(keys, cap):
+    """Pack the non-KEY_FILL entries of ``keys`` (distinct by caller
+    contract) to an ascending prefix. Returns (keys[cap], count)."""
+    n = keys.shape[0]
+    _assert_cap_contract(n, cap)
+    n_pad = pad_size(n)
+    if n_pad > n:
+        keys = jnp.concatenate(
+            [keys, jnp.full(n_pad - n, KEY_FILL, jnp.uint32)])
+    out, total = _compact_call(keys, n_pad)
+    return out[:cap], jnp.minimum(total, cap)
+
+
+def _compact2_body(hi_ref, lo_ref, out_hi_ref, out_lo_ref, total_ref,
+                   *, S, K):
+    hi = hi_ref[:]
+    lo = lo_ref[:]
+    lane = lax.broadcasted_iota(jnp.uint32, hi.shape, 1)
+    row = lax.broadcasted_iota(jnp.uint32, hi.shape, 0)
+    flat = row * LANE + lane
+    live = (hi != jnp.uint32(KEY_FILL)) | (lo != jnp.uint32(KEY_FILL))
+    total_ref[0] = jnp.sum(live.astype(jnp.int32))
+    out_hi_ref[:], out_lo_ref[:] = _bitonic_sort2(hi, lo, flat, S=S, K=K)
+
+
+@partial(jax.jit, static_argnames=("n_pad",))
+def _compact2_call(hi, lo, n_pad):
+    S = n_pad // LANE
+    K = n_pad.bit_length() - 1
+    out_hi, out_lo, total = pl.pallas_call(
+        partial(_compact2_body, S=S, K=K),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_shape=[jax.ShapeDtypeStruct((S, LANE), jnp.uint32),
+                   jax.ShapeDtypeStruct((S, LANE), jnp.uint32),
+                   jax.ShapeDtypeStruct((1,), jnp.int32)],
+        input_output_aliases={0: 0, 1: 1},
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )(hi.reshape(S, LANE), lo.reshape(S, LANE))
+    return out_hi.reshape(-1), out_lo.reshape(-1), total[0]
+
+
+def compact_keys2(hi, lo, cap):
+    """Pair twin of :func:`compact_keys`: dropped entries are KEY_FILL
+    in BOTH words; survivors distinct. Returns (hi[cap], lo[cap],
+    count)."""
+    n = hi.shape[0]
+    _assert_cap_contract(n, cap)
+    n_pad = pad_size(n)
+    if n_pad > n:
+        pad = jnp.full(n_pad - n, KEY_FILL, jnp.uint32)
+        hi = jnp.concatenate([hi, pad])
+        lo = jnp.concatenate([lo, pad])
+    out_hi, out_lo, total = _compact2_call(hi, lo, n_pad)
+    return out_hi[:cap], out_lo[:cap], jnp.minimum(total, cap)
+
+
 def dedup_keys(key, valid, cap):
     """In-VMEM twin of ``bfs._dedup_keys``: single-u32-key sort-dedup
     (invalid flag in bit 31) with sort-based compaction, in one pallas
     kernel. Returns (keys[cap] ascending + KEY_FILL padding, count,
     overflow). Caller must have checked :func:`available`."""
     n = key.shape[0]
+    _assert_cap_contract(n, cap)
     n_pad = pad_size(n)
     key = key | ((~valid).astype(jnp.uint32) << 31)
     if n_pad > n:

@@ -477,6 +477,8 @@ def _run_packed_chunks(p, mesh, axis, tables_h, cap_schedule, *, b,
 
     snapshots = [] if explain else None
     base = 0
+    n_chunks = 0
+    peak_total = 1
     while base < p.R:
         if cancel is not None and cancel.is_set():
             return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
@@ -529,6 +531,8 @@ def _run_packed_chunks(p, mesh, axis, tables_h, cap_schedule, *, b,
             return out
         keys, counts = k2, c2
         base += n
+        n_chunks += 1
+        peak_total = max(peak_total, int(total))
         # Shrink back to a smaller (faster) program when the global
         # frontier has room to spare; survivors are globally packed to
         # the front, so slicing each device's prefix keeps them all.
@@ -538,4 +542,11 @@ def _run_packed_chunks(p, mesh, axis, tables_h, cap_schedule, *, b,
             level -= 1
             cap = new_cap
     return {"valid?": True, "analyzer": "tpu-bfs-sharded",
-            "dedup": "packed-keys", "final-frontier-size": int(total)}
+            "dedup": "packed-keys", "final-frontier-size": int(total),
+            # Shard observability (the multi-chip speedup evidence the
+            # day real hardware exists): the collective dedup packs
+            # survivors to the global front, so occupancy is the
+            # balanced prefix-fill of cap_local per device.
+            "chunks": n_chunks, "peak-frontier": peak_total,
+            "cap-per-device": cap,
+            "shard-occupancy": [int(x) for x in np.asarray(counts)]}

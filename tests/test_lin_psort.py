@@ -95,3 +95,88 @@ def test_engine_parity_invalid_with_psort(interpret_psort):
     r_dev = bfs.check_packed(p)
     r_cpu = cpu.check_packed(p)
     assert r_dev["valid?"] == r_cpu["valid?"]
+
+
+def test_dedup2_dom_parity_fuzz(interpret_psort):
+    """Pair-key dominance dedup: pallas quad kernel vs the lax path of
+    bfs._dedup_keys2_dom on random configs with crash/read masks."""
+    import jax.numpy as jnp
+
+    from jepsen_tpu.lin.bfs import _dedup_keys2_dom
+
+    rng = np.random.default_rng(42)
+    for trial in range(6):
+        n = (1024, 2048, 4096)[trial % 3]
+        cap = n // 2
+        cmask_lo = np.uint32(rng.integers(0, 1 << 12))
+        rmask_lo = np.uint32(rng.integers(0, 1 << 12) << 12) & ~cmask_lo
+        cmask_hi = np.uint32(rng.integers(0, 1 << 8))
+        rmask_hi = np.uint32(rng.integers(0, 1 << 8) << 8) & ~cmask_hi
+        hi = rng.integers(0, 1 << 16, n).astype(np.uint32)
+        lo = rng.integers(0, 1 << 24, n).astype(np.uint32)
+        valid = rng.random(n) < 0.8
+        args = (jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(valid),
+                cap, jnp.uint32(cmask_hi), jnp.uint32(cmask_lo),
+                jnp.uint32(rmask_hi), jnp.uint32(rmask_lo))
+        h1, l1, c1, o1 = _dedup_keys2_dom(*args, use_psort=False)
+        h2, l2, c2, o2 = _dedup_keys2_dom(*args, use_psort=True)
+        assert int(c1) == int(c2), trial
+        assert bool(o1) == bool(o2), trial
+        assert np.array_equal(np.asarray(h1), np.asarray(h2)), trial
+        assert np.array_equal(np.asarray(l1), np.asarray(l2)), trial
+
+
+def test_compact_keys_parity(interpret_psort):
+    """compact_keys packs distinct non-KEY_FILL entries ascending."""
+    import jax.numpy as jnp
+
+    from jepsen_tpu.lin import psort
+
+    rng = np.random.default_rng(9)
+    vals = rng.choice(1 << 20, size=700, replace=False).astype(np.uint32)
+    keys = np.full(2048, 0xFFFFFFFF, np.uint32)
+    keys[rng.choice(2048, size=700, replace=False)] = vals
+    out, count = psort.compact_keys(jnp.asarray(keys), 1024)
+    assert int(count) == 700
+    ref = np.sort(vals)
+    assert np.array_equal(np.asarray(out)[:700], ref)
+    assert (np.asarray(out)[700:] == 0xFFFFFFFF).all()
+
+
+def test_compact_keys2_parity(interpret_psort):
+    import jax.numpy as jnp
+
+    from jepsen_tpu.lin import psort
+
+    rng = np.random.default_rng(10)
+    n = 2048
+    hi = rng.integers(0, 1 << 8, n).astype(np.uint32)
+    lo = rng.integers(0, 1 << 16, n).astype(np.uint32)
+    live = rng.random(n) < 0.3
+    # distinct pairs only where live
+    flat = (hi.astype(np.uint64) << 32) | lo
+    _, first_idx = np.unique(flat, return_index=True)
+    keep = np.zeros(n, bool)
+    keep[first_idx] = True
+    live &= keep
+    hi2 = np.where(live, hi, np.uint32(0xFFFFFFFF))
+    lo2 = np.where(live, lo, np.uint32(0xFFFFFFFF))
+    out_hi, out_lo, count = psort.compact_keys2(
+        jnp.asarray(hi2), jnp.asarray(lo2), 1024)
+    k = int(count)
+    assert k == int(live.sum())
+    ref = np.sort(flat[live])
+    got = (np.asarray(out_hi)[:k].astype(np.uint64) << 32) | \
+        np.asarray(out_lo)[:k]
+    assert np.array_equal(got, ref)
+
+
+def test_dedup_cap_contract_enforced(interpret_psort):
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from jepsen_tpu.lin import psort
+
+    keys = jnp.zeros(1024, jnp.uint32)
+    with _pytest.raises(ValueError, match="cap"):
+        psort.dedup_keys(keys, jnp.ones(1024, bool), 4096)

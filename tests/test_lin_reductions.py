@@ -142,6 +142,23 @@ class TestReducedCpuExactness:
         got = bfs.check_packed(p)
         assert got["valid?"] == want["valid?"] is True, (seed, got)
 
+    @pytest.mark.parametrize("seed", range(2))
+    def test_crash_dominance_pair_band_invalid_parity(self, seed):
+        """Invalid-verdict parity on the pair-key dominance band: the
+        corrupted partitioned history must stay invalid AND name the
+        same violating op as the CPU oracle (death-row exactness of the
+        prune on the band partition histories actually use)."""
+        h = synth.generate_partitioned_register_history(
+            100, concurrency=30, seed=seed, partition_every=50,
+            partition_len=15, max_crashes=4)
+        hh = synth.corrupt_history(h, seed=seed + 1)
+        p = prepare.prepare(m.cas_register(), hh)
+        want = cpu.check_packed(p)
+        got = bfs.check_packed(p)
+        assert got["valid?"] == want["valid?"], (seed, got, want)
+        if want["valid?"] is False:
+            assert got["op"] == want["op"], (seed, got, want)
+
     @pytest.mark.parametrize("seed", range(10))
     def test_crash_heavy_register_fuzz(self, seed):
         """The crashed-chain reduction's home turf: many identical
@@ -330,3 +347,55 @@ class TestWideWindowDevice:
         assert got["valid?"] == want["valid?"]
         if want["valid?"] is False:
             assert got["op"] == want["op"]
+
+
+class TestJitLinearization:
+    """The just-in-time linearization gate (bfs.expansion_tables
+    exp_jit/exp_rv): expansions fire only for the returner, its
+    precondition chain, or read absorption. EXACT — fuzzed for verdict
+    and death-row parity against the CPU oracle and the eager device
+    search."""
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_cas_chain_fuzz(self, seed):
+        """cas-heavy histories (long precondition chains) with crashes:
+        the shape where lazy gating could soonest lose a needed
+        excursion."""
+        h = synth.generate_register_history(
+            60, concurrency=8, seed=seed, value_range=4, crash_prob=0.25,
+            max_crashes=6, fs=("cas", "cas", "write", "read"))
+        for hh in (h, synth.corrupt_history(h, seed=seed)):
+            p = prepare.prepare(m.cas_register(), hh)
+            want = cpu.check_packed(p)
+            got = bfs.check_packed(p)
+            assert got["valid?"] == want["valid?"], (seed, got, want)
+            if want["valid?"] is False:
+                assert got["op"] == want["op"]
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_lazy_eager_device_parity(self, seed):
+        """Same verdict with the gate on and off (eager device path)."""
+        h = synth.generate_partitioned_register_history(
+            150, concurrency=20, seed=seed, partition_every=60,
+            partition_len=20, max_crashes=5, value_range=4)
+        for hh in (h, synth.corrupt_history(h, seed=seed + 7)):
+            p = prepare.prepare(m.cas_register(), hh)
+            lazy = bfs.check_packed(p, lazy=True)
+            p2 = prepare.prepare(m.cas_register(), hh)
+            eager = bfs.check_packed(p2, lazy=False)
+            assert lazy["valid?"] == eager["valid?"], (seed, lazy, eager)
+            if eager["valid?"] is False:
+                assert lazy["op"] == eager["op"]
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_wide_window_read_heavy_fuzz(self, seed):
+        """Read-heavy wide windows: the per-config rv clause must keep
+        every read satisfiable."""
+        h = synth.generate_register_history(
+            80, concurrency=16, seed=seed, value_range=3, crash_prob=0.1,
+            max_crashes=4, fs=("read", "read", "write", "cas"))
+        for hh in (h, synth.corrupt_history(h, seed=seed)):
+            p = prepare.prepare(m.cas_register(), hh)
+            want = cpu.check_packed(p)
+            got = bfs.check_packed(p)
+            assert got["valid?"] == want["valid?"], (seed, got, want)
