@@ -1,10 +1,18 @@
 # jepsen_tpu development targets.
 
-.PHONY: test integration integration-local bench
+.PHONY: test test-quick integration integration-local bench
 
 # Unit + parity suite on the virtual 8-device CPU mesh (no cluster).
+# Hardware note: ~8 min on a 4-core box; the compile-heavy lin parity
+# tests make a 1-core box take well over an hour (use test-quick there).
 test:
 	python -m pytest tests/ -q
+
+# Fast tier: the no-XLA-compile tests (history/generator/nemesis math,
+# wire-protocol fakes, suite maps, checkers on hand histories) — about
+# a minute even on one core.
+test-quick:
+	python -m pytest tests/ -q -m quick
 
 # Cluster integration matrix against the dockerized 1-control + 5-node
 # environment: brings the compose cluster up, then runs the per-suite

@@ -8,32 +8,44 @@ with vs_baseline = achieved ops/s over the 100k-in-60s target rate.
 The headline history carries crashed (:info) ops — the frontier-inflating
 case that makes list-based checkers struggle — checked by the dense
 config-space bitmap engine (jepsen_tpu.lin.dense), which crashed ops cost
-nothing extra. Two secondary probes cover BASELINE config 5's band
-(cockroach-class concurrency 30, cockroach.clj:40-41), where the sparse
-engine's exact reductions + dominance pruning decide histories knossos
-DNFs on outright:
+nothing extra. Secondary probes cover BASELINE configs 3-5:
 
+- ``mutex_c30``: lock histories at concurrency 30 (config 3).
 - ``wide_window_c30``: a saturated single-register history at
-  concurrency 30 (window ~26).
-- ``partitioned_c30``: a partition-nemesis history (the literal config-5
-  shape): minority ops crash indeterminate during partitions.
+  concurrency 30 (window ~26) — the class knossos DNFs on.
+- ``independent_keys``: 1k keys' subhistories decided in one vmapped
+  device batch (config 4, independent.clj:246-296).
+- ``partitioned_c30``: the literal config-5 shape — a 100k-op
+  partition-nemesis history, 24 crashed mutators, window 49.
+
+FAULT ISOLATION: every secondary probe runs in its own subprocess
+(``python bench.py --probe KEY``), so a TPU worker crash kills the
+child, not the bench — round 4 lost a known-good probe to the previous
+probe's kernel fault. Probes run safe-first; after a failed probe the
+bench waits out the ~60 s worker restart and verifies recovery with a
+trivial dispatch before the next probe.
 
 Runs on whatever jax.devices() provides (the real TPU chip under the
-driver). Hardened: any failure on the crashed-op history still reports
-the crash-free number with an "error" field instead of a bare nonzero
-exit, so a round never records zero information.
+driver).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 import traceback
 
 N_OPS = 100_000
 TARGET_SECONDS = 60.0
+
+# (key, timeout_seconds) safe-first: the known-dangerous partitioned
+# probe runs LAST so a fault cannot shadow any other number.
+PROBE_ORDER = (("mutex_c30", 600), ("wide_window_c30", 600),
+               ("independent_keys", 900), ("partitioned_c30", 1500))
+WORKER_RESTART_S = 75
 
 
 def _check_timed(history, n_ops):
@@ -85,67 +97,172 @@ def _check_timed(history, n_ops):
         "verdict": r["valid?"], "analyzer": r.get("analyzer")}
 
 
-def _probe(detail: dict, key: str, make_history, n_ops: int,
-           model=None) -> None:
-    """Run one secondary capability probe: warm once (compile), then
-    time. Never fails the bench; records timing or the error."""
-    import traceback
+def _timed_check(make_history, n_ops, model=None):
+    """Warm once (compile), then time one device check. Returns the
+    probe's result dict."""
+    from jepsen_tpu import models as m
+    from jepsen_tpu.lin import device_check_packed, prepare
 
-    try:
-        from jepsen_tpu import models as m
-        from jepsen_tpu.lin import device_check_packed, prepare
-
-        h = make_history()
-        p = prepare.prepare(model if model is not None
-                            else m.cas_register(), h)
-        r = device_check_packed(p)          # warm/compile
-        t0 = time.time()
-        r = device_check_packed(p)
-        dt = time.time() - t0
-        detail[key] = {
-            "n_ops": n_ops, "window": p.window,
-            "crashed": len(p.crashed_ops),
-            "verdict": r.get("valid?"),
-            "analyzer": r.get("analyzer"),
-            "seconds": round(dt, 1),
-            "ops_per_sec": round(n_ops / dt, 1)}
-    except Exception:
-        detail[key] = {"error": traceback.format_exc(limit=2)}
+    h = make_history()
+    p = prepare.prepare(model if model is not None
+                        else m.cas_register(), h)
+    r = device_check_packed(p)          # warm/compile
+    t0 = time.time()
+    r = device_check_packed(p)
+    dt = time.time() - t0
+    return {
+        "n_ops": n_ops, "window": p.window,
+        "crashed": len(p.crashed_ops),
+        "verdict": r.get("valid?"),
+        "analyzer": r.get("analyzer"),
+        "seconds": round(dt, 1),
+        "ops_per_sec": round(n_ops / dt, 1)}
 
 
-def _wide_probes(detail: dict) -> None:
-    """BASELINE config-5 probes (skippable via JEPSEN_TPU_BENCH_WIDE=0).
-    The class where list-based searches — the reference's knossos at
-    cockroach's concurrency, cockroach.clj:40-41 — DNF outright."""
-    if os.environ.get("JEPSEN_TPU_BENCH_WIDE", "1") == "0":
-        return
+def _probe_ping():
+    """Trivial device dispatch: proves the TPU worker is back up."""
+    import jax
+    import jax.numpy as jnp
+
+    x = int(jnp.sum(jnp.arange(8)))
+    return {"ok": x == 28, "platform": jax.devices()[0].platform}
+
+
+def _probe_mutex_c30():
+    from jepsen_tpu import models as m
     from jepsen_tpu.lin import synth
 
-    _probe(detail, "wide_window_c30",
-           lambda: synth.generate_register_history(
-               500, concurrency=30, seed=7, value_range=5,
-               crash_prob=0.002, max_crashes=4), 500)
-    if "error" not in detail.get("wide_window_c30", {}):
-        detail["wide_window_c30"]["note"] = (
-            "adversarial ceiling: fully saturated window-26 schedule, "
-            "denser than the config-5 pacing partitioned_c30 measures")
+    return _timed_check(
+        lambda: synth.generate_mutex_history(
+            5000, concurrency=30, seed=7, crash_prob=0.002,
+            max_crashes=4), 5000, model=m.mutex())
+
+
+def _probe_wide_window_c30():
+    from jepsen_tpu.lin import synth
+
+    r = _timed_check(
+        lambda: synth.generate_register_history(
+            500, concurrency=30, seed=7, value_range=5,
+            crash_prob=0.002, max_crashes=4), 500)
+    r["note"] = ("adversarial ceiling: fully saturated window-26 "
+                 "schedule, denser than the config-5 pacing "
+                 "partitioned_c30 measures")
+    return r
+
+
+def _probe_partitioned_c30():
     # The literal config-5 shape at the reference's staggered pacing
     # (etcd.clj:167-179 staggers invocations; invoke_bias=0.45 models
     # that): 30 processes, partition crashes, ~6-13 live ops in flight,
     # 24 crashed mutators accumulating over ~50 partition cycles
     # (window 49) — at the LITERAL 100k-op size of BASELINE config 5.
-    _probe(detail, "partitioned_c30",
-           lambda: synth.generate_partitioned_register_history(
-               100_000, seed=7, invoke_bias=0.45), 100_000)
-    # BASELINE config 3: lock (Mutex) histories at the same concurrency
-    # (hazelcast.clj:379-386 / zookeeper locks). Contention serializes
-    # the window, so the dense engine absorbs these.
-    from jepsen_tpu import models as m
+    from jepsen_tpu.lin import synth
 
-    _probe(detail, "mutex_c30",
-           lambda: synth.generate_mutex_history(
-               5000, concurrency=30, seed=7, crash_prob=0.002,
-               max_crashes=4), 5000, model=m.mutex())
+    return _timed_check(
+        lambda: synth.generate_partitioned_register_history(
+            100_000, seed=7, invoke_bias=0.45), 100_000)
+
+
+def _probe_independent_keys():
+    """BASELINE config 4: per-key registers decided as ONE vmapped
+    device batch (lin.batched; independent.clj:246-296 checks keys one
+    at a time on the JVM)."""
+    from jepsen_tpu import models as m
+    from jepsen_tpu.lin import batched, synth
+
+    n_keys, ops_per_key = 1000, 100
+    subs = {k: synth.generate_register_history(
+        ops_per_key, concurrency=5, seed=1000 + k, value_range=5,
+        crash_prob=0.002, max_crashes=2) for k in range(n_keys)}
+    model = m.cas_register()
+    r = batched.try_check_batch(model, subs)    # warm/compile
+    if r is None or len(r) < n_keys:
+        raise RuntimeError(
+            f"batch covered {0 if r is None else len(r)}/{n_keys} keys")
+    t0 = time.time()
+    r = batched.try_check_batch(model, subs)
+    dt = time.time() - t0
+    false_keys = sum(1 for v in r.values() if v["valid?"] is False)
+    unknown_keys = sum(1 for v in r.values()
+                       if v["valid?"] not in (True, False))
+    n_ops = n_keys * ops_per_key
+    return {"n_ops": n_ops, "n_keys": n_keys,
+            # All histories are linearizable by construction: any False
+            # is a checker bug, any non-bool an undecided key.
+            "verdict": True if not (false_keys or unknown_keys)
+            else ("unknown" if not false_keys else False),
+            "false_keys": false_keys, "unknown_keys": unknown_keys,
+            "analyzer": next(iter(r.values()))["analyzer"],
+            "seconds": round(dt, 2),
+            "ops_per_sec": round(n_ops / dt, 1)}
+
+
+PROBES = {"ping": _probe_ping, "mutex_c30": _probe_mutex_c30,
+          "wide_window_c30": _probe_wide_window_c30,
+          "partitioned_c30": _probe_partitioned_c30,
+          "independent_keys": _probe_independent_keys}
+
+
+def _run_probe_subprocess(key: str, timeout: int):
+    """Run one probe isolated in a child process; returns its result
+    dict or {"error": ...}. The child prints ONE json line on its last
+    stdout line."""
+    try:
+        cp = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--probe", key],
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"error": f"probe timed out after {timeout}s"}
+    lines = [ln for ln in (cp.stdout or "").splitlines() if ln.strip()]
+    if lines:
+        try:
+            return json.loads(lines[-1])
+        except json.JSONDecodeError:
+            pass
+    tail = ((cp.stderr or "") + (cp.stdout or ""))[-2000:]
+    return {"error": f"probe exited rc={cp.returncode}: {tail}"}
+
+
+def _verify_recovery() -> bool:
+    """After a probe failure (possible worker crash), wait out the
+    restart and prove the chip answers again."""
+    for _ in range(3):
+        time.sleep(WORKER_RESTART_S)
+        r = _run_probe_subprocess("ping", 120)
+        if r.get("ok"):
+            return True
+    return False
+
+
+def _wide_probes(detail: dict) -> None:
+    """BASELINE config 3-5 probes (skippable via JEPSEN_TPU_BENCH_WIDE=0),
+    each in its own subprocess, safe-first (see module docstring)."""
+    if os.environ.get("JEPSEN_TPU_BENCH_WIDE", "1") == "0":
+        return
+    for key, timeout in PROBE_ORDER:
+        r = _run_probe_subprocess(key, timeout)
+        detail[key] = r
+        if "error" in r:
+            # The fault may have killed the worker; recover before the
+            # next probe so one crash cannot shadow later numbers.
+            recovered = _verify_recovery()
+            detail[key]["worker_recovered"] = recovered
+            if not recovered:
+                break
+
+
+def _probe_main(key: str) -> None:
+    from jepsen_tpu.util import enable_compile_cache
+
+    enable_compile_cache()
+    try:
+        r = PROBES[key]()
+    except Exception:
+        r = {"error": traceback.format_exc(limit=4)}
+    print(json.dumps(r))
+    sys.stdout.flush()
+    sys.exit(0)
 
 
 def main() -> None:
@@ -190,4 +307,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--probe":
+        _probe_main(sys.argv[2])
+    else:
+        main()

@@ -43,6 +43,7 @@ even that overflows does the verdict become an honest "unknown"
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -92,6 +93,37 @@ SYNC_CHUNKS = 2
 SPIKE_DROPBACK = 32768
 MAX_DEVICE_WINDOW = 64
 CHUNK = 512
+
+# In-chunk tier ceiling for the pair-key crash-dom band (the 100k
+# partitioned-history class, BASELINE config 5). Both observed fatal
+# shapes put big windowed/pair dedups inside the 512-row nested-while
+# program (bench at cap 131072, probe_r4h at 262144 — round-4 lore);
+# tiers at or below this ceiling are the shapes that ran clean through
+# the first 7k rows of the exact faulting history. Rows needing more
+# overflow OUT of the chunk program into the host-row executor
+# (_host_rows). Env JEPSEN_TPU_TIER_CAP overrides for fault triage.
+CHUNK_TIER_CAP = 16384
+
+# Host-row mode: a blowup row's closure passes run as SINGLE-dispatch
+# programs sequenced from the host — no nested while, no tier switch —
+# so the windowed dominance prune stays engaged at every capacity
+# (dom_force) and the shapes the chunk program kernel-faults on never
+# form. HOST_DOM_MAX_N bounds each pass's candidate array (cap*(1+Mg))
+# so every dedup stays inside the in-VMEM psort kernels; the expansion
+# group width per capacity follows from it.
+HOST_ROW_CAPS = (16384, 65536, 262144)
+HOST_DOM_MAX_N = 1 << 19
+
+
+def _tier_cap() -> int:
+    env = os.environ.get("JEPSEN_TPU_TIER_CAP", "")
+    return int(env) if env else CHUNK_TIER_CAP
+
+
+def _host_mg(cap: int, M: int) -> int:
+    """Expansion-group width for a host-row pass at ``cap``: the widest
+    Mg keeping the candidate array within HOST_DOM_MAX_N."""
+    return max(1, min(M, HOST_DOM_MAX_N // cap - 1))
 
 
 KEY_FILL = jnp.uint32(0xFFFFFFFF)  # pad beyond count; sorts after any config
@@ -167,7 +199,7 @@ def _seg_first(c, start):
 
 
 def _dedup_keys_dom(key, valid, cap, cmask, rmask,
-                    use_psort: bool = False):
+                    use_psort: bool = False, dom_force: bool = False):
     """Sort-dedup with DOMINANCE pruning over crashed-op and read bits.
     ``cmask``/``rmask`` are the key-space masks of this row's crashed
     and pure (read) slots.
@@ -199,7 +231,8 @@ def _dedup_keys_dom(key, valid, cap, cmask, rmask,
     # packed word is a subset" — one sort operand, one subset test.
     w = (key & cmask) | ((~key) & rmask)
     if use_psort and psort.available(n):
-        return psort.dedup_keys_dom(a, w, cmask, rmask, cap)
+        return psort.dedup_keys_dom(a, w, cmask, rmask, cap,
+                                    force_window=dom_force)
     a_s, w_s = lax.sort((a, w), num_keys=2)
     first = jnp.arange(n) == 0
     dup = (a_s == jnp.roll(a_s, 1)) & (w_s == jnp.roll(w_s, 1)) & ~first
@@ -210,7 +243,7 @@ def _dedup_keys_dom(key, valid, cap, cmask, rmask,
     # predecessors at small offsets catch the chain parents the group
     # representative misses.
     idx = jnp.arange(n)
-    for dd in psort.dom_window(n):
+    for dd in psort.dom_window(n, dom_force):
         a_d = jnp.roll(a_s, dd)
         w_d = jnp.roll(w_s, dd)
         dominated = dominated | (
@@ -225,7 +258,8 @@ def _dedup_keys_dom(key, valid, cap, cmask, rmask,
 
 
 def _dedup_keys2_dom(hi, lo, valid, cap, cmask_hi, cmask_lo,
-                     rmask_hi, rmask_lo, use_psort: bool = False):
+                     rmask_hi, rmask_lo, use_psort: bool = False,
+                     dom_force: bool = False):
     """Pair-key twin of _dedup_keys_dom (see there): 4-operand sort by
     (group, dominance-word) pairs, group-representative dominance
     prune, full-key-ascending compaction. Routes to the in-VMEM pallas
@@ -240,7 +274,8 @@ def _dedup_keys2_dom(hi, lo, valid, cap, cmask_hi, cmask_lo,
     w_lo = (lo & cmask_lo) | ((~lo) & rmask_lo)
     if use_psort and psort.available(n):
         return psort.dedup_keys2_dom(a_hi, a_lo, w_hi, w_lo, cmask_hi,
-                                     cmask_lo, rmask_hi, rmask_lo, cap)
+                                     cmask_lo, rmask_hi, rmask_lo, cap,
+                                     force_window=dom_force)
     ah, al, wh, wl = lax.sort((a_hi, a_lo, w_hi, w_lo), num_keys=4)
     first = jnp.arange(n) == 0
 
@@ -254,7 +289,7 @@ def _dedup_keys2_dom(hi, lo, valid, cap, cmask_hi, cmask_lo,
     dominated = ((fh & ~wh) == 0) & ((fl & ~wl) == 0) & \
         ~((wh == fh) & (wl == fl))
     idx = jnp.arange(n)
-    for dd in psort.dom_window(n):
+    for dd in psort.dom_window(n, dom_force):
         ah_d = jnp.roll(ah, dd)
         al_d = jnp.roll(al, dd)
         wh_d = jnp.roll(wh, dd)
@@ -541,12 +576,12 @@ def reduction_bit_tables(p: PackedHistory, nw: int):
 @partial(jax.jit, static_argnames=("cap", "step_fn", "state_bits",
                                    "nil_id", "read_value_match",
                                    "use_psort", "row_tiers", "key_hi",
-                                   "crash_dom"))
+                                   "crash_dom", "max_tier"))
 def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v, pure, pred_bit,
                   bits, state, count, exp_tables=None, *, cap, step_fn,
                   state_bits=None, nil_id=None, read_value_match=False,
                   use_psort=False, row_tiers=True, key_hi=False,
-                  crash_dom=False):
+                  crash_dom=False, max_tier=None):
     """Process up to n_rows return events (tables are CHUNK-row static
     shapes; rows past n_rows are ignored) starting from a carried frontier.
 
@@ -574,7 +609,8 @@ def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v, pure, pred_bit,
             bits, state, count, exp_tables, cap=cap, step_fn=step_fn,
             state_bits=state_bits, nil_id=nil_id,
             read_value_match=read_value_match, use_psort=use_psort,
-            row_tiers=row_tiers, key_hi=key_hi, crash_dom=crash_dom)
+            row_tiers=row_tiers, key_hi=key_hi, crash_dom=crash_dom,
+            max_tier=max_tier)
     C, W = active.shape
     nw = bits.shape[1]
 
@@ -772,7 +808,8 @@ def _closure_pass_keys(keys_in, count, act, f_row, v_row, pure_row,
 
 def _closure_pass_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
                                exp, *, cap, W, b, nil_id, step_fn,
-                               use_psort=False, crash_dom=False):
+                               use_psort=False, crash_dom=False,
+                               dom_force=False):
     """ONE closure pass over packed key configs with mutator-compacted
     expansion columns (bfs.expansion_tables): semantically identical to
     _closure_pass_keys for the read-value-match register family (fuzzed
@@ -869,7 +906,8 @@ def _closure_pass_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
         if crash_dom:
             h2, l2, n2, o2 = _dedup_keys2_dom(
                 cand_hi, cand_lo, cand_valid, cap, crash_hi, crash_lo,
-                read_hi, read_lo, use_psort=use_psort)
+                read_hi, read_lo, use_psort=use_psort,
+                dom_force=dom_force)
         else:
             h2, l2, n2, o2 = _dedup_keys2(cand_hi, cand_lo, cand_valid,
                                           cap, use_psort=use_psort)
@@ -878,7 +916,8 @@ def _closure_pass_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
         return l2, h2, n2, changed, o2
     if crash_dom:
         l2, n2, o2 = _dedup_keys_dom(cand_lo, cand_valid, cap, crash_lo,
-                                     read_lo, use_psort=use_psort)
+                                     read_lo, use_psort=use_psort,
+                                     dom_force=dom_force)
     else:
         l2, n2, o2 = _dedup_keys(cand_lo, cand_valid, cap,
                                  use_psort=use_psort)
@@ -971,7 +1010,7 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
                        exp_tables=None, *, cap, step_fn,
                        state_bits, nil_id, read_value_match=False,
                        use_psort=False, row_tiers=True, key_hi=False,
-                       crash_dom=False):
+                       crash_dom=False, max_tier=None):
     """Packed-key row loop (see _search_chunk): each config is ONE
     uint32 (bits << state_bits | state id) — or an (lo, hi) u32 pair
     when ``key_hi`` (windows up to 60+state bits; the cockroach-class
@@ -991,7 +1030,13 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
     # register band and the generic packed band (mutex — BASELINE
     # config 3's lock histories) both tier.
     tiered = row_tiers
-    tiers = tuple(t for t in ROW_TIERS if t < cap) + (cap,) \
+    # ``max_tier`` caps the in-chunk ladder BELOW the frontier capacity:
+    # rows needing bigger tiers overflow to the host-row executor
+    # (_host_rows) instead of running the big windowed-dominance dedups
+    # inside this nested-while program — the shapes that kernel-fault
+    # the axon runtime on the 100k partitioned history (round-4 lore).
+    top = cap if max_tier is None else min(cap, max_tier)
+    tiers = tuple(t for t in ROW_TIERS if t < top) + (top,) \
         if tiered else (cap,)
 
     def row_at_tier(tier, r, lo, hi, count):
@@ -1092,23 +1137,38 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
 
     def row_body(carry):
         r, lo, hi, count, dead, ovf = carry
-        if len(tiers) == 1:
-            l2, h2, n2, dead, o2 = row_at_tier(cap, r, lo, hi, count)
-        else:
+
+        def run_row():
+            if len(tiers) == 1:
+                return row_at_tier(tiers[0], r, lo, hi, count)
             # Smallest tier holding TIER_MARGIN x the live count; a
             # mid-row overflow escalates straight to the top tier (the
             # row is functional, so the retry is exact).
             idx = jnp.int32(0)
             for t in tiers[:-1]:
                 idx = idx + (count * TIER_MARGIN > t).astype(jnp.int32)
-            l2, h2, n2, dead, o2 = lax.switch(
+            l2, h2, n2, d2, o2 = lax.switch(
                 idx, [partial(row_at_tier, t) for t in tiers],
                 r, lo, hi, count)
             need_top = o2 & (idx < len(tiers) - 1)
-            l2, h2, n2, dead, o2 = lax.cond(
+            return lax.cond(
                 need_top,
-                lambda: row_at_tier(cap, r, lo, hi, count),
-                lambda: (l2, h2, n2, dead, o2))
+                lambda: row_at_tier(tiers[-1], r, lo, hi, count),
+                lambda: (l2, h2, n2, d2, o2))
+
+        if tiers[-1] < cap:
+            # Tier-capped band: an entry frontier bigger than the top
+            # tier cannot run in-chunk at all — flag overflow with the
+            # frontier untouched and let the host-row executor own the
+            # row (slicing it to the tier would silently drop live
+            # configs: verdict-flipping).
+            l2, h2, n2, dead, o2 = lax.cond(
+                count > tiers[-1],
+                lambda: (lo, hi, count, jnp.bool_(False),
+                         jnp.bool_(True)),
+                run_row)
+        else:
+            l2, h2, n2, dead, o2 = run_row()
         return (r + 1, l2, h2, n2, dead, ovf | o2)
 
     def row_cond(carry):
@@ -1229,6 +1289,198 @@ def _spike_rows(p, r0, bits, state, count, *, tables_h, caps, dropback,
     return bits, state, int(count), r, False, False, False, top_used
 
 
+@partial(jax.jit, static_argnames=("cap", "W", "b", "nil_id", "step_fn",
+                                   "use_psort", "crash_dom"))
+def _host_closure_pass(lo, hi, count, act, v_row, pure_row, exp_r, *,
+                       cap, W, b, nil_id, step_fn, use_psort,
+                       crash_dom):
+    """One host-dispatched closure pass (see _host_rows): exactly
+    _closure_pass_keys_compact with the dominance window FORCED on
+    regardless of dedup size — safe here because the dedup is the whole
+    program, not a stage of a nested-while chunk."""
+    l2, h2, n2, changed, ovf = _closure_pass_keys_compact(
+        lo, hi, count, act, v_row, pure_row, exp_r, cap=cap, W=W, b=b,
+        nil_id=nil_id, step_fn=step_fn, use_psort=use_psort,
+        crash_dom=crash_dom, dom_force=True)
+    return l2, h2, n2, jnp.stack([changed.astype(jnp.int32),
+                                  ovf.astype(jnp.int32)])
+
+
+@partial(jax.jit, static_argnames=("cap", "b", "use_psort", "key_hi"))
+def _host_filter_pass(lo, hi, count, s, *, cap, b, use_psort, key_hi):
+    """Host-dispatched return-event filter (see _host_rows)."""
+    if key_hi:
+        lo, hi, count, _ = _filter_pass_keys2(lo, hi, count, s, cap=cap,
+                                              b=b, use_psort=use_psort)
+    else:
+        lo, count, _ = _filter_pass_keys(lo, count, s, cap=cap, b=b,
+                                         use_psort=use_psort)
+    return lo, hi, count
+
+
+@partial(jax.jit, static_argnames=("cap", "b", "nil_id", "key_hi"))
+def _host_pack(bits, state, count, *, cap, b, nil_id, key_hi):
+    if key_hi:
+        return _pack_frontier_keys2(bits, state, count, cap, b, nil_id)
+    return _pack_frontier_keys(bits, state, count, cap, b, nil_id), None
+
+
+@partial(jax.jit, static_argnames=("cap", "b", "nil_id", "nw", "key_hi"))
+def _host_unpack(lo, hi, count, *, cap, b, nil_id, nw, key_hi):
+    if key_hi:
+        return _unpack_frontier_keys2(lo, hi, count, cap, b, nil_id, nw)
+    return _unpack_frontier_keys(lo, count, cap, b, nil_id)
+
+
+def _fit_keys(lo, hi, cap):
+    """Grow (KEY_FILL pad) or shrink (prefix slice — live keys are a
+    compacted ascending prefix; caller guarantees count <= cap) key
+    arrays to ``cap``."""
+    n = lo.shape[0]
+    if n < cap:
+        pad = jnp.full(cap - n, KEY_FILL, jnp.uint32)
+        return (jnp.concatenate([lo, pad]),
+                None if hi is None else jnp.concatenate([hi, pad]))
+    if n > cap:
+        return lo[:cap], None if hi is None else hi[:cap]
+    return lo, hi
+
+
+def _exp_group(exp_h, r, g, mg):
+    """Group ``g``'s Mg-column slice of row ``r``'s expansion tables
+    (host-side numpy; zero-padded — padding columns have exp_act False,
+    so they are inert). Per-row scalars (the crash/read masks) pass
+    through unsliced."""
+    out = []
+    for t in exp_h:
+        tr = t[r]
+        if np.ndim(tr) >= 1:
+            sl = tr[g * mg:(g + 1) * mg]
+            if sl.shape[0] < mg:
+                sl = np.concatenate(
+                    [sl, np.zeros((mg - sl.shape[0],) + sl.shape[1:],
+                                  tr.dtype)])
+            out.append(jnp.asarray(sl))
+        else:
+            out.append(jnp.asarray(tr))
+    return tuple(out)
+
+
+def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
+               dropback, step_fn, state_bits, nil_id, use_psort,
+               key_hi, crash_dom, cancel, snapshots,
+               min_rows: int = 2):
+    """Host-sequenced row mode for the compact register band's blowup
+    rows (the crashed-subset waves of BASELINE config 5's partition
+    histories). Each closure pass — expand one Mg-column group, then
+    the windowed-dominance dedup — is its OWN device dispatch, with the
+    host driving the group cycle, fixpoint detection, and capacity
+    escalation. The nested-while chunk program kernel-faults the axon
+    runtime on exactly these shapes (round-4 lore: bench at cap 131072
+    and probe_r4h at 262144 both died in the wave chunk), while the
+    same dedups run clean standalone; host sequencing also keeps the
+    dominance window engaged at EVERY capacity (psort dom_force), which
+    is what collapses the wave (rep-only pruning leaves 389k configs;
+    rep+window converges to ~14k). ~100 ms tunnel sync per pass — only
+    rows whose frontiers outgrow the chunked tiers ever come here.
+
+    Same contract as _spike_rows: returns (bits, state, count_int,
+    next_row, dead, overflowed, cancelled, top_cap_used)."""
+    ret_slot_h, active_h, _slot_f_h, slot_v_h, pure_h, _pred = tables_h
+    b = state_bits
+    W = p.window
+    nw = (W + 31) // 32
+    M = exp_h[0].shape[-1]
+    count_i = int(count)
+    top_used = caps[0]
+
+    def lvl_for(c):
+        for i, cc in enumerate(caps):
+            if c * TIER_MARGIN <= cc:
+                return i
+        return len(caps) - 1
+
+    def unpack(lo, hi, cnt, cap):
+        return _host_unpack(lo, hi, cnt, cap=cap, b=b, nil_id=nil_id,
+                            nw=nw, key_hi=key_hi)
+
+    if count_i > caps[-1]:
+        return bits, state, count_i, r0, False, True, False, top_used
+    lvl = lvl_for(count_i)
+    cap = caps[lvl]
+    lo, hi = _host_pack(bits, state, jnp.int32(count_i), cap=cap, b=b,
+                        nil_id=nil_id, key_hi=key_hi)
+    count = jnp.int32(count_i)
+    r = r0
+    while r < p.R:
+        if cancel is not None and cancel.is_set():
+            bits, state = unpack(lo, hi, count, cap)
+            return (bits, state, count_i, r, False, False, True,
+                    top_used)
+        if snapshots is not None:
+            sb, ss = unpack(lo, hi, count, cap)
+            snapshots[:] = [(r, sb, ss, count)]
+        act = jnp.asarray(active_h[r])
+        v_row = jnp.asarray(slot_v_h[r])
+        pure_row = jnp.asarray(pure_h[r])
+        entry = (lo, hi, count, lvl)
+        while True:  # closure fixpoint, escalating capacity on overflow
+            cap = caps[lvl]
+            top_used = max(top_used, cap)
+            mg = _host_mg(cap, M)
+            G = -(-M // mg)
+            lo, hi = _fit_keys(lo, hi, cap)
+            g = since = 0
+            ovf = False
+            while since < G:
+                exp_r = _exp_group(exp_h, r, g, mg)
+                lo, hi, count, flags = _host_closure_pass(
+                    lo, hi, count, act, v_row, pure_row, exp_r,
+                    cap=cap, W=W, b=b, nil_id=nil_id, step_fn=step_fn,
+                    use_psort=use_psort, crash_dom=crash_dom)
+                ch, ov = (int(x) for x in np.asarray(flags))
+                if os.environ.get("JEPSEN_TPU_HOST_DEBUG") == "1":
+                    print(f"[host] r={r} cap={cap} g={g}/{G} "
+                          f"since={since} count={int(count)} "
+                          f"ch={ch} ov={ov}", flush=True)
+                if ov:
+                    ovf = True
+                    break
+                since = 0 if ch else since + 1
+                g = (g + 1) % G
+            if not ovf:
+                break
+            if lvl + 1 >= len(caps):
+                # Overflow of the last host cap: hand back the row's
+                # ENTRY frontier (the escalation restart point — the
+                # mid-pass arrays are truncated) as an honest failure.
+                # Unpack at the entry arrays' OWN size: entry lvl is
+                # the level selected for the row, which can exceed the
+                # arrays' cap when the previous row finished smaller.
+                e_lo, e_hi, e_count, _ = entry
+                bits, state = unpack(e_lo, e_hi, e_count,
+                                     e_lo.shape[0])
+                return (bits, state, int(e_count), r, False, True,
+                        False, top_used)
+            lo, hi, count, _ = entry
+            lvl += 1
+        lo, hi, count = _host_filter_pass(
+            lo, hi, count, jnp.int32(int(ret_slot_h[r])), cap=cap, b=b,
+            use_psort=use_psort, key_hi=key_hi)
+        count_i = int(count)
+        r += 1
+        if count_i == 0:
+            # Dead at row r-1; the explain snapshot is anchored at its
+            # entry frontier (set above), spanning ONE row of replay.
+            bits, state = unpack(lo, hi, count, cap)
+            return bits, state, 0, r, True, False, False, top_used
+        if r - r0 >= min_rows and count_i <= dropback:
+            break
+        lvl = lvl_for(count_i)
+    bits, state = unpack(lo, hi, count, cap)
+    return bits, state, count_i, r, False, False, False, top_used
+
+
 def _pack_frontier_keys(bits, state, count, cap, b, nil_id):
     """THE packed-key encoding — ``bits << b | state-id`` with NIL
     remapped to nil_id, KEY_FILL past count, padded/sliced to ``cap``.
@@ -1347,7 +1599,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                  spike_caps=SPIKE_CAP_SCHEDULE,
                  spike_dropback: int = SPIKE_DROPBACK,
                  packed_keys: bool | None = None,
-                 lazy: bool = True) -> dict:
+                 lazy: bool = True, host_caps=HOST_ROW_CAPS) -> dict:
     """Decide linearizability of a packed history on device.
 
     Host loop over CHUNK-row device dispatches; the frontier carries
@@ -1429,6 +1681,11 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                 cap_schedule = PACKED_CAP_SCHEDULE[-1:]
             else:
                 cap_schedule = PACKED_CAP_SCHEDULE
+    # Pair-key crash-dom band (the 100k partitioned class): cap the
+    # in-chunk tier ladder so the big windowed dedup shapes never form
+    # inside the nested-while program (they kernel-fault the axon
+    # runtime); blowup rows overflow to the host-row executor instead.
+    max_tier = _tier_cap() if (key_hi and crash_dom) else None
     level = 0
     cap = cap_schedule[level]
     bits = jnp.zeros((cap, nw), jnp.uint32)
@@ -1476,7 +1733,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                     state_bits=state_bits, nil_id=nil_id,
                     read_value_match=read_value_match,
                     use_psort=use_psort, key_hi=key_hi,
-                    crash_dom=crash_dom)
+                    crash_dom=crash_dom, max_tier=max_tier)
                 flags.append(jnp.stack((ovf.astype(jnp.int32),
                                         dead.astype(jnp.int32), c2)))
                 bits, state, count = b2, s2, c2
@@ -1510,15 +1767,34 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                 cap=cap_schedule[level], step_fn=step_fn,
                 state_bits=state_bits, nil_id=nil_id,
                 read_value_match=read_value_match, use_psort=use_psort,
-                key_hi=key_hi, crash_dom=crash_dom)
+                key_hi=key_hi, crash_dom=crash_dom, max_tier=max_tier)
             if not bool(ovf):
                 break
-            if level + 1 >= len(cap_schedule):
-                # Spike caps must strictly exceed the chunked top cap:
-                # a smaller cap would silently drop live frontier
-                # configs — verdict-flipping. The multiword ladder is
-                # additionally memory-bounded (fat states).
-                if state_bits is None:
+            # With a tier cap, a bigger chunk cap cannot grow the
+            # effective tier ladder (tiers top out at max_tier and
+            # every dedup/filter bounds count by it), so retrying the
+            # chunk at the next level is provably futile — skip the
+            # redundant dispatch (and its 15-70 s compile) and route
+            # straight past the chunked engine.
+            no_grow = max_tier is not None \
+                and level + 1 < len(cap_schedule) \
+                and min(cap_schedule[level + 1], max_tier) \
+                == min(cap_schedule[level], max_tier)
+            if level + 1 >= len(cap_schedule) or no_grow:
+                # Route past the chunked engine. The compact crash-dom
+                # band goes to the HOST-ROW executor (its waves need
+                # the dominance window at every capacity, which only
+                # single-dispatch programs can carry safely on this
+                # runtime); other bands go to the spike executor.
+                host_mode = exp_h is not None and crash_dom
+                if host_mode:
+                    sp_caps = host_caps
+                elif state_bits is None:
+                    # Spike caps must strictly exceed the chunked top
+                    # cap: a smaller cap would silently drop live
+                    # frontier configs — verdict-flipping. The multiword
+                    # ladder is additionally memory-bounded (fat
+                    # states).
                     sp_caps = _mw_spike_caps(p.window, nw, S,
                                              cap_schedule[-1], spike_caps)
                 else:
@@ -1547,24 +1823,40 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                         state_bits=state_bits, nil_id=nil_id,
                         read_value_match=read_value_match,
                         use_psort=use_psort, key_hi=key_hi,
-                        crash_dom=crash_dom)
+                        crash_dom=crash_dom, max_tier=max_tier)
                     if not bool(o_pre):
                         bits, state, count = b2, s2, c2
                     else:
                         n_pre = 0  # extremely rare: spike at first row
-                # Dropback clamped so the handed-back frontier always
-                # fits the chunked engine's top cap.
-                spiked = _spike_rows(
-                    p, base + n_pre, bits, state, count,
-                    tables_h=(ret_slot_h, active_h, slot_f_h, slot_v_h,
-                              pure_h, pred_bit_h),
-                    caps=sp_caps,
-                    dropback=min(spike_dropback, cap_schedule[-1]),
-                    step_fn=step_fn, state_bits=state_bits,
-                    nil_id=nil_id, read_value_match=read_value_match,
-                    cancel=cancel, snapshots=snapshots,
-                    use_psort=use_psort, exp_h=exp_h, key_hi=key_hi,
-                    crash_dom=crash_dom)
+                if host_mode:
+                    # Dropback clamped so the handed-back frontier fits
+                    # the capped in-chunk tiers with selection margin.
+                    hdrop = min(spike_dropback,
+                                (max_tier or cap_schedule[-1])
+                                // TIER_MARGIN)
+                    spiked = _host_rows(
+                        p, base + n_pre, bits, state, count,
+                        tables_h=(ret_slot_h, active_h, slot_f_h,
+                                  slot_v_h, pure_h, pred_bit_h),
+                        exp_h=exp_h, caps=sp_caps, dropback=hdrop,
+                        step_fn=step_fn, state_bits=state_bits,
+                        nil_id=nil_id, use_psort=use_psort,
+                        key_hi=key_hi, crash_dom=crash_dom,
+                        cancel=cancel, snapshots=snapshots)
+                else:
+                    # Dropback clamped so the handed-back frontier
+                    # always fits the chunked engine's top cap.
+                    spiked = _spike_rows(
+                        p, base + n_pre, bits, state, count,
+                        tables_h=(ret_slot_h, active_h, slot_f_h,
+                                  slot_v_h, pure_h, pred_bit_h),
+                        caps=sp_caps,
+                        dropback=min(spike_dropback, cap_schedule[-1]),
+                        step_fn=step_fn, state_bits=state_bits,
+                        nil_id=nil_id, read_value_match=read_value_match,
+                        cancel=cancel, snapshots=snapshots,
+                        use_psort=use_psort, exp_h=exp_h, key_hi=key_hi,
+                        crash_dom=crash_dom)
                 spike_top = sp_caps[-1]
                 break
             # Retry this chunk from its entry frontier at the next cap.
@@ -1603,8 +1895,15 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                 # chunks run clean.
                 level = len(cap_schedule) - 1
                 cap = cap_schedule[level]
-                bits = s_bits[:cap]
-                state = s_state[:cap]
+                # Spike hands back oversized arrays (slice); host-row
+                # mode may hand back smaller ones (pad).
+                if s_bits.shape[0] >= cap:
+                    bits = s_bits[:cap]
+                    state = s_state[:cap]
+                else:
+                    g = cap - s_bits.shape[0]
+                    bits = jnp.pad(s_bits, ((0, g), (0, 0)))
+                    state = jnp.pad(s_state, ((0, g), (0, 0)))
                 count = jnp.int32(count_i)
                 base = next_r
                 continue

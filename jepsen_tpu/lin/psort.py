@@ -74,12 +74,15 @@ DOM_WINDOW = (1, 2)
 DOM_WINDOW_MAX_N = 1 << 18
 
 
-def dom_window(n: int) -> tuple:
+def dom_window(n: int, force: bool = False) -> tuple:
     """The dominance window for an n-element dedup (empty past the
     size gate — see DOM_WINDOW). ``JEPSEN_TPU_DOM_WINDOW`` overrides:
     ``0`` disables the window entirely (the fault-triage escape
     hatch), any other integer replaces the max-pad EXPONENT (default
-    log2(DOM_WINDOW_MAX_N))."""
+    log2(DOM_WINDOW_MAX_N)). ``force`` skips the size gate (not the
+    env kill switch): host-sequenced single-pass dispatches keep the
+    window engaged at capacities where the nested-while chunk programs
+    fault (bfs._host_rows)."""
     env = os.environ.get("JEPSEN_TPU_DOM_WINDOW", "")
     if env == "0":
         return ()
@@ -87,6 +90,8 @@ def dom_window(n: int) -> tuple:
     if ":" in env:
         env, k = env.split(":")
         k = int(k)
+    if force:
+        return DOM_WINDOW[:k]
     max_n = (1 << int(env)) if env else DOM_WINDOW_MAX_N
     return DOM_WINDOW[:k] if pad_size(n) <= max_n else ()
 
@@ -314,7 +319,7 @@ def _flat_prev(x, d, S):
 
 
 def _dedup_dom_body(masks_ref, a_ref, w_ref, out_ref, total_ref,
-                    *, S, K):
+                    *, S, K, force=False):
     """Sort (group-part, dominance-word) pairs, drop duplicates and
     dominated entries (see bfs._dedup_keys_dom: the word packs crashed
     bits as-is and read bits complemented, so dominance is a single
@@ -344,7 +349,7 @@ def _dedup_dom_body(masks_ref, a_ref, w_ref, out_ref, total_ref,
         done = done | _flat_prev(done, d, S)
         d <<= 1
     dominated = ((f & ~w) == 0) & (w != f)
-    for dd in dom_window(S * LANE):
+    for dd in dom_window(S * LANE, force):
         a_d = _flat_prev(a, dd, S)
         w_d = _flat_prev(w, dd, S)
         dominated = dominated | (
@@ -358,13 +363,13 @@ def _dedup_dom_body(masks_ref, a_ref, w_ref, out_ref, total_ref,
     out_ref[:] = _bitonic_sort(full, flat, lane, S=S, K=K)
 
 
-@partial(jax.jit, static_argnames=("n_pad",))
-def _dedup_dom_call(a, w, cmask, rmask, n_pad):
+@partial(jax.jit, static_argnames=("n_pad", "force"))
+def _dedup_dom_call(a, w, cmask, rmask, n_pad, force=False):
     S = n_pad // LANE
     K = n_pad.bit_length() - 1
     masks = jnp.stack([cmask, rmask]).astype(jnp.uint32)
     out, total = pl.pallas_call(
-        partial(_dedup_dom_body, S=S, K=K),
+        partial(_dedup_dom_body, S=S, K=K, force=force),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
                   pl.BlockSpec(memory_space=pltpu.VMEM),
                   pl.BlockSpec(memory_space=pltpu.VMEM)],
@@ -380,7 +385,7 @@ def _dedup_dom_call(a, w, cmask, rmask, n_pad):
     return out.reshape(-1), total[0]
 
 
-def dedup_keys_dom(a, w, cmask, rmask, cap):
+def dedup_keys_dom(a, w, cmask, rmask, cap, force_window=False):
     """In-VMEM twin of the lax path in ``bfs._dedup_keys_dom``. ``a`` is
     the group part (mutator bits + state) with the invalid flag already
     in bit 31; ``w`` the packed dominance word (crashed bits | inverted
@@ -393,7 +398,8 @@ def dedup_keys_dom(a, w, cmask, rmask, cap):
         pad = jnp.full(n_pad - n, KEY_FILL, jnp.uint32)
         a = jnp.concatenate([a, pad])
         w = jnp.concatenate([w, jnp.zeros(n_pad - n, jnp.uint32)])
-    out, total = _dedup_dom_call(a, w, cmask, rmask, n_pad)
+    out, total = _dedup_dom_call(a, w, cmask, rmask, n_pad,
+                                 force=force_window)
     if out.shape[0] > cap:
         out = out[:cap]
     return out, jnp.minimum(total, cap), total > cap
@@ -435,7 +441,8 @@ def _bitonic_sort4(a, b, c, d, flat, *, S, K):
 
 
 def _dedup2_dom_body(masks_ref, a_hi_ref, a_lo_ref, w_hi_ref, w_lo_ref,
-                     out_hi_ref, out_lo_ref, total_ref, *, S, K):
+                     out_hi_ref, out_lo_ref, total_ref, *, S, K,
+                     force=False):
     """Pair-key twin of _dedup_dom_body (see bfs._dedup_keys2_dom): sort
     by (group pair, dominance-word pair), drop duplicates and dominated
     entries, emit recombined full keys ascending by (hi, lo). masks_ref
@@ -473,7 +480,7 @@ def _dedup2_dom_body(masks_ref, a_hi_ref, a_lo_ref, w_hi_ref, w_lo_ref,
         d <<= 1
     dominated = ((fh & ~w_hi) == 0) & ((fl & ~w_lo) == 0) & \
         ~((w_hi == fh) & (w_lo == fl))
-    for dd in dom_window(S * LANE):
+    for dd in dom_window(S * LANE, force):
         ah_d = _flat_prev(a_hi, dd, S)
         al_d = _flat_prev(a_lo, dd, S)
         wh_d = _flat_prev(w_hi, dd, S)
@@ -496,12 +503,12 @@ def _dedup2_dom_body(masks_ref, a_hi_ref, a_lo_ref, w_hi_ref, w_lo_ref,
                                                   flat, S=S, K=K)
 
 
-@partial(jax.jit, static_argnames=("n_pad",))
-def _dedup2_dom_call(a_hi, a_lo, w_hi, w_lo, masks, n_pad):
+@partial(jax.jit, static_argnames=("n_pad", "force"))
+def _dedup2_dom_call(a_hi, a_lo, w_hi, w_lo, masks, n_pad, force=False):
     S = n_pad // LANE
     K = n_pad.bit_length() - 1
     out_hi, out_lo, total = pl.pallas_call(
-        partial(_dedup2_dom_body, S=S, K=K),
+        partial(_dedup2_dom_body, S=S, K=K, force=force),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
         + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 4,
         out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
@@ -520,7 +527,7 @@ def _dedup2_dom_call(a_hi, a_lo, w_hi, w_lo, masks, n_pad):
 
 
 def dedup_keys2_dom(a_hi, a_lo, w_hi, w_lo, cmask_hi, cmask_lo,
-                    rmask_hi, rmask_lo, cap):
+                    rmask_hi, rmask_lo, cap, force_window=False):
     """In-VMEM twin of the lax path in ``bfs._dedup_keys2_dom``. ``a``
     pair carries group bits (invalid flag already in a_hi bit 31), ``w``
     pair the packed dominance words. Returns (hi[cap], lo[cap], count,
@@ -538,7 +545,8 @@ def dedup_keys2_dom(a_hi, a_lo, w_hi, w_lo, cmask_hi, cmask_lo,
     masks = jnp.stack([cmask_hi, cmask_lo, rmask_hi, rmask_lo]) \
         .astype(jnp.uint32)
     out_hi, out_lo, total = _dedup2_dom_call(a_hi, a_lo, w_hi, w_lo,
-                                             masks, n_pad)
+                                             masks, n_pad,
+                                             force=force_window)
     if out_hi.shape[0] > cap:
         out_hi = out_hi[:cap]
         out_lo = out_lo[:cap]

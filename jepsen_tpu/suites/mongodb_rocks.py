@@ -3,9 +3,11 @@
 
 The reference's one performance-focused suite (:163): generate document
 insert load, no safety checker beyond the perf graphs. DB install swaps
-mongod's storage engine to RocksDB. The Mongo wire protocol (OP_MSG)
-needs a driver, so the client is gated; no-cluster runs drive the
-workload fake and still exercise the latency/rate graph pipeline.
+mongod's storage engine to RocksDB. Non-fake runs drive the real wire
+client (jepsen_tpu.suites.mongowire.TableClient — OP_MSG + from-scratch
+BSON; fake-server-tested in tests/test_mongowire.py); ``--fake`` runs
+keep the workload fake and still exercise the latency/rate graph
+pipeline.
 """
 
 from __future__ import annotations

@@ -12,6 +12,10 @@ from collections import deque
 from jepsen_tpu.history import Op
 from jepsen_tpu.suites.amqpwire import (AmqpClient, MutexClient,
                                         QueueClient)
+import pytest
+
+# Quick tier: no XLA compiles (make test-quick / pytest -m quick).
+pytestmark = pytest.mark.quick
 
 FRAME_END = 0xCE
 

@@ -7,6 +7,10 @@ import jepsen_tpu.history as h
 from jepsen_tpu import codec, models, report, repl, store
 from jepsen_tpu.lin import analysis
 from jepsen_tpu.lin import report as lin_report
+import pytest
+
+# Quick tier: no XLA compiles (make test-quick / pytest -m quick).
+pytestmark = pytest.mark.quick
 
 
 class TestCodec:

@@ -9,6 +9,9 @@ import pytest
 
 from jepsen_tpu import cli
 
+# Quick tier: no XLA compiles (make test-quick / pytest -m quick).
+pytestmark = pytest.mark.quick
+
 
 class TestConcurrency:
     def test_plain(self):

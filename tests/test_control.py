@@ -12,6 +12,9 @@ import pytest
 from jepsen_tpu import control as c
 from jepsen_tpu.control import util as cu
 
+# Quick tier: no XLA compiles (make test-quick / pytest -m quick).
+pytestmark = pytest.mark.quick
+
 
 class TestEscape:
     def test_plain(self):

@@ -12,6 +12,9 @@ import pytest
 from jepsen_tpu.history import Op
 from jepsen_tpu.suites import crate
 
+# Quick tier: no XLA compiles (make test-quick / pytest -m quick).
+pytestmark = pytest.mark.quick
+
 
 class FakeCrate:
     """Tiny CrateDB: tables of rows with `_version`, dup-key errors,

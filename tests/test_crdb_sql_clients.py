@@ -16,6 +16,9 @@ from jepsen_tpu.history import Op
 from jepsen_tpu.suites import cockroachdb as cr
 from jepsen_tpu.suites import workloads
 
+# Quick tier: no XLA compiles (make test-quick / pytest -m quick).
+pytestmark = pytest.mark.quick
+
 
 class MiniCrdb:
     """Single-lock serializable mini SQL engine for the statements the

@@ -11,6 +11,9 @@ import pytest
 from jepsen_tpu.history import Op
 from jepsen_tpu.suites import elasticsearch as es
 
+# Quick tier: no XLA compiles (make test-quick / pytest -m quick).
+pytestmark = pytest.mark.quick
+
 
 class TestChecker:
     def test_valid(self):

@@ -8,6 +8,10 @@ import time
 
 from jepsen_tpu import generator as g
 from jepsen_tpu.history import Op
+import pytest
+
+# Quick tier: no XLA compiles (make test-quick / pytest -m quick).
+pytestmark = pytest.mark.quick
 
 TEST = {"concurrency": 3, "nodes": ["n1", "n2", "n3"]}
 

@@ -14,6 +14,10 @@ from jepsen_tpu.suites import hazelwire
 from jepsen_tpu.suites.hazelwire import (HazelcastClient, IdClient,
                                          LockClient, QueueClient,
                                          SetClient)
+import pytest
+
+# Quick tier: no XLA compiles (make test-quick / pytest -m quick).
+pytestmark = pytest.mark.quick
 
 HEADER = 22
 

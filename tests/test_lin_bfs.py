@@ -60,20 +60,32 @@ class TestBasics:
         assert both(m.cas_register(), h, cap_schedule=(1, 4096))
 
     def test_overflow_returns_unknown(self):
-        # With the spike executor's caps also exhausted, overflow is an
-        # honest unknown (never a truncated-frontier verdict).
+        # With the spike/host executors' caps also exhausted, overflow
+        # is an honest unknown (never a truncated-frontier verdict).
         h = synth.generate_register_history(30, concurrency=5, seed=1,
                                             crash_prob=0.3)
         p = prepare.prepare(m.cas_register(), h)
-        r = bfs.check_packed(p, cap_schedule=(1,), spike_caps=(2,))
+        r = bfs.check_packed(p, cap_schedule=(1,), spike_caps=(2,),
+                             host_caps=(2,))
         assert r["valid?"] == "unknown"
         assert "exceeded" in r["error"]
 
     def test_overflow_spills_to_spike_executor(self):
-        # Chunked caps exhausted -> the host-driven spike executor picks
-        # the search up at bigger caps and still decides.
+        # Chunked caps exhausted -> the host-driven executors (host-row
+        # mode for this crash-heavy register band) pick the search up
+        # at bigger caps and still decide.
         h = synth.generate_register_history(30, concurrency=5, seed=1,
                                             crash_prob=0.3)
+        p = prepare.prepare(m.cas_register(), h)
+        want = cpu.check_packed(p)["valid?"]
+        r = bfs.check_packed(p, cap_schedule=(1,), spike_caps=(512, 4096))
+        assert r["valid?"] == want
+
+    def test_overflow_spills_to_host_rows_crash_free_spike(self):
+        # A crash-FREE compact-band history keeps the spike executor
+        # (host mode only owns crash-dom searches).
+        h = synth.generate_register_history(30, concurrency=5, seed=1,
+                                            crash_prob=0)
         p = prepare.prepare(m.cas_register(), h)
         want = cpu.check_packed(p)["valid?"]
         r = bfs.check_packed(p, cap_schedule=(1,), spike_caps=(512, 4096))

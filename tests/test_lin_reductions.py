@@ -317,6 +317,68 @@ class TestWideWindowDevice:
                              spike_caps=(1024, 16384), spike_dropback=4)
         assert r["valid?"] == want
 
+    @pytest.mark.parametrize("seed", range(3))
+    def test_host_row_mode_parity(self, seed):
+        """Host-row executor parity (single-key crash-dom band): tiny
+        chunked caps force every breathing row through the
+        host-sequenced single-pass dispatches (bfs._host_rows) with the
+        dominance window forced on at every capacity."""
+        h = synth.generate_register_history(
+            60, concurrency=6, seed=seed, value_range=3, crash_prob=0.3,
+            max_crashes=8)
+        for hh in (h, synth.corrupt_history(h, seed=seed)):
+            p = prepare.prepare(m.cas_register(), hh)
+            want = cpu.check_packed(p)
+            got = bfs.check_packed(p, cap_schedule=(8,),
+                                   host_caps=(64, 4096))
+            assert got["valid?"] == want["valid?"], (seed, got, want)
+            if want["valid?"] is False:
+                assert got["op"] == want["op"]
+
+    @pytest.mark.parametrize("seed", range(2))
+    def test_host_row_mode_pair_band_parity(self, seed):
+        """Host-row executor on the pair-key crash-dom band — the 100k
+        partitioned class's exact shape, scaled down."""
+        h = synth.generate_partitioned_register_history(
+            100, concurrency=30, seed=seed, partition_every=50,
+            partition_len=15, max_crashes=4)
+        for hh in (h, synth.corrupt_history(h, seed=seed + 3)):
+            p = prepare.prepare(m.cas_register(), hh)
+            want = cpu.check_packed(p)
+            got = bfs.check_packed(p, cap_schedule=(8,),
+                                   host_caps=(64, 4096))
+            assert got["valid?"] == want["valid?"], (seed, got, want)
+            if want["valid?"] is False:
+                assert got["op"] == want["op"]
+
+    def test_host_row_mode_overflow_unknown(self):
+        """Host caps exhausted mid-wave: honest unknown, never a
+        truncated-frontier verdict."""
+        h = synth.generate_register_history(
+            60, concurrency=6, seed=1, value_range=3, crash_prob=0.3,
+            max_crashes=8)
+        p = prepare.prepare(m.cas_register(), h)
+        r = bfs.check_packed(p, cap_schedule=(2,), host_caps=(4,))
+        assert r["valid?"] == "unknown"
+        assert "exceeded" in r["error"]
+
+    def test_explain_through_host_row_death(self):
+        """A death decided inside host-row mode must still produce
+        final-paths via the dead row's entry snapshot."""
+        h = synth.corrupt_history(
+            synth.generate_register_history(120, concurrency=8, seed=4,
+                                            value_range=3,
+                                            crash_prob=0.2,
+                                            max_crashes=6), seed=4)
+        p = prepare.prepare(m.cas_register(), h)
+        want = cpu.check_packed(p)
+        got = bfs.check_packed(p, cap_schedule=(2,),
+                               host_caps=(64, 4096), explain=True)
+        assert got["valid?"] == want["valid?"]
+        if want["valid?"] is False:
+            assert got["op"] == want["op"]
+            assert got["final-paths"], got
+
     def test_explain_through_spike_death(self):
         # A death decided inside spike mode must still produce
         # final-paths, via the dead ROW's entry snapshot (bounded

@@ -20,6 +20,9 @@ from jepsen_tpu.suites.mongowire import (BankClient, DocumentCasClient,
                                          TableClient, bson_decode,
                                          bson_encode)
 
+# Quick tier: no XLA compiles (make test-quick / pytest -m quick).
+pytestmark = pytest.mark.quick
+
 OP_QUERY = 2004
 OP_REPLY = 1
 OP_MSG = 2013

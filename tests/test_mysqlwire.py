@@ -18,6 +18,9 @@ import pytest
 
 from jepsen_tpu.suites.mysqlwire import MyClient, MyError, _scramble
 
+# Quick tier: no XLA compiles (make test-quick / pytest -m quick).
+pytestmark = pytest.mark.quick
+
 PASSWORD = "s3cret"
 NONCE = bytes(range(1, 21))          # 20-byte challenge
 

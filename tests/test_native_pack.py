@@ -9,6 +9,9 @@ from jepsen_tpu import native_ext
 from jepsen_tpu.history import History, invoke_op, ok_op, info_op
 from jepsen_tpu.lin import prepare, synth
 
+# Quick tier: no XLA compiles (make test-quick / pytest -m quick).
+pytestmark = pytest.mark.quick
+
 needs_native = pytest.mark.skipif(
     not native_ext.available(), reason="native library unavailable")
 

@@ -11,6 +11,9 @@ from jepsen_tpu import tests_support as ts
 from jepsen_tpu.history import Op
 from jepsen_tpu.util import majority
 
+# Quick tier: no XLA compiles (make test-quick / pytest -m quick).
+pytestmark = pytest.mark.quick
+
 NODES = ["n1", "n2", "n3", "n4", "n5"]
 
 

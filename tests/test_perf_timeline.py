@@ -7,6 +7,10 @@ from jepsen_tpu import checker as c
 from jepsen_tpu.checker import perf_graphs as perf
 from jepsen_tpu.checker import timeline
 from jepsen_tpu.history import Op, invoke_op, ok_op
+import pytest
+
+# Quick tier: no XLA compiles (make test-quick / pytest -m quick).
+pytestmark = pytest.mark.quick
 
 
 def test_bucket_points():

@@ -11,6 +11,10 @@ import threading
 
 from jepsen_tpu.history import Op
 from jepsen_tpu.suites import aerowire, rethinkwire
+import pytest
+
+# Quick tier: no XLA compiles (make test-quick / pytest -m quick).
+pytestmark = pytest.mark.quick
 
 # --- fake rethinkdb --------------------------------------------------------
 

@@ -20,6 +20,9 @@ from jepsen_tpu import adya, core
 from jepsen_tpu import suites
 from jepsen_tpu.suites import common, workloads
 
+# Quick tier: no XLA compiles (make test-quick / pytest -m quick).
+pytestmark = pytest.mark.quick
+
 
 def run_fake(test_map: dict) -> dict:
     test_map["name"] = None  # no store writes from unit tests

@@ -13,6 +13,9 @@ import pytest
 from jepsen_tpu.suites.zkwire import (ZBADVERSION, ZNONODE, ZkClient,
                                       ZkError, ZkRegisterClient)
 
+# Quick tier: no XLA compiles (make test-quick / pytest -m quick).
+pytestmark = pytest.mark.quick
+
 # --- fake ZooKeeper server ---------------------------------------------------
 
 
