@@ -95,24 +95,42 @@ MAX_DEVICE_WINDOW = 64
 CHUNK = 512
 
 # In-chunk tier ceiling for the pair-key crash-dom band (the 100k
-# partitioned-history class, BASELINE config 5). Both observed fatal
-# shapes put big windowed/pair dedups inside the 512-row nested-while
-# program (bench at cap 131072, probe_r4h at 262144 — round-4 lore);
-# tiers at or below this ceiling are the shapes that ran clean through
-# the first 7k rows of the exact faulting history. Rows needing more
-# overflow OUT of the chunk program into the host-row executor
-# (_host_rows). Env JEPSEN_TPU_TIER_CAP overrides for fault triage.
-CHUNK_TIER_CAP = 16384
+# partitioned-history class, BASELINE config 5). Round-5 probes on the
+# exact faulting history discriminated the fault: the GROUP-CYCLING
+# closure path (G > 1 — the lax.dynamic_slice expansion-group subpass
+# machinery inside the nested while) kernel-faults the axon worker at
+# the first partition wave (chunk 1536, G=17 at tier 16384), while the
+# same pad-2^18 windowed quad dedups run clean in-chunk when UNGROUPED
+# (G=1) and clean standalone at any pad to 2^19. At or below this tier
+# the DOM_WINDOW_MAX_N grouping bound gives Mg >= 63 >= M for every
+# pair-band history (M <= W <= 57), so in-chunk closure is always
+# ungrouped; rows needing more overflow OUT of the chunk program into
+# the host-row executor (_host_rows), whose grouping is host-sequenced
+# numpy slicing — no in-program slice path exists there.
+# Env JEPSEN_TPU_TIER_CAP overrides for fault triage.
+CHUNK_TIER_CAP = 65536
 
 # Host-row mode: a blowup row's closure passes run as SINGLE-dispatch
 # programs sequenced from the host — no nested while, no tier switch —
-# so the windowed dominance prune stays engaged at every capacity
-# (dom_force) and the shapes the chunk program kernel-faults on never
-# form. HOST_DOM_MAX_N bounds each pass's candidate array (cap*(1+Mg))
-# so every dedup stays inside the in-VMEM psort kernels; the expansion
-# group width per capacity follows from it.
-HOST_ROW_CAPS = (16384, 65536, 262144)
-HOST_DOM_MAX_N = 1 << 19
+# with the dominance window engaged at every capacity (dom_force) and
+# the expansion UNGROUPED (all M columns per pass). Ungrouped matters
+# for termination, not just shape: with grouped passes the frontier is
+# a function of (input, group) and can enter a period-G orbit under
+# the content-dependent windowed prune (observed live: count
+# oscillating 4124<->4110 forever at row 1579 of the 100k partitioned
+# history) — which inside a nested lax.while_loop is an infinite loop
+# the runtime kills, i.e. the very "kernel fault" that blocked this
+# class. Ungrouped passes make the frontier a deterministic function
+# of itself alone, so the changed-vs-input fixpoint terminates.
+HOST_ROW_CAPS = (4096, 16384, 65536, 262144, 524288)
+
+# The crash-dom band's in-chunk candidate bound (tier*(1+Mg)): large
+# enough that closure stays UNGROUPED (G=1) at every tier up to
+# CHUNK_TIER_CAP for any window (Mg >= M always) — grouping is the
+# nontermination hazard, and the band's dominance dedups force the lax
+# chain path regardless of size, so no psort/window size gate applies.
+# Env JEPSEN_TPU_CAND_MAX overrides for fault triage.
+CHUNK_CAND_MAX = 1 << 22
 
 
 def _tier_cap() -> int:
@@ -120,10 +138,9 @@ def _tier_cap() -> int:
     return int(env) if env else CHUNK_TIER_CAP
 
 
-def _host_mg(cap: int, M: int) -> int:
-    """Expansion-group width for a host-row pass at ``cap``: the widest
-    Mg keeping the candidate array within HOST_DOM_MAX_N."""
-    return max(1, min(M, HOST_DOM_MAX_N // cap - 1))
+def _cand_max() -> int:
+    env = os.environ.get("JEPSEN_TPU_CAND_MAX", "")
+    return int(env) if env else CHUNK_CAND_MAX
 
 
 KEY_FILL = jnp.uint32(0xFFFFFFFF)  # pad beyond count; sorts after any config
@@ -199,7 +216,8 @@ def _seg_first(c, start):
 
 
 def _dedup_keys_dom(key, valid, cap, cmask, rmask,
-                    use_psort: bool = False, dom_force: bool = False):
+                    use_psort: bool = False, dom_force: bool = False,
+                    dom_iters: int = 1):
     """Sort-dedup with DOMINANCE pruning over crashed-op and read bits.
     ``cmask``/``rmask`` are the key-space masks of this row's crashed
     and pure (read) slots.
@@ -235,22 +253,47 @@ def _dedup_keys_dom(key, valid, cap, cmask, rmask,
                                     force_window=dom_force)
     a_s, w_s = lax.sort((a, w), num_keys=2)
     first = jnp.arange(n) == 0
-    dup = (a_s == jnp.roll(a_s, 1)) & (w_s == jnp.roll(w_s, 1)) & ~first
-    start = first | (a_s != jnp.roll(a_s, 1))
-    f = _seg_first(w_s, start)
-    dominated = ((f & ~w_s) == 0) & (w_s != f)
-    # Windowed pairwise (psort.DOM_WINDOW): a subset sorts earlier, so
-    # predecessors at small offsets catch the chain parents the group
-    # representative misses.
     idx = jnp.arange(n)
-    for dd in psort.dom_window(n, dom_force):
-        a_d = jnp.roll(a_s, dd)
-        w_d = jnp.roll(w_s, dd)
-        dominated = dominated | (
-            (idx >= dd) & (a_d == a_s) & ((w_d & ~w_s) == 0)
-            & (w_d != w_s))
-    keep = (a_s >> 31 == 0) & ~dup & ~dominated
-    total = jnp.sum(keep.astype(jnp.int32))
+    total = jnp.int32(0)
+    keep = first
+    for round_ in range(max(1, dom_iters if dom_force else 1)):
+        if round_:
+            # Compact survivors (order-preserving) so distant
+            # dominators become chain-reachable — see _dedup_keys2_dom.
+            fill = jnp.uint32(KEY_FILL)
+            a_s = jnp.where(keep, a_s, fill)
+            w_s = jnp.where(keep, w_s, fill)
+            a_s, w_s = lax.sort((a_s, w_s), num_keys=2)
+        dup = (a_s == jnp.roll(a_s, 1)) & (w_s == jnp.roll(w_s, 1)) \
+            & ~first
+        start = first | (a_s != jnp.roll(a_s, 1))
+        f = _seg_first(w_s, start)
+        dominated = ((f & ~w_s) == 0) & (w_s != f)
+        # Windowed pairwise (psort.DOM_WINDOW): a subset sorts earlier,
+        # so predecessors at small offsets catch the chain parents the
+        # group representative misses.
+        for dd in psort.dom_window(n, dom_force):
+            a_d = jnp.roll(a_s, dd)
+            w_d = jnp.roll(w_s, dd)
+            dominated = dominated | (
+                (idx >= dd) & (a_d == a_s) & ((w_d & ~w_s) == 0)
+                & (w_d != w_s))
+        if dom_force:
+            # Chain scan over distances 1..DOM_CHAIN (psort.DOM_CHAIN):
+            # loop-carried roll, exact predicate at every span.
+            def chain_body(i, c):
+                ra, rw, dom = c
+                ra = jnp.roll(ra, 1)
+                rw = jnp.roll(rw, 1)
+                dom = dom | ((idx >= i) & (ra == a_s)
+                             & ((rw & ~w_s) == 0) & (rw != w_s))
+                return ra, rw, dom
+
+            _, _, dominated = lax.fori_loop(
+                1, psort.DOM_CHAIN + 1, chain_body,
+                (a_s, w_s, dominated))
+        keep = (a_s >> 31 == 0) & ~dup & ~dominated
+        total = jnp.sum(keep.astype(jnp.int32))
     overflow = total > cap
     full = (a_s & 0x7FFFFFFF) | (w_s & cmask) | ((~w_s) & rmask)
     out = lax.sort(jnp.where(keep, full, KEY_FILL))
@@ -259,11 +302,17 @@ def _dedup_keys_dom(key, valid, cap, cmask, rmask,
 
 def _dedup_keys2_dom(hi, lo, valid, cap, cmask_hi, cmask_lo,
                      rmask_hi, rmask_lo, use_psort: bool = False,
-                     dom_force: bool = False):
+                     dom_force: bool = False, dom_iters: int = 1):
     """Pair-key twin of _dedup_keys_dom (see there): 4-operand sort by
     (group, dominance-word) pairs, group-representative dominance
     prune, full-key-ascending compaction. Routes to the in-VMEM pallas
-    quad kernel when sized for it. Returns (hi[cap], lo[cap], count,
+    quad kernel when sized for it. With ``dom_force`` the prune also
+    runs the chain scan, ITERATED ``dom_iters`` times: each round
+    compacts survivors (preserving sort order), so previously-distant
+    dominators become chain-reachable — iterated rounds approach the
+    true antichain where one round is span-limited (measured on the
+    100k partitioned history's mid-waves: one round leaves 500k+ live,
+    overflowing every capacity). Returns (hi[cap], lo[cap], count,
     overflow)."""
     n = hi.shape[0]
     g_hi = ~(cmask_hi | rmask_hi)
@@ -278,28 +327,58 @@ def _dedup_keys2_dom(hi, lo, valid, cap, cmask_hi, cmask_lo,
                                      force_window=dom_force)
     ah, al, wh, wl = lax.sort((a_hi, a_lo, w_hi, w_lo), num_keys=4)
     first = jnp.arange(n) == 0
+    idx = jnp.arange(n)
 
     def eqp(x):
         return x == jnp.roll(x, 1)
 
-    dup = eqp(ah) & eqp(al) & eqp(wh) & eqp(wl) & ~first
-    start = first | ~(eqp(ah) & eqp(al))
-    fh = _seg_first(wh, start)
-    fl = _seg_first(wl, start)
-    dominated = ((fh & ~wh) == 0) & ((fl & ~wl) == 0) & \
-        ~((wh == fh) & (wl == fl))
-    idx = jnp.arange(n)
-    for dd in psort.dom_window(n, dom_force):
-        ah_d = jnp.roll(ah, dd)
-        al_d = jnp.roll(al, dd)
-        wh_d = jnp.roll(wh, dd)
-        wl_d = jnp.roll(wl, dd)
-        dominated = dominated | (
-            (idx >= dd) & (ah_d == ah) & (al_d == al)
-            & ((wh_d & ~wh) == 0) & ((wl_d & ~wl) == 0)
-            & ~((wh_d == wh) & (wl_d == wl)))
-    keep = (ah >> 31 == 0) & ~dup & ~dominated
-    total = jnp.sum(keep.astype(jnp.int32))
+    total = jnp.int32(0)
+    keep = first
+    for round_ in range(max(1, dom_iters if dom_force else 1)):
+        if round_:
+            # Compact survivors to a sorted prefix: masking to KEY_FILL
+            # (invalid flag set) and re-sorting preserves the 4-word
+            # lexicographic order among the living.
+            fill = jnp.uint32(KEY_FILL)
+            ah = jnp.where(keep, ah, fill)
+            al = jnp.where(keep, al, fill)
+            wh = jnp.where(keep, wh, fill)
+            wl = jnp.where(keep, wl, fill)
+            ah, al, wh, wl = lax.sort((ah, al, wh, wl), num_keys=4)
+        dup = eqp(ah) & eqp(al) & eqp(wh) & eqp(wl) & ~first
+        start = first | ~(eqp(ah) & eqp(al))
+        fh = _seg_first(wh, start)
+        fl = _seg_first(wl, start)
+        dominated = ((fh & ~wh) == 0) & ((fl & ~wl) == 0) & \
+            ~((wh == fh) & (wl == fl))
+        for dd in psort.dom_window(n, dom_force):
+            ah_d = jnp.roll(ah, dd)
+            al_d = jnp.roll(al, dd)
+            wh_d = jnp.roll(wh, dd)
+            wl_d = jnp.roll(wl, dd)
+            dominated = dominated | (
+                (idx >= dd) & (ah_d == ah) & (al_d == al)
+                & ((wh_d & ~wh) == 0) & ((wl_d & ~wl) == 0)
+                & ~((wh_d == wh) & (wl_d == wl)))
+        if dom_force:
+            # Chain scan over distances 1..DOM_CHAIN (psort.DOM_CHAIN).
+            def chain_body(i, c):
+                rah, ral, rwh, rwl, dom = c
+                rah = jnp.roll(rah, 1)
+                ral = jnp.roll(ral, 1)
+                rwh = jnp.roll(rwh, 1)
+                rwl = jnp.roll(rwl, 1)
+                dom = dom | (
+                    (idx >= i) & (rah == ah) & (ral == al)
+                    & ((rwh & ~wh) == 0) & ((rwl & ~wl) == 0)
+                    & ~((rwh == wh) & (rwl == wl)))
+                return rah, ral, rwh, rwl, dom
+
+            _, _, _, _, dominated = lax.fori_loop(
+                1, psort.DOM_CHAIN + 1, chain_body, (ah, al, wh, wl,
+                                                     dominated))
+        keep = (ah >> 31 == 0) & ~dup & ~dominated
+        total = jnp.sum(keep.astype(jnp.int32))
     overflow = total > cap
     out_hi = jnp.where(
         keep, (ah & 0x7FFFFFFF) | (wh & cmask_hi) | ((~wh) & rmask_hi),
@@ -809,7 +888,7 @@ def _closure_pass_keys(keys_in, count, act, f_row, v_row, pure_row,
 def _closure_pass_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
                                exp, *, cap, W, b, nil_id, step_fn,
                                use_psort=False, crash_dom=False,
-                               dom_force=False):
+                               dom_iters=2):
     """ONE closure pass over packed key configs with mutator-compacted
     expansion columns (bfs.expansion_tables): semantically identical to
     _closure_pass_keys for the read-value-match register family (fuzzed
@@ -904,10 +983,18 @@ def _closure_pass_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
         cand_hi = jnp.concatenate([jnp.where(cfg_valid, hi1, 0),
                                    new_hi.reshape(-1)])
         if crash_dom:
+            # Dominance dedups always take the LAX path with the full
+            # window + chain scan (dom_force): the chain catches
+            # dominators at EVERY offset up to DOM_CHAIN where the
+            # static power-of-two window tests exact offsets only —
+            # without it the per-row crashed-subset transients
+            # (entry frontiers of 3-51 configs ballooning past the top
+            # tier) trip the host executor every ~40 rows. Mosaic
+            # cannot legalize the chain in the psort kernels.
             h2, l2, n2, o2 = _dedup_keys2_dom(
                 cand_hi, cand_lo, cand_valid, cap, crash_hi, crash_lo,
-                read_hi, read_lo, use_psort=use_psort,
-                dom_force=dom_force)
+                read_hi, read_lo, use_psort=False, dom_force=True,
+                dom_iters=dom_iters)
         else:
             h2, l2, n2, o2 = _dedup_keys2(cand_hi, cand_lo, cand_valid,
                                           cap, use_psort=use_psort)
@@ -915,9 +1002,11 @@ def _closure_pass_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
             (n2 != count)
         return l2, h2, n2, changed, o2
     if crash_dom:
+        # Lax + chain always — see the pair-key branch above.
         l2, n2, o2 = _dedup_keys_dom(cand_lo, cand_valid, cap, crash_lo,
-                                     read_lo, use_psort=use_psort,
-                                     dom_force=dom_force)
+                                     read_lo, use_psort=False,
+                                     dom_force=True,
+                                     dom_iters=dom_iters)
     else:
         l2, n2, o2 = _dedup_keys(cand_lo, cand_valid, cap,
                                  use_psort=use_psort)
@@ -1065,20 +1154,37 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
 
         if exp_tables is not None:
             M_cols = exp_tables[0].shape[-1]
-            Mg = max(1, psort.DOM_WINDOW_MAX_N // tier - 1)
+            # Candidate bound: the crash-dom pair band must keep every
+            # in-chunk dedup within CHUNK_CAND_MAX (see there); other
+            # bands group only to keep the dominance window engaged.
+            cand_bound = _cand_max() if (crash_dom and key_hi) \
+                else psort.DOM_WINDOW_MAX_N
+            Mg = max(1, cand_bound // tier - 1)
             G = -(-M_cols // Mg) if Mg < M_cols else 1
             Mg = min(Mg, M_cols)
         else:
             G = 1
 
+        # Closure-iteration ceiling: the windowed dominance prune is
+        # content-sensitive, so a GROUPED closure (frontier a function
+        # of input AND group) can enter a period-G orbit that never
+        # meets the G-consecutive-unchanged fixpoint — inside this
+        # lax.while_loop that is an infinite loop the runtime watchdog
+        # kills (the round-4/5 "kernel faults" on the partitioned
+        # class). Legitimate convergence needs O(G * window) passes;
+        # exhaustion beyond the ceiling flags OVERFLOW — sound: the
+        # row re-runs in the host executor, whose ungrouped passes
+        # terminate.
+        it_max = G * (W + 4) + 8
+
         def closure_cond(c):
-            return (c[-2] < G) & ~c[-1]
+            return (c[-3] < G) & ~c[-1]
 
         def closure_body(c):
             if key_hi:
-                lo_in, hi_in, count, g, since, ovf = c
+                lo_in, hi_in, count, g, since, it, ovf = c
             else:
-                lo_in, count, g, since, ovf = c
+                lo_in, count, g, since, it, ovf = c
                 hi_in = None
             if exp_tables is not None:
                 exp_r = []
@@ -1106,22 +1212,23 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
                 h2 = None
             g2 = jnp.where(g + 1 >= G, 0, g + 1)
             since2 = jnp.where(changed, jnp.int32(0), since + 1)
+            o3 = ovf | o2 | (it + 1 >= it_max)
             if key_hi:
-                return (l2, h2, n2, g2, since2, ovf | o2)
-            return (l2, n2, g2, since2, ovf | o2)
+                return (l2, h2, n2, g2, since2, it + 1, o3)
+            return (l2, n2, g2, since2, it + 1, o3)
 
         if key_hi:
             init = (l_t, h_t, count, jnp.int32(0), jnp.int32(0),
-                    jnp.bool_(False))
-            l_t, h_t, count, _, _, ovf = lax.while_loop(
+                    jnp.int32(0), jnp.bool_(False))
+            l_t, h_t, count, _, _, _, ovf = lax.while_loop(
                 closure_cond, closure_body, init)
             l_t, h_t, count, dead = _filter_pass_keys2(
                 l_t, h_t, count, ret_slot[r], cap=tier, b=b,
                 use_psort=use_psort)
         else:
             init = (l_t, count, jnp.int32(0), jnp.int32(0),
-                    jnp.bool_(False))
-            l_t, count, _, _, ovf = lax.while_loop(
+                    jnp.int32(0), jnp.bool_(False))
+            l_t, count, _, _, _, ovf = lax.while_loop(
                 closure_cond, closure_body, init)
             l_t, count, dead = _filter_pass_keys(
                 l_t, count, ret_slot[r], cap=tier, b=b,
@@ -1295,13 +1402,17 @@ def _host_closure_pass(lo, hi, count, act, v_row, pure_row, exp_r, *,
                        cap, W, b, nil_id, step_fn, use_psort,
                        crash_dom):
     """One host-dispatched closure pass (see _host_rows): exactly
-    _closure_pass_keys_compact with the dominance window FORCED on
-    regardless of dedup size — safe here because the dedup is the whole
-    program, not a stage of a nested-while chunk."""
+    _closure_pass_keys_compact with the dominance window + chain scan
+    FORCED on regardless of dedup size — safe here because the dedup is
+    the whole program, not a stage of a nested-while chunk. Always the
+    LAX dedup path: Mosaic cannot legalize the chain scan in the psort
+    kernels (see psort.DOM_CHAIN), and at a ~100 ms host sync per pass
+    the in-VMEM kernels' advantage is noise."""
+    del use_psort
     l2, h2, n2, changed, ovf = _closure_pass_keys_compact(
         lo, hi, count, act, v_row, pure_row, exp_r, cap=cap, W=W, b=b,
-        nil_id=nil_id, step_fn=step_fn, use_psort=use_psort,
-        crash_dom=crash_dom, dom_force=True)
+        nil_id=nil_id, step_fn=step_fn, use_psort=False,
+        crash_dom=crash_dom, dom_iters=6)
     return l2, h2, n2, jnp.stack([changed.astype(jnp.int32),
                                   ovf.astype(jnp.int32)])
 
@@ -1346,26 +1457,6 @@ def _fit_keys(lo, hi, cap):
     return lo, hi
 
 
-def _exp_group(exp_h, r, g, mg):
-    """Group ``g``'s Mg-column slice of row ``r``'s expansion tables
-    (host-side numpy; zero-padded — padding columns have exp_act False,
-    so they are inert). Per-row scalars (the crash/read masks) pass
-    through unsliced."""
-    out = []
-    for t in exp_h:
-        tr = t[r]
-        if np.ndim(tr) >= 1:
-            sl = tr[g * mg:(g + 1) * mg]
-            if sl.shape[0] < mg:
-                sl = np.concatenate(
-                    [sl, np.zeros((mg - sl.shape[0],) + sl.shape[1:],
-                                  tr.dtype)])
-            out.append(jnp.asarray(sl))
-        else:
-            out.append(jnp.asarray(tr))
-    return tuple(out)
-
-
 def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
                dropback, step_fn, state_bits, nil_id, use_psort,
                key_hi, crash_dom, cancel, snapshots,
@@ -1390,7 +1481,6 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
     b = state_bits
     W = p.window
     nw = (W + 31) // 32
-    M = exp_h[0].shape[-1]
     count_i = int(count)
     top_used = caps[0]
 
@@ -1423,31 +1513,34 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
         act = jnp.asarray(active_h[r])
         v_row = jnp.asarray(slot_v_h[r])
         pure_row = jnp.asarray(pure_h[r])
+        exp_r = tuple(jnp.asarray(t[r]) for t in exp_h)
         entry = (lo, hi, count, lvl)
+        # Pass budget per (row, capacity): ungrouped convergence needs
+        # O(window) passes; exhaustion escalates like an overflow
+        # (sound — the row restarts from its entry frontier).
+        it_max = 4 * W + 16
         while True:  # closure fixpoint, escalating capacity on overflow
             cap = caps[lvl]
             top_used = max(top_used, cap)
-            mg = _host_mg(cap, M)
-            G = -(-M // mg)
             lo, hi = _fit_keys(lo, hi, cap)
-            g = since = 0
+            it = 0
             ovf = False
-            while since < G:
-                exp_r = _exp_group(exp_h, r, g, mg)
+            while True:
                 lo, hi, count, flags = _host_closure_pass(
                     lo, hi, count, act, v_row, pure_row, exp_r,
                     cap=cap, W=W, b=b, nil_id=nil_id, step_fn=step_fn,
                     use_psort=use_psort, crash_dom=crash_dom)
                 ch, ov = (int(x) for x in np.asarray(flags))
+                it += 1
                 if os.environ.get("JEPSEN_TPU_HOST_DEBUG") == "1":
-                    print(f"[host] r={r} cap={cap} g={g}/{G} "
-                          f"since={since} count={int(count)} "
-                          f"ch={ch} ov={ov}", flush=True)
-                if ov:
+                    print(f"[host] r={r} cap={cap} it={it} "
+                          f"count={int(count)} ch={ch} ov={ov}",
+                          flush=True)
+                if ov or it >= it_max:
                     ovf = True
                     break
-                since = 0 if ch else since + 1
-                g = (g + 1) % G
+                if not ch:
+                    break
             if not ovf:
                 break
             if lvl + 1 >= len(caps):
@@ -1681,11 +1774,18 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                 cap_schedule = PACKED_CAP_SCHEDULE[-1:]
             else:
                 cap_schedule = PACKED_CAP_SCHEDULE
-    # Pair-key crash-dom band (the 100k partitioned class): cap the
-    # in-chunk tier ladder so the big windowed dedup shapes never form
-    # inside the nested-while program (they kernel-fault the axon
-    # runtime); blowup rows overflow to the host-row executor instead.
-    max_tier = _tier_cap() if (key_hi and crash_dom) else None
+    # Crash-dom compact bands (the partitioned class, both key widths):
+    # cap the in-chunk tier ladder so the group-cycled closure (whose
+    # windowed prune can orbit instead of converging — see
+    # CHUNK_TIER_CAP) never runs inside the nested-while program;
+    # blowup rows overflow to the host-row executor instead.
+    max_tier = _tier_cap() if (exp_h is not None and crash_dom) else None
+    if max_tier is not None and cap_schedule in (PACKED_CAP_SCHEDULE,
+                                                 PACKED_CAP_SCHEDULE[-1:]):
+        # Counts never exceed the tier cap in this band, so the chunk
+        # cap only needs selection margin over it: smaller carry
+        # arrays, cheaper per-chunk fixed costs.
+        cap_schedule = (TIER_MARGIN * max_tier,)
     level = 0
     cap = cap_schedule[level]
     bits = jnp.zeros((cap, nw), jnp.uint32)
@@ -1709,6 +1809,16 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     base = 0
     deferred = snapshots is None
     classic_until = -1
+    _dbg = os.environ.get("JEPSEN_TPU_HOST_DEBUG") == "1"
+    if _dbg:
+        import time as _time
+        _t0 = _time.time()
+
+        def _dlog(msg):
+            print(f"[chunk +{_time.time()-_t0:7.1f}s] {msg}", flush=True)
+    else:
+        def _dlog(msg):
+            pass
     while base < p.R:
         if deferred and base >= classic_until:
             # Optimistic fast path: dispatch a batch of chunks without
@@ -1741,6 +1851,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             fl = np.asarray(jnp.stack(flags))   # ONE transfer per batch
             if not fl[:, :2].any():
                 cnt = int(fl[-1, 2])
+                _dlog(f"fast batch -> base {base} count {cnt}")
                 while level > 0 and \
                         cnt * 4 <= cap_schedule[level - 1]:
                     level -= 1
@@ -1751,6 +1862,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             classic_until = base
             bits, state, count, level, base = entry
             cap = cap_schedule[level]
+            _dlog(f"fast batch TRIPPED -> replay from {base}")
         if snapshots is not None:
             # only the last snapshot is ever replayed (the dead row is
             # always inside the current chunk): keep HBM flat
@@ -1816,6 +1928,8 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                 # r_done-1), so spike mode starts at the spike, not at
                 # chunk entry.
                 n_pre = int(r_done) - 1
+                _dlog(f"chunk {base} OVF at row {base + max(n_pre, 0)}"
+                      f" -> recovery")
                 if n_pre > 0:
                     b2, s2, c2, _, _, o_pre = _search_chunk(
                         jnp.int32(n_pre), *tables, bits, state, count,
@@ -1828,6 +1942,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                         bits, state, count = b2, s2, c2
                     else:
                         n_pre = 0  # extremely rare: spike at first row
+                _dlog(f"recovered; host/spike from {base + n_pre}")
                 if host_mode:
                     # Dropback clamped so the handed-back frontier fits
                     # the capped in-chunk tiers with selection margin.
@@ -1895,6 +2010,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                 # chunks run clean.
                 level = len(cap_schedule) - 1
                 cap = cap_schedule[level]
+                _dlog(f"resume chunks at {next_r} count {count_i}")
                 # Spike hands back oversized arrays (slice); host-row
                 # mode may hand back smaller ones (pad).
                 if s_bits.shape[0] >= cap:

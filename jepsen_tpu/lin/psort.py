@@ -65,13 +65,28 @@ KEY_FILL = 0xFFFFFFFF     # plain int: used inside kernels as a literal
 # while the pallas kernels with the window ran clean to 2^18. Pruning
 # less at the rare big-tier dedups is sound; the small-tier dedups
 # that run every pass keep the frontier collapsed.
-# Two distances, not more: 4+ distances at pad 2^16+ kernel-fault the
-# axon worker inside the chunk program (probed on the 100k partitioned
-# history's wave chunk; 2 distances at 2^18 run clean), and offline
-# simulation of the wave shows iterated (1,2)+rep converges to 14.4k
-# configs vs 9.9k for 8 distances — the extra distances buy little.
-DOM_WINDOW = (1, 2)
+# Eight power-of-two distances: round 4 capped this at (1, 2) after
+# in-chunk probes with more distances kernel-faulted — but those probes
+# ran GROUPED chunk programs, whose real failure was the group-cycle
+# fixpoint orbit (see bfs.CHUNK_TIER_CAP); round 5's ungrouped chunks
+# carry the full static window cleanly, and the wider span is what
+# keeps the partitioned class's sustained crashed-subset frontier
+# collapsed in-chunk (measured: (1,2)+rep leaves 130k live configs on
+# the wave where chained pruning holds ~30k).
+DOM_WINDOW = (1, 2, 4, 8, 16, 32, 64, 128)
 DOM_WINDOW_MAX_N = 1 << 18
+# Forced-window (host-row) dedups additionally run a CHAIN scan: a
+# fori_loop carrying a consecutively-shifted copy tests every
+# predecessor at distances 1..DOM_CHAIN, so in-group dominance pairs up
+# to that span are caught (the static DOM_WINDOW misses all but the
+# nearest — measured on the 100k partitioned history's wave, rep+(1,2)
+# converge to 130k live configs where the true antichain is ~9k). The
+# loop-carried shift keeps the program tiny regardless of span. Mosaic
+# cannot legalize the scan inside the pallas kernels, so forced dedups
+# take the LAX path (bfs._dedup_keys_dom / _dedup_keys2_dom), where the
+# chain compiles as a plain fori of rolls; host passes force use_psort
+# off accordingly.
+DOM_CHAIN = 128
 
 
 def dom_window(n: int, force: bool = False) -> tuple:
