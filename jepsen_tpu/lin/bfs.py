@@ -983,18 +983,20 @@ def _closure_pass_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
         cand_hi = jnp.concatenate([jnp.where(cfg_valid, hi1, 0),
                                    new_hi.reshape(-1)])
         if crash_dom:
-            # Dominance dedups always take the LAX path with the full
-            # window + chain scan (dom_force): the chain catches
-            # dominators at EVERY offset up to DOM_CHAIN where the
-            # static power-of-two window tests exact offsets only —
-            # without it the per-row crashed-subset transients
-            # (entry frontiers of 3-51 configs ballooning past the top
-            # tier) trip the host executor every ~40 rows. Mosaic
-            # cannot legalize the chain in the psort kernels.
+            # Dominance dedups ALWAYS take the forced lax path (window
+            # + chain scan + iterated prune-compact rounds); the chain
+            # catches dominators at EVERY offset up to DOM_CHAIN where
+            # the static window tests exact offsets only, and it is
+            # what collapses the crashed-subset transients. The psort
+            # dom kernels are additionally excluded on stability
+            # grounds: both round-5 runs that routed small dom dedups
+            # through them (probe_r5fc/fd) killed the worker mid-
+            # history (~rows 13-20k) where the all-lax run (probe_r5fa)
+            # ran clean to 35k+, matching round 4's in-chunk faults.
             h2, l2, n2, o2 = _dedup_keys2_dom(
                 cand_hi, cand_lo, cand_valid, cap, crash_hi, crash_lo,
-                read_hi, read_lo, use_psort=False, dom_force=True,
-                dom_iters=dom_iters)
+                read_hi, read_lo, use_psort=False,
+                dom_force=True, dom_iters=dom_iters)
         else:
             h2, l2, n2, o2 = _dedup_keys2(cand_hi, cand_lo, cand_valid,
                                           cap, use_psort=use_psort)
@@ -1002,7 +1004,7 @@ def _closure_pass_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
             (n2 != count)
         return l2, h2, n2, changed, o2
     if crash_dom:
-        # Lax + chain always — see the pair-key branch above.
+        # Forced lax path always — see the pair-key branch above.
         l2, n2, o2 = _dedup_keys_dom(cand_lo, cand_valid, cap, crash_lo,
                                      read_lo, use_psort=False,
                                      dom_force=True,
@@ -1402,12 +1404,12 @@ def _host_closure_pass(lo, hi, count, act, v_row, pure_row, exp_r, *,
                        cap, W, b, nil_id, step_fn, use_psort,
                        crash_dom):
     """One host-dispatched closure pass (see _host_rows): exactly
-    _closure_pass_keys_compact with the dominance window + chain scan
-    FORCED on regardless of dedup size — safe here because the dedup is
-    the whole program, not a stage of a nested-while chunk. Always the
-    LAX dedup path: Mosaic cannot legalize the chain scan in the psort
-    kernels (see psort.DOM_CHAIN), and at a ~100 ms host sync per pass
-    the in-VMEM kernels' advantage is noise."""
+    _closure_pass_keys_compact with the forced lax chain prune
+    (use_psort off so every dedup takes it) at the aggressive
+    iteration count — host rows are the blowups by definition, and the
+    big caps need the extra prune-compact rounds to hold the
+    mid-history waves (measured: one round leaves 500k+ live configs
+    at row 22599, overflowing every capacity)."""
     del use_psort
     l2, h2, n2, changed, ovf = _closure_pass_keys_compact(
         lo, hi, count, act, v_row, pure_row, exp_r, cap=cap, W=W, b=b,

@@ -316,6 +316,7 @@ def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
             r = base + int(r_done) - 1
             ret = p.ops[int(p.ret_op[r])]
             out = {"valid?": False, "analyzer": "tpu-dense",
+                   "backend": "pallas" if use_pallas else "xla",
                    "dead-row": r,
                    "op": {"process": ret.process, "f": ret.f,
                           "value": ret.value, "index": ret.op_index,
@@ -331,6 +332,7 @@ def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
         base += n
 
     return {"valid?": True, "analyzer": "tpu-dense",
+            "backend": "pallas" if use_pallas else "xla",
             "final-frontier-popcount": int(
                 jnp.sum(lax.population_count(F))),
             "configs": []}

@@ -65,28 +65,30 @@ KEY_FILL = 0xFFFFFFFF     # plain int: used inside kernels as a literal
 # while the pallas kernels with the window ran clean to 2^18. Pruning
 # less at the rare big-tier dedups is sound; the small-tier dedups
 # that run every pass keep the frontier collapsed.
-# Eight power-of-two distances: round 4 capped this at (1, 2) after
-# in-chunk probes with more distances kernel-faulted — but those probes
-# ran GROUPED chunk programs, whose real failure was the group-cycle
-# fixpoint orbit (see bfs.CHUNK_TIER_CAP); round 5's ungrouped chunks
-# carry the full static window cleanly, and the wider span is what
-# keeps the partitioned class's sustained crashed-subset frontier
-# collapsed in-chunk (measured: (1,2)+rep leaves 130k live configs on
-# the wave where chained pruning holds ~30k).
-DOM_WINDOW = (1, 2, 4, 8, 16, 32, 64, 128)
+# Two distances. Round 4 found 4+ distances kernel-fault in-chunk;
+# round 5 briefly widened this to 8 distances believing that lore was
+# the group-cycle-orbit bug misattributed, and the widened pallas
+# kernels then killed the worker mid-history (probe_r5fc, row ~20k) —
+# so the round-4 finding stands for the PALLAS kernels. (1, 2) is the
+# proven-safe static window; real pruning strength comes from the
+# FORCED lax path (chain scan over 1..DOM_CHAIN + iterated rounds),
+# which escalation tiers and host passes always use.
+DOM_WINDOW = (1, 2)
 DOM_WINDOW_MAX_N = 1 << 18
-# Forced-window (host-row) dedups additionally run a CHAIN scan: a
-# fori_loop carrying a consecutively-shifted copy tests every
-# predecessor at distances 1..DOM_CHAIN, so in-group dominance pairs up
-# to that span are caught (the static DOM_WINDOW misses all but the
-# nearest — measured on the 100k partitioned history's wave, rep+(1,2)
-# converge to 130k live configs where the true antichain is ~9k). The
-# loop-carried shift keeps the program tiny regardless of span. Mosaic
-# cannot legalize the scan inside the pallas kernels, so forced dedups
-# take the LAX path (bfs._dedup_keys_dom / _dedup_keys2_dom), where the
-# chain compiles as a plain fori of rolls; host passes force use_psort
-# off accordingly.
+# Forced-window dedups additionally run a CHAIN scan: a carried copy
+# shifted by one more position each step tests every predecessor at
+# distances 1..DOM_CHAIN, so in-group dominance pairs up to that span
+# are caught (the static DOM_WINDOW misses all but the nearest —
+# measured on the 100k partitioned history's wave, rep+(1,2) converge
+# to 130k live configs where the true antichain is ~9k), and the whole
+# prune+compact runs DOM_ITERS rounds so survivors compact together and
+# previously-distant dominators become chain-reachable. In the lax path
+# the chain is a fori of rolls; Mosaic cannot legalize that scan, so
+# the pallas kernels unroll it statically (~DOM_CHAIN extra vector
+# steps per round — still far cheaper than the stage-overhead-bound
+# lax.sort at these sizes).
 DOM_CHAIN = 128
+DOM_ITERS = 2
 
 
 def dom_window(n: int, force: bool = False) -> tuple:
@@ -347,29 +349,51 @@ def _dedup_dom_body(masks_ref, a_ref, w_ref, out_ref, total_ref,
     lane = lax.broadcasted_iota(jnp.uint32, a.shape, 1)
     row = lax.broadcasted_iota(jnp.uint32, a.shape, 0)
     flat = row * LANE + lane
+    first = flat == 0
 
     a, w = _bitonic_sort2(a, w, flat, S=S, K=K)
-
-    first = flat == 0
-    pa = _flat_prev(a, 1, S)
-    dup = (a == pa) & (w == _flat_prev(w, 1, S)) & ~first
-    start = first | (a != pa)
-    # Segmented broadcast of each group's representative word (the scan
-    # runs on u32 flags: bool-vector rolls don't reliably lower).
-    f = w
-    done = start.astype(jnp.uint32)
-    d = 1
-    while d < (1 << K):
-        f = jnp.where(done != 0, f, _flat_prev(f, d, S))
-        done = done | _flat_prev(done, d, S)
-        d <<= 1
-    dominated = ((f & ~w) == 0) & (w != f)
-    for dd in dom_window(S * LANE, force):
-        a_d = _flat_prev(a, dd, S)
-        w_d = _flat_prev(w, dd, S)
-        dominated = dominated | (
-            (flat >= dd) & (a_d == a) & ((w_d & ~w) == 0) & (w_d != w))
-    keep = (a >> 31 == 0) & ~dup & ~dominated
+    keep = first
+    for round_ in range(DOM_ITERS if force else 1):
+        if round_:
+            # Compact survivors (order-preserving re-sort of
+            # FILL-masked pairs) so distant dominators become
+            # chain-reachable — lax twin: bfs._dedup_keys_dom rounds.
+            fill = jnp.uint32(KEY_FILL)
+            a = jnp.where(keep, a, fill)
+            w = jnp.where(keep, w, fill)
+            a, w = _bitonic_sort2(a, w, flat, S=S, K=K)
+        pa = _flat_prev(a, 1, S)
+        dup = (a == pa) & (w == _flat_prev(w, 1, S)) & ~first
+        start = first | (a != pa)
+        # Segmented broadcast of each group's representative word (the
+        # scan runs on u32 flags: bool-vector rolls don't reliably
+        # lower).
+        f = w
+        done = start.astype(jnp.uint32)
+        d = 1
+        while d < (1 << K):
+            f = jnp.where(done != 0, f, _flat_prev(f, d, S))
+            done = done | _flat_prev(done, d, S)
+            d <<= 1
+        dominated = ((f & ~w) == 0) & (w != f)
+        for dd in dom_window(S * LANE, force):
+            a_d = _flat_prev(a, dd, S)
+            w_d = _flat_prev(w, dd, S)
+            dominated = dominated | (
+                (flat >= dd) & (a_d == a) & ((w_d & ~w) == 0)
+                & (w_d != w))
+        if force:
+            # Statically-unrolled chain scan over distances
+            # 1..DOM_CHAIN (Mosaic cannot legalize the fori the lax
+            # twin uses).
+            ra, rw = a, w
+            for dd in range(1, DOM_CHAIN + 1):
+                ra = _flat_prev(ra, 1, S)
+                rw = _flat_prev(rw, 1, S)
+                dominated = dominated | (
+                    (flat >= dd) & (ra == a) & ((rw & ~w) == 0)
+                    & (rw != w))
+        keep = (a >> 31 == 0) & ~dup & ~dominated
     total_ref[0] = jnp.sum(keep.astype(jnp.int32))
     full = jnp.where(
         keep,
@@ -474,37 +498,60 @@ def _dedup2_dom_body(masks_ref, a_hi_ref, a_lo_ref, w_hi_ref, w_lo_ref,
     row = lax.broadcasted_iota(jnp.uint32, a_hi.shape, 0)
     flat = row * LANE + lane
 
+    first = flat == 0
     a_hi, a_lo, w_hi, w_lo = _bitonic_sort4(a_hi, a_lo, w_hi, w_lo,
                                             flat, S=S, K=K)
-
-    first = flat == 0
-    pah = _flat_prev(a_hi, 1, S)
-    pal = _flat_prev(a_lo, 1, S)
-    same_a = (a_hi == pah) & (a_lo == pal)
-    dup = same_a & (w_hi == _flat_prev(w_hi, 1, S)) & \
-        (w_lo == _flat_prev(w_lo, 1, S)) & ~first
-    start = first | ~same_a
-    fh = w_hi
-    fl = w_lo
-    done = start.astype(jnp.uint32)
-    d = 1
-    while d < (1 << K):
-        fh = jnp.where(done != 0, fh, _flat_prev(fh, d, S))
-        fl = jnp.where(done != 0, fl, _flat_prev(fl, d, S))
-        done = done | _flat_prev(done, d, S)
-        d <<= 1
-    dominated = ((fh & ~w_hi) == 0) & ((fl & ~w_lo) == 0) & \
-        ~((w_hi == fh) & (w_lo == fl))
-    for dd in dom_window(S * LANE, force):
-        ah_d = _flat_prev(a_hi, dd, S)
-        al_d = _flat_prev(a_lo, dd, S)
-        wh_d = _flat_prev(w_hi, dd, S)
-        wl_d = _flat_prev(w_lo, dd, S)
-        dominated = dominated | (
-            (flat >= dd) & (ah_d == a_hi) & (al_d == a_lo)
-            & ((wh_d & ~w_hi) == 0) & ((wl_d & ~w_lo) == 0)
-            & ~((wh_d == w_hi) & (wl_d == w_lo)))
-    keep = (a_hi >> 31 == 0) & ~dup & ~dominated
+    keep = first
+    for round_ in range(DOM_ITERS if force else 1):
+        if round_:
+            # Order-preserving compaction between rounds (see
+            # _dedup_dom_body).
+            fill = jnp.uint32(KEY_FILL)
+            a_hi = jnp.where(keep, a_hi, fill)
+            a_lo = jnp.where(keep, a_lo, fill)
+            w_hi = jnp.where(keep, w_hi, fill)
+            w_lo = jnp.where(keep, w_lo, fill)
+            a_hi, a_lo, w_hi, w_lo = _bitonic_sort4(
+                a_hi, a_lo, w_hi, w_lo, flat, S=S, K=K)
+        pah = _flat_prev(a_hi, 1, S)
+        pal = _flat_prev(a_lo, 1, S)
+        same_a = (a_hi == pah) & (a_lo == pal)
+        dup = same_a & (w_hi == _flat_prev(w_hi, 1, S)) & \
+            (w_lo == _flat_prev(w_lo, 1, S)) & ~first
+        start = first | ~same_a
+        fh = w_hi
+        fl = w_lo
+        done = start.astype(jnp.uint32)
+        d = 1
+        while d < (1 << K):
+            fh = jnp.where(done != 0, fh, _flat_prev(fh, d, S))
+            fl = jnp.where(done != 0, fl, _flat_prev(fl, d, S))
+            done = done | _flat_prev(done, d, S)
+            d <<= 1
+        dominated = ((fh & ~w_hi) == 0) & ((fl & ~w_lo) == 0) & \
+            ~((w_hi == fh) & (w_lo == fl))
+        for dd in dom_window(S * LANE, force):
+            ah_d = _flat_prev(a_hi, dd, S)
+            al_d = _flat_prev(a_lo, dd, S)
+            wh_d = _flat_prev(w_hi, dd, S)
+            wl_d = _flat_prev(w_lo, dd, S)
+            dominated = dominated | (
+                (flat >= dd) & (ah_d == a_hi) & (al_d == a_lo)
+                & ((wh_d & ~w_hi) == 0) & ((wl_d & ~w_lo) == 0)
+                & ~((wh_d == w_hi) & (wl_d == w_lo)))
+        if force:
+            # Statically-unrolled chain scan (see _dedup_dom_body).
+            rah, ral, rwh, rwl = a_hi, a_lo, w_hi, w_lo
+            for dd in range(1, DOM_CHAIN + 1):
+                rah = _flat_prev(rah, 1, S)
+                ral = _flat_prev(ral, 1, S)
+                rwh = _flat_prev(rwh, 1, S)
+                rwl = _flat_prev(rwl, 1, S)
+                dominated = dominated | (
+                    (flat >= dd) & (rah == a_hi) & (ral == a_lo)
+                    & ((rwh & ~w_hi) == 0) & ((rwl & ~w_lo) == 0)
+                    & ~((rwh == w_hi) & (rwl == w_lo)))
+        keep = (a_hi >> 31 == 0) & ~dup & ~dominated
     total_ref[0] = jnp.sum(keep.astype(jnp.int32))
     full_hi = jnp.where(
         keep,

@@ -126,6 +126,67 @@ def test_dedup2_dom_parity_fuzz(interpret_psort):
         assert np.array_equal(np.asarray(l1), np.asarray(l2)), trial
 
 
+def test_dedup2_dom_forced_chain_parity_fuzz(interpret_psort):
+    """FORCED dominance dedup (window + unrolled chain + iterated
+    rounds): pallas quad kernel vs the lax fori path at the pallas
+    kernel's iteration count."""
+    import jax.numpy as jnp
+
+    from jepsen_tpu.lin import psort
+    from jepsen_tpu.lin.bfs import _dedup_keys2_dom
+
+    rng = np.random.default_rng(17)
+    for trial in range(4):
+        n = (1024, 2048, 4096)[trial % 3]
+        cap = n // 2
+        cmask_lo = np.uint32(rng.integers(0, 1 << 12))
+        rmask_lo = np.uint32(rng.integers(0, 1 << 12) << 12) & ~cmask_lo
+        cmask_hi = np.uint32(rng.integers(0, 1 << 8))
+        rmask_hi = np.uint32(rng.integers(0, 1 << 8) << 8) & ~cmask_hi
+        hi = rng.integers(0, 1 << 16, n).astype(np.uint32)
+        lo = rng.integers(0, 1 << 24, n).astype(np.uint32)
+        valid = rng.random(n) < 0.8
+        args = (jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(valid),
+                cap, jnp.uint32(cmask_hi), jnp.uint32(cmask_lo),
+                jnp.uint32(rmask_hi), jnp.uint32(rmask_lo))
+        h1, l1, c1, o1 = _dedup_keys2_dom(
+            *args, use_psort=False, dom_force=True,
+            dom_iters=psort.DOM_ITERS)
+        h2, l2, c2, o2 = _dedup_keys2_dom(*args, use_psort=True,
+                                          dom_force=True)
+        assert int(c1) == int(c2), trial
+        assert bool(o1) == bool(o2), trial
+        assert np.array_equal(np.asarray(h1), np.asarray(h2)), trial
+        assert np.array_equal(np.asarray(l1), np.asarray(l2)), trial
+
+
+def test_dedup_dom_forced_chain_parity_fuzz(interpret_psort):
+    """Single-key forced dominance dedup: pallas vs lax."""
+    import jax.numpy as jnp
+
+    from jepsen_tpu.lin import psort
+    from jepsen_tpu.lin.bfs import _dedup_keys_dom
+
+    rng = np.random.default_rng(23)
+    for trial in range(4):
+        n = (1024, 2048)[trial % 2]
+        cap = n // 2
+        cmask = np.uint32(rng.integers(0, 1 << 10))
+        rmask = np.uint32(rng.integers(0, 1 << 10) << 10) & ~cmask
+        key = rng.integers(0, 1 << 24, n).astype(np.uint32)
+        valid = rng.random(n) < 0.8
+        args = (jnp.asarray(key), jnp.asarray(valid), cap,
+                jnp.uint32(cmask), jnp.uint32(rmask))
+        k1, c1, o1 = _dedup_keys_dom(*args, use_psort=False,
+                                     dom_force=True,
+                                     dom_iters=psort.DOM_ITERS)
+        k2, c2, o2 = _dedup_keys_dom(*args, use_psort=True,
+                                     dom_force=True)
+        assert int(c1) == int(c2), trial
+        assert bool(o1) == bool(o2), trial
+        assert np.array_equal(np.asarray(k1), np.asarray(k2)), trial
+
+
 def test_compact_keys_parity(interpret_psort):
     """compact_keys packs distinct non-KEY_FILL entries ascending."""
     import jax.numpy as jnp
