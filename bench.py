@@ -47,7 +47,7 @@ TARGET_SECONDS = 60.0
 # host-row executor's wave segments (decided at all is the round-5
 # breakthrough; it was a kernel fault before).
 PROBE_ORDER = (("mutex_c30", 600), ("wide_window_c30", 600),
-               ("independent_keys", 900), ("partitioned_c30", 4000))
+               ("independent_keys", 900), ("partitioned_c30", 5300))
 WORKER_RESTART_S = 75
 
 
