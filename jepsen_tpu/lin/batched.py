@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from jepsen_tpu import util
 from jepsen_tpu.lin import bfs, prepare
 from jepsen_tpu.lin.prepare import PackedHistory
 from jepsen_tpu.models.kernels import F_NOOP
@@ -163,6 +164,7 @@ def try_check_batch(model, subs: dict) -> dict | None:
     results: dict = {}
     for group in groups.values():
         r = _check_group(group)
+        util.progress_tick()   # liveness: one tick per decided group
         if r is not None:
             results.update(r)
     return results or None

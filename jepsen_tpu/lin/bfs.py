@@ -51,6 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from jepsen_tpu import util
 from jepsen_tpu.lin import psort
 from jepsen_tpu.lin.prepare import PackedHistory
 
@@ -83,10 +84,14 @@ SPIKE_CHUNK = 32
 # compute it gates. Flags are fetched for SYNC_CHUNKS chunks in one
 # transfer; a tripped flag rewinds to the batch entry and replays
 # chunk-by-chunk (escalation/spike/dead handling live there).
-# 2, not more: queueing 8 unsynced chunk programs on the axon worker
-# kernel-faulted it on the 100k partitioned history (the same chunks
-# run clean when synced individually — the runtime objects to the
-# dispatch queue depth, not the programs).
+# 2 by default: queueing 8 unsynced chunk programs on the axon worker
+# "kernel-faulted" it on the 100k partitioned history in round 4 — but
+# round 5 attributed that round's faults to the grouped-closure orbit
+# (an infinite in-program loop the watchdog kills), so the queue-depth
+# blame was never re-established. Env JEPSEN_TPU_SYNC_CHUNKS overrides
+# so the bench can re-test deeper queues on the literal config-5
+# history (fault-isolated in its probe subprocess) and gate the value
+# on evidence instead of superstition.
 SYNC_CHUNKS = 2
 # Frontier size at which spike mode hands back to full-size chunks (at
 # a mini-chunk boundary with count at most this).
@@ -139,8 +144,34 @@ def _tier_cap() -> int:
 
 
 def _cand_max() -> int:
+    """Resolved ONCE per check_packed call and threaded into
+    _search_chunk as a static argname (like max_tier), so an env change
+    between checks in one process retraces instead of silently reusing
+    the previously traced grouping."""
     env = os.environ.get("JEPSEN_TPU_CAND_MAX", "")
     return int(env) if env else CHUNK_CAND_MAX
+
+
+def _sync_chunks() -> int:
+    env = os.environ.get("JEPSEN_TPU_SYNC_CHUNKS", "")
+    return max(1, int(env)) if env else SYNC_CHUNKS
+
+
+def _fused_closure() -> bool:
+    """The host-row closure fixpoint runs as ONE device while_loop
+    program per (row, capacity) by default; ``JEPSEN_TPU_FUSED_CLOSURE=0``
+    falls back to one dispatch per closure pass (the round-5 shape) for
+    fault triage on the real chip."""
+    return os.environ.get("JEPSEN_TPU_FUSED_CLOSURE", "1") != "0"
+
+
+def _host_it_max(W: int) -> int:
+    """Closure pass budget per (row, capacity) in the host-row executor:
+    ungrouped convergence needs O(window) passes; the ceiling converts a
+    would-be nontermination into an honest budget overflow. Env
+    JEPSEN_TPU_HOST_IT_MAX overrides for fault triage and tests."""
+    env = os.environ.get("JEPSEN_TPU_HOST_IT_MAX", "")
+    return int(env) if env else 4 * W + 16
 
 
 KEY_FILL = jnp.uint32(0xFFFFFFFF)  # pad beyond count; sorts after any config
@@ -655,12 +686,12 @@ def reduction_bit_tables(p: PackedHistory, nw: int):
 @partial(jax.jit, static_argnames=("cap", "step_fn", "state_bits",
                                    "nil_id", "read_value_match",
                                    "use_psort", "row_tiers", "key_hi",
-                                   "crash_dom", "max_tier"))
+                                   "crash_dom", "max_tier", "cand_max"))
 def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v, pure, pred_bit,
                   bits, state, count, exp_tables=None, *, cap, step_fn,
                   state_bits=None, nil_id=None, read_value_match=False,
                   use_psort=False, row_tiers=True, key_hi=False,
-                  crash_dom=False, max_tier=None):
+                  crash_dom=False, max_tier=None, cand_max=None):
     """Process up to n_rows return events (tables are CHUNK-row static
     shapes; rows past n_rows are ignored) starting from a carried frontier.
 
@@ -689,7 +720,7 @@ def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v, pure, pred_bit,
             state_bits=state_bits, nil_id=nil_id,
             read_value_match=read_value_match, use_psort=use_psort,
             row_tiers=row_tiers, key_hi=key_hi, crash_dom=crash_dom,
-            max_tier=max_tier)
+            max_tier=max_tier, cand_max=cand_max)
     C, W = active.shape
     nw = bits.shape[1]
 
@@ -1101,7 +1132,7 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
                        exp_tables=None, *, cap, step_fn,
                        state_bits, nil_id, read_value_match=False,
                        use_psort=False, row_tiers=True, key_hi=False,
-                       crash_dom=False, max_tier=None):
+                       crash_dom=False, max_tier=None, cand_max=None):
     """Packed-key row loop (see _search_chunk): each config is ONE
     uint32 (bits << state_bits | state id) — or an (lo, hi) u32 pair
     when ``key_hi`` (windows up to 60+state bits; the cockroach-class
@@ -1156,10 +1187,18 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
 
         if exp_tables is not None:
             M_cols = exp_tables[0].shape[-1]
-            # Candidate bound: the crash-dom pair band must keep every
-            # in-chunk dedup within CHUNK_CAND_MAX (see there); other
-            # bands group only to keep the dominance window engaged.
-            cand_bound = _cand_max() if (crash_dom and key_hi) \
+            # Candidate bound: ALL crash-dom rows (pair AND single-key)
+            # use the large CHUNK_CAND_MAX bound so in-chunk closure is
+            # ungrouped (G=1) at every tier — grouping is the period-G
+            # orbit hazard, and crash-dom dedups force the lax chain
+            # path regardless of size, so the psort/window size-gate
+            # rationale behind the smaller bound does not apply to them.
+            # (Round 5 covered only the pair band; the single-key band
+            # still ran grouped closures at tiers 16384/65536 and paid
+            # needless host-row escalations.) Other bands group to keep
+            # the windowed dominance prune engaged in psort-sized
+            # dedups.
+            cand_bound = (cand_max or CHUNK_CAND_MAX) if crash_dom \
                 else psort.DOM_WINDOW_MAX_N
             Mg = max(1, cand_bound // tier - 1)
             G = -(-M_cols // Mg) if Mg < M_cols else 1
@@ -1214,7 +1253,12 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
                 h2 = None
             g2 = jnp.where(g + 1 >= G, 0, g + 1)
             since2 = jnp.where(changed, jnp.int32(0), since + 1)
-            o3 = ovf | o2 | (it + 1 >= it_max)
+            # Convergence before ceiling: a pass that completes the
+            # G-unchanged fixpoint exactly at the iteration budget is
+            # converged, not overflowed (the ceiling exists to convert
+            # nontermination into an honest overflow, and since2 >= G
+            # IS termination).
+            o3 = ovf | o2 | ((it + 1 >= it_max) & (since2 < G))
             if key_hi:
                 return (l2, h2, n2, g2, since2, it + 1, o3)
             return (l2, n2, g2, since2, it + 1, o3)
@@ -1324,7 +1368,7 @@ def _spike_rows(p, r0, bits, state, count, *, tables_h, caps, dropback,
                 step_fn, state_bits, nil_id, read_value_match, cancel,
                 snapshots, min_rows: int = 64, use_psort: bool = False,
                 exp_h=None, key_hi: bool = False,
-                crash_dom: bool = False):
+                crash_dom: bool = False, cand_max=None):
     """Spike mode: SPIKE_CHUNK-row mini-chunks of the SAME _search_chunk
     program at the big spike capacities. The axon runtime faults on a
     512-row chunk past cap 131072 but runs an 8-row chunk clean at 2^20
@@ -1360,12 +1404,13 @@ def _spike_rows(p, r0, bits, state, count, *, tables_h, caps, dropback,
         sp_exp = None if exp_h is None else tuple(
             jnp.asarray(_chunk_slice(t, r, SPIKE_CHUNK)) for t in exp_h)
         while True:
+            util.progress_tick()
             b2, s2, c2, r_done, dead, ovf = _search_chunk(
                 jnp.int32(m_n), *sp_tables, bits, state, count, sp_exp,
                 cap=caps[lvl], step_fn=step_fn, state_bits=state_bits,
                 nil_id=nil_id, read_value_match=read_value_match,
                 use_psort=use_psort, row_tiers=False, key_hi=key_hi,
-                crash_dom=crash_dom)
+                crash_dom=crash_dom, cand_max=cand_max)
             if not bool(ovf):
                 break
             if lvl + 1 >= len(caps):
@@ -1386,7 +1431,7 @@ def _spike_rows(p, r0, bits, state, count, *, tables_h, caps, dropback,
                     state_bits=state_bits, nil_id=nil_id,
                     read_value_match=read_value_match,
                     use_psort=use_psort, row_tiers=False, key_hi=key_hi,
-                    crash_dom=crash_dom)
+                    crash_dom=crash_dom, cand_max=cand_max)
                 if not bool(o3):
                     snapshots[:] = [(r + int(r_done) - 1, b3, s3, c3)]
             return (b2, s2, int(c2), r + int(r_done), True, False, False,
@@ -1419,9 +1464,74 @@ def _host_closure_pass(lo, hi, count, act, v_row, pure_row, exp_r, *,
                                   ovf.astype(jnp.int32)])
 
 
-@partial(jax.jit, static_argnames=("cap", "b", "use_psort", "key_hi"))
-def _host_filter_pass(lo, hi, count, s, *, cap, b, use_psort, key_hi):
-    """Host-dispatched return-event filter (see _host_rows)."""
+@partial(jax.jit, static_argnames=("cap", "W", "b", "nil_id", "step_fn",
+                                   "use_psort", "crash_dom", "key_hi",
+                                   "it_max", "dom_iters"))
+def _host_closure_fixpoint(lo, hi, count, act, v_row, pure_row, exp_r,
+                           ret, *, cap, W, b, nil_id, step_fn,
+                           use_psort, crash_dom, key_hi, it_max,
+                           dom_iters=6):
+    """The DEVICE-RESIDENT closure fixpoint for one host row: the whole
+    multi-pass closure (each pass = _closure_pass_keys_compact with the
+    forced lax chain prune at the aggressive dom_iters, exactly
+    _host_closure_pass) runs as ONE ``lax.while_loop`` program, with
+    the round-5 iteration ceiling carried IN-PROGRAM — the loop exits
+    on convergence, dedup overflow, or ``it_max`` passes, so a would-be
+    orbit still becomes an honest overflow flag instead of a watchdog
+    kill, without paying the ~100 ms host tunnel round trip per pass
+    (round 5 paid it_max-bounded multiples of it across ~90 episodes —
+    the dominant cost of the 3217 s config-5 decide).
+
+    Runtime-safety envelope: this is a ONE-row program — the axon
+    runtime objects to rows*cap program complexity (8/32/64-row chunks
+    run clean at cap 2^20 where 512-row chunks fault at 2^18), and the
+    closure here is always UNGROUPED (all M columns per pass, no
+    lax.dynamic_slice group machinery), which round 5 proved clean
+    in-chunk at these dedup shapes and which makes the frontier a
+    deterministic function of itself so the fixpoint terminates.
+
+    The return-event filter is fused in: when the closure converges the
+    returned arrays are already filtered, so a clean row costs ONE
+    dispatch + one 4-int flag fetch. It honors ``use_psort`` exactly
+    like the unfused fallback's _host_filter_pass (only the CLOSURE
+    pass forces the lax chain path — see _host_closure_pass), so
+    FUSED_CLOSURE=0 triage compares the same program mix, just
+    unfused. On a non-converged exit the filter output is garbage by
+    construction; the host discards it and restarts from its entry
+    snapshot (escalation semantics unchanged).
+
+    Convergence is tested before the ceiling: a pass that reaches the
+    fixpoint exactly at ``it_max`` exits converged, not overflowed.
+
+    Returns (lo, hi, flags) with flags = i32[4]:
+    [converged, dedup_overflow, passes_used, post-filter count]."""
+    def cond(c):
+        _, _, _, it, changed, ovf = c
+        return changed & ~ovf & (it < it_max)
+
+    def body(c):
+        lo, hi, count, it, _, ovf = c
+        l2, h2, n2, changed, o2 = _closure_pass_keys_compact(
+            lo, hi, count, act, v_row, pure_row, exp_r, cap=cap, W=W,
+            b=b, nil_id=nil_id, step_fn=step_fn, use_psort=False,
+            crash_dom=crash_dom, dom_iters=dom_iters)
+        return (l2, h2, n2, it + 1, changed, ovf | o2)
+
+    lo, hi, count, it, changed, ovf = lax.while_loop(
+        cond, body,
+        (lo, hi, count, jnp.int32(0), jnp.bool_(True), jnp.bool_(False)))
+    converged = ~changed & ~ovf
+    lo, hi, count = _filter_keys_any(lo, hi, count, ret, cap=cap, b=b,
+                                     use_psort=use_psort, key_hi=key_hi)
+    return lo, hi, jnp.stack([converged.astype(jnp.int32),
+                              ovf.astype(jnp.int32), it, count])
+
+
+def _filter_keys_any(lo, hi, count, s, *, cap, b, use_psort, key_hi):
+    """The key_hi/use_psort return-filter dispatch, shared (traceable,
+    not jitted itself) by the fused fixpoint and _host_filter_pass so
+    the FUSED_CLOSURE=0 triage fallback can never silently diverge
+    from the fused program's filter semantics."""
     if key_hi:
         lo, hi, count, _ = _filter_pass_keys2(lo, hi, count, s, cap=cap,
                                               b=b, use_psort=use_psort)
@@ -1429,6 +1539,13 @@ def _host_filter_pass(lo, hi, count, s, *, cap, b, use_psort, key_hi):
         lo, count, _ = _filter_pass_keys(lo, count, s, cap=cap, b=b,
                                          use_psort=use_psort)
     return lo, hi, count
+
+
+@partial(jax.jit, static_argnames=("cap", "b", "use_psort", "key_hi"))
+def _host_filter_pass(lo, hi, count, s, *, cap, b, use_psort, key_hi):
+    """Host-dispatched return-event filter (see _host_rows)."""
+    return _filter_keys_any(lo, hi, count, s, cap=cap, b=b,
+                            use_psort=use_psort, key_hi=key_hi)
 
 
 @partial(jax.jit, static_argnames=("cap", "b", "nil_id", "key_hi"))
@@ -1462,29 +1579,46 @@ def _fit_keys(lo, hi, cap):
 def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
                dropback, step_fn, state_bits, nil_id, use_psort,
                key_hi, crash_dom, cancel, snapshots,
-               min_rows: int = 2):
+               min_rows: int = 2, stats: dict | None = None):
     """Host-sequenced row mode for the compact register band's blowup
     rows (the crashed-subset waves of BASELINE config 5's partition
-    histories). Each closure pass — expand one Mg-column group, then
-    the windowed-dominance dedup — is its OWN device dispatch, with the
-    host driving the group cycle, fixpoint detection, and capacity
-    escalation. The nested-while chunk program kernel-faults the axon
-    runtime on exactly these shapes (round-4 lore: bench at cap 131072
-    and probe_r4h at 262144 both died in the wave chunk), while the
-    same dedups run clean standalone; host sequencing also keeps the
-    dominance window engaged at EVERY capacity (psort dom_force), which
-    is what collapses the wave (rep-only pruning leaves 389k configs;
-    rep+window converges to ~14k). ~100 ms tunnel sync per pass — only
+    histories). Each row's whole closure fixpoint runs as ONE device
+    dispatch (_host_closure_fixpoint: a lax.while_loop over ungrouped
+    closure passes with the iteration ceiling in-program and the return
+    filter fused in), with the host driving only capacity escalation —
+    one ~100 ms tunnel round trip per (row, capacity) instead of one
+    per closure PASS (the round-5 shape, ~12+ passes per row across
+    ~90 episodes: the dominant cost of the 3217 s config-5 decide).
+    ``JEPSEN_TPU_FUSED_CLOSURE=0`` falls back to per-pass dispatches
+    (_host_closure_pass) for fault triage. Single-dispatch sequencing
+    also keeps the dominance window engaged at EVERY capacity
+    (psort dom_force), which is what collapses the wave (rep-only
+    pruning leaves 389k configs; rep+window converges to ~14k). Only
     rows whose frontiers outgrow the chunked tiers ever come here.
 
+    ``stats`` (when given) accumulates observability counters:
+    ``rows`` (host rows run), ``dispatches`` (closure-program
+    dispatches — the tunnel round trips the fusion is cutting) and
+    ``passes`` (closure passes executed inside them).
+
     Same contract as _spike_rows: returns (bits, state, count_int,
-    next_row, dead, overflowed, cancelled, top_cap_used)."""
+    next_row, dead, overflowed, cancelled, top_cap_used) — except
+    ``overflowed`` is falsy or a REASON string: "capacity" (a dedup
+    overflowed the last host cap) or "budget" (the closure pass budget
+    was exhausted there — the nontermination class round 5 diagnosed;
+    reporting it as a capacity overflow would misdirect triage)."""
     ret_slot_h, active_h, _slot_f_h, slot_v_h, pure_h, _pred = tables_h
     b = state_bits
     W = p.window
     nw = (W + 31) // 32
     count_i = int(count)
     top_used = caps[0]
+    fused = _fused_closure()
+    if stats is None:
+        stats = {}
+    stats.setdefault("rows", 0)
+    stats.setdefault("dispatches", 0)
+    stats.setdefault("passes", 0)
 
     def lvl_for(c):
         for i, cc in enumerate(caps):
@@ -1497,7 +1631,8 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
                             nw=nw, key_hi=key_hi)
 
     if count_i > caps[-1]:
-        return bits, state, count_i, r0, False, True, False, top_used
+        return (bits, state, count_i, r0, False, "capacity", False,
+                top_used)
     lvl = lvl_for(count_i)
     cap = caps[lvl]
     lo, hi = _host_pack(bits, state, jnp.int32(count_i), cap=cap, b=b,
@@ -1515,53 +1650,91 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
         act = jnp.asarray(active_h[r])
         v_row = jnp.asarray(slot_v_h[r])
         pure_row = jnp.asarray(pure_h[r])
+        ret = jnp.int32(int(ret_slot_h[r]))
         exp_r = tuple(jnp.asarray(t[r]) for t in exp_h)
         entry = (lo, hi, count, lvl)
         # Pass budget per (row, capacity): ungrouped convergence needs
         # O(window) passes; exhaustion escalates like an overflow
         # (sound — the row restarts from its entry frontier).
-        it_max = 4 * W + 16
+        it_max = _host_it_max(W)
+        stats["rows"] += 1
+        budget_out = False
+        filtered = False
         while True:  # closure fixpoint, escalating capacity on overflow
             cap = caps[lvl]
             top_used = max(top_used, cap)
             lo, hi = _fit_keys(lo, hi, cap)
-            it = 0
-            ovf = False
-            while True:
-                lo, hi, count, flags = _host_closure_pass(
-                    lo, hi, count, act, v_row, pure_row, exp_r,
+            util.progress_tick()
+            if fused:
+                lo, hi, flags = _host_closure_fixpoint(
+                    lo, hi, count, act, v_row, pure_row, exp_r, ret,
                     cap=cap, W=W, b=b, nil_id=nil_id, step_fn=step_fn,
-                    use_psort=use_psort, crash_dom=crash_dom)
-                ch, ov = (int(x) for x in np.asarray(flags))
-                it += 1
+                    use_psort=use_psort, crash_dom=crash_dom,
+                    key_hi=key_hi, it_max=it_max)
+                conv, ov, it, cnt = (int(x) for x in np.asarray(flags))
+                stats["dispatches"] += 1
+                stats["passes"] += it
+                count = jnp.int32(cnt)
+                ovf = not conv
+                budget_out = bool(ovf and not ov)
+                filtered = True
                 if os.environ.get("JEPSEN_TPU_HOST_DEBUG") == "1":
-                    print(f"[host] r={r} cap={cap} it={it} "
-                          f"count={int(count)} ch={ch} ov={ov}",
+                    print(f"[host] r={r} cap={cap} fused it={it} "
+                          f"count={cnt} conv={conv} ov={ov}",
                           flush=True)
-                if ov or it >= it_max:
-                    ovf = True
-                    break
-                if not ch:
-                    break
+            else:
+                it = 0
+                ovf = False
+                budget_out = False
+                while True:
+                    lo, hi, count, flags = _host_closure_pass(
+                        lo, hi, count, act, v_row, pure_row, exp_r,
+                        cap=cap, W=W, b=b, nil_id=nil_id,
+                        step_fn=step_fn, use_psort=use_psort,
+                        crash_dom=crash_dom)
+                    ch, ov = (int(x) for x in np.asarray(flags))
+                    it += 1
+                    stats["dispatches"] += 1
+                    stats["passes"] += 1
+                    if os.environ.get("JEPSEN_TPU_HOST_DEBUG") == "1":
+                        print(f"[host] r={r} cap={cap} it={it} "
+                              f"count={int(count)} ch={ch} ov={ov}",
+                              flush=True)
+                    if ov:
+                        ovf = True
+                        break
+                    # Convergence BEFORE the ceiling: a pass that
+                    # settles exactly at the budget is converged, not
+                    # overflowed (the ceiling exists to convert
+                    # nontermination into an honest overflow).
+                    if not ch:
+                        break
+                    if it >= it_max:
+                        ovf = True
+                        budget_out = True
+                        break
             if not ovf:
                 break
             if lvl + 1 >= len(caps):
                 # Overflow of the last host cap: hand back the row's
                 # ENTRY frontier (the escalation restart point — the
-                # mid-pass arrays are truncated) as an honest failure.
-                # Unpack at the entry arrays' OWN size: entry lvl is
-                # the level selected for the row, which can exceed the
-                # arrays' cap when the previous row finished smaller.
+                # mid-pass arrays are truncated) as an honest failure,
+                # tagged with WHY (capacity vs pass budget). Unpack at
+                # the entry arrays' OWN size: entry lvl is the level
+                # selected for the row, which can exceed the arrays'
+                # cap when the previous row finished smaller.
                 e_lo, e_hi, e_count, _ = entry
                 bits, state = unpack(e_lo, e_hi, e_count,
                                      e_lo.shape[0])
-                return (bits, state, int(e_count), r, False, True,
+                return (bits, state, int(e_count), r, False,
+                        "budget" if budget_out else "capacity",
                         False, top_used)
             lo, hi, count, _ = entry
             lvl += 1
-        lo, hi, count = _host_filter_pass(
-            lo, hi, count, jnp.int32(int(ret_slot_h[r])), cap=cap, b=b,
-            use_psort=use_psort, key_hi=key_hi)
+        if not filtered:
+            lo, hi, count = _host_filter_pass(
+                lo, hi, count, ret, cap=cap, b=b,
+                use_psort=use_psort, key_hi=key_hi)
         count_i = int(count)
         r += 1
         if count_i == 0:
@@ -1788,6 +1961,14 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
         # cap only needs selection margin over it: smaller carry
         # arrays, cheaper per-chunk fixed costs.
         cap_schedule = (TIER_MARGIN * max_tier,)
+    # Env knobs resolved ONCE per check: cand_max is a static argname of
+    # _search_chunk (so a changed JEPSEN_TPU_CAND_MAX retraces instead
+    # of silently reusing a stale grouping), sync_chunks sets the fast
+    # path's dispatch queue depth between host flag syncs.
+    cand_max = _cand_max()
+    sync_chunks = _sync_chunks()
+    host_stats: dict = {"episodes": 0, "rows": 0, "dispatches": 0,
+                        "passes": 0}
     level = 0
     cap = cap_schedule[level]
     bits = jnp.zeros((cap, nw), jnp.uint32)
@@ -1796,6 +1977,11 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     count = jnp.int32(1)
     max_cap_used = cap
     snapshots: list | None = [] if explain else None
+
+    def _with_stats(out: dict) -> dict:
+        if host_stats["episodes"]:
+            out["host-stats"] = dict(host_stats)
+        return out
 
     def chunk_tables(base):
         tables = (jnp.asarray(_chunk_slice(ret_slot_h, base, chunk)),
@@ -1833,10 +2019,11 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             # spike mode, and dead-row reporting.
             entry = (bits, state, count, level, base)
             flags = []
-            while base < p.R and len(flags) < SYNC_CHUNKS:
+            while base < p.R and len(flags) < sync_chunks:
                 if cancel is not None and cancel.is_set():
-                    return {"valid?": "unknown", "analyzer": "tpu-bfs",
-                            "error": "cancelled"}
+                    return _with_stats(
+                        {"valid?": "unknown", "analyzer": "tpu-bfs",
+                         "error": "cancelled"})
                 n = min(chunk, p.R - base)
                 tables, exp_c = chunk_tables(base)
                 b2, s2, c2, r_done, dead, ovf = _search_chunk(
@@ -1845,12 +2032,14 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                     state_bits=state_bits, nil_id=nil_id,
                     read_value_match=read_value_match,
                     use_psort=use_psort, key_hi=key_hi,
-                    crash_dom=crash_dom, max_tier=max_tier)
+                    crash_dom=crash_dom, max_tier=max_tier,
+                    cand_max=cand_max)
                 flags.append(jnp.stack((ovf.astype(jnp.int32),
                                         dead.astype(jnp.int32), c2)))
                 bits, state, count = b2, s2, c2
                 base += n
             fl = np.asarray(jnp.stack(flags))   # ONE transfer per batch
+            util.progress_tick()
             if not fl[:, :2].any():
                 cnt = int(fl[-1, 2])
                 _dlog(f"fast batch -> base {base} count {cnt}")
@@ -1870,18 +2059,21 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             # always inside the current chunk): keep HBM flat
             snapshots[:] = [(base, bits, state, count)]
         if cancel is not None and cancel.is_set():
-            return {"valid?": "unknown", "analyzer": "tpu-bfs",
-                    "error": "cancelled"}
+            return _with_stats({"valid?": "unknown",
+                                "analyzer": "tpu-bfs",
+                                "error": "cancelled"})
         n = min(chunk, p.R - base)
         tables, exp_c = chunk_tables(base)
         spiked = None
         while True:
+            util.progress_tick()
             b2, s2, c2, r_done, dead, ovf = _search_chunk(
                 jnp.int32(n), *tables, bits, state, count, exp_c,
                 cap=cap_schedule[level], step_fn=step_fn,
                 state_bits=state_bits, nil_id=nil_id,
                 read_value_match=read_value_match, use_psort=use_psort,
-                key_hi=key_hi, crash_dom=crash_dom, max_tier=max_tier)
+                key_hi=key_hi, crash_dom=crash_dom, max_tier=max_tier,
+                cand_max=cand_max)
             if not bool(ovf):
                 break
             # With a tier cap, a bigger chunk cap cannot grow the
@@ -1922,9 +2114,11 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                         c for c in spike_caps
                         if c > cap_schedule[-1])) or None
                 if sp_caps is None:
-                    return {"valid?": "unknown", "analyzer": "tpu-bfs",
-                            "error": ("frontier exceeded capacity "
-                                      f"{cap_schedule[-1]}")}
+                    return _with_stats(
+                        {"valid?": "unknown", "analyzer": "tpu-bfs",
+                         "overflow": "capacity",
+                         "error": ("frontier exceeded capacity "
+                                   f"{cap_schedule[-1]}")})
                 # Recover the frontier just before the spike row with ONE
                 # re-run of the rows that did fit (the failed run's
                 # r_done-1), so spike mode starts at the spike, not at
@@ -1939,7 +2133,8 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                         state_bits=state_bits, nil_id=nil_id,
                         read_value_match=read_value_match,
                         use_psort=use_psort, key_hi=key_hi,
-                        crash_dom=crash_dom, max_tier=max_tier)
+                        crash_dom=crash_dom, max_tier=max_tier,
+                        cand_max=cand_max)
                     if not bool(o_pre):
                         bits, state, count = b2, s2, c2
                     else:
@@ -1951,6 +2146,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                     hdrop = min(spike_dropback,
                                 (max_tier or cap_schedule[-1])
                                 // TIER_MARGIN)
+                    host_stats["episodes"] += 1
                     spiked = _host_rows(
                         p, base + n_pre, bits, state, count,
                         tables_h=(ret_slot_h, active_h, slot_f_h,
@@ -1959,7 +2155,8 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                         step_fn=step_fn, state_bits=state_bits,
                         nil_id=nil_id, use_psort=use_psort,
                         key_hi=key_hi, crash_dom=crash_dom,
-                        cancel=cancel, snapshots=snapshots)
+                        cancel=cancel, snapshots=snapshots,
+                        stats=host_stats)
                 else:
                     # Dropback clamped so the handed-back frontier
                     # always fits the chunked engine's top cap.
@@ -1973,7 +2170,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                         nil_id=nil_id, read_value_match=read_value_match,
                         cancel=cancel, snapshots=snapshots,
                         use_psort=use_psort, exp_h=exp_h, key_hi=key_hi,
-                        crash_dom=crash_dom)
+                        crash_dom=crash_dom, cand_max=cand_max)
                 spike_top = sp_caps[-1]
                 break
             # Retry this chunk from its entry frontier at the next cap.
@@ -1988,21 +2185,36 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
              top_used) = spiked
             max_cap_used = max(max_cap_used, top_used)
             if cancelled:
-                return {"valid?": "unknown", "analyzer": "tpu-bfs",
-                        "error": "cancelled"}
+                return _with_stats(
+                    {"valid?": "unknown", "analyzer": "tpu-bfs",
+                     "error": "cancelled"})
             if ovf_h:
-                return {"valid?": "unknown", "analyzer": "tpu-bfs",
-                        "error": ("frontier exceeded capacity "
-                                  f"{spike_top}")}
+                # Honest overflow taxonomy: a closure-pass-budget
+                # exhaustion (the nontermination class round 5
+                # diagnosed) must not masquerade as a capacity
+                # overflow, or triage chases frontier size instead of
+                # convergence.
+                if ovf_h == "budget":
+                    return _with_stats(
+                        {"valid?": "unknown", "analyzer": "tpu-bfs",
+                         "overflow": "budget",
+                         "error": ("closure pass budget exceeded at "
+                                   f"capacity {spike_top}")})
+                return _with_stats(
+                    {"valid?": "unknown", "analyzer": "tpu-bfs",
+                     "overflow": "capacity",
+                     "error": ("frontier exceeded capacity "
+                               f"{spike_top}")})
             if dead_h:
                 # Snapshots were re-anchored at the dead row's entry by
                 # _spike_rows (one row of CPU replay for explain).
                 r_done = jnp.int32(next_r - base)
                 dead = True
             elif next_r >= p.R:
-                return {"valid?": True, "analyzer": "tpu-bfs",
-                        "configs": [], "final-frontier-size": count_i,
-                        "max-cap": max_cap_used}
+                return _with_stats(
+                    {"valid?": True, "analyzer": "tpu-bfs",
+                     "configs": [], "final-frontier-size": count_i,
+                     "max-cap": max_cap_used})
             else:
                 # Resume full-size chunks at the hand-back row — at the
                 # TOP chunked level: the neighbourhood of a spike tends
@@ -2039,7 +2251,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
 
                 out.update(witness.tail_replay_sparse(p, snapshots, r,
                                                       cancel=cancel))
-            return out
+            return _with_stats(out)
         bits, state, count = b2, s2, c2
         base += n
         # Frontier is compacted to the front, so a shrunken frontier can
@@ -2050,6 +2262,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             bits = bits[:cap]
             state = state[:cap]
 
-    return {"valid?": True, "analyzer": "tpu-bfs", "configs": [],
-            "final-frontier-size": int(count),
-            "max-cap": max_cap_used}
+    return _with_stats({"valid?": True, "analyzer": "tpu-bfs",
+                        "configs": [],
+                        "final-frontier-size": int(count),
+                        "max-cap": max_cap_used})

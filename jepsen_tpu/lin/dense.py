@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from jepsen_tpu import util
 from jepsen_tpu.lin.prepare import PackedHistory
 
 # Largest window the dense representation will take: 2**20 words = 4 MiB
@@ -312,6 +313,7 @@ def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
                 jnp.asarray(pad_w(_chunk_slice(slot_v_h, base, chunk),
                                   w_cur)),
                 w=w_cur, ns=ns, step_fn=step_fn)
+        util.progress_tick()   # liveness: one tick per decided chunk
         if bool(dead):
             r = base + int(r_done) - 1
             ret = p.ops[int(p.ret_op[r])]

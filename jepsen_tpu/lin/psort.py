@@ -424,13 +424,38 @@ def _dedup_dom_call(a, w, cmask, rmask, n_pad, force=False):
     return out.reshape(-1), total[0]
 
 
+def _assert_force_window_interpret_only(force_window: bool) -> None:
+    """``force_window=True`` (the statically-unrolled DOM_CHAIN scan +
+    iterated prune rounds) exists ONLY for interpret-mode parity tests
+    against the lax chain path. On the real Mosaic backend it is
+    compile-pathological (the unrolled 128-distance chain takes 20+
+    minutes to compile) and KILLED the TPU worker mid-history in both
+    round-5 runs that enabled it (probe_r5fc/fd, rows ~13-20k) — every
+    production crash-dom call site therefore hard-codes the forced lax
+    path (bfs._dedup_keys_dom/_dedup_keys2_dom with dom_force=True).
+    Fail fast so a future caller cannot silently re-enter the
+    known-unstable path."""
+    if force_window and not _interpret():
+        raise RuntimeError(
+            "psort force_window dominance dedup must not run on the "
+            "real Mosaic backend: it is compile-pathological and "
+            "killed the TPU worker in both round-5 runs that enabled "
+            "it; use the forced-lax chain path "
+            "(bfs._dedup_keys_dom/_dedup_keys2_dom with "
+            "dom_force=True) instead")
+
+
 def dedup_keys_dom(a, w, cmask, rmask, cap, force_window=False):
     """In-VMEM twin of the lax path in ``bfs._dedup_keys_dom``. ``a`` is
     the group part (mutator bits + state) with the invalid flag already
     in bit 31; ``w`` the packed dominance word (crashed bits | inverted
     read bits); ``cmask``/``rmask`` u32 scalars for recombination.
-    Returns (keys[cap] full-key ascending, count, overflow)."""
+    Returns (keys[cap] full-key ascending, count, overflow).
+
+    ``force_window=True`` is interpret-mode-only (parity tests): see
+    :func:`_assert_force_window_interpret_only`."""
     n = a.shape[0]
+    _assert_force_window_interpret_only(force_window)
     _assert_cap_contract(n, cap)
     n_pad = pad_size(n)
     if n_pad > n:
@@ -593,8 +618,12 @@ def dedup_keys2_dom(a_hi, a_lo, w_hi, w_lo, cmask_hi, cmask_lo,
     """In-VMEM twin of the lax path in ``bfs._dedup_keys2_dom``. ``a``
     pair carries group bits (invalid flag already in a_hi bit 31), ``w``
     pair the packed dominance words. Returns (hi[cap], lo[cap], count,
-    overflow), survivors full-key ascending by (hi, lo)."""
+    overflow), survivors full-key ascending by (hi, lo).
+
+    ``force_window=True`` is interpret-mode-only (parity tests): see
+    :func:`_assert_force_window_interpret_only`."""
     n = a_hi.shape[0]
+    _assert_force_window_interpret_only(force_window)
     _assert_cap_contract(n, cap)
     n_pad = pad_size(n)
     if n_pad > n:

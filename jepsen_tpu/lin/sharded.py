@@ -33,6 +33,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from jepsen_tpu import util
 from jepsen_tpu.lin.bfs import KEY_FILL, _expand_keys, _pad_rows
 
 # The sparse sharded frontier keeps single-word bitsets (the all_gather
@@ -413,6 +414,7 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
             break
     if bool(overflow):
         return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
+                "overflow": "capacity",
                 "error": f"frontier exceeded {cap_schedule[-1]} per device"}
     if bool(ok):
         return {"valid?": True, "analyzer": "tpu-bfs-sharded",
@@ -491,6 +493,7 @@ def _run_packed_chunks(p, mesh, axis, tables_h, cap_schedule, *, b,
         tbl = tuple(jnp.asarray(_chunk_slice(a, base, SHARDED_CHUNK))
                     for a in tables_h)
         while True:
+            util.progress_tick()   # liveness: one tick per chunk dispatch
             k2, c2, r_done, dead, ovf, total = _search_sharded_keys(
                 *tbl, keys, counts, jnp.int32(n),
                 cap_local=cap_schedule[level], step_fn=step_fn,
@@ -500,6 +503,7 @@ def _run_packed_chunks(p, mesh, axis, tables_h, cap_schedule, *, b,
                 break
             if level + 1 >= len(cap_schedule):
                 return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
+                        "overflow": "capacity",
                         "error": (f"frontier exceeded {cap_schedule[-1]} "
                                   f"per device")}
             # Retry this chunk from its entry frontier at the next cap.

@@ -200,6 +200,31 @@ def nemesis_intervals(history) -> list[tuple]:
     return intervals
 
 
+# ---------------------------------------------------------------------------
+# Liveness progress counter (bench probe watchdog).
+#
+# The device engines tick this at every host-visible step (chunk batch,
+# host-row closure dispatch, spike mini-chunk, dense chunk, batched key
+# group). A monitoring thread (bench.py probe children) samples it: the
+# counter advancing proves dispatches are completing, so a stalled value
+# discriminates a WEDGED tunnel dispatch (observed ~25 min on the shared
+# chip) from a merely long-running but progressing search. Monotonic,
+# process-local, monitoring-grade (GIL-atomic increments; no lock).
+
+_progress = 0
+
+
+def progress_tick() -> None:
+    """Record one unit of engine forward progress (see above)."""
+    global _progress
+    _progress += 1
+
+
+def progress() -> int:
+    """Current progress counter value (monotonic within a process)."""
+    return _progress
+
+
 def enable_compile_cache(path: str | None = None) -> str | None:
     """Enable JAX's persistent compilation cache rooted in the repo.
 

@@ -1,0 +1,238 @@
+"""Loss-proof bench artifact + probe stall watchdog (round-6
+satellites; BENCH_r05 recorded ``parsed: null`` because one external
+timeout erased every number, and the shared-chip tunnel has wedged
+single dispatches ~25 min).
+
+These tests exercise bench.py's parent-side machinery with scripted
+child processes and stubbed probes — no jax, no device — so they run
+in the quick tier and in any environment.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+pytestmark = pytest.mark.quick
+
+_BENCH_PATH = os.path.join(os.path.dirname(__file__), os.pardir,
+                           "bench.py")
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench_under_test",
+                                                  _BENCH_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _child(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return [sys.executable, str(p)]
+
+
+def test_partitioned_budget_derives_from_time_spent(bench, monkeypatch):
+    monkeypatch.setattr(bench, "TOTAL_BUDGET_S", 7000)
+    t0 = time.time()
+    # Nothing spent yet: the full ceiling fits.
+    assert bench._partitioned_budget(t0, 5300) == 5300
+    # 3000 s already burned by earlier probes: the budget shrinks so
+    # the bench total stays inside the driver's window.
+    assert bench._partitioned_budget(t0 - 3000, 5300) == pytest.approx(
+        4000, abs=2)
+    # Never below the floor, even when the clock is exhausted.
+    assert bench._partitioned_budget(t0 - 9000, 5300) == \
+        bench.PARTITIONED_MIN_S
+
+
+def test_probe_child_result_parses(bench, tmp_path):
+    argv = _child(tmp_path, "ok.py", """
+        import json
+        print("HB 1", flush=True)
+        print(json.dumps({"verdict": True, "seconds": 0.1}))
+    """)
+    r, why = bench._run_probe_subprocess("x", timeout=30, argv=argv,
+                                         stall_s=20)
+    assert why is None
+    assert r == {"verdict": True, "seconds": 0.1}
+
+
+def test_watchdog_kills_stalled_child(bench, tmp_path):
+    # A child whose heartbeat VALUE stops advancing is a wedged
+    # dispatch: the watchdog must kill it after ~stall_s, not wait out
+    # the probe budget (a wedged probe costs its detection window).
+    argv = _child(tmp_path, "stall.py", """
+        import time
+        print("HB 7", flush=True)
+        while True:
+            time.sleep(0.3)
+            print("HB 7", flush=True)   # alive but NOT progressing
+    """)
+    t0 = time.time()
+    r, why = bench._run_probe_subprocess("x", timeout=60, argv=argv,
+                                         stall_s=2)
+    dt = time.time() - t0
+    assert why == "stall"
+    assert "stalled" in r["error"]
+    assert dt < 30, f"stall detection took {dt:.1f}s, not ~2s"
+
+
+def test_watchdog_spares_progressing_child(bench, tmp_path):
+    # Advancing heartbeat values reset the stall clock: a slow but
+    # progressing probe survives a stall_s shorter than its runtime.
+    argv = _child(tmp_path, "slowok.py", """
+        import json, time
+        for i in range(8):
+            time.sleep(0.5)
+            print(f"HB {i}", flush=True)
+        print(json.dumps({"verdict": True}))
+    """)
+    r, why = bench._run_probe_subprocess("x", timeout=60, argv=argv,
+                                         stall_s=2)
+    assert why is None
+    assert r == {"verdict": True}
+
+
+def test_stall_retries_once_and_records(bench, tmp_path, monkeypatch):
+    # First attempt wedges; the retry runs with the remaining budget
+    # and the artifact records both the retry count and the first
+    # attempt's error.
+    marker = tmp_path / "ran_once"
+    argv = _child(tmp_path, "flaky.py", f"""
+        import json, os, time
+        marker = {str(marker)!r}
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            print("HB 0", flush=True)
+            while True:               # wedge forever on the first run
+                time.sleep(0.3)
+                print("HB 0", flush=True)
+        print(json.dumps({{"verdict": True, "attempt": 2}}))
+    """)
+    real = bench._run_probe_subprocess
+
+    def fake(key, timeout, env_extra=None, stall_s=bench.STALL_S):
+        return real(key, timeout, env_extra=env_extra, stall_s=2,
+                    argv=argv)
+
+    monkeypatch.setattr(bench, "_run_probe_subprocess", fake)
+    r = bench._run_probe("x", timeout=60)
+    assert r["verdict"] is True and r["attempt"] == 2
+    assert r["stall_retries"] == 1
+    assert "stalled" in r["first_attempt"]["error"]
+
+
+def test_wide_probes_reemit_after_every_probe(bench, monkeypatch,
+                                              capsys):
+    # The loss-proof contract: the full result line is re-printed after
+    # EVERY completed probe, so killing the bench at any point leaves
+    # the probes completed so far on stdout's last JSON line.
+    monkeypatch.setattr(bench, "PROBE_ORDER",
+                        (("alpha", 10), ("beta", 10),
+                         ("partitioned_c30", 100)))
+    monkeypatch.setattr(
+        bench, "_run_probe",
+        lambda key, timeout, env_extra=None, stall_s=None:
+        {"verdict": True, "probe": key})
+    out = {"metric": "m", "value": 1, "detail": {}}
+    bench._wide_probes(out["detail"], out, time.time())
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.splitlines() if ln.strip()]
+    assert len(lines) == 3            # one emission per probe
+    # Each successive line strictly grows the completed-probe set, and
+    # the LAST line carries all of them (what an external kill leaves).
+    assert set(lines[0]["detail"]) == {"alpha"}
+    assert set(lines[1]["detail"]) == {"alpha", "beta"}
+    assert set(lines[2]["detail"]) == {"alpha", "beta",
+                                       "partitioned_c30"}
+    # The partitioned probe ran the SYNC_CHUNKS=8 + fused-closure
+    # re-test first and recorded the gating evidence + its derived
+    # budget in the artifact.
+    part = lines[2]["detail"]["partitioned_c30"]
+    assert part["sync_chunks"] == 8 and part["fused_closure"] == 1
+    # Experimental (non-final) rungs get the remaining clock capped by
+    # the ceiling, NOT the PARTITIONED_MIN_S floor (the floor is
+    # reserved for the final proven rung).
+    assert 0 < part["budget_seconds"] <= 100
+
+
+def test_partitioned_attempt_ladder_preserves_headline(bench,
+                                                       monkeypatch):
+    # Every rung failing must still leave detail["partitioned_c30"]
+    # populated (no KeyError for artifact consumers), archive each
+    # failed rung under its suffixed key, and END the ladder on the
+    # proven round-5 shape (SYNC_CHUNKS=2, FUSED_CLOSURE=0) so a fault
+    # in the fused program alone cannot cost the headline number.
+    monkeypatch.setattr(bench, "PROBE_ORDER", (("partitioned_c30", 100),))
+    monkeypatch.setattr(bench, "_verify_recovery", lambda: True)
+    seen = []
+
+    def fake_probe(key, timeout, env_extra=None, stall_s=None):
+        seen.append(dict(env_extra))
+        return {"error": "boom"}
+
+    monkeypatch.setattr(bench, "_run_probe", fake_probe)
+    detail: dict = {}
+    out = {"detail": detail}
+    bench._wide_probes(detail, out, time.time())
+    assert [e["JEPSEN_TPU_SYNC_CHUNKS"] for e in seen] == ["8", "2", "2"]
+    assert [e["JEPSEN_TPU_FUSED_CLOSURE"] for e in seen] == \
+        ["1", "1", "0"]
+    for tag in ("sync8", "sync2", "unfused"):
+        assert "error" in detail[f"partitioned_c30_{tag}"]
+    final = detail["partitioned_c30"]
+    assert final["fused_closure"] == 0 and final["sync_chunks"] == 2
+
+    # A success mid-ladder stops escalation: the fused sync2 rung
+    # winning means the unfused fallback never runs.
+    seen.clear()
+    detail.clear()
+
+    def flaky_probe(key, timeout, env_extra=None, stall_s=None):
+        seen.append(dict(env_extra))
+        if env_extra["JEPSEN_TPU_SYNC_CHUNKS"] == "8":
+            return {"error": "boom"}
+        return {"verdict": True}
+
+    monkeypatch.setattr(bench, "_run_probe", flaky_probe)
+    bench._wide_probes(detail, out, time.time())
+    assert len(seen) == 2
+    assert detail["partitioned_c30"]["verdict"] is True
+    assert detail["partitioned_c30"]["fused_closure"] == 1
+    assert "partitioned_c30_sync8" in detail
+    assert "partitioned_c30_unfused" not in detail
+
+
+def test_partitioned_ladder_reserves_floor_for_fallback(bench,
+                                                        monkeypatch):
+    # With the wall clock nearly exhausted, the experimental rungs are
+    # SKIPPED (recorded as such) and the whole remaining floor goes to
+    # the proven round-5 fallback rung — the budget floor is spent
+    # once, not once per rung.
+    monkeypatch.setattr(bench, "PROBE_ORDER", (("partitioned_c30", 100),))
+    monkeypatch.setattr(bench, "_verify_recovery", lambda: True)
+    monkeypatch.setattr(bench, "TOTAL_BUDGET_S",
+                        bench.PARTITIONED_MIN_S * 1.5)
+    seen = []
+
+    def fake_probe(key, timeout, env_extra=None, stall_s=None):
+        seen.append(dict(env_extra))
+        return {"verdict": True}
+
+    monkeypatch.setattr(bench, "_run_probe", fake_probe)
+    detail: dict = {}
+    bench._wide_probes(detail, {"detail": detail}, time.time())
+    assert len(seen) == 1
+    assert seen[0]["JEPSEN_TPU_FUSED_CLOSURE"] == "0"
+    assert "skipped" in detail["partitioned_c30_sync8"]["error"]
+    assert "skipped" in detail["partitioned_c30_sync2"]["error"]
+    assert detail["partitioned_c30"]["verdict"] is True
+    assert detail["partitioned_c30"]["budget_seconds"] == \
+        bench.PARTITIONED_MIN_S

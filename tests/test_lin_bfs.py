@@ -61,14 +61,68 @@ class TestBasics:
 
     def test_overflow_returns_unknown(self):
         # With the spike/host executors' caps also exhausted, overflow
-        # is an honest unknown (never a truncated-frontier verdict).
+        # is an honest unknown (never a truncated-frontier verdict),
+        # tagged as a CAPACITY overflow (the frontier genuinely
+        # outgrew the last cap — distinct from a closure pass-budget
+        # exhaustion, which reports "budget").
         h = synth.generate_register_history(30, concurrency=5, seed=1,
                                             crash_prob=0.3)
         p = prepare.prepare(m.cas_register(), h)
         r = bfs.check_packed(p, cap_schedule=(1,), spike_caps=(2,),
                              host_caps=(2,))
         assert r["valid?"] == "unknown"
-        assert "exceeded" in r["error"]
+        assert r["overflow"] == "capacity"
+        assert "frontier exceeded capacity" in r["error"]
+
+    @pytest.mark.parametrize("fused", ["1", "0"])
+    def test_pass_budget_exhaustion_reports_budget(self, monkeypatch,
+                                                   fused):
+        # A 1-pass closure budget cannot settle any real crash-dom
+        # wave: the host-row executor must escalate through its caps
+        # and then report the exhaustion as a BUDGET overflow (the
+        # nontermination class round 5 diagnosed), not a capacity
+        # overflow — on both the fused fixpoint program and the
+        # per-pass fallback.
+        monkeypatch.setenv("JEPSEN_TPU_HOST_IT_MAX", "1")
+        monkeypatch.setenv("JEPSEN_TPU_FUSED_CLOSURE", fused)
+        h = synth.generate_register_history(30, concurrency=5, seed=1,
+                                            crash_prob=0.3)
+        p = prepare.prepare(m.cas_register(), h)
+        r = bfs.check_packed(p, cap_schedule=(2,), host_caps=(4096,))
+        assert r["valid?"] == "unknown"
+        assert r["overflow"] == "budget"
+        assert "closure pass budget exceeded" in r["error"]
+        # The budget taxonomy rides the host-stats observability too.
+        assert r["host-stats"]["dispatches"] >= 1
+
+    def test_unfused_closure_fallback_parity(self, monkeypatch):
+        # JEPSEN_TPU_FUSED_CLOSURE=0 (the fault-triage fallback: one
+        # dispatch per closure pass, the round-5 shape) must decide
+        # exactly like the fused fixpoint program.
+        monkeypatch.setenv("JEPSEN_TPU_FUSED_CLOSURE", "0")
+        h = synth.generate_register_history(30, concurrency=5, seed=1,
+                                            crash_prob=0.3)
+        p = prepare.prepare(m.cas_register(), h)
+        want = cpu.check_packed(p)["valid?"]
+        r = bfs.check_packed(p, cap_schedule=(1,), spike_caps=(512, 4096))
+        assert r["valid?"] == want
+
+    def test_host_stats_reported(self):
+        # Any search that entered the host-row executor reports its
+        # episode/dispatch/pass counters (the round-6 acceptance
+        # metric: fused dispatches per row ~= capacity escalations,
+        # far below the per-pass count).
+        h = synth.generate_register_history(30, concurrency=5, seed=1,
+                                            crash_prob=0.3)
+        p = prepare.prepare(m.cas_register(), h)
+        r = bfs.check_packed(p, cap_schedule=(1,), spike_caps=(512, 4096))
+        # This shape is KNOWN to route rows through the host executor
+        # (cap 1 overflows immediately); host-stats must be attached —
+        # a conditional check here would go silently vacuous if the
+        # stats wiring broke.
+        s = r["host-stats"]
+        assert s["episodes"] >= 1 and s["rows"] >= 1
+        assert s["passes"] >= s["dispatches"] >= 1
 
     def test_overflow_spills_to_spike_executor(self):
         # Chunked caps exhausted -> the host-driven executors (host-row

@@ -360,7 +360,11 @@ class TestWideWindowDevice:
         p = prepare.prepare(m.cas_register(), h)
         r = bfs.check_packed(p, cap_schedule=(2,), host_caps=(4,))
         assert r["valid?"] == "unknown"
-        assert "exceeded" in r["error"]
+        # Taxonomy: a genuine frontier-size overflow reports
+        # "capacity"; closure pass-budget exhaustion would report
+        # "budget" (see test_lin_bfs).
+        assert r["overflow"] == "capacity"
+        assert "frontier exceeded capacity" in r["error"]
 
     def test_explain_through_host_row_death(self):
         """A death decided inside host-row mode must still produce
