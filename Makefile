@@ -1,6 +1,6 @@
 # jepsen_tpu development targets.
 
-.PHONY: test test-quick integration integration-local bench
+.PHONY: test test-quick integration integration-local bench probe-config5
 
 # Unit + parity suite on the virtual 8-device CPU mesh (no cluster).
 # Hardware note: ~8 min on a 4-core box; the compile-heavy lin parity
@@ -32,3 +32,17 @@ integration-local:
 # Headline benchmark on the real TPU chip (exclusive).
 bench:
 	python bench.py
+
+# One-command probe of the EXACT config-5 history (100k-op partitioned,
+# window 49, 24 crashed mutators, pair keys) — CLAUDE.md says to probe
+# this shape after every engine change; the 5k/window-25 shapes do not
+# exercise the crash-dom/host-row paths at all. Runs one timed check in
+# the bench's probe harness (heartbeat lines + host-stats in the result
+# JSON), timeout-guarded so a wedged tunnel dispatch cannot hold the
+# shell. Takes the real TPU chip exclusively; engine env knobs
+# (doc/env.md) pass through, e.g.:
+#   make probe-config5 JEPSEN_TPU_HOST_ROWS_K=1
+PROBE_CONFIG5_TIMEOUT ?= 5400
+probe-config5:
+	timeout -k 30 $(PROBE_CONFIG5_TIMEOUT) \
+		python bench.py --probe partitioned_c30

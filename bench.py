@@ -69,6 +69,12 @@ WORKER_RESTART_S = 75
 # (env-overridable for driver environments with different budgets).
 TOTAL_BUDGET_S = float(os.environ.get("JEPSEN_TPU_BENCH_BUDGET", 7000))
 PARTITIONED_MIN_S = 900
+# Budget for the SMALL-input probe of the K-row wave program that
+# gates the wave rungs of the partitioned ladder (CLAUDE.md: probe new
+# kernels on small inputs with a timeout first — a fault kills the
+# worker for ~a minute, and a wedge would otherwise burn a full
+# partitioned stall window before the ladder fell through).
+WAVE_SMOKE_BUDGET_S = 600
 
 # Probe stall watchdog: children emit "HB <progress>" heartbeat lines
 # every HEARTBEAT_S from the engines' liveness counter
@@ -242,6 +248,39 @@ def _probe_partitioned_c30():
             100_000, seed=7, invoke_bias=0.45), 100_000, warm=False)
 
 
+def _probe_wave_smoke():
+    """Small-input probe of the round-7 K-row wave program
+    (bfs._host_closure_fixpoint_rows) at the TOP host capacity — the
+    rows*cap envelope the program has never run on this chip. The
+    window-34 pair-band witness shape (140 ops) is forced entirely
+    through host rows with K=4 at cap 524288, so one seconds-scale
+    fault-isolated run exercises exactly what the multi-hour wave
+    rungs would; the ladder skips those rungs if this fails
+    (probe-small-first, CLAUDE.md)."""
+    from jepsen_tpu import models as m
+    from jepsen_tpu.lin import bfs, prepare, synth
+
+    os.environ["JEPSEN_TPU_HOST_STICKY"] = "1"
+    os.environ["JEPSEN_TPU_HOST_ROWS_K"] = "4"
+    h = synth.generate_partitioned_register_history(
+        140, concurrency=40, seed=0, partition_every=60,
+        partition_len=20, max_crashes=10)
+    p = prepare.prepare(m.cas_register(), h)
+    t0 = time.time()
+    r = bfs.check_packed(p, cap_schedule=(8,),
+                         host_caps=bfs.HOST_ROW_CAPS[-1:])
+    out = {"events": len(h), "window": p.window,
+           "host_cap": bfs.HOST_ROW_CAPS[-1],
+           "verdict": r.get("valid?"),
+           "seconds": round(time.time() - t0, 1),
+           "host_stats": r.get("host-stats")}
+    if r.get("valid?") is not True:
+        out["error"] = f"wave smoke verdict {r.get('valid?')!r}"
+    elif not (r.get("host-stats") or {}).get("multi_rows"):
+        out["error"] = "wave smoke ran no wave batches (vacuous probe)"
+    return out
+
+
 def _probe_independent_keys():
     """BASELINE config 4: per-key registers decided as ONE vmapped
     device batch (lin.batched; independent.clj:246-296 checks keys one
@@ -279,7 +318,8 @@ def _probe_independent_keys():
 PROBES = {"ping": _probe_ping, "mutex_c30": _probe_mutex_c30,
           "wide_window_c30": _probe_wide_window_c30,
           "partitioned_c30": _probe_partitioned_c30,
-          "independent_keys": _probe_independent_keys}
+          "independent_keys": _probe_independent_keys,
+          "wave_smoke": _probe_wave_smoke}
 
 
 def _run_probe_subprocess(key: str, timeout: int, env_extra=None,
@@ -415,31 +455,92 @@ def _wide_probes(detail: dict, out: dict, t_start: float) -> None:
     partitioned_c30 runs an ATTEMPT LADDER, most experimental first,
     each rung fault-isolated in its own subprocess with its config
     recorded so failures archive as gating evidence instead of erasing
-    the headline: (1) SYNC_CHUNKS=8 + fused closure — the round-6
-    re-test of round 4's queue-depth blame that round 5's orbit
-    diagnosis un-established; (2) SYNC_CHUNKS=2 + fused — the
-    conservative queue depth with the round-6 fused fixpoint; (3)
-    SYNC_CHUNKS=2 + FUSED_CLOSURE=0 — the literal round-5 shape that
-    is PROVEN to decide on this chip, so a fault in the never-probed
-    fused program cannot cost the headline partitioned number. Every
-    env var is forced explicitly (children inherit the parent env; an
-    exported override must not run a rung at a config other than the
-    one its artifact records)."""
+    the headline. The round-7 ladder peels the wave-executor axes off
+    one at a time, so a fault names its own culprit and the final rung
+    is always a shape already proven on this chip. The wave rungs are
+    additionally gated by a ``wave_smoke`` pre-probe — the K-row
+    program on the SMALL window-34 witness shape at the top host cap
+    (probe-small-first, CLAUDE.md): if the seconds-scale probe fails,
+    the wave rungs are skipped (recorded) instead of spending
+    multi-hour budgets discovering the same fault. The rungs:
+    (1) ``wave8`` —
+    sticky caps + K=4 fused wave batches + SYNC_CHUNKS=8 (the full
+    round-7 configuration, including the round-6 queue-depth re-test);
+    (2) ``wave`` — the same at the conservative SYNC_CHUNKS=2, so a
+    wave fault is separated from a queue-depth fault; (3) ``sticky``
+    — sticky caps only (K=1: no never-probed device program, the
+    wave's host-side scheduling half); (4) ``r6`` — the literal
+    round-6 fused shape (sticky off, K=1); (5) ``unfused`` —
+    FUSED_CLOSURE=0, the round-5 per-pass shape PROVEN to decide on
+    this chip, so no experimental fault can cost the headline
+    partitioned number. Every env var is forced explicitly on every
+    rung (children inherit the parent env; an exported override must
+    not run a rung at a config other than the one its artifact
+    records). Each rung's result carries ``host_stats`` (per-cap wall
+    seconds, wasted escalation passes, sticky hit/miss, wave-batch
+    dispatch counts — bfs._host_rows), so the dispatch-drop factor
+    and the residual cost profile read directly off the artifact."""
     if os.environ.get("JEPSEN_TPU_BENCH_WIDE", "1") == "0":
         return
     for i, (key, ceiling) in enumerate(PROBE_ORDER):
         if key == "partitioned_c30":
+            def _rung(sync, fused, sticky, k, tag):
+                return ({"JEPSEN_TPU_SYNC_CHUNKS": str(sync),
+                         "JEPSEN_TPU_FUSED_CLOSURE": str(fused),
+                         "JEPSEN_TPU_HOST_STICKY": str(sticky),
+                         "JEPSEN_TPU_HOST_ROWS_K": str(k)},
+                        {"sync_chunks": sync, "fused_closure": fused,
+                         "host_sticky": sticky, "host_rows_k": k}, tag)
+
             attempts = (
-                ({"JEPSEN_TPU_SYNC_CHUNKS": "8",
-                  "JEPSEN_TPU_FUSED_CLOSURE": "1"},
-                 {"sync_chunks": 8, "fused_closure": 1}, "sync8"),
-                ({"JEPSEN_TPU_SYNC_CHUNKS": "2",
-                  "JEPSEN_TPU_FUSED_CLOSURE": "1"},
-                 {"sync_chunks": 2, "fused_closure": 1}, "sync2"),
-                ({"JEPSEN_TPU_SYNC_CHUNKS": "2",
-                  "JEPSEN_TPU_FUSED_CLOSURE": "0"},
-                 {"sync_chunks": 2, "fused_closure": 0}, "unfused"),
+                _rung(8, 1, 1, 4, "wave8"),
+                _rung(2, 1, 1, 4, "wave"),
+                _rung(2, 1, 1, 1, "sticky"),
+                _rung(2, 1, 0, 1, "r6"),
+                _rung(2, 0, 0, 1, "unfused"),
             )
+            # Probe-small-first gate (CLAUDE.md): the K-row wave
+            # program has never run on this chip, so a seconds-scale
+            # small-shape probe at the top host cap decides whether
+            # the wave rungs may spend multi-hour budgets on it — a
+            # wedge in an ungated rung would burn a full
+            # PARTITIONED_STALL_S window (plus a retry) per rung.
+            wave_ok = False
+            smoke_ran = False
+            remaining = TOTAL_BUDGET_S - (time.time() - t_start)
+            # Only run the smoke when a wave rung could still run
+            # AFTER it at worst case — otherwise the smoke's budget
+            # comes straight out of the proven final rung for a
+            # gating decision nothing consumes.
+            if remaining >= 2 * PARTITIONED_MIN_S + WAVE_SMOKE_BUDGET_S:
+                smoke_ran = True
+                smoke = _run_probe(
+                    "wave_smoke", WAVE_SMOKE_BUDGET_S,
+                    env_extra={"JEPSEN_TPU_SYNC_CHUNKS": "2",
+                               "JEPSEN_TPU_FUSED_CLOSURE": "1",
+                               "JEPSEN_TPU_HOST_STICKY": "1",
+                               "JEPSEN_TPU_HOST_ROWS_K": "4"},
+                    stall_s=WAVE_SMOKE_BUDGET_S / 2)
+                detail["wave_smoke"] = smoke
+                _emit(out)
+                wave_ok = "error" not in smoke
+                if not wave_ok:
+                    # The smoke fault may have killed the worker; the
+                    # remaining (non-wave) rungs need it back. A
+                    # failed recovery abandons the whole ladder (the
+                    # per-rung pattern below) — dispatching a rung at
+                    # a dead worker burns its stall window for
+                    # nothing, and detail[key] must still be
+                    # populated for artifact consumers.
+                    recovered = _verify_recovery()
+                    smoke["worker_recovered"] = recovered
+                    _emit(out)
+                    if not recovered:
+                        attempts = ()
+                        r = {"error": ("wave smoke fault killed the "
+                                       "TPU worker and it did not "
+                                       "recover; partitioned ladder "
+                                       "abandoned")}
             for a_i, (env_extra, tags, tag) in enumerate(attempts):
                 last = a_i + 1 == len(attempts)
                 remaining = TOTAL_BUDGET_S - (time.time() - t_start)
@@ -454,6 +555,18 @@ def _wide_probes(detail: dict, out: dict, t_start: float) -> None:
                     skipped["error"] = ("skipped: remaining budget "
                                        "reserved for the proven "
                                        "fallback rung")
+                    detail[f"partitioned_c30_{tag}"] = skipped
+                    continue
+                if tags["host_rows_k"] > 1 and not wave_ok:
+                    # Honest skip reason: a smoke that FAILED is
+                    # gating evidence against the wave program; a
+                    # smoke that never ran (no clock for it) is not.
+                    skipped = dict(tags)
+                    skipped["error"] = (
+                        "skipped: wave smoke probe failed "
+                        "(probe-small-first)" if smoke_ran else
+                        "skipped: no budget to smoke-probe the wave "
+                        "program (probe-small-first)")
                     detail[f"partitioned_c30_{tag}"] = skipped
                     continue
                 budget = _partitioned_budget(t_start, ceiling) if last \

@@ -44,6 +44,7 @@ even that overflows does the verdict become an honest "unknown"
 from __future__ import annotations
 
 import os
+import time as _time
 from functools import partial
 
 import jax
@@ -163,6 +164,30 @@ def _fused_closure() -> bool:
     falls back to one dispatch per closure pass (the round-5 shape) for
     fault triage on the real chip."""
     return os.environ.get("JEPSEN_TPU_FUSED_CLOSURE", "1") != "0"
+
+
+def _host_sticky() -> bool:
+    """Sticky-cap escalation in the host-row executor: the wave's last
+    converged capacity level seeds the next row's starting level, so a
+    blowup wave stops re-climbing the ladder from ``lvl_for(count)``
+    on every row (each failed climb is a full fused fixpoint run whose
+    output is thrown away). Host-side scheduling only — the escalate-
+    on-overflow semantics (and therefore soundness) are untouched.
+    ``JEPSEN_TPU_HOST_STICKY=0`` restores the round-6 cold ladder for
+    fault triage and A/B timing."""
+    return os.environ.get("JEPSEN_TPU_HOST_STICKY", "1") != "0"
+
+
+def _host_rows_k() -> int:
+    """Rows per fused multi-row closure program (the wave fast path):
+    a same-cap wave of K consecutive rows costs ~1 dispatch per K rows
+    instead of one per row. Default 4 — well inside the runtime-safety
+    envelope (8-row chunks run clean at cap 2^20; rows*cap program
+    complexity is the fault driver, round-3/5 lore).
+    ``JEPSEN_TPU_HOST_ROWS_K=1`` forces the proven one-row-per-dispatch
+    round-6 path (also forced when FUSED_CLOSURE=0)."""
+    env = os.environ.get("JEPSEN_TPU_HOST_ROWS_K", "")
+    return max(1, int(env)) if env else 4
 
 
 def _host_it_max(W: int) -> int:
@@ -1460,8 +1485,12 @@ def _host_closure_pass(lo, hi, count, act, v_row, pure_row, exp_r, *,
         lo, hi, count, act, v_row, pure_row, exp_r, cap=cap, W=W, b=b,
         nil_id=nil_id, step_fn=step_fn, use_psort=False,
         crash_dom=crash_dom, dom_iters=6)
+    # The settled count rides the flag vector so the host's one
+    # np.asarray fetch per pass covers it — the sticky-cap peak signal
+    # must not cost a second ~100 ms tunnel round trip on the round-5
+    # triage fallback path.
     return l2, h2, n2, jnp.stack([changed.astype(jnp.int32),
-                                  ovf.astype(jnp.int32)])
+                                  ovf.astype(jnp.int32), n2])
 
 
 @partial(jax.jit, static_argnames=("cap", "W", "b", "nil_id", "step_fn",
@@ -1492,7 +1521,7 @@ def _host_closure_fixpoint(lo, hi, count, act, v_row, pure_row, exp_r,
 
     The return-event filter is fused in: when the closure converges the
     returned arrays are already filtered, so a clean row costs ONE
-    dispatch + one 4-int flag fetch. It honors ``use_psort`` exactly
+    dispatch + one 5-int flag fetch. It honors ``use_psort`` exactly
     like the unfused fallback's _host_filter_pass (only the CLOSURE
     pass forces the lax chain path — see _host_closure_pass), so
     FUSED_CLOSURE=0 triage compares the same program mix, just
@@ -1503,28 +1532,120 @@ def _host_closure_fixpoint(lo, hi, count, act, v_row, pure_row, exp_r,
     Convergence is tested before the ceiling: a pass that reaches the
     fixpoint exactly at ``it_max`` exits converged, not overflowed.
 
-    Returns (lo, hi, flags) with flags = i32[4]:
-    [converged, dedup_overflow, passes_used, post-filter count]."""
+    ``peak`` (the largest SETTLED per-pass frontier count seen during
+    the closure) rides the carry at zero extra dispatch cost: it is
+    the sticky-cap decay signal — ``lvl_for(count)`` underestimates a
+    wave row's true capacity need (the entry count is small; the
+    mid-closure frontier is what outgrows the cap), while the peak is
+    exactly the quantity the capacity must hold.
+
+    Returns (lo, hi, flags) with flags = i32[5]:
+    [converged, dedup_overflow, passes_used, post-filter count,
+    peak settled count]."""
+    lo, hi, count, it, converged, ovf, peak = _closure_fixpoint_loop(
+        lo, hi, count, act, v_row, pure_row, exp_r, count, cap=cap,
+        W=W, b=b, nil_id=nil_id, step_fn=step_fn, crash_dom=crash_dom,
+        it_max=it_max, dom_iters=dom_iters)
+    lo, hi, count = _filter_keys_any(lo, hi, count, ret, cap=cap, b=b,
+                                     use_psort=use_psort, key_hi=key_hi)
+    return lo, hi, jnp.stack([converged.astype(jnp.int32),
+                              ovf.astype(jnp.int32), it, count, peak])
+
+
+def _closure_fixpoint_loop(lo, hi, count, act, v_row, pure_row, exp_r,
+                           peak, *, cap, W, b, nil_id, step_fn,
+                           crash_dom, it_max, dom_iters):
+    """THE one-row host closure fixpoint ``lax.while_loop`` (traceable,
+    not jitted itself), shared by the one-row program
+    (_host_closure_fixpoint) and the K-row wave program
+    (_host_closure_fixpoint_rows) so the wave fast path can never
+    silently drift from the proven per-row pass/ceiling semantics —
+    the _filter_keys_any precedent from round 6. ``peak`` is the
+    loop-carried max settled count (the sticky-cap decay signal).
+    Returns (lo, hi, count, passes, converged, ovf, peak)."""
     def cond(c):
-        _, _, _, it, changed, ovf = c
+        _, _, _, it, changed, ovf, _ = c
         return changed & ~ovf & (it < it_max)
 
     def body(c):
-        lo, hi, count, it, _, ovf = c
+        lo, hi, count, it, _, ovf, pk = c
         l2, h2, n2, changed, o2 = _closure_pass_keys_compact(
             lo, hi, count, act, v_row, pure_row, exp_r, cap=cap, W=W,
             b=b, nil_id=nil_id, step_fn=step_fn, use_psort=False,
             crash_dom=crash_dom, dom_iters=dom_iters)
-        return (l2, h2, n2, it + 1, changed, ovf | o2)
+        return (l2, h2, n2, it + 1, changed, ovf | o2,
+                jnp.maximum(pk, n2))
 
-    lo, hi, count, it, changed, ovf = lax.while_loop(
+    lo, hi, count, it, changed, ovf, peak = lax.while_loop(
         cond, body,
-        (lo, hi, count, jnp.int32(0), jnp.bool_(True), jnp.bool_(False)))
-    converged = ~changed & ~ovf
-    lo, hi, count = _filter_keys_any(lo, hi, count, ret, cap=cap, b=b,
-                                     use_psort=use_psort, key_hi=key_hi)
-    return lo, hi, jnp.stack([converged.astype(jnp.int32),
-                              ovf.astype(jnp.int32), it, count])
+        (lo, hi, count, jnp.int32(0), jnp.bool_(True), jnp.bool_(False),
+         peak))
+    return lo, hi, count, it, ~changed & ~ovf, ovf, peak
+
+
+@partial(jax.jit, static_argnames=("cap", "W", "b", "nil_id", "step_fn",
+                                   "use_psort", "crash_dom", "key_hi",
+                                   "it_max", "K", "dom_iters"))
+def _host_closure_fixpoint_rows(lo, hi, count, acts, v_rows, pure_rows,
+                                exp_rs, rets, n_rows, *, cap, W, b,
+                                nil_id, step_fn, use_psort, crash_dom,
+                                key_hi, it_max, K, dom_iters=6):
+    """The WAVE fast path of the host-row executor: K consecutive rows
+    as ONE device program — an outer ``lax.while_loop`` over row index
+    whose body is exactly the one-row fused fixpoint
+    (_host_closure_fixpoint: ungrouped closure passes with the
+    iteration ceiling in-program, return filter fused in). A same-cap
+    wave then costs ~1 tunnel dispatch per K rows instead of per row.
+
+    Strictly OPTIMISTIC: the program commits only when every row
+    converges cleanly. Any in-program trip — a dedup overflow, a pass
+    budget exhaustion (both leave that row's arrays mid-closure
+    garbage), or death (the explain snapshot must re-anchor at the
+    dead row's entry) — exits the row loop early, and the host resumes
+    PER-ROW from the batch entry snapshot, i.e. the proven round-6
+    path with its escalation/taxonomy/witness semantics untouched.
+    Soundness therefore never rests on this program: a committed batch
+    is one that ran the identical per-row pass/filter pipeline to
+    convergence, merely fused.
+
+    Runtime-safety envelope: K rows at one cap — round-3/5 lore has
+    8/32/64-row chunk programs clean at cap 2^20 while 512-row chunks
+    fault at 2^18 (rows*cap complexity is the driver), and K defaults
+    to 4 (_host_rows_k). The closure is ungrouped everywhere, so the
+    per-row fixpoint terminates for the same reason the one-row
+    program does. Per-row tables arrive stacked [K, ...]; rows past
+    ``n_rows`` are zero padding and never execute (the row loop stops
+    first — running a padding row's filter would corrupt the
+    frontier).
+
+    Returns (lo, hi, flags) with flags = i32[6]:
+    [rows_done, all_converged, dead, total passes, peak settled count,
+    post-filter count]."""
+    def row_cond(c):
+        i, _, _, _, _, _, clean, dead = c
+        return (i < n_rows) & clean & ~dead
+
+    def row_body(c):
+        i, lo, hi, count, it_tot, peak, _, _ = c
+        exp_r = tuple(t[i] for t in exp_rs)
+        lo, hi, count, it, converged, _, peak = _closure_fixpoint_loop(
+            lo, hi, count, acts[i], v_rows[i], pure_rows[i], exp_r,
+            peak, cap=cap, W=W, b=b, nil_id=nil_id, step_fn=step_fn,
+            crash_dom=crash_dom, it_max=it_max, dom_iters=dom_iters)
+        lo, hi, count = _filter_keys_any(lo, hi, count, rets[i],
+                                         cap=cap, b=b,
+                                         use_psort=use_psort,
+                                         key_hi=key_hi)
+        return (i + 1, lo, hi, count, it_tot + it, peak, converged,
+                count == 0)
+
+    i, lo, hi, count, it_tot, peak, clean, dead = lax.while_loop(
+        row_cond, row_body,
+        (jnp.int32(0), lo, hi, count, jnp.int32(0), count,
+         jnp.bool_(True), jnp.bool_(False)))
+    return lo, hi, jnp.stack([i, clean.astype(jnp.int32),
+                              dead.astype(jnp.int32), it_tot, peak,
+                              count])
 
 
 def _filter_keys_any(lo, hi, count, s, *, cap, b, use_psort, key_hi):
@@ -1576,6 +1697,43 @@ def _fit_keys(lo, hi, cap):
     return lo, hi
 
 
+class _HostSnapshot:
+    """LAZY explain snapshot for the host-row executor: holds the
+    packed device key arrays (immutable) and unpacks only when the
+    snapshot is actually consumed for an explain/replay. The eager
+    shape paid one unpack program dispatch (a ~100 ms tunnel round
+    trip) per host row, and a verdict consumes at most ONE snapshot —
+    every other unpack was pure waste. ``materialize()`` returns the
+    (base_row, bits, state, count) tuple witness.tail_replay_sparse
+    expects; _materialize_snapshots normalizes mixed lists."""
+
+    __slots__ = ("base", "_lo", "_hi", "_count", "_b", "_nil_id",
+                 "_nw", "_key_hi")
+
+    def __init__(self, base, lo, hi, count, b, nil_id, nw, key_hi):
+        self.base = base
+        self._lo, self._hi, self._count = lo, hi, count
+        self._b, self._nil_id = b, nil_id
+        self._nw, self._key_hi = nw, key_hi
+
+    def materialize(self):
+        bits, state = _host_unpack(
+            self._lo, self._hi, self._count, cap=self._lo.shape[0],
+            b=self._b, nil_id=self._nil_id, nw=self._nw,
+            key_hi=self._key_hi)
+        return (self.base, bits, state, self._count)
+
+
+def _materialize_snapshots(snapshots):
+    """Resolve any lazy host-row snapshots into the concrete
+    (base, bits, state, count) tuples the witness replay consumes
+    (chunk/spike snapshots are already concrete and pass through)."""
+    if snapshots is None:
+        return None
+    return [s.materialize() if isinstance(s, _HostSnapshot) else s
+            for s in snapshots]
+
+
 def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
                dropback, step_fn, state_bits, nil_id, use_psort,
                key_hi, crash_dom, cancel, snapshots,
@@ -1596,10 +1754,40 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
     pruning leaves 389k configs; rep+window converges to ~14k). Only
     rows whose frontiers outgrow the chunked tiers ever come here.
 
+    The executor is WAVE-AWARE (round 7), on three independently
+    env-gated axes over the unchanged escalation core:
+
+    - STICKY CAPS (_host_sticky): a wave's last converged capacity
+      level seeds the next row's starting level instead of the cold
+      ``lvl_for(count)`` — entry counts are small even when the
+      mid-closure frontier needs the big caps, so the cold ladder
+      re-climbs (and throws away a full fixpoint run per failed rung)
+      on every row of a wave. The sticky level decays one level per
+      row whose in-program PEAK settled count fits comfortably below
+      it, so an over-provisioned level drains back. Starting level is
+      the only thing sticky touches: overflow still escalates, so
+      soundness is untouched.
+    - WAVE BATCHES (_host_rows_k): K consecutive rows run as ONE
+      fused device program (_host_closure_fixpoint_rows) when the
+      executor is not recovering from a trip — ~1 dispatch per K rows
+      on a same-cap wave. Strictly optimistic: any in-program trip
+      (overflow, budget, death) discards the batch and resumes
+      PER-ROW from the batch entry (the proven round-6 shape).
+    - TIMING/WASTE OBSERVABILITY: see the stats keys below — bench's
+      partitioned probe surfaces them so the residual config-5 cost
+      profile reads directly off the artifact.
+
     ``stats`` (when given) accumulates observability counters:
     ``rows`` (host rows run), ``dispatches`` (closure-program
-    dispatches — the tunnel round trips the fusion is cutting) and
-    ``passes`` (closure passes executed inside them).
+    dispatches — the tunnel round trips the wave axes are cutting),
+    ``passes`` (closure passes executed inside them),
+    ``wasted_passes`` (passes whose output was discarded: failed
+    escalation rungs and tripped wave batches), ``sticky_hits`` /
+    ``sticky_misses`` (rows whose sticky-raised starting level
+    converged without / despite further escalation), ``multi_rows`` /
+    ``multi_dispatches`` / ``multi_trips`` (wave-batch traffic), and
+    ``cap_seconds`` (wall seconds of closure dispatches per
+    capacity).
 
     Same contract as _spike_rows: returns (bits, state, count_int,
     next_row, dead, overflowed, cancelled, top_cap_used) — except
@@ -1614,11 +1802,20 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
     count_i = int(count)
     top_used = caps[0]
     fused = _fused_closure()
+    sticky = _host_sticky()
+    K = _host_rows_k() if fused else 1
+    # Pass budget per (row, capacity): ungrouped convergence needs
+    # O(window) passes; exhaustion escalates like an overflow (sound —
+    # the row restarts from its entry frontier).
+    it_max = _host_it_max(W)
+    dbg = os.environ.get("JEPSEN_TPU_HOST_DEBUG") == "1"
     if stats is None:
         stats = {}
-    stats.setdefault("rows", 0)
-    stats.setdefault("dispatches", 0)
-    stats.setdefault("passes", 0)
+    for k in ("rows", "dispatches", "passes", "wasted_passes",
+              "sticky_hits", "sticky_misses", "multi_rows",
+              "multi_dispatches", "multi_trips"):
+        stats.setdefault(k, 0)
+    stats.setdefault("cap_seconds", {})
 
     def lvl_for(c):
         for i, cc in enumerate(caps):
@@ -1630,55 +1827,134 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
         return _host_unpack(lo, hi, cnt, cap=cap, b=b, nil_id=nil_id,
                             nw=nw, key_hi=key_hi)
 
+    def snap(at_r, lo, hi, cnt):
+        # Lazy: the packed refs are stored; the device->host unpack
+        # runs only if an explain/replay actually consumes them.
+        if snapshots is not None:
+            snapshots[:] = [_HostSnapshot(at_r, lo, hi, cnt, b, nil_id,
+                                          nw, key_hi)]
+
     if count_i > caps[-1]:
         return (bits, state, count_i, r0, False, "capacity", False,
                 top_used)
-    lvl = lvl_for(count_i)
+    sticky_lvl = lvl = lvl_for(count_i)
     cap = caps[lvl]
     lo, hi = _host_pack(bits, state, jnp.int32(count_i), cap=cap, b=b,
                         nil_id=nil_id, key_hi=key_hi)
     count = jnp.int32(count_i)
     r = r0
+    per_row_until = r0   # rows below this resume per-row after a trip
     while r < p.R:
         if cancel is not None and cancel.is_set():
-            bits, state = unpack(lo, hi, count, cap)
+            bits, state = unpack(lo, hi, count, lo.shape[0])
             return (bits, state, count_i, r, False, False, True,
                     top_used)
-        if snapshots is not None:
-            sb, ss = unpack(lo, hi, count, cap)
-            snapshots[:] = [(r, sb, ss, count)]
+        natural = lvl_for(count_i)
+        start_lvl = max(natural, sticky_lvl) if sticky else natural
+        raised = start_lvl > natural
+        # ---- wave fast path: K rows fused into ONE dispatch --------
+        kn = min(K, p.R - r)
+        if kn > 1 and r >= per_row_until:
+            lvl = start_lvl
+            cap = caps[lvl]
+            top_used = max(top_used, cap)
+            snap(r, lo, hi, count)
+            lo, hi = _fit_keys(lo, hi, cap)
+            entry = (lo, hi, count, lvl)
+            acts = jnp.asarray(_chunk_slice(active_h, r, K))
+            v_rows = jnp.asarray(_chunk_slice(slot_v_h, r, K))
+            pure_rows = jnp.asarray(_chunk_slice(pure_h, r, K))
+            rets = jnp.asarray(_chunk_slice(ret_slot_h, r, K))
+            exp_rs = tuple(jnp.asarray(_chunk_slice(t, r, K))
+                           for t in exp_h)
+            util.progress_tick()
+            t0 = _time.monotonic()
+            lo2, hi2, flags = _host_closure_fixpoint_rows(
+                lo, hi, count, acts, v_rows, pure_rows, exp_rs, rets,
+                jnp.int32(kn), cap=cap, W=W, b=b, nil_id=nil_id,
+                step_fn=step_fn, use_psort=use_psort,
+                crash_dom=crash_dom, key_hi=key_hi, it_max=it_max,
+                K=K)
+            done, clean, dead_f, it_tot, pk, cnt = (
+                int(x) for x in np.asarray(flags))
+            util.stat_time(stats, "cap_seconds", cap,
+                           _time.monotonic() - t0)
+            util.stat_bump(stats, "dispatches")
+            util.stat_bump(stats, "multi_dispatches")
+            util.stat_bump(stats, "passes", it_tot)
+            if dbg:
+                print(f"[host] r={r} cap={cap} wave kn={kn} "
+                      f"done={done} clean={clean} dead={dead_f} "
+                      f"it={it_tot} peak={pk} count={cnt}", flush=True)
+            if clean and not dead_f and done == kn:
+                lo, hi, count = lo2, hi2, jnp.int32(cnt)
+                count_i = cnt
+                util.stat_bump(stats, "rows", kn)
+                util.stat_bump(stats, "multi_rows", kn)
+                if sticky:
+                    if raised:
+                        util.stat_bump(stats, "sticky_hits", kn)
+                    if lvl > sticky_lvl:
+                        # A batch that ran at a HIGHER natural level
+                        # converged there: seed the next wave rows at
+                        # it (mirrors the per-row raise — without this
+                        # a stale-low sticky re-trips every batch of
+                        # the wave).
+                        sticky_lvl = lvl
+                    elif lvl_for(pk) < sticky_lvl:
+                        sticky_lvl -= 1
+                r += kn
+                if r - r0 >= min_rows and count_i <= dropback:
+                    break
+                continue
+            # Trip (overflow / budget / death somewhere in the batch):
+            # the carried arrays are mid-closure garbage for the
+            # tripped row — discard the whole batch and resume
+            # PER-ROW from the batch entry, where escalation, the
+            # overflow taxonomy, and death snapshot anchoring live.
+            util.stat_bump(stats, "multi_trips")
+            util.stat_bump(stats, "wasted_passes", it_tot)
+            lo, hi, count, lvl = entry
+            per_row_until = r + kn
+        # ---- per-row path (the proven round-6 shape) ---------------
+        snap(r, lo, hi, count)
         act = jnp.asarray(active_h[r])
         v_row = jnp.asarray(slot_v_h[r])
         pure_row = jnp.asarray(pure_h[r])
         ret = jnp.int32(int(ret_slot_h[r]))
         exp_r = tuple(jnp.asarray(t[r]) for t in exp_h)
+        lvl = start_lvl
         entry = (lo, hi, count, lvl)
-        # Pass budget per (row, capacity): ungrouped convergence needs
-        # O(window) passes; exhaustion escalates like an overflow
-        # (sound — the row restarts from its entry frontier).
-        it_max = _host_it_max(W)
         stats["rows"] += 1
         budget_out = False
         filtered = False
+        escalated = False
+        peak_row = count_i
         while True:  # closure fixpoint, escalating capacity on overflow
             cap = caps[lvl]
             top_used = max(top_used, cap)
             lo, hi = _fit_keys(lo, hi, cap)
             util.progress_tick()
             if fused:
+                t0 = _time.monotonic()
                 lo, hi, flags = _host_closure_fixpoint(
                     lo, hi, count, act, v_row, pure_row, exp_r, ret,
                     cap=cap, W=W, b=b, nil_id=nil_id, step_fn=step_fn,
                     use_psort=use_psort, crash_dom=crash_dom,
                     key_hi=key_hi, it_max=it_max)
-                conv, ov, it, cnt = (int(x) for x in np.asarray(flags))
+                conv, ov, it, cnt, pk = (int(x)
+                                         for x in np.asarray(flags))
+                util.stat_time(stats, "cap_seconds", cap,
+                               _time.monotonic() - t0)
                 stats["dispatches"] += 1
                 stats["passes"] += it
                 count = jnp.int32(cnt)
                 ovf = not conv
                 budget_out = bool(ovf and not ov)
                 filtered = True
-                if os.environ.get("JEPSEN_TPU_HOST_DEBUG") == "1":
+                if not ovf:
+                    peak_row = max(peak_row, pk)
+                if dbg:
                     print(f"[host] r={r} cap={cap} fused it={it} "
                           f"count={cnt} conv={conv} ov={ov}",
                           flush=True)
@@ -1686,19 +1962,24 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
                 it = 0
                 ovf = False
                 budget_out = False
+                pk_att = count_i
                 while True:
+                    t0 = _time.monotonic()
                     lo, hi, count, flags = _host_closure_pass(
                         lo, hi, count, act, v_row, pure_row, exp_r,
                         cap=cap, W=W, b=b, nil_id=nil_id,
                         step_fn=step_fn, use_psort=use_psort,
                         crash_dom=crash_dom)
-                    ch, ov = (int(x) for x in np.asarray(flags))
+                    ch, ov, cnt = (int(x) for x in np.asarray(flags))
+                    util.stat_time(stats, "cap_seconds", cap,
+                                   _time.monotonic() - t0)
                     it += 1
                     stats["dispatches"] += 1
                     stats["passes"] += 1
-                    if os.environ.get("JEPSEN_TPU_HOST_DEBUG") == "1":
+                    pk_att = max(pk_att, cnt)
+                    if dbg:
                         print(f"[host] r={r} cap={cap} it={it} "
-                              f"count={int(count)} ch={ch} ov={ov}",
+                              f"count={cnt} ch={ch} ov={ov}",
                               flush=True)
                     if ov:
                         ovf = True
@@ -1713,8 +1994,13 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
                         ovf = True
                         budget_out = True
                         break
+                if not ovf:
+                    peak_row = max(peak_row, pk_att)
             if not ovf:
                 break
+            # The failed rung's passes were thrown away — the waste
+            # the sticky cap exists to cut.
+            util.stat_bump(stats, "wasted_passes", it)
             if lvl + 1 >= len(caps):
                 # Overflow of the last host cap: hand back the row's
                 # ENTRY frontier (the escalation restart point — the
@@ -1731,6 +2017,20 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
                         False, top_used)
             lo, hi, count, _ = entry
             lvl += 1
+            escalated = True
+        if sticky:
+            if raised:
+                util.stat_bump(
+                    stats, "sticky_misses" if escalated
+                    else "sticky_hits")
+            if lvl > sticky_lvl:
+                sticky_lvl = lvl
+            elif lvl_for(peak_row) < sticky_lvl:
+                # Decay on the in-program peak, not the settled exit
+                # count: waves enter small and blow up mid-closure, so
+                # the exit count would decay sticky every row and
+                # re-climb every next one.
+                sticky_lvl -= 1
         if not filtered:
             lo, hi, count = _host_filter_pass(
                 lo, hi, count, ret, cap=cap, b=b,
@@ -1744,8 +2044,7 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
             return bits, state, 0, r, True, False, False, top_used
         if r - r0 >= min_rows and count_i <= dropback:
             break
-        lvl = lvl_for(count_i)
-    bits, state = unpack(lo, hi, count, cap)
+    bits, state = unpack(lo, hi, count, lo.shape[0])
     return bits, state, count_i, r, False, False, False, top_used
 
 
@@ -1968,7 +2267,10 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     cand_max = _cand_max()
     sync_chunks = _sync_chunks()
     host_stats: dict = {"episodes": 0, "rows": 0, "dispatches": 0,
-                        "passes": 0}
+                        "passes": 0, "wasted_passes": 0,
+                        "sticky_hits": 0, "sticky_misses": 0,
+                        "multi_rows": 0, "multi_dispatches": 0,
+                        "multi_trips": 0, "cap_seconds": {}}
     level = 0
     cap = cap_schedule[level]
     bits = jnp.zeros((cap, nw), jnp.uint32)
@@ -1980,7 +2282,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
 
     def _with_stats(out: dict) -> dict:
         if host_stats["episodes"]:
-            out["host-stats"] = dict(host_stats)
+            out["host-stats"] = util.round_stats(host_stats)
         return out
 
     def chunk_tables(base):
@@ -2249,8 +2551,9 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             if snapshots and not (cancel is not None and cancel.is_set()):
                 from jepsen_tpu.lin import witness
 
-                out.update(witness.tail_replay_sparse(p, snapshots, r,
-                                                      cancel=cancel))
+                out.update(witness.tail_replay_sparse(
+                    p, _materialize_snapshots(snapshots), r,
+                    cancel=cancel))
             return _with_stats(out)
         bits, state, count = b2, s2, c2
         base += n

@@ -225,6 +225,41 @@ def progress() -> int:
     return _progress
 
 
+def stat_bump(stats: dict, key: str, n: int = 1) -> None:
+    """Accumulate an integer observability counter in a stats dict
+    (host-row executor episode/dispatch/pass/waste counters — see
+    bfs._host_rows). Missing keys start at 0, so call sites never need
+    setdefault choreography."""
+    stats[key] = stats.get(key, 0) + n
+
+
+def stat_time(stats: dict, key: str, bucket, seconds: float) -> None:
+    """Accumulate wall seconds into a per-bucket timing histogram
+    ``stats[key][bucket]`` (e.g. per-capacity closure wall time,
+    bucket = the cap). Raw float accumulation — round at reporting
+    time (round_stats), not per sample."""
+    d = stats.setdefault(key, {})
+    d[bucket] = d.get(bucket, 0.0) + seconds
+
+
+def round_stats(stats: dict, ndigits: int = 2) -> dict:
+    """Artifact-ready copy of a stats dict: floats rounded (recursively
+    through one level of nested dicts — the timing histograms), other
+    values passed through. The engines accumulate raw floats so
+    precision is not lost sample by sample; verdicts and bench JSON
+    carry the rounded copy."""
+    out: dict = {}
+    for k, v in stats.items():
+        if isinstance(v, dict):
+            out[k] = {kk: (round(vv, ndigits) if isinstance(vv, float)
+                           else vv) for kk, vv in v.items()}
+        elif isinstance(v, float):
+            out[k] = round(v, ndigits)
+        else:
+            out[k] = v
+    return out
+
+
 def enable_compile_cache(path: str | None = None) -> str | None:
     """Enable JAX's persistent compilation cache rooted in the repo.
 

@@ -145,18 +145,21 @@ def test_wide_probes_reemit_after_every_probe(bench, monkeypatch,
     bench._wide_probes(out["detail"], out, time.time())
     lines = [json.loads(ln) for ln in
              capsys.readouterr().out.splitlines() if ln.strip()]
-    assert len(lines) == 3            # one emission per probe
+    # One emission per probe, plus one for the wave smoke pre-probe.
+    assert len(lines) == 4
     # Each successive line strictly grows the completed-probe set, and
     # the LAST line carries all of them (what an external kill leaves).
     assert set(lines[0]["detail"]) == {"alpha"}
     assert set(lines[1]["detail"]) == {"alpha", "beta"}
-    assert set(lines[2]["detail"]) == {"alpha", "beta",
+    assert set(lines[2]["detail"]) == {"alpha", "beta", "wave_smoke"}
+    assert set(lines[3]["detail"]) == {"alpha", "beta", "wave_smoke",
                                        "partitioned_c30"}
-    # The partitioned probe ran the SYNC_CHUNKS=8 + fused-closure
-    # re-test first and recorded the gating evidence + its derived
-    # budget in the artifact.
-    part = lines[2]["detail"]["partitioned_c30"]
+    # The partitioned probe ran the full round-7 wave configuration
+    # (sticky caps + K=4 wave batches + SYNC_CHUNKS=8) first and
+    # recorded the gating evidence + its derived budget.
+    part = lines[3]["detail"]["partitioned_c30"]
     assert part["sync_chunks"] == 8 and part["fused_closure"] == 1
+    assert part["host_sticky"] == 1 and part["host_rows_k"] == 4
     # Experimental (non-final) rungs get the remaining clock capped by
     # the ceiling, NOT the PARTITIONED_MIN_S floor (the floor is
     # reserved for the final proven rung).
@@ -182,16 +185,29 @@ def test_partitioned_attempt_ladder_preserves_headline(bench,
     detail: dict = {}
     out = {"detail": detail}
     bench._wide_probes(detail, out, time.time())
-    assert [e["JEPSEN_TPU_SYNC_CHUNKS"] for e in seen] == ["8", "2", "2"]
+    # The failed smoke pre-probe (first call, SYNC 2 / K 4) gates the
+    # wave rungs off (probe-small-first): only the K=1 rungs run, and
+    # the ladder ends on the round-5 per-pass shape proven on this
+    # chip.
+    assert [e["JEPSEN_TPU_HOST_ROWS_K"] for e in seen] == \
+        ["4", "1", "1", "1"]
     assert [e["JEPSEN_TPU_FUSED_CLOSURE"] for e in seen] == \
-        ["1", "1", "0"]
-    for tag in ("sync8", "sync2", "unfused"):
-        assert "error" in detail[f"partitioned_c30_{tag}"]
+        ["1", "1", "1", "0"]
+    assert [e["JEPSEN_TPU_HOST_STICKY"] for e in seen] == \
+        ["1", "1", "0", "0"]
+    assert "error" in detail["wave_smoke"]
+    for tag in ("wave8", "wave"):
+        assert "probe-small-first" in \
+            detail[f"partitioned_c30_{tag}"]["error"]
+    for tag in ("sticky", "r6", "unfused"):
+        assert detail[f"partitioned_c30_{tag}"]["error"] == "boom"
     final = detail["partitioned_c30"]
     assert final["fused_closure"] == 0 and final["sync_chunks"] == 2
+    assert final["host_sticky"] == 0 and final["host_rows_k"] == 1
 
-    # A success mid-ladder stops escalation: the fused sync2 rung
-    # winning means the unfused fallback never runs.
+    # A passing smoke admits the wave rungs; a success mid-ladder
+    # stops escalation: the wave rung at the conservative queue depth
+    # winning means the later fallback rungs never run.
     seen.clear()
     detail.clear()
 
@@ -203,11 +219,68 @@ def test_partitioned_attempt_ladder_preserves_headline(bench,
 
     monkeypatch.setattr(bench, "_run_probe", flaky_probe)
     bench._wide_probes(detail, out, time.time())
-    assert len(seen) == 2
+    # smoke (passes), wave8 (fails), wave (wins).
+    assert len(seen) == 3
+    assert [e["JEPSEN_TPU_SYNC_CHUNKS"] for e in seen] == \
+        ["2", "8", "2"]
     assert detail["partitioned_c30"]["verdict"] is True
     assert detail["partitioned_c30"]["fused_closure"] == 1
-    assert "partitioned_c30_sync8" in detail
+    assert detail["partitioned_c30"]["host_rows_k"] == 4
+    assert "partitioned_c30_wave8" in detail
+    assert "partitioned_c30_sticky" not in detail
     assert "partitioned_c30_unfused" not in detail
+
+
+def test_wave_rungs_skip_honestly_when_smoke_has_no_budget(
+        bench, monkeypatch):
+    # Budget window where the rungs could still run but the smoke
+    # can't fit before them: the smoke is skipped and the wave rungs
+    # must record a NO-BUDGET reason, never a smoke verdict that was
+    # never produced (false gating evidence in the artifact).
+    monkeypatch.setattr(bench, "PROBE_ORDER", (("partitioned_c30", 100),))
+    monkeypatch.setattr(bench, "_verify_recovery", lambda: True)
+    monkeypatch.setattr(
+        bench, "TOTAL_BUDGET_S",
+        2 * bench.PARTITIONED_MIN_S + bench.WAVE_SMOKE_BUDGET_S / 2)
+    seen = []
+
+    def fake_probe(key, timeout, env_extra=None, stall_s=None):
+        seen.append(key)
+        return {"verdict": True}
+
+    monkeypatch.setattr(bench, "_run_probe", fake_probe)
+    detail: dict = {}
+    bench._wide_probes(detail, {"detail": detail}, time.time())
+    assert "wave_smoke" not in seen and "wave_smoke" not in detail
+    for tag in ("wave8", "wave"):
+        err = detail[f"partitioned_c30_{tag}"]["error"]
+        assert "no budget to smoke-probe" in err
+        assert "failed" not in err
+    # The K=1 rung still ran and won.
+    assert detail["partitioned_c30"]["verdict"] is True
+    assert detail["partitioned_c30"]["host_rows_k"] == 1
+
+
+def test_ladder_abandoned_when_smoke_kills_worker_for_good(
+        bench, monkeypatch):
+    # A smoke fault with NO worker recovery must abandon the ladder
+    # (dispatching rungs at a dead worker burns their stall windows)
+    # while still populating detail["partitioned_c30"] for artifact
+    # consumers.
+    monkeypatch.setattr(bench, "PROBE_ORDER", (("partitioned_c30", 100),))
+    monkeypatch.setattr(bench, "_verify_recovery", lambda: False)
+    seen = []
+
+    def fake_probe(key, timeout, env_extra=None, stall_s=None):
+        seen.append(key)
+        return {"error": "kernel fault"}
+
+    monkeypatch.setattr(bench, "_run_probe", fake_probe)
+    detail: dict = {}
+    bench._wide_probes(detail, {"detail": detail}, time.time())
+    assert seen == ["wave_smoke"], "no rung may run on a dead worker"
+    assert detail["wave_smoke"]["worker_recovered"] is False
+    assert "abandoned" in detail["partitioned_c30"]["error"]
 
 
 def test_partitioned_ladder_reserves_floor_for_fallback(bench,
@@ -231,8 +304,13 @@ def test_partitioned_ladder_reserves_floor_for_fallback(bench,
     bench._wide_probes(detail, {"detail": detail}, time.time())
     assert len(seen) == 1
     assert seen[0]["JEPSEN_TPU_FUSED_CLOSURE"] == "0"
-    assert "skipped" in detail["partitioned_c30_sync8"]["error"]
-    assert "skipped" in detail["partitioned_c30_sync2"]["error"]
+    assert seen[0]["JEPSEN_TPU_HOST_ROWS_K"] == "1"
+    # No clock for experiments: even the wave smoke pre-probe is
+    # skipped, and the skips record the BUDGET reason, not a smoke
+    # verdict that never existed.
+    assert "wave_smoke" not in detail
+    for tag in ("wave8", "wave", "sticky", "r6"):
+        assert "budget" in detail[f"partitioned_c30_{tag}"]["error"]
     assert detail["partitioned_c30"]["verdict"] is True
     assert detail["partitioned_c30"]["budget_seconds"] == \
         bench.PARTITIONED_MIN_S

@@ -1,0 +1,145 @@
+"""Wave-aware host-row executor parity (round-7 tentpole): the sticky
+cap escalation and the K-row fused wave batches are OPTIMIZATIONS over
+the proven round-6 per-row cold ladder — they must change dispatch
+counts, never verdicts.
+
+Two shapes split the coverage by cost: the window-34 pair-key
+crash-dom WITNESS shape (the scaled-down literal config-5 class; the
+5k/window-25 shapes do not exercise these paths at all — CLAUDE.md
+round-5 lore, cap shapes matching tests/test_lin_crashdom_witness.py
+so the marginal XLA compile cost is just the K-row wave programs)
+carries the verdict/death-row parity tests, and the cheap single-key
+crash-dom band (tiny windows, second-scale programs) carries the
+mechanics: forced-overflow per-row resume, dispatch-per-row
+amortization, and the sticky/waste counters."""
+
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu.lin import bfs, prepare, synth
+
+# Only the second-scale small-band tests ride the quick tier
+# (CLAUDE.md bills it as the ~1 min no-compile tier); the pair-band
+# witness parity test compiles the K-row program at the big caps and
+# runs in the default (not-slow) tier instead.
+quick = pytest.mark.quick
+
+
+@pytest.fixture(scope="module")
+def pair_band_packed():
+    # The corrupted window-34 partition shape of the crashdom witness
+    # suite (identical params — shared compiled shapes).
+    h = synth.generate_partitioned_register_history(
+        140, concurrency=40, seed=0, partition_every=60,
+        partition_len=20, max_crashes=10)
+    return prepare.prepare(m.cas_register(),
+                           synth.corrupt_history(h, seed=3))
+
+
+@pytest.fixture(scope="module")
+def small_band_packed():
+    # Single-key crash-dom band: same host-row executor, second-scale
+    # programs (window ~15), linearizable by construction.
+    h = synth.generate_register_history(60, concurrency=6, seed=1,
+                                        crash_prob=0.25)
+    return prepare.prepare(m.cas_register(), h)
+
+
+def _run(monkeypatch, p, *, sticky, k, cap_schedule, host_caps, **kw):
+    monkeypatch.setenv("JEPSEN_TPU_HOST_STICKY", str(sticky))
+    monkeypatch.setenv("JEPSEN_TPU_HOST_ROWS_K", str(k))
+    return bfs.check_packed(p, cap_schedule=cap_schedule,
+                            host_caps=host_caps, **kw)
+
+
+def _run_pair(monkeypatch, p, *, sticky, k, **kw):
+    return _run(monkeypatch, p, sticky=sticky, k=k, cap_schedule=(8,),
+                host_caps=(64, 4096), **kw)
+
+
+def _run_small(monkeypatch, p, *, sticky, k, host_caps=(8, 64, 512)):
+    return _run(monkeypatch, p, sticky=sticky, k=k, cap_schedule=(1,),
+                host_caps=host_caps)
+
+
+def test_wave_modes_match_cold_ladder_on_witness(monkeypatch,
+                                                 pair_band_packed):
+    p = pair_band_packed
+    # The shape must land in the pair-key crash-dom band, or the wave
+    # machinery is not what decides here.
+    assert p.window + max(len(p.unintern), 2).bit_length() > 31
+    assert len(p.crashed_ops) > 0
+
+    cold = _run_pair(monkeypatch, p, sticky=0, k=1, explain=True)
+    assert cold["valid?"] is False and cold["final-paths"]
+
+    for sticky, k in ((1, 1), (1, 4)):
+        got = _run_pair(monkeypatch, p, sticky=sticky, k=k,
+                        explain=True)
+        assert got["valid?"] is False
+        assert got["op"] == cold["op"]
+        assert got["dead-row"] == cold["dead-row"]
+        # Witness validity (full model replay) is covered in
+        # test_lin_crashdom_witness, which runs the default wave
+        # config; here the paths must exist and name the same op.
+        assert got["final-paths"]
+        assert got["host-stats"]["rows"] >= 1
+
+
+@quick
+def test_forced_overflow_resumes_per_row(monkeypatch,
+                                         small_band_packed):
+    # A tiny first host cap makes wave batches trip on overflow; the
+    # executor must resume PER-ROW from the batch entry (the proven
+    # round-6 shape, escalation included) — same verdict as the cold
+    # ladder, with the discarded batch work visible in the stats.
+    p = small_band_packed
+    cold = _run_small(monkeypatch, p, sticky=0, k=1)
+    assert cold["valid?"] is True
+
+    got = _run_small(monkeypatch, p, sticky=1, k=4)
+    assert got["valid?"] is True
+    s = got["host-stats"]
+    assert s["multi_trips"] >= 1, \
+        "caps this tiny must trip at least one wave batch"
+    # Tripped batches are discarded work: the waste observability must
+    # record them (acceptance: wasted passes read off the artifact).
+    assert s["wasted_passes"] >= 1
+    # ``rows`` counts both wave-committed and per-row rows; a trip
+    # implies per-row activity beyond the committed batches.
+    assert s["rows"] > s["multi_rows"]
+
+
+@quick
+def test_wave_batches_cut_dispatches_per_row(monkeypatch,
+                                             small_band_packed):
+    # With a comfortable single cap (no escalation anywhere) the wave
+    # fast path must commit batches: strictly fewer closure dispatches
+    # than host rows — the <1 dispatch/row acceptance criterion.
+    p = small_band_packed
+    got = _run_small(monkeypatch, p, sticky=1, k=4, host_caps=(512,))
+    assert got["valid?"] is True
+    s = got["host-stats"]
+    assert s["multi_rows"] > 0 and s["multi_trips"] == 0
+    assert s["dispatches"] < s["rows"], (
+        f"wave batches must amortize dispatches: {s}")
+
+
+@quick
+def test_sticky_cap_counters_and_no_extra_waste(monkeypatch,
+                                                small_band_packed):
+    # Sticky caps change STARTING levels only: verdict parity with the
+    # cold ladder, at least one sticky hit on this escalating shape,
+    # and never MORE wasted escalation passes than the cold ladder
+    # (K=1 on both sides isolates the sticky axis).
+    p = small_band_packed
+    cold = _run_small(monkeypatch, p, sticky=0, k=1)
+    on = _run_small(monkeypatch, p, sticky=1, k=1)
+    assert on["valid?"] is cold["valid?"] is True
+    assert on["host-stats"]["sticky_hits"] >= 1
+    assert on["host-stats"]["wasted_passes"] <= \
+        cold["host-stats"]["wasted_passes"]
+    # Per-cap wall seconds flow into the verdict for both runs (the
+    # residual-cost-profile observability the bench artifact surfaces).
+    assert on["host-stats"]["cap_seconds"]
+    assert all(v >= 0 for v in on["host-stats"]["cap_seconds"].values())
