@@ -10,9 +10,15 @@ test:
 
 # Fast tier: the no-XLA-compile tests (history/generator/nemesis math,
 # wire-protocol fakes, suite maps, checkers on hand histories) — about
-# a minute even on one core.
+# a minute even on one core (cold .jax_cache: a few minutes while the
+# `compiles`-marked engine tests warm it). The timeout guard keeps a
+# wedged process from holding the shell, and the conftest no-compile
+# check (tests/conftest.py) fails any quick test that triggers an
+# unexempted XLA compile — the tier's promise, enforced.
+TEST_QUICK_TIMEOUT ?= 900
 test-quick:
-	python -m pytest tests/ -q -m quick
+	timeout -k 15 $(TEST_QUICK_TIMEOUT) \
+		python -m pytest tests/ -q -m quick
 
 # Cluster integration matrix against the dockerized 1-control + 5-node
 # environment: brings the compose cluster up, then runs the per-suite
@@ -42,7 +48,22 @@ bench:
 # shell. Takes the real TPU chip exclusively; engine env knobs
 # (doc/env.md) pass through, e.g.:
 #   make probe-config5 JEPSEN_TPU_HOST_ROWS_K=1
+# After the run the quarantine-ledger DELTA is printed (cli.py
+# quarantine diff), so an engine change that newly faults a shape is
+# visible in this one command; the probe's exit code is preserved.
 PROBE_CONFIG5_TIMEOUT ?= 5400
+# Frontier checkpoint: a probe killed by the timeout (or a fault)
+# leaves .jax_cache/probe_config5.ckpt.npz, and the NEXT probe-config5
+# run resumes the decide mid-history (resumed_from_row in its JSON)
+# instead of restarting from op 0.
+PROBE_CONFIG5_CKPT ?= .jax_cache/probe_config5.ckpt.npz
 probe-config5:
+	@mkdir -p .jax_cache
+	@cp .jax_cache/quarantine.json /tmp/jepsen_tpu_q5_before.json \
+		2>/dev/null || echo '{"shapes": {}}' \
+		> /tmp/jepsen_tpu_q5_before.json
 	timeout -k 30 $(PROBE_CONFIG5_TIMEOUT) \
-		python bench.py --probe partitioned_c30
+		env JEPSEN_TPU_CKPT=$(PROBE_CONFIG5_CKPT) \
+		python bench.py --probe partitioned_c30; rc=$$?; \
+	python -m jepsen_tpu.cli quarantine diff \
+		--before /tmp/jepsen_tpu_q5_before.json; exit $$rc

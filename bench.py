@@ -93,6 +93,11 @@ HEARTBEAT_S = 20
 STALL_S = float(os.environ.get("JEPSEN_TPU_BENCH_STALL_S", 600))
 PARTITIONED_STALL_S = float(
     os.environ.get("JEPSEN_TPU_BENCH_STALL_PART_S", 1800))
+# Grace between SIGTERM and SIGKILL on a wedged child: SIGTERM lets a
+# child that is merely slow flush its result line; a child wedged
+# inside the TPU runtime ignores it and needs SIGKILL (a wedged
+# teardown used to leave the child alive and the kill unrecorded).
+KILL_GRACE_S = float(os.environ.get("JEPSEN_TPU_BENCH_KILL_GRACE_S", 10))
 
 
 def _emit(out: dict) -> None:
@@ -200,6 +205,11 @@ def _timed_check(make_history, n_ops, model=None, warm=True):
         out["host_stats"] = r["host-stats"]
     if r.get("max-cap") is not None:
         out["max_cap"] = r["max-cap"]
+    if r.get("resumed-from-row") is not None:
+        # The run continued a checkpoint (JEPSEN_TPU_CKPT) instead of
+        # restarting from op 0 — the timing covers only the resumed
+        # tail, so the artifact must say so.
+        out["resumed_from_row"] = r["resumed-from-row"]
     return out
 
 
@@ -386,9 +396,31 @@ def _run_probe_subprocess(key: str, timeout: int, env_extra=None,
             why = "stall"
             break
         time.sleep(0.2)
+    kill_info = None
     if why is not None:
-        proc.kill()
-    proc.wait()
+        # SIGTERM -> SIGKILL escalation, all of it RECORDED: a wedged
+        # teardown used to survive a bare kill() race and leave the
+        # child alive with no trace of the event in the artifact. The
+        # record carries the last heartbeat progress value so triage
+        # can see how far the engine got before the wedge.
+        kill_info = {"why": why, "last_hb": state["last_hb"],
+                     "silent_s": round(
+                         time.time() - state["last_activity"], 1)}
+        proc.terminate()
+        try:
+            proc.wait(timeout=KILL_GRACE_S)
+            kill_info["sigkill"] = False
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            kill_info["sigkill"] = True
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                # Should be impossible (SIGKILL), but a kernel-stuck
+                # child must be visible, not silently abandoned.
+                kill_info["unkillable"] = True
+    else:
+        proc.wait()
     t_out.join(timeout=5)
     t_err.join(timeout=5)
     # A result already on the pipe wins over the kill reason: a probe
@@ -402,15 +434,21 @@ def _run_probe_subprocess(key: str, timeout: int, env_extra=None,
         if not ln.lstrip().startswith("{"):
             continue
         try:
-            return json.loads(ln), None
+            r = json.loads(ln)
+            if isinstance(r, dict) and kill_info is not None:
+                # Completed result recovered from a child that then
+                # had to be killed in teardown: record the kill.
+                r["teardown_kill"] = kill_info
+            return r, None
         except json.JSONDecodeError:
             continue
     if why == "timeout":
-        return {"error": f"probe timed out after {timeout}s"}, why
+        return {"error": f"probe timed out after {timeout}s",
+                "kill": kill_info}, why
     if why == "stall":
         return {"error": (f"probe stalled: no progress for "
                           f"{int(stall_s)}s (wedged dispatch), "
-                          "killed")}, why
+                          "killed"), "kill": kill_info}, why
     tail = (state.get("stderr", "") + "\n".join(lines))[-2000:]
     return {"error": f"probe exited rc={proc.returncode}: {tail}"}, None
 
@@ -485,12 +523,25 @@ def _wide_probes(detail: dict, out: dict, t_start: float) -> None:
     for i, (key, ceiling) in enumerate(PROBE_ORDER):
         if key == "partitioned_c30":
             def _rung(sync, fused, sticky, k, tag):
+                # Per-rung frontier checkpoint: a stall-killed child's
+                # retry (and a bench re-run after an external kill)
+                # RESUMES the partitioned decide mid-history instead of
+                # restarting from op 0. Per-rung paths keep rung
+                # timings honest (a rung never resumes another rung's
+                # progress); the engine deletes the file on a definite
+                # verdict and stamps resumed runs with
+                # resumed_from_row.
+                ck = os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    ".jax_cache", f"bench_partitioned_{tag}.ckpt.npz")
                 return ({"JEPSEN_TPU_SYNC_CHUNKS": str(sync),
                          "JEPSEN_TPU_FUSED_CLOSURE": str(fused),
                          "JEPSEN_TPU_HOST_STICKY": str(sticky),
-                         "JEPSEN_TPU_HOST_ROWS_K": str(k)},
+                         "JEPSEN_TPU_HOST_ROWS_K": str(k),
+                         "JEPSEN_TPU_CKPT": ck},
                         {"sync_chunks": sync, "fused_closure": fused,
-                         "host_sticky": sticky, "host_rows_k": k}, tag)
+                         "host_sticky": sticky, "host_rows_k": k,
+                         "checkpoint": ck}, tag)
 
             attempts = (
                 _rung(8, 1, 1, 4, "wave8"),

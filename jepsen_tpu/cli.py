@@ -141,7 +141,7 @@ def suite_commands(test_fn: Callable[[dict], dict],
             opt_spec(p)
 
     return [single_test_cmd(test_fn, opt_spec=spec), serve_cmd(),
-            analyze_cmd()]
+            analyze_cmd(), quarantine_cmd()]
 
 
 def serve_cmd() -> dict:
@@ -207,6 +207,76 @@ def analyze_cmd() -> dict:
 
     return {"name": "analyze", "parser": build_parser, "run": run_cmd,
             "help": "re-check a saved history (optionally on device)"}
+
+
+def quarantine_cmd() -> dict:
+    """Manage the fault-shape quarantine ledger
+    (jepsen_tpu.lin.supervise): the persistent record of traced program
+    shapes that faulted or wedged the TPU runtime, which routes future
+    runs straight to each shape's proven fallback rung. ``list`` prints
+    it, ``clear`` removes entries (all, or ``--shape`` ones) after an
+    engine fix, ``diff --before SNAPSHOT`` prints the delta against a
+    saved copy (what ``make probe-config5`` runs after its probe)."""
+
+    def build_parser(p: argparse.ArgumentParser):
+        p.add_argument("action", choices=["list", "clear", "diff"])
+        p.add_argument("--ledger", help="ledger path (default: the "
+                       "engines' JEPSEN_TPU_QUARANTINE resolution)")
+        p.add_argument("--shape", action="append",
+                       help="shape key(s) for clear; repeatable")
+        p.add_argument("--before",
+                       help="for diff (required there): a prior copy "
+                            "of the ledger file")
+
+    def run_cmd(opts: argparse.Namespace) -> int:
+        import json
+
+        from jepsen_tpu.lin import supervise
+
+        path = opts.ledger or supervise.ledger_path()
+        if opts.action == "list":
+            shapes = supervise.load_ledger(path)
+            if not shapes:
+                print(f"quarantine ledger empty ({path})")
+                return EXIT_OK
+            for k in sorted(shapes):
+                e = shapes[k]
+                print(f"{k}  reason={e.get('reason')} "
+                      f"count={e.get('count')} last={e.get('last')}")
+            return EXIT_OK
+        if opts.action == "clear":
+            n = supervise.clear_ledger(keys=opts.shape, path=path)
+            print(f"cleared {n} quarantined shape(s)")
+            return EXIT_OK
+        # diff — an unreadable/malformed --before must fail loudly:
+        # silently treating it as empty would report every
+        # long-standing entry as "newly faulted", the exact misread
+        # the probe-config5 delta exists to prevent.
+        if not opts.before:
+            print("quarantine diff requires --before SNAPSHOT",
+                  file=sys.stderr)
+            return EXIT_USAGE
+        try:
+            with open(opts.before) as fh:
+                before = json.load(fh).get("shapes", {})
+        except (OSError, ValueError) as e:
+            print(f"cannot read --before snapshot {opts.before!r}: "
+                  f"{e}", file=sys.stderr)
+            return EXIT_ERROR
+        delta = supervise.ledger_delta(before, path=path)
+        if not delta:
+            print("quarantine delta: none")
+            return EXIT_OK
+        print(f"quarantine delta: {len(delta)} shape(s) newly faulted")
+        for k in sorted(delta):
+            e = delta[k]
+            print(f"  {k}  reason={e.get('reason')} "
+                  f"count={e.get('count')}")
+        return EXIT_OK
+
+    return {"name": "quarantine", "parser": build_parser,
+            "run": run_cmd,
+            "help": "list/clear/diff the fault-shape quarantine ledger"}
 
 
 def run(commands, argv=None) -> int:
@@ -279,8 +349,8 @@ def _demo_test_fn(options: dict) -> dict:
 def main_default(argv=None) -> None:
     """The bare `jepsen-tpu` console script (pyproject entry point):
     demo test + serve + analyze, like `python -m jepsen_tpu.cli`."""
-    main([single_test_cmd(_demo_test_fn), serve_cmd(), analyze_cmd()],
-         argv)
+    main([single_test_cmd(_demo_test_fn), serve_cmd(), analyze_cmd(),
+          quarantine_cmd()], argv)
 
 
 if __name__ == "__main__":

@@ -47,7 +47,8 @@ def analysis(model, history, algorithm: str = "competition", **kw) -> dict:
     ``"competition"`` — race both like knossos.competition (the reference
     selects among these at checker.clj:90-93).
     """
-    known = {"witness", "cancel", "chunk", "cap_schedule", "explain"}
+    known = {"witness", "cancel", "chunk", "cap_schedule", "explain",
+             "checkpoint", "resume"}
     if kw.keys() - known:
         raise TypeError(f"unknown analysis options {kw.keys() - known}")
     try:
@@ -90,11 +91,13 @@ def device_check_packed(packed: PackedHistory, cancel=None, **kw) -> dict:
     the sparse sort-dedup frontier (:mod:`jepsen_tpu.lin.bfs`)."""
     from jepsen_tpu.lin import bfs, dense
 
-    known = {"chunk", "cap_schedule", "explain"}
+    known = {"chunk", "cap_schedule", "explain", "checkpoint", "resume"}
     if kw.keys() - known:
         # e.g. snapshots= is dense-only: call dense.check_packed directly.
         raise TypeError(f"unknown device-check options {kw.keys() - known}")
     if dense.plan(packed) is not None:
+        # checkpoint/resume are sparse-engine options (dense histories
+        # decide in seconds; there is nothing worth resuming).
         dkw = {k: v for k, v in kw.items() if k in ("chunk", "explain")}
         return dense.check_packed(packed, cancel=cancel, **dkw)
     return bfs.check_packed(packed, cancel=cancel, **kw)
@@ -111,7 +114,8 @@ def _competition(packed: PackedHistory, cancel=None, **kw) -> dict:
 
     cpu_kw = {k: v for k, v in kw.items() if k in ("witness",)}
     dev_kw = {k: v for k, v in kw.items()
-              if k in ("chunk", "cap_schedule", "explain")}
+              if k in ("chunk", "cap_schedule", "explain", "checkpoint",
+                       "resume")}
     lock = threading.Lock()
     state: dict = {"result": None, "finished": 0}
     done = threading.Event()

@@ -53,7 +53,7 @@ import numpy as np
 from jax import lax
 
 from jepsen_tpu import util
-from jepsen_tpu.lin import psort
+from jepsen_tpu.lin import psort, supervise
 from jepsen_tpu.lin.prepare import PackedHistory
 
 # Caps for the nested-while chunked engine. 131072 is the largest level
@@ -1393,7 +1393,8 @@ def _spike_rows(p, r0, bits, state, count, *, tables_h, caps, dropback,
                 step_fn, state_bits, nil_id, read_value_match, cancel,
                 snapshots, min_rows: int = 64, use_psort: bool = False,
                 exp_h=None, key_hi: bool = False,
-                crash_dom: bool = False, cand_max=None):
+                crash_dom: bool = False, cand_max=None,
+                stats: dict | None = None):
     """Spike mode: SPIKE_CHUNK-row mini-chunks of the SAME _search_chunk
     program at the big spike capacities. The axon runtime faults on a
     512-row chunk past cap 131072 but runs an 8-row chunk clean at 2^20
@@ -1430,13 +1431,29 @@ def _spike_rows(p, r0, bits, state, count, *, tables_h, caps, dropback,
             jnp.asarray(_chunk_slice(t, r, SPIKE_CHUNK)) for t in exp_h)
         while True:
             util.progress_tick()
-            b2, s2, c2, r_done, dead, ovf = _search_chunk(
-                jnp.int32(m_n), *sp_tables, bits, state, count, sp_exp,
-                cap=caps[lvl], step_fn=step_fn, state_bits=state_bits,
-                nil_id=nil_id, read_value_match=read_value_match,
-                use_psort=use_psort, row_tiers=False, key_hi=key_hi,
-                crash_dom=crash_dom, cand_max=cand_max)
-            if not bool(ovf):
+
+            def _mini(bits=bits, state=state, count=count, lvl=lvl):
+                out = _search_chunk(
+                    jnp.int32(m_n), *sp_tables, bits, state, count,
+                    sp_exp, cap=caps[lvl], step_fn=step_fn,
+                    state_bits=state_bits, nil_id=nil_id,
+                    read_value_match=read_value_match,
+                    use_psort=use_psort, row_tiers=False, key_hi=key_hi,
+                    crash_dom=crash_dom, cand_max=cand_max)
+                return out, bool(out[5])
+
+            spike_key = supervise.shape_key(
+                "spike", rows=SPIKE_CHUNK, cap=caps[lvl],
+                window=p.window,
+                kernel=p.kernel.name if p.kernel else "generic")
+            outcome, val = supervise.run_guarded("spike", spike_key,
+                                                 _mini, stats=stats)
+            if outcome != "ok":
+                return (bits, state, int(count), r, False,
+                        "wedged" if outcome == "wedge" else "fault",
+                        False, top_used)
+            (b2, s2, c2, r_done, dead, ovf), ovf_b = val
+            if not ovf_b:
                 break
             if lvl + 1 >= len(caps):
                 return (bits, state, int(count), r, False, True, False,
@@ -1734,10 +1751,101 @@ def _materialize_snapshots(snapshots):
             for s in snapshots]
 
 
+def _host_row_cpu(p, r, lo, hi, count_i, *, b, nil_id, key_hi, nw,
+                  crash_dom=False, cancel=None):
+    """The LAST rung of the host-row fallback ladder: one row's whole
+    closure + return filter on the CPU oracle (cpu.search_rows with
+    ``reduce=True`` — the same exact reduction family every device
+    engine consumes, parity-fuzzed in tests/test_lin_reductions.py),
+    entered only when every device rung for this row has faulted or
+    wedged. Deliberately DEVICE-FREE end to end: the packed keys are
+    decoded and re-encoded with the numpy codec (supervise.np_*) since
+    the device may be mid-restart after the fault that sent us here.
+
+    With ``crash_dom`` the survivors additionally run the EXACT
+    crashed-subset/read-bit dominance prune (the _dedup_keys_dom rule,
+    group representative = popcount-ordered antichain scan) on the
+    host — without it the handed-back frontier is the UNpruned
+    crashed-subset wave, which overflows the very capacities whose
+    device programs just faulted. Raises cpu.Cancelled through.
+    Returns (lo_np, hi_np|None, count, dead); output arrays are sized
+    max(input cap, survivor count), KEY_FILL padded, key-ascending."""
+    from jepsen_tpu.lin import cpu
+    from jepsen_tpu.models.kernels import NIL
+
+    lo_h = np.asarray(lo)
+    hi_h = np.asarray(hi) if key_hi else None
+    cap = int(lo_h.shape[0])
+    bits, state = supervise.np_unpack_keys(
+        lo_h, hi_h, count_i, b, nil_id, nw, key_hi, int(NIL))
+    packed = bits[:, 0].astype(object)
+    for w in range(1, bits.shape[1]):
+        packed = packed | (bits[:, w].astype(object) << (32 * w))
+    configs = set(zip((int(x) for x in packed),
+                      map(tuple, state.tolist())))
+    try:
+        configs, _ = cpu.search_rows(p, configs, None, r, r + 1,
+                                     cancel=cancel, reduce=True)
+    except cpu.Dead:
+        return lo_h, hi_h, 0, True
+    if crash_dom:
+        from jepsen_tpu.lin.prepare import reduction_tables
+
+        pure_tbl, _pred = reduction_tables(p)
+        act = np.asarray(p.active)[r]
+        crashed = np.asarray(p.crashed)[r]
+        cmask = rmask = 0
+        for j in range(p.window):
+            if act[j] and crashed[j]:
+                cmask |= 1 << j
+            elif act[j] and pure_tbl[r, j]:
+                rmask |= 1 << j
+        if cmask or rmask:
+            # Group by (mutator bits, state); within a group X
+            # dominates Y iff X's packed dominance word (crashed bits
+            # as-is, read bits complemented — disjoint masks, so
+            # subset test is one AND) is a strict subset of Y's.
+            # Popcount-ascending scan keeps exactly the antichain:
+            # a dominator always has fewer bits than its victims.
+            groups: dict = {}
+            for bset, st in configs:
+                w = (bset & cmask) | (~bset & rmask)
+                groups.setdefault((bset & ~(cmask | rmask), st),
+                                  []).append((bin(w).count("1"), w,
+                                              bset))
+            pruned = []
+            for (gbits, st), lst in groups.items():
+                lst.sort()
+                kept: list[int] = []
+                for _pc, w, bset in lst:
+                    if any((kw & ~w) == 0 for kw in kept):
+                        continue
+                    kept.append(w)
+                    pruned.append((bset, st))
+            configs = pruned
+    n2 = len(configs)
+
+    def enc(bset, st):
+        sid = nil_id if st[0] == int(NIL) else st[0]
+        return (bset << b) | sid
+
+    ordered = sorted(configs, key=lambda c: enc(*c))
+    bits2 = np.zeros((n2, nw), np.uint32)
+    state2 = np.zeros((n2, 1), np.int32)
+    for i, (bset, st) in enumerate(ordered):
+        for w in range(nw):
+            bits2[i, w] = (bset >> (32 * w)) & 0xFFFFFFFF
+        state2[i, 0] = st[0]
+    lo2, hi2 = supervise.np_pack_keys(bits2, state2, b, nil_id, key_hi,
+                                      int(NIL), max(cap, n2))
+    return lo2, hi2, n2, False
+
+
 def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
                dropback, step_fn, state_bits, nil_id, use_psort,
                key_hi, crash_dom, cancel, snapshots,
-               min_rows: int = 2, stats: dict | None = None):
+               min_rows: int = 2, stats: dict | None = None,
+               ckpt=None, sticky0=None):
     """Host-sequenced row mode for the compact register band's blowup
     rows (the crashed-subset waves of BASELINE config 5's partition
     histories). Each row's whole closure fixpoint runs as ONE device
@@ -1809,13 +1917,19 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
     # the row restarts from its entry frontier).
     it_max = _host_it_max(W)
     dbg = os.environ.get("JEPSEN_TPU_HOST_DEBUG") == "1"
+    kname = p.kernel.name if p.kernel is not None else "generic"
     if stats is None:
         stats = {}
     for k in ("rows", "dispatches", "passes", "wasted_passes",
               "sticky_hits", "sticky_misses", "multi_rows",
-              "multi_dispatches", "multi_trips"):
+              "multi_dispatches", "multi_trips", "watchdog_trips",
+              "faults", "quarantine_skips", "cpu_rows"):
         stats.setdefault(k, 0)
     stats.setdefault("cap_seconds", {})
+
+    def skey(site, cap_, rows_=1):
+        return supervise.shape_key(site, rows=rows_, cap=cap_, window=W,
+                                   kernel=kname)
 
     def lvl_for(c):
         for i, cc in enumerate(caps):
@@ -1834,10 +1948,33 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
             snapshots[:] = [_HostSnapshot(at_r, lo, hi, cnt, b, nil_id,
                                           nw, key_hi)]
 
+    def save_ckpt(at_r, lo_, hi_, cnt_i):
+        # Episode-boundary frontier checkpoint: packed keys + row
+        # cursor + sticky level + host-stats, written only at COMMITTED
+        # row boundaries (the resumed run re-runs the identical
+        # deterministic dispatch sequence from here, so the verdict
+        # provably matches the uninterrupted run). Interval-gated: the
+        # device->host key copy is ~MBs, paid at most once per
+        # ckpt.every_s.
+        if ckpt is None or not ckpt.due():
+            return
+        arrays = {"lo": np.asarray(lo_)}
+        if key_hi:
+            arrays["hi"] = np.asarray(hi_)
+        ckpt.save("host", at_r, cnt_i, arrays,
+                  {"key_hi": key_hi, "b": b, "nil_id": nil_id, "nw": nw,
+                   "sticky_lvl": sticky_lvl,
+                   "host_stats": util.round_stats(stats)})
+
     if count_i > caps[-1]:
         return (bits, state, count_i, r0, False, "capacity", False,
                 top_used)
     sticky_lvl = lvl = lvl_for(count_i)
+    if sticky0 is not None:
+        # Resume: the checkpoint carries the wave's sticky level so a
+        # resumed run re-enters the wave at the capacity it had already
+        # climbed to instead of re-paying the cold ladder.
+        sticky_lvl = max(sticky_lvl, min(int(sticky0), len(caps) - 1))
     cap = caps[lvl]
     lo, hi = _host_pack(bits, state, jnp.int32(count_i), cap=cap, b=b,
                         nil_id=nil_id, key_hi=key_hi)
@@ -1854,7 +1991,14 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
         raised = start_lvl > natural
         # ---- wave fast path: K rows fused into ONE dispatch --------
         kn = min(K, p.R - r)
-        if kn > 1 and r >= per_row_until:
+        use_wave = kn > 1 and r >= per_row_until
+        if use_wave and supervise.quarantined(
+                skey("host-wave", caps[start_lvl], kn)):
+            # A quarantined wave shape routes straight to the proven
+            # per-row rung — the round 2-5 fault lore as machine state.
+            util.stat_bump(stats, "quarantine_skips")
+            use_wave = False
+        if use_wave:
             lvl = start_lvl
             cap = caps[lvl]
             top_used = max(top_used, cap)
@@ -1869,24 +2013,37 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
                            for t in exp_h)
             util.progress_tick()
             t0 = _time.monotonic()
-            lo2, hi2, flags = _host_closure_fixpoint_rows(
-                lo, hi, count, acts, v_rows, pure_rows, exp_rs, rets,
-                jnp.int32(kn), cap=cap, W=W, b=b, nil_id=nil_id,
-                step_fn=step_fn, use_psort=use_psort,
-                crash_dom=crash_dom, key_hi=key_hi, it_max=it_max,
-                K=K)
-            done, clean, dead_f, it_tot, pk, cnt = (
-                int(x) for x in np.asarray(flags))
-            util.stat_time(stats, "cap_seconds", cap,
-                           _time.monotonic() - t0)
-            util.stat_bump(stats, "dispatches")
-            util.stat_bump(stats, "multi_dispatches")
-            util.stat_bump(stats, "passes", it_tot)
-            if dbg:
-                print(f"[host] r={r} cap={cap} wave kn={kn} "
-                      f"done={done} clean={clean} dead={dead_f} "
-                      f"it={it_tot} peak={pk} count={cnt}", flush=True)
-            if clean and not dead_f and done == kn:
+
+            def _wave(lo=lo, hi=hi, count=count):
+                lo2, hi2, flags = _host_closure_fixpoint_rows(
+                    lo, hi, count, acts, v_rows, pure_rows, exp_rs,
+                    rets, jnp.int32(kn), cap=cap, W=W, b=b,
+                    nil_id=nil_id, step_fn=step_fn, use_psort=use_psort,
+                    crash_dom=crash_dom, key_hi=key_hi, it_max=it_max,
+                    K=K)
+                return lo2, hi2, np.asarray(flags)
+
+            # The K-row fixpoint legitimately runs minutes in one
+            # dispatch: 3x the base watchdog deadline.
+            outcome, val = supervise.run_guarded(
+                "host-wave", skey("host-wave", cap, kn), _wave,
+                scale=3.0, stats=stats)
+            tripped = None if outcome == "ok" else outcome
+            if tripped is None:
+                lo2, hi2, flags = val
+                done, clean, dead_f, it_tot, pk, cnt = (
+                    int(x) for x in flags)
+                util.stat_time(stats, "cap_seconds", cap,
+                               _time.monotonic() - t0)
+                util.stat_bump(stats, "dispatches")
+                util.stat_bump(stats, "multi_dispatches")
+                util.stat_bump(stats, "passes", it_tot)
+                if dbg:
+                    print(f"[host] r={r} cap={cap} wave kn={kn} "
+                          f"done={done} clean={clean} dead={dead_f} "
+                          f"it={it_tot} peak={pk} count={cnt}",
+                          flush=True)
+            if tripped is None and clean and not dead_f and done == kn:
                 lo, hi, count = lo2, hi2, jnp.int32(cnt)
                 count_i = cnt
                 util.stat_bump(stats, "rows", kn)
@@ -1904,16 +2061,19 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
                     elif lvl_for(pk) < sticky_lvl:
                         sticky_lvl -= 1
                 r += kn
+                save_ckpt(r, lo, hi, count_i)
                 if r - r0 >= min_rows and count_i <= dropback:
                     break
                 continue
-            # Trip (overflow / budget / death somewhere in the batch):
-            # the carried arrays are mid-closure garbage for the
-            # tripped row — discard the whole batch and resume
-            # PER-ROW from the batch entry, where escalation, the
-            # overflow taxonomy, and death snapshot anchoring live.
+            # Trip (overflow / budget / death somewhere in the batch —
+            # or a wedged/faulted wave dispatch): the carried arrays
+            # are mid-closure garbage for the tripped row — discard
+            # the whole batch and resume PER-ROW from the batch entry,
+            # where escalation, the overflow taxonomy, and death
+            # snapshot anchoring live.
             util.stat_bump(stats, "multi_trips")
-            util.stat_bump(stats, "wasted_passes", it_tot)
+            if tripped is None:
+                util.stat_bump(stats, "wasted_passes", it_tot)
             lo, hi, count, lvl = entry
             per_row_until = r + kn
         # ---- per-row path (the proven round-6 shape) ---------------
@@ -1929,21 +2089,47 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
         budget_out = False
         filtered = False
         escalated = False
+        cpu_row = False
+        row_fused = fused
         peak_row = count_i
         while True:  # closure fixpoint, escalating capacity on overflow
             cap = caps[lvl]
             top_used = max(top_used, cap)
             lo, hi = _fit_keys(lo, hi, cap)
             util.progress_tick()
-            if fused:
+            run_fused = row_fused
+            if run_fused and supervise.quarantined(
+                    skey("host-fixpoint", cap)):
+                # Quarantined fused shape: run this capacity on the
+                # proven per-pass rung instead of re-faulting it.
+                util.stat_bump(stats, "quarantine_skips")
+                run_fused = False
+            if run_fused:
                 t0 = _time.monotonic()
-                lo, hi, flags = _host_closure_fixpoint(
-                    lo, hi, count, act, v_row, pure_row, exp_r, ret,
-                    cap=cap, W=W, b=b, nil_id=nil_id, step_fn=step_fn,
-                    use_psort=use_psort, crash_dom=crash_dom,
-                    key_hi=key_hi, it_max=it_max)
-                conv, ov, it, cnt, pk = (int(x)
-                                         for x in np.asarray(flags))
+
+                def _fixpoint(lo=lo, hi=hi, count=count):
+                    l2, h2, flags = _host_closure_fixpoint(
+                        lo, hi, count, act, v_row, pure_row, exp_r,
+                        ret, cap=cap, W=W, b=b, nil_id=nil_id,
+                        step_fn=step_fn, use_psort=use_psort,
+                        crash_dom=crash_dom, key_hi=key_hi,
+                        it_max=it_max)
+                    return l2, h2, np.asarray(flags)
+
+                # One fused fixpoint legitimately runs minutes:
+                # 3x the base watchdog deadline.
+                outcome, val = supervise.run_guarded(
+                    "host-fixpoint", skey("host-fixpoint", cap),
+                    _fixpoint, scale=3.0, stats=stats)
+                if outcome != "ok":
+                    # Wedged/faulted fused program: this row falls to
+                    # the unfused per-pass rung at the same capacity,
+                    # restarting from its entry frontier.
+                    row_fused = False
+                    lo, hi, count, _ = entry
+                    continue
+                lo, hi, flags = val
+                conv, ov, it, cnt, pk = (int(x) for x in flags)
                 util.stat_time(stats, "cap_seconds", cap,
                                _time.monotonic() - t0)
                 stats["dispatches"] += 1
@@ -1959,18 +2145,38 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
                           f"count={cnt} conv={conv} ov={ov}",
                           flush=True)
             else:
+                if supervise.quarantined(skey("host-pass", cap)):
+                    # Even the unfused per-pass program is quarantined
+                    # at this shape: last rung — the CPU oracle.
+                    util.stat_bump(stats, "quarantine_skips")
+                    cpu_row = True
+                    break
                 it = 0
                 ovf = False
                 budget_out = False
                 pk_att = count_i
                 while True:
                     t0 = _time.monotonic()
-                    lo, hi, count, flags = _host_closure_pass(
-                        lo, hi, count, act, v_row, pure_row, exp_r,
-                        cap=cap, W=W, b=b, nil_id=nil_id,
-                        step_fn=step_fn, use_psort=use_psort,
-                        crash_dom=crash_dom)
-                    ch, ov, cnt = (int(x) for x in np.asarray(flags))
+
+                    def _pass(lo=lo, hi=hi, count=count):
+                        l2, h2, c2, flags = _host_closure_pass(
+                            lo, hi, count, act, v_row, pure_row,
+                            exp_r, cap=cap, W=W, b=b,
+                            nil_id=nil_id, step_fn=step_fn,
+                            use_psort=use_psort,
+                            crash_dom=crash_dom)
+                        return l2, h2, c2, np.asarray(flags)
+
+                    outcome, val = supervise.run_guarded(
+                        "host-pass", skey("host-pass", cap), _pass,
+                        stats=stats)
+                    if outcome != "ok":
+                        # Wedged/faulted per-pass program: last rung —
+                        # the CPU oracle owns this row.
+                        cpu_row = True
+                        break
+                    lo, hi, count, flags = val
+                    ch, ov, cnt = (int(x) for x in flags)
                     util.stat_time(stats, "cap_seconds", cap,
                                    _time.monotonic() - t0)
                     it += 1
@@ -1985,8 +2191,8 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
                         ovf = True
                         break
                     # Convergence BEFORE the ceiling: a pass that
-                    # settles exactly at the budget is converged, not
-                    # overflowed (the ceiling exists to convert
+                    # settles exactly at the budget is converged,
+                    # not overflowed (the ceiling exists to convert
                     # nontermination into an honest overflow).
                     if not ch:
                         break
@@ -1994,6 +2200,8 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
                         ovf = True
                         budget_out = True
                         break
+                if cpu_row:
+                    break
                 if not ovf:
                     peak_row = max(peak_row, pk_att)
             if not ovf:
@@ -2018,6 +2226,68 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
             lo, hi, count, _ = entry
             lvl += 1
             escalated = True
+        if cpu_row:
+            # ---- CPU-oracle rung: every device rung for this row
+            # faulted, wedged, or is quarantined. Run the row on the
+            # host spec from its ENTRY frontier (the mid-closure
+            # arrays are garbage), device-free.
+            from jepsen_tpu.models.kernels import NIL
+
+            e_lo, e_hi, e_count, _ = entry
+            e_count_i = int(e_count)
+            if e_count_i > supervise.cpu_row_max():
+                # A frontier this size would grind the Python closure
+                # for hours: honest give-up, tagged so triage chases
+                # the fault, not frontier size.
+                bits_np, state_np = supervise.np_unpack_keys(
+                    np.asarray(e_lo),
+                    np.asarray(e_hi) if key_hi else None,
+                    e_count_i, b, nil_id, nw, key_hi, int(NIL))
+                return (jnp.asarray(bits_np), jnp.asarray(state_np),
+                        e_count_i, r, False, "wedged", False, top_used)
+            from jepsen_tpu.lin import cpu as _cpu
+
+            try:
+                lo_np, hi_np, n2, dead_cpu = _host_row_cpu(
+                    p, r, e_lo, e_hi, e_count_i, b=b, nil_id=nil_id,
+                    key_hi=key_hi, nw=nw, crash_dom=crash_dom,
+                    cancel=cancel)
+            except _cpu.Cancelled:
+                bits_np, state_np = supervise.np_unpack_keys(
+                    np.asarray(e_lo),
+                    np.asarray(e_hi) if key_hi else None,
+                    e_count_i, b, nil_id, nw, key_hi, int(NIL))
+                return (jnp.asarray(bits_np), jnp.asarray(state_np),
+                        e_count_i, r, False, False, True, top_used)
+            util.stat_bump(stats, "cpu_rows")
+            if dbg:
+                print(f"[host] r={r} cpu-oracle rung count={n2} "
+                      f"dead={dead_cpu}", flush=True)
+            r += 1
+            if dead_cpu or n2 == 0:
+                # Dead at row r-1; the explain snapshot is anchored at
+                # its entry frontier (snap() above), exactly like the
+                # device dead path.
+                return (jnp.zeros((1, nw), jnp.uint32),
+                        jnp.zeros((1, 1), jnp.int32), 0, r, True,
+                        False, False, top_used)
+            if n2 > caps[-1]:
+                # The capacity-unbounded CPU closure outgrew the host
+                # ladder: an honest overflow — handing the oversized
+                # frontier forward would let the next row's _fit_keys
+                # silently TRUNCATE live configs (verdict-flipping).
+                bits_np2, state_np2 = supervise.np_unpack_keys(
+                    lo_np, hi_np, n2, b, nil_id, nw, key_hi, int(NIL))
+                return (jnp.asarray(bits_np2), jnp.asarray(state_np2),
+                        n2, r, False, "capacity", False, top_used)
+            lo = jnp.asarray(lo_np)
+            hi = jnp.asarray(hi_np) if key_hi else None
+            count = jnp.int32(n2)
+            count_i = n2
+            save_ckpt(r, lo, hi, count_i)
+            if r - r0 >= min_rows and count_i <= dropback:
+                break
+            continue
         if sticky:
             if raised:
                 util.stat_bump(
@@ -2042,6 +2312,7 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
             # entry frontier (set above), spanning ONE row of replay.
             bits, state = unpack(lo, hi, count, cap)
             return bits, state, 0, r, True, False, False, top_used
+        save_ckpt(r, lo, hi, count_i)
         if r - r0 >= min_rows and count_i <= dropback:
             break
     bits, state = unpack(lo, hi, count, lo.shape[0])
@@ -2166,7 +2437,8 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                  spike_caps=SPIKE_CAP_SCHEDULE,
                  spike_dropback: int = SPIKE_DROPBACK,
                  packed_keys: bool | None = None,
-                 lazy: bool = True, host_caps=HOST_ROW_CAPS) -> dict:
+                 lazy: bool = True, host_caps=HOST_ROW_CAPS,
+                 checkpoint=None, resume=None) -> dict:
     """Decide linearizability of a packed history on device.
 
     Host loop over CHUNK-row device dispatches; the frontier carries
@@ -2179,6 +2451,22 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     snapshots and, on an invalid verdict, replays the failing tail on
     the CPU oracle to emit configs + final-paths
     (:mod:`jepsen_tpu.lin.witness`).
+
+    The search runs SUPERVISED (:mod:`jepsen_tpu.lin.supervise`): every
+    dispatch carries a watchdog deadline with bounded retry (a wedged
+    tunnel dispatch costs its detection window, not the process), a
+    faulting program shape is quarantined so future runs route straight
+    to its proven fallback rung, and — with ``checkpoint`` (a path, a
+    prebuilt Checkpointer, or the ``JEPSEN_TPU_CKPT`` env) — the
+    frontier is checkpointed at committed row boundaries so
+    ``resume`` (a path, or by default the checkpoint file itself when
+    it exists; ``False`` disables) continues a killed run mid-history.
+    A resumed verdict provably equals the uninterrupted run: the
+    checkpoint holds an exact committed frontier at a row boundary and
+    the continuation re-runs the same deterministic dispatch sequence.
+    Checkpoints are deleted on a definite verdict and kept on
+    unknown/cancelled/wedged ones; the verdict carries
+    ``resumed-from-row`` when a resume happened.
     """
     if p.kernel is None:
         return {"valid?": "unknown", "analyzer": "tpu-bfs",
@@ -2266,11 +2554,14 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     # path's dispatch queue depth between host flag syncs.
     cand_max = _cand_max()
     sync_chunks = _sync_chunks()
+    kname = p.kernel.name if p.kernel is not None else "generic"
     host_stats: dict = {"episodes": 0, "rows": 0, "dispatches": 0,
                         "passes": 0, "wasted_passes": 0,
                         "sticky_hits": 0, "sticky_misses": 0,
                         "multi_rows": 0, "multi_dispatches": 0,
-                        "multi_trips": 0, "cap_seconds": {}}
+                        "multi_trips": 0, "watchdog_trips": 0,
+                        "faults": 0, "quarantine_skips": 0,
+                        "cpu_rows": 0, "cap_seconds": {}}
     level = 0
     cap = cap_schedule[level]
     bits = jnp.zeros((cap, nw), jnp.uint32)
@@ -2280,9 +2571,80 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     max_cap_used = cap
     snapshots: list | None = [] if explain else None
 
+    # --- checkpoint/resume wiring (supervise module docstring) ------
+    ckpt = None
+    if checkpoint is not None and not isinstance(checkpoint, (str, bool)):
+        ckpt = checkpoint                      # prebuilt Checkpointer
+        ckpt_file = ckpt.path
+    else:
+        ckpt_file = checkpoint if isinstance(checkpoint, str) \
+            else supervise.ckpt_path()
+        if ckpt_file:
+            ckpt = supervise.Checkpointer(
+                ckpt_file, supervise.history_fingerprint(p))
+    resume_host = None
+    resumed_from = None
+    start_row = 0
+    if resume is not False:
+        rpath = resume if isinstance(resume, str) else ckpt_file
+        if rpath and os.path.exists(rpath):
+            fp = ckpt.fingerprint if ckpt is not None \
+                else supervise.history_fingerprint(p)
+            rd = supervise.load_checkpoint(rpath, fp)
+            if rd is not None:
+                from jepsen_tpu.models.kernels import NIL
+
+                rcount = rd["count"]
+                if rd["kind"] == "host":
+                    m = rd["meta"]
+                    if (m.get("b") == state_bits
+                            and m.get("key_hi") == key_hi
+                            and m.get("nw") == nw
+                            and exp_h is not None and crash_dom):
+                        rbits, rstate = supervise.np_unpack_keys(
+                            rd["lo"], rd.get("hi"), rcount, state_bits,
+                            nil_id, nw, key_hi, int(NIL))
+                        resume_host = (rbits, rstate, rcount,
+                                       m.get("sticky_lvl"))
+                        start_row = resumed_from = rd["row"]
+                        for k, v in (m.get("host_stats") or {}).items():
+                            if k == "cap_seconds" and isinstance(v,
+                                                                 dict):
+                                # JSON stringified the int cap
+                                # buckets; restore them or stat_time
+                                # appends duplicate '4096'/4096 keys
+                                # and pre-resume timings vanish.
+                                host_stats[k] = {
+                                    int(b) if str(b).isdigit() else b:
+                                    t for b, t in v.items()}
+                            elif k in host_stats:
+                                host_stats[k] = v
+                elif rcount <= cap_schedule[-1]:
+                    level = next(i for i, c in enumerate(cap_schedule)
+                                 if rcount <= c)
+                    cap = cap_schedule[level]
+                    max_cap_used = max(max_cap_used, cap)
+                    rb = np.zeros((cap, nw), np.uint32)
+                    rs = np.zeros((cap, S), np.int32)
+                    rb[:rcount] = np.asarray(rd["bits"])[:rcount]
+                    rs[:rcount] = np.asarray(rd["state"])[:rcount]
+                    bits = jnp.asarray(rb)
+                    state = jnp.asarray(rs)
+                    count = jnp.int32(rcount)
+                    start_row = resumed_from = rd["row"]
+
     def _with_stats(out: dict) -> dict:
-        if host_stats["episodes"]:
+        if host_stats["episodes"] or host_stats["watchdog_trips"] \
+                or host_stats["faults"] or host_stats["quarantine_skips"] \
+                or host_stats["cpu_rows"]:
             out["host-stats"] = util.round_stats(host_stats)
+        if resumed_from is not None:
+            out["resumed-from-row"] = resumed_from
+        if ckpt is not None and out.get("valid?") in (True, False):
+            # A finished search must not be resumed by a later fresh
+            # run; an unknown/cancelled/wedged verdict keeps the
+            # checkpoint so a re-run continues instead of restarting.
+            ckpt.clear()
         return out
 
     def chunk_tables(base):
@@ -2296,7 +2658,91 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             jnp.asarray(_chunk_slice(t, base, chunk)) for t in exp_h)
         return tables, exp_c
 
-    base = 0
+    def _dead_verdict(dead_row: int) -> dict:
+        ret = p.ops[int(p.ret_op[dead_row])]
+        out = {"valid?": False, "analyzer": "tpu-bfs",
+               "dead-row": dead_row,
+               "op": {"process": ret.process, "f": ret.f,
+                      "value": ret.value, "index": ret.op_index,
+                      "ok": ret.ok},
+               "configs": [], "final-paths": []}
+        if snapshots and not (cancel is not None and cancel.is_set()):
+            from jepsen_tpu.lin import witness
+
+            out.update(witness.tail_replay_sparse(
+                p, _materialize_snapshots(snapshots), dead_row,
+                cancel=cancel))
+        return _with_stats(out)
+
+    def _consume_spiked(spiked, spike_top):
+        """Fold a host-row/spike executor result back into the chunk
+        loop state. Returns ("return", verdict) | ("continue", None) |
+        ("dead", next_r) — shared by the overflow hand-off and the
+        host-kind checkpoint resume so the two paths cannot drift."""
+        nonlocal bits, state, count, base, level, cap, max_cap_used
+        (s_bits, s_state, count_i, next_r, dead_h, ovf_h, cancelled,
+         top_used) = spiked
+        max_cap_used = max(max_cap_used, top_used)
+        if cancelled:
+            return ("return", _with_stats(
+                {"valid?": "unknown", "analyzer": "tpu-bfs",
+                 "error": "cancelled"}))
+        if ovf_h:
+            # Honest overflow taxonomy: a closure-pass-budget
+            # exhaustion (the nontermination class round 5 diagnosed)
+            # and a wedge/fault that survived the whole fallback
+            # ladder must not masquerade as capacity overflows, or
+            # triage chases frontier size instead of the real cause.
+            if ovf_h == "budget":
+                return ("return", _with_stats(
+                    {"valid?": "unknown", "analyzer": "tpu-bfs",
+                     "overflow": "budget",
+                     "error": ("closure pass budget exceeded at "
+                               f"capacity {spike_top}")}))
+            if ovf_h in ("wedged", "fault"):
+                return ("return", _with_stats(
+                    {"valid?": "unknown", "analyzer": "tpu-bfs",
+                     "overflow": "wedge" if ovf_h == "wedged"
+                     else "fault",
+                     "error": ("wedged/faulted dispatch survived the "
+                               "fallback ladder near row "
+                               f"{next_r}")}))
+            return ("return", _with_stats(
+                {"valid?": "unknown", "analyzer": "tpu-bfs",
+                 "overflow": "capacity",
+                 "error": ("frontier exceeded capacity "
+                           f"{spike_top}")}))
+        if dead_h:
+            # Snapshots were re-anchored at the dead row's entry by
+            # the executor (one row of CPU replay for explain).
+            return ("dead", next_r)
+        if next_r >= p.R:
+            return ("return", _with_stats(
+                {"valid?": True, "analyzer": "tpu-bfs",
+                 "configs": [], "final-frontier-size": count_i,
+                 "max-cap": max_cap_used}))
+        # Resume full-size chunks at the hand-back row — at the TOP
+        # chunked level: the neighbourhood of a spike tends to spike
+        # again, and re-climbing the whole cap ladder there costs far
+        # more than one over-provisioned chunk. The shrink logic in
+        # the main loop drops the level back once chunks run clean.
+        level = len(cap_schedule) - 1
+        cap = cap_schedule[level]
+        _dlog(f"resume chunks at {next_r} count {count_i}")
+        # Spike hands back oversized arrays (slice); host-row mode may
+        # hand back smaller ones (pad).
+        if s_bits.shape[0] >= cap:
+            bits = s_bits[:cap]
+            state = s_state[:cap]
+        else:
+            g = cap - s_bits.shape[0]
+            bits = jnp.pad(s_bits, ((0, g), (0, 0)))
+            state = jnp.pad(s_state, ((0, g), (0, 0)))
+        count = jnp.int32(count_i)
+        base = next_r
+        return ("continue", None)
+
+    base = start_row
     deferred = snapshots is None
     classic_until = -1
     _dbg = os.environ.get("JEPSEN_TPU_HOST_DEBUG") == "1"
@@ -2310,6 +2756,31 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
         def _dlog(msg):
             pass
     while base < p.R:
+        if resume_host is not None:
+            # Host-kind checkpoint: re-enter the host-row executor
+            # directly with the checkpointed frontier, sticky level,
+            # and stats — the continuation of the interrupted episode.
+            rbits, rstate, rcount, rsticky = resume_host
+            resume_host = None
+            host_stats["episodes"] += 1
+            hdrop = min(spike_dropback,
+                        (max_tier or cap_schedule[-1]) // TIER_MARGIN)
+            spiked = _host_rows(
+                p, base, jnp.asarray(rbits), jnp.asarray(rstate),
+                jnp.int32(rcount),
+                tables_h=(ret_slot_h, active_h, slot_f_h, slot_v_h,
+                          pure_h, pred_bit_h),
+                exp_h=exp_h, caps=host_caps, dropback=hdrop,
+                step_fn=step_fn, state_bits=state_bits, nil_id=nil_id,
+                use_psort=use_psort, key_hi=key_hi, crash_dom=crash_dom,
+                cancel=cancel, snapshots=snapshots, stats=host_stats,
+                ckpt=ckpt, sticky0=rsticky)
+            act_, payload = _consume_spiked(spiked, host_caps[-1])
+            if act_ == "return":
+                return payload
+            if act_ == "dead":
+                return _dead_verdict(payload - 1)
+            continue
         if deferred and base >= classic_until:
             # Optimistic fast path: dispatch a batch of chunks without
             # host syncs, then fetch every chunk's (ovf, dead) flags in
@@ -2318,33 +2789,73 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             # tripped flag rewinds to the batch entry (frontier arrays
             # are immutable device values) and replays chunk-by-chunk
             # through the classic path below, which owns escalation,
-            # spike mode, and dead-row reporting.
+            # spike mode, and dead-row reporting. The whole batch runs
+            # as ONE supervised unit: the thunk is a pure function of
+            # the batch entry, so a watchdog retry re-dispatches from
+            # there exactly.
+            if cancel is not None and cancel.is_set():
+                return _with_stats(
+                    {"valid?": "unknown", "analyzer": "tpu-bfs",
+                     "error": "cancelled"})
             entry = (bits, state, count, level, base)
-            flags = []
-            while base < p.R and len(flags) < sync_chunks:
-                if cancel is not None and cancel.is_set():
-                    return _with_stats(
-                        {"valid?": "unknown", "analyzer": "tpu-bfs",
-                         "error": "cancelled"})
-                n = min(chunk, p.R - base)
-                tables, exp_c = chunk_tables(base)
-                b2, s2, c2, r_done, dead, ovf = _search_chunk(
-                    jnp.int32(n), *tables, bits, state, count, exp_c,
-                    cap=cap_schedule[level], step_fn=step_fn,
-                    state_bits=state_bits, nil_id=nil_id,
-                    read_value_match=read_value_match,
-                    use_psort=use_psort, key_hi=key_hi,
-                    crash_dom=crash_dom, max_tier=max_tier,
-                    cand_max=cand_max)
-                flags.append(jnp.stack((ovf.astype(jnp.int32),
-                                        dead.astype(jnp.int32), c2)))
-                bits, state, count = b2, s2, c2
-                base += n
-            fl = np.asarray(jnp.stack(flags))   # ONE transfer per batch
+
+            def _fast_batch(entry=entry):
+                bits, state, count, level, base = entry
+                flags = []
+                while base < p.R and len(flags) < sync_chunks:
+                    n = min(chunk, p.R - base)
+                    tables, exp_c = chunk_tables(base)
+                    b2, s2, c2, r_done, dead, ovf = _search_chunk(
+                        jnp.int32(n), *tables, bits, state, count,
+                        exp_c, cap=cap_schedule[level], step_fn=step_fn,
+                        state_bits=state_bits, nil_id=nil_id,
+                        read_value_match=read_value_match,
+                        use_psort=use_psort, key_hi=key_hi,
+                        crash_dom=crash_dom, max_tier=max_tier,
+                        cand_max=cand_max)
+                    flags.append(jnp.stack((ovf.astype(jnp.int32),
+                                            dead.astype(jnp.int32),
+                                            c2)))
+                    bits, state, count = b2, s2, c2
+                    base += n
+                # ONE transfer per batch
+                return bits, state, count, base, np.asarray(
+                    jnp.stack(flags))
+
+            batch_key = supervise.shape_key(
+                "chunk-batch", rows=chunk, cap=cap_schedule[level],
+                window=p.window, kernel=kname)
+            # The thunk runs up to sync_chunks sequential chunk
+            # dispatches: the deadline scales with the batch so a
+            # deep queue (bench's SYNC_CHUNKS=8 rung) of healthy
+            # top-cap chunks cannot false-trip the watchdog (a
+            # spurious retry would double the unsynced dispatch
+            # queue depth — the round-4 fault condition). A fault
+            # (dead worker) records its shape and reports honestly —
+            # never escapes as a raw exception.
+            outcome, val = supervise.run_guarded(
+                "chunk-batch", batch_key, _fast_batch,
+                scale=sync_chunks, stats=host_stats)
+            if outcome == "wedge":
+                return _with_stats(
+                    {"valid?": "unknown", "analyzer": "tpu-bfs",
+                     "overflow": "wedge", "error": str(val)})
+            if outcome == "fault":
+                return _with_stats(
+                    {"valid?": "unknown", "analyzer": "tpu-bfs",
+                     "overflow": "fault",
+                     "error": f"dispatch fault near row {base}: "
+                              f"{val!r}"})
+            bits, state, count, base, fl = val
             util.progress_tick()
             if not fl[:, :2].any():
                 cnt = int(fl[-1, 2])
                 _dlog(f"fast batch -> base {base} count {cnt}")
+                if ckpt is not None and ckpt.due():
+                    ckpt.save("chunk", base, cnt,
+                              {"bits": np.asarray(bits)[:max(cnt, 1)],
+                               "state": np.asarray(state)
+                               [:max(cnt, 1)]}, {})
                 while level > 0 and \
                         cnt * 4 <= cap_schedule[level - 1]:
                     level -= 1
@@ -2369,14 +2880,36 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
         spiked = None
         while True:
             util.progress_tick()
-            b2, s2, c2, r_done, dead, ovf = _search_chunk(
-                jnp.int32(n), *tables, bits, state, count, exp_c,
-                cap=cap_schedule[level], step_fn=step_fn,
-                state_bits=state_bits, nil_id=nil_id,
-                read_value_match=read_value_match, use_psort=use_psort,
-                key_hi=key_hi, crash_dom=crash_dom, max_tier=max_tier,
-                cand_max=cand_max)
-            if not bool(ovf):
+
+            def _chunk(bits=bits, state=state, count=count,
+                       level=level):
+                out = _search_chunk(
+                    jnp.int32(n), *tables, bits, state, count, exp_c,
+                    cap=cap_schedule[level], step_fn=step_fn,
+                    state_bits=state_bits, nil_id=nil_id,
+                    read_value_match=read_value_match,
+                    use_psort=use_psort, key_hi=key_hi,
+                    crash_dom=crash_dom, max_tier=max_tier,
+                    cand_max=cand_max)
+                return out, bool(out[5])
+
+            chunk_key = supervise.shape_key(
+                "chunk", rows=chunk, cap=cap_schedule[level],
+                window=p.window, kernel=kname)
+            outcome, val = supervise.run_guarded(
+                "chunk", chunk_key, _chunk, stats=host_stats)
+            if outcome == "wedge":
+                return _with_stats(
+                    {"valid?": "unknown", "analyzer": "tpu-bfs",
+                     "overflow": "wedge", "error": str(val)})
+            if outcome == "fault":
+                return _with_stats(
+                    {"valid?": "unknown", "analyzer": "tpu-bfs",
+                     "overflow": "fault",
+                     "error": f"dispatch fault near row {base}: "
+                              f"{val!r}"})
+            (b2, s2, c2, r_done, dead, ovf), ovf_b = val
+            if not ovf_b:
                 break
             # With a tier cap, a bigger chunk cap cannot grow the
             # effective tier ladder (tiers top out at max_tier and
@@ -2458,7 +2991,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                         nil_id=nil_id, use_psort=use_psort,
                         key_hi=key_hi, crash_dom=crash_dom,
                         cancel=cancel, snapshots=snapshots,
-                        stats=host_stats)
+                        stats=host_stats, ckpt=ckpt)
                 else:
                     # Dropback clamped so the handed-back frontier
                     # always fits the chunked engine's top cap.
@@ -2472,7 +3005,8 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                         nil_id=nil_id, read_value_match=read_value_match,
                         cancel=cancel, snapshots=snapshots,
                         use_psort=use_psort, exp_h=exp_h, key_hi=key_hi,
-                        crash_dom=crash_dom, cand_max=cand_max)
+                        crash_dom=crash_dom, cand_max=cand_max,
+                        stats=host_stats)
                 spike_top = sp_caps[-1]
                 break
             # Retry this chunk from its entry frontier at the next cap.
@@ -2483,80 +3017,21 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             bits = jnp.pad(bits, ((0, grow), (0, 0)))
             state = jnp.pad(state, ((0, grow), (0, 0)))
         if spiked is not None:
-            (s_bits, s_state, count_i, next_r, dead_h, ovf_h, cancelled,
-             top_used) = spiked
-            max_cap_used = max(max_cap_used, top_used)
-            if cancelled:
-                return _with_stats(
-                    {"valid?": "unknown", "analyzer": "tpu-bfs",
-                     "error": "cancelled"})
-            if ovf_h:
-                # Honest overflow taxonomy: a closure-pass-budget
-                # exhaustion (the nontermination class round 5
-                # diagnosed) must not masquerade as a capacity
-                # overflow, or triage chases frontier size instead of
-                # convergence.
-                if ovf_h == "budget":
-                    return _with_stats(
-                        {"valid?": "unknown", "analyzer": "tpu-bfs",
-                         "overflow": "budget",
-                         "error": ("closure pass budget exceeded at "
-                                   f"capacity {spike_top}")})
-                return _with_stats(
-                    {"valid?": "unknown", "analyzer": "tpu-bfs",
-                     "overflow": "capacity",
-                     "error": ("frontier exceeded capacity "
-                               f"{spike_top}")})
-            if dead_h:
-                # Snapshots were re-anchored at the dead row's entry by
-                # _spike_rows (one row of CPU replay for explain).
-                r_done = jnp.int32(next_r - base)
-                dead = True
-            elif next_r >= p.R:
-                return _with_stats(
-                    {"valid?": True, "analyzer": "tpu-bfs",
-                     "configs": [], "final-frontier-size": count_i,
-                     "max-cap": max_cap_used})
-            else:
-                # Resume full-size chunks at the hand-back row — at the
-                # TOP chunked level: the neighbourhood of a spike tends
-                # to spike again, and re-climbing the whole cap ladder
-                # there costs far more than one over-provisioned chunk.
-                # The shrink logic below drops the level back once
-                # chunks run clean.
-                level = len(cap_schedule) - 1
-                cap = cap_schedule[level]
-                _dlog(f"resume chunks at {next_r} count {count_i}")
-                # Spike hands back oversized arrays (slice); host-row
-                # mode may hand back smaller ones (pad).
-                if s_bits.shape[0] >= cap:
-                    bits = s_bits[:cap]
-                    state = s_state[:cap]
-                else:
-                    g = cap - s_bits.shape[0]
-                    bits = jnp.pad(s_bits, ((0, g), (0, 0)))
-                    state = jnp.pad(s_state, ((0, g), (0, 0)))
-                count = jnp.int32(count_i)
-                base = next_r
-                continue
+            act_, payload = _consume_spiked(spiked, spike_top)
+            if act_ == "return":
+                return payload
+            if act_ == "dead":
+                return _dead_verdict(payload - 1)
+            continue
         if bool(dead):
-            r = base + int(r_done) - 1
-            ret = p.ops[int(p.ret_op[r])]
-            out = {"valid?": False, "analyzer": "tpu-bfs",
-                   "dead-row": r,
-                   "op": {"process": ret.process, "f": ret.f,
-                          "value": ret.value, "index": ret.op_index,
-                          "ok": ret.ok},
-                   "configs": [], "final-paths": []}
-            if snapshots and not (cancel is not None and cancel.is_set()):
-                from jepsen_tpu.lin import witness
-
-                out.update(witness.tail_replay_sparse(
-                    p, _materialize_snapshots(snapshots), r,
-                    cancel=cancel))
-            return _with_stats(out)
+            return _dead_verdict(base + int(r_done) - 1)
         bits, state, count = b2, s2, c2
         base += n
+        if ckpt is not None and ckpt.due():
+            cnt = int(count)
+            ckpt.save("chunk", base, cnt,
+                      {"bits": np.asarray(bits)[:max(cnt, 1)],
+                       "state": np.asarray(state)[:max(cnt, 1)]}, {})
         # Frontier is compacted to the front, so a shrunken frontier can
         # drop back to a smaller (faster) program by slicing.
         while level > 0 and int(count) * 4 <= cap_schedule[level - 1]:

@@ -34,6 +34,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from jepsen_tpu import util
+from jepsen_tpu.lin import supervise
 from jepsen_tpu.lin.bfs import KEY_FILL, _expand_keys, _pad_rows
 
 # The sparse sharded frontier keeps single-word bitsets (the all_gather
@@ -493,15 +494,19 @@ def _run_packed_chunks(p, mesh, axis, tables_h, cap_schedule, *, b,
     n_chunks = 0
     n_escalations = 0
     peak_total = 1
+    sup_stats: dict = {"watchdog_trips": 0, "faults": 0}
 
     def mesh_stats():
         # Observability twin of the single-chip engine's host-stats:
         # attached to EVERY verdict shape (success, death, overflow)
         # so bench/driver artifacts can read the dispatch and
         # escalation profile without re-running.
-        return {"chunks": n_chunks, "escalations": n_escalations,
-                "peak-frontier": peak_total,
-                "cap-per-device": cap_schedule[level]}
+        out = {"chunks": n_chunks, "escalations": n_escalations,
+               "peak-frontier": peak_total,
+               "cap-per-device": cap_schedule[level]}
+        if sup_stats["watchdog_trips"] or sup_stats["faults"]:
+            out.update(sup_stats)
+        return out
 
     while base < p.R:
         if cancel is not None and cancel.is_set():
@@ -516,12 +521,35 @@ def _run_packed_chunks(p, mesh, axis, tables_h, cap_schedule, *, b,
                     for a in tables_h)
         while True:
             util.progress_tick()   # liveness: one tick per chunk dispatch
-            k2, c2, r_done, dead, ovf, total = _search_sharded_keys(
-                *tbl, keys, counts, jnp.int32(n),
-                cap_local=cap_schedule[level], step_fn=step_fn,
-                mesh=mesh, b=b, nil_id=nil_id,
-                read_value_match=read_value_match, axis=axis)
-            if not bool(ovf):
+
+            def _mesh_chunk(keys=keys, counts=counts, level=level):
+                out = _search_sharded_keys(
+                    *tbl, keys, counts, jnp.int32(n),
+                    cap_local=cap_schedule[level], step_fn=step_fn,
+                    mesh=mesh, b=b, nil_id=nil_id,
+                    read_value_match=read_value_match, axis=axis)
+                return out, bool(out[4])
+
+            mesh_key = supervise.shape_key(
+                "mesh-chunk", rows=SHARDED_CHUNK,
+                cap=cap_schedule[level], window=p.window,
+                kernel=p.kernel.name)
+            outcome, val = supervise.run_guarded(
+                "mesh-chunk", mesh_key, _mesh_chunk, stats=sup_stats)
+            if outcome == "wedge":
+                return {"valid?": "unknown",
+                        "analyzer": "tpu-bfs-sharded",
+                        "overflow": "wedge",
+                        "mesh-stats": mesh_stats(), "error": str(val)}
+            if outcome == "fault":
+                return {"valid?": "unknown",
+                        "analyzer": "tpu-bfs-sharded",
+                        "overflow": "fault",
+                        "mesh-stats": mesh_stats(),
+                        "error": f"dispatch fault near row {base}: "
+                                 f"{val!r}"}
+            (k2, c2, r_done, dead, ovf, total), ovf_b = val
+            if not ovf_b:
                 break
             if level + 1 >= len(cap_schedule):
                 return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",

@@ -1,0 +1,584 @@
+"""Supervised dispatch runtime for the linearizability engines.
+
+The chip's failure modes are the bottleneck of 100k-op on-device
+decides as much as throughput (rounds 2-7 lore, CLAUDE.md): kernel
+faults kill the TPU worker for ~a minute, the shared-chip tunnel can
+wedge a single dispatch ~25 minutes, and a watchdog-killed in-program
+orbit presents exactly like a fault. Until now recovery was "kill and
+re-run" at the PROCESS level (bench.py's parent-side stall watchdog),
+throwing away whole multi-hour runs. This module moves supervision
+down into the library, per DISPATCH:
+
+- :func:`call` — the dispatch watchdog: runs one engine dispatch in a
+  worker thread under a per-call-site deadline and a bounded retry
+  budget. Engine dispatch thunks are pure functions of immutable
+  device arrays, so abandoning a wedged thread and re-dispatching is
+  exact. Exhaustion raises :class:`WedgedDispatch`, which the call
+  sites translate into their fallback ladder rung (wave -> per-row
+  fused -> unfused passes -> CPU oracle) or an honest "unknown".
+- The **fault-shape quarantine ledger** — a persistent JSON beside the
+  XLA compile cache keyed by traced program shape (site, rows x cap,
+  window, kernel family). A dispatch that faults (or repeatedly
+  wedges — one wedge is often environmental, see
+  WEDGE_QUARANTINE_COUNT) records its shape. The HOST-ROW sites
+  (host-wave / host-fixpoint / host-pass) consult the ledger and route
+  quarantined shapes straight to their proven fallback rung in future
+  runs, including fresh processes — the round 2-5 fault lore as
+  machine state instead of CLAUDE.md prose. The base-rung sites
+  (chunk, chunk-batch, spike, mesh-chunk) have no alternative rung:
+  their entries are observability only (the `make probe-config5`
+  ledger delta and triage), not routing.
+  ``cli.py quarantine list|clear|diff`` manages it.
+- :class:`Checkpointer` / :func:`load_checkpoint` — **frontier
+  checkpoint/resume**: at episode boundaries the engines serialize the
+  packed frontier, row cursor, sticky level, and host-stats to an
+  ``.npz`` beside the run; ``lin.device_check_packed(..., resume=...)``
+  continues a killed or faulted run mid-history. Soundness rests on
+  the checkpoint carrying an EXACT committed frontier at a row
+  boundary: the continuation re-runs the identical deterministic
+  dispatch sequence, so the resumed verdict and death row provably
+  equal the uninterrupted run (parity-tested against lin/cpu.py).
+
+Env knobs (all tabled in doc/env.md): JEPSEN_TPU_SUPERVISE,
+JEPSEN_TPU_DISPATCH_DEADLINE_S, JEPSEN_TPU_DISPATCH_RETRIES,
+JEPSEN_TPU_QUARANTINE, JEPSEN_TPU_CKPT, JEPSEN_TPU_CKPT_EVERY_S,
+JEPSEN_TPU_WEDGE (test hook), JEPSEN_TPU_CPU_ROW_MAX.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Callable
+
+import numpy as np
+
+from jepsen_tpu import util
+
+CKPT_VERSION = 1
+LEDGER_VERSION = 1
+# Events kept in the in-stats trip log (monitoring-grade; the ledger
+# holds the durable record).
+MAX_EVENTS = 8
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def enabled() -> bool:
+    """Dispatch watchdog master switch; ``JEPSEN_TPU_SUPERVISE=0``
+    runs every dispatch unwrapped (triage: rule the supervision layer
+    itself out)."""
+    return os.environ.get("JEPSEN_TPU_SUPERVISE", "1") != "0"
+
+
+def base_deadline_s() -> float:
+    """Base per-dispatch deadline. Call sites scale it (the fused
+    closure fixpoint and the K-row wave program legitimately run
+    minutes in ONE dispatch, so they pass scale 3; chunk-batch and
+    per-pass sites use scale 1)."""
+    return util.env_float("JEPSEN_TPU_DISPATCH_DEADLINE_S", 600.0)
+
+
+def retry_budget() -> int:
+    """Re-dispatches after a wedge before :class:`WedgedDispatch`."""
+    return util.env_int("JEPSEN_TPU_DISPATCH_RETRIES", 1)
+
+
+def cpu_row_max() -> int:
+    """Largest frontier the CPU-oracle ladder rung accepts (a pure
+    Python closure over a bigger set would grind for hours; past this
+    the ladder reports an honest wedge/fault overflow instead)."""
+    return util.env_int("JEPSEN_TPU_CPU_ROW_MAX", 1 << 16)
+
+
+class WedgedDispatch(Exception):
+    """A dispatch exceeded its watchdog deadline on every attempt.
+    The call site falls to its next ladder rung or reports an honest
+    "unknown" — it must never hang the process."""
+
+    def __init__(self, site: str, deadline_s: float, attempts: int):
+        self.site, self.deadline_s, self.attempts = \
+            site, deadline_s, attempts
+        super().__init__(
+            f"dispatch at site {site!r} exceeded the {deadline_s:.0f}s "
+            f"watchdog deadline on {attempts} attempt(s)")
+
+
+# --- wedge injection (test hook) -------------------------------------------
+# JEPSEN_TPU_WEDGE="site:count[:deadline_s]" (or inject_wedge()) makes
+# the next ``count`` supervised calls at ``site`` run a fake thunk that
+# blocks past the deadline WITHOUT touching the device — so tests (and
+# the bench artifact test) exercise detection, retry, and the fallback
+# ladder deterministically. The real thunk runs on the next attempt.
+# The optional per-injection deadline applies ONLY to the injected
+# attempts, so a test can prove fast detection at one site without
+# starving every other dispatch in the process.
+
+_injected: dict[str, list] = {}   # site -> [count, deadline_s | None]
+_env_wedge_loaded: str | None = None
+_lock = threading.Lock()
+
+
+def inject_wedge(site: str, n: int = 1,
+                 deadline_s: float | None = None) -> None:
+    with _lock:
+        e = _injected.setdefault(site, [0, deadline_s])
+        e[0] += n
+        if deadline_s is not None:
+            e[1] = deadline_s
+
+
+def _consume_injection(site: str):
+    """None when this attempt runs the real thunk; otherwise the
+    deadline to use for the injected (fake-wedged) attempt."""
+    global _env_wedge_loaded
+    with _lock:
+        env = os.environ.get("JEPSEN_TPU_WEDGE", "")
+        if env and env != _env_wedge_loaded:
+            _env_wedge_loaded = env
+            for part in env.split(","):
+                bits = part.split(":")
+                if bits and bits[0]:
+                    e = _injected.setdefault(bits[0].strip(), [0, None])
+                    e[0] += int(bits[1]) if len(bits) > 1 and bits[1] \
+                        else 1
+                    if len(bits) > 2 and bits[2]:
+                        e[1] = float(bits[2])
+        e = _injected.get(site)
+        if e is not None and e[0] > 0:
+            e[0] -= 1
+            return e[1] if e[1] is not None else -1.0
+        return None
+
+
+def _note_event(stats: dict | None, site: str, kind: str,
+                detail: str = "") -> None:
+    if stats is None:
+        return
+    util.stat_bump(stats, "watchdog_trips" if kind == "wedge"
+                   else "faults")
+    ev = stats.setdefault("supervise_events", [])
+    if len(ev) < MAX_EVENTS:
+        e = {"site": site, "kind": kind}
+        if detail:
+            e["detail"] = detail[:200]
+        ev.append(e)
+
+
+def note_fault(stats: dict | None, site: str, detail: str = "") -> None:
+    """Record a dispatch FAULT (the thunk raised — a dead worker, an
+    XLA runtime error) in the stats trip log; the wedge twin is
+    recorded by :func:`call` itself."""
+    _note_event(stats, site, "fault", detail)
+
+
+def call(site: str, thunk: Callable, *, scale: float = 1.0,
+         deadline_s: float | None = None, retries: int | None = None,
+         stats: dict | None = None):
+    """Run one engine dispatch thunk under the watchdog.
+
+    The thunk is dispatched from a daemon worker thread and joined
+    with the deadline; a join timeout is a WEDGE: the worker is
+    abandoned (on a truly wedged tunnel it blocks in the runtime — the
+    same state the process was in before, except now the search can
+    act on it), the trip is recorded in ``stats``, and the thunk is
+    re-dispatched up to the retry budget. Thunks MUST be pure
+    functions of immutable inputs (every engine dispatch is: jitted
+    programs of device arrays), so a retry is exact.
+
+    After a deadline miss the worker gets one short GRACE join (25% of
+    the deadline, capped at 60 s) before the retry dispatches: a stall
+    that resolves just past the deadline — the common shared-chip case
+    — is harvested instead of raced. The residual race is inherent (an
+    XLA dispatch cannot be cancelled): a retry can overlap a still-
+    wedged dispatch that later resumes, briefly doubling the queue
+    depth; deadlines are therefore sized as upper bounds of legitimate
+    dispatch time, not latency targets.
+
+    Exceptions from the thunk propagate unchanged (fault
+    classification and ledger recording are the call site's job — it
+    knows the program shape; see :func:`run_guarded`). Raises
+    :class:`WedgedDispatch` when the budget is exhausted."""
+    if not enabled():
+        return thunk()
+    deadline = deadline_s if deadline_s is not None \
+        else base_deadline_s() * scale
+    attempts = max(1, (retries if retries is not None
+                       else retry_budget()) + 1)
+    for _attempt in range(attempts):
+        fn = thunk
+        join_deadline = deadline
+        inj = _consume_injection(site)
+        if inj is not None:
+            # Fake wedge: blocks past the deadline without running the
+            # real dispatch (racing an abandoned REAL dispatch against
+            # its retry would touch device state twice). An injection-
+            # carried deadline applies to this attempt only.
+            if inj > 0:
+                join_deadline = inj
+            fn = lambda: threading.Event().wait(  # noqa: E731
+                join_deadline * 10)
+        result: list = []
+        err: list = []
+
+        def run(fn=fn):
+            try:
+                result.append(fn())
+            except BaseException as e:  # noqa: BLE001 - reported below
+                err.append(e)
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"supervised-{site}")
+        t.start()
+        t.join(join_deadline)
+        if t.is_alive():
+            # Grace join: harvest a just-late completion instead of
+            # racing a second dispatch against it (see docstring).
+            t.join(min(join_deadline * 0.25, 60.0))
+        if t.is_alive():
+            _note_event(stats, site, "wedge")
+            # Liveness: detection and the retry ARE forward progress.
+            # Without this tick bench's parent stall watchdog (whose
+            # windows are sized like these deadlines) would kill the
+            # child at the same moment the in-library ladder starts —
+            # making the recovery paths unreachable exactly where
+            # they matter.
+            util.progress_tick()
+            continue
+        if err:
+            raise err[0]
+        return result[0]
+    raise WedgedDispatch(site, deadline, attempts)
+
+
+def run_guarded(site: str, key: str, thunk: Callable, *,
+                scale: float = 1.0, stats: dict | None = None,
+                retries: int | None = None):
+    """:func:`call` + the fault taxonomy + ledger recording, in one
+    place (the seven engine call sites differ only in their fallback
+    ACTION). Returns ``(outcome, value)``: ``("ok", result)``,
+    ``("wedge", WedgedDispatch)`` — budget exhausted, shape recorded —
+    or ``("fault", exc)`` — the dispatch raised RuntimeError/OSError
+    (dead worker, XLA runtime error), event noted in ``stats`` and
+    shape recorded. Other exceptions (programming errors) propagate."""
+    try:
+        return "ok", call(site, thunk, scale=scale, stats=stats,
+                          retries=retries)
+    except WedgedDispatch as e:
+        record_fault(key, "wedge")
+        return "wedge", e
+    except (RuntimeError, OSError) as e:
+        note_fault(stats, site, repr(e))
+        record_fault(key, "fault", repr(e))
+        return "fault", e
+
+
+# --- fault-shape quarantine ledger -----------------------------------------
+
+
+def ledger_path() -> str | None:
+    """The quarantine ledger lives beside the persistent XLA compile
+    cache (both are per-checkout machine state). ``JEPSEN_TPU_QUARANTINE``
+    overrides the path; ``0`` disables the ledger entirely."""
+    env = os.environ.get("JEPSEN_TPU_QUARANTINE", "")
+    if env == "0":
+        return None
+    if env:
+        return env
+    return os.path.join(_repo_root(), ".jax_cache", "quarantine.json")
+
+
+def shape_key(site: str, *, cap: int, window: int, kernel: str,
+              rows: int = 1) -> str:
+    """The traced-program-shape key: what the runtime objects to is
+    (program family) x (rows x cap complexity) x (window/kernel
+    bucket) — the round 2-5 fault-lore coordinates."""
+    return f"{site}|rows{rows}|cap{cap}|w{window}|{kernel}"
+
+
+_ledger_cache: tuple[str, float, dict] | None = None
+
+
+def load_ledger(path: str | None = None) -> dict:
+    """The ledger's ``shapes`` dict ({} when absent/disabled/corrupt —
+    a damaged ledger must never block a check). mtime-cached: the
+    host-row executor consults it per row."""
+    global _ledger_cache
+    path = path or ledger_path()
+    if path is None:
+        return {}
+    try:
+        mtime = os.stat(path).st_mtime
+    except OSError:
+        return {}
+    if _ledger_cache is not None and _ledger_cache[0] == path \
+            and _ledger_cache[1] == mtime:
+        return _ledger_cache[2]
+    try:
+        with open(path) as fh:
+            shapes = json.load(fh).get("shapes", {})
+    except (OSError, ValueError):
+        return {}
+    _ledger_cache = (path, mtime, shapes)
+    return shapes
+
+
+# A single WEDGE does not quarantine a shape: tunnel stalls are often
+# environmental (the shared chip wedges healthy dispatches ~25 min,
+# CLAUDE.md), and one transient event must not permanently route a
+# healthy program to a slower rung. A STREAK of wedges of the SAME
+# shape within the window is evidence (two isolated stalls weeks apart
+# are still environmental — the streak resets); faults (the dispatch
+# raised) quarantine immediately.
+WEDGE_QUARANTINE_COUNT = 2
+WEDGE_STREAK_WINDOW_S = 6 * 3600.0
+
+_TS_FMT = "%Y-%m-%dT%H:%M:%SZ"
+
+
+def _parse_ts(s) -> float | None:
+    import calendar
+
+    try:
+        return calendar.timegm(time.strptime(s, _TS_FMT))
+    except (TypeError, ValueError):
+        return None
+
+
+def quarantined(key: str, path: str | None = None) -> dict | None:
+    e = load_ledger(path).get(key)
+    if e is None:
+        return None
+    # Wedge tolerance applies only to shapes that have NEVER faulted:
+    # a fault is hard evidence regardless of later wedges.
+    if e.get("reason") == "wedge" and not e.get("faulted") \
+            and e.get("streak", e.get("count", 0)) \
+            < WEDGE_QUARANTINE_COUNT:
+        return None
+    return e
+
+
+def _write_ledger(path: str, shapes: dict) -> None:
+    global _ledger_cache
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump({"version": LEDGER_VERSION, "shapes": shapes}, fh,
+                  indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    _ledger_cache = None
+
+
+def record_fault(key: str, reason: str, detail: str = "",
+                 path: str | None = None) -> dict | None:
+    """Record (or re-record) a faulting shape. ``reason`` is "fault"
+    (the dispatch raised) or "wedge" (watchdog deadline). Last-writer-
+    wins read-modify-write with an atomic replace — monitoring-grade
+    concurrency, matching the bench's subprocess fan-out."""
+    path = path or ledger_path()
+    if path is None:
+        return None
+    shapes = dict(load_ledger(path))
+    now_s = time.time()
+    now = time.strftime(_TS_FMT, time.gmtime(now_s))
+    e = dict(shapes.get(key) or {"first": now, "count": 0})
+    if reason == "wedge":
+        prev = _parse_ts(e.get("last"))
+        within = prev is not None and now_s - prev <= \
+            WEDGE_STREAK_WINDOW_S
+        e["streak"] = (e.get("streak", 0) + 1) if within else 1
+    else:
+        e["faulted"] = True
+    e.update(reason=reason, count=e.get("count", 0) + 1, last=now)
+    if detail:
+        e["detail"] = detail[:500]
+    shapes[key] = e
+    _write_ledger(path, shapes)
+    return e
+
+
+def clear_ledger(keys=None, path: str | None = None) -> int:
+    """Remove ``keys`` (or everything) from the ledger; returns the
+    number of entries removed."""
+    path = path or ledger_path()
+    if path is None:
+        return 0
+    shapes = dict(load_ledger(path))
+    if keys is None:
+        removed = len(shapes)
+        shapes = {}
+    else:
+        removed = 0
+        for k in keys:
+            if shapes.pop(k, None) is not None:
+                removed += 1
+    _write_ledger(path, shapes)
+    return removed
+
+
+def ledger_delta(before: dict, path: str | None = None) -> dict:
+    """Shapes newly recorded (or re-faulted) since ``before`` (a prior
+    ``load_ledger`` snapshot) — what ``make probe-config5`` prints so
+    an engine change that newly faults a shape is visible in one
+    command."""
+    now = load_ledger(path)
+    out = {}
+    for k, e in now.items():
+        old = before.get(k)
+        if old is None or old.get("count") != e.get("count"):
+            out[k] = e
+    return out
+
+
+# --- frontier checkpoint/resume --------------------------------------------
+
+
+def ckpt_path() -> str | None:
+    return os.environ.get("JEPSEN_TPU_CKPT", "") or None
+
+
+def ckpt_every_s() -> float:
+    return util.env_float("JEPSEN_TPU_CKPT_EVERY_S", 60.0)
+
+
+def history_fingerprint(p) -> str:
+    """Identity of a packed history for resume safety: a checkpoint
+    resumes ONLY onto the exact same search input (tables, window,
+    kernel, interning) — anything else is rejected and the run starts
+    fresh."""
+    h = hashlib.sha256()
+    h.update(f"{p.kernel.name if p.kernel else None}|{p.window}|{p.R}|"
+             f"{len(p.unintern)}".encode())
+    for a in (p.ret_slot, p.active, p.slot_f, p.slot_v, p.crashed,
+              p.init_state):
+        arr = np.ascontiguousarray(np.asarray(a))
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+class Checkpointer:
+    """Interval-gated frontier checkpoint writer (atomic ``.npz``).
+
+    ``save`` is called by the engines at committed row boundaries (the
+    chunk loop after a clean batch, the host-row executor after each
+    committed row/batch — the episode boundaries); ``due()`` gates the
+    device->host frontier copy to once per ``every_s``. ``on_save`` is
+    a test hook (the resume parity test kills the search right after a
+    boundary write)."""
+
+    def __init__(self, path: str, fingerprint: str,
+                 every_s: float | None = None):
+        self.path = path
+        self.fingerprint = fingerprint
+        self.every_s = ckpt_every_s() if every_s is None else every_s
+        self._last = float("-inf")
+        self.writes = 0
+        self.on_save = None
+
+    def due(self) -> bool:
+        return time.monotonic() - self._last >= self.every_s
+
+    def save(self, kind: str, row: int, count: int,
+             arrays: dict, meta: dict | None = None) -> None:
+        m = {"version": CKPT_VERSION, "fingerprint": self.fingerprint,
+             "kind": kind, "row": int(row), "count": int(count)}
+        m.update(meta or {})
+        payload = {k: np.asarray(v) for k, v in arrays.items()}
+        payload["__meta__"] = np.frombuffer(
+            json.dumps(m, default=str).encode(), dtype=np.uint8)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **payload)
+        os.replace(tmp, self.path)
+        self._last = time.monotonic()
+        self.writes += 1
+        if self.on_save is not None:
+            self.on_save(kind, int(row))
+
+    def clear(self) -> None:
+        """Remove the checkpoint (called on a DEFINITE verdict — a
+        later fresh run must not resume a finished search; unknown /
+        wedged / cancelled verdicts keep it so a re-run continues)."""
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass
+
+
+def load_checkpoint(path: str, fingerprint: str) -> dict | None:
+    """Load + validate a checkpoint. Returns
+    ``{"kind", "row", "count", "meta", <arrays>}`` or None when
+    missing, corrupt, version-skewed, or fingerprint-mismatched —
+    resume degrades to a fresh run, never an exception."""
+    try:
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["__meta__"]).decode())
+            if meta.get("version") != CKPT_VERSION:
+                return None
+            if meta.get("fingerprint") != fingerprint:
+                return None
+            out = {k: z[k] for k in z.files if k != "__meta__"}
+    except Exception:  # noqa: BLE001 - any damage means "no checkpoint"
+        return None
+    out.update(kind=meta["kind"], row=int(meta["row"]),
+               count=int(meta["count"]), meta=meta)
+    return out
+
+
+# --- numpy (device-free) packed-key codec ----------------------------------
+# Host-side mirror of bfs._pack/_unpack_frontier_keys[2]: the CPU-
+# oracle ladder rung and host-kind checkpoint resume must decode/encode
+# frontiers WITHOUT a device dispatch (the device may be the thing
+# that's dead).
+
+KEY_FILL = np.uint32(0xFFFFFFFF)
+
+
+def np_unpack_keys(lo, hi, count, b, nil_id, nw, key_hi, nil_state):
+    """(bits[count, nw] uint32, state[count, 1] int32) from packed key
+    arrays (numpy, first ``count`` live entries)."""
+    n = int(count)
+    lo = np.asarray(lo)[:n].astype(np.uint64)
+    mask = np.uint64((1 << b) - 1)
+    if key_hi:
+        full = lo | (np.asarray(hi)[:n].astype(np.uint64) << np.uint64(32))
+    else:
+        full = lo
+    sv = (full & mask).astype(np.int64)
+    state = np.where(sv == nil_id, nil_state, sv).astype(np.int32)
+    bits_full = full >> np.uint64(b)
+    cols = [(bits_full & np.uint64(0xFFFFFFFF)).astype(np.uint32)]
+    if nw > 1:
+        cols.append((bits_full >> np.uint64(32)).astype(np.uint32))
+    bits = np.stack(cols, axis=1)
+    if nw > len(cols):
+        bits = np.pad(bits, ((0, 0), (0, nw - len(cols))))
+    return bits, state[:, None]
+
+
+def np_pack_keys(bits, state, b, nil_id, key_hi, nil_state, cap):
+    """(lo[cap], hi[cap]|None) uint32 arrays from unpacked frontier
+    rows (KEY_FILL padded) — numpy twin of bfs._pack_frontier_keys[2]."""
+    bits = np.asarray(bits, dtype=np.uint64)
+    state = np.asarray(state)
+    n = bits.shape[0]
+    sv = state[:, 0].astype(np.int64)
+    ps = np.where(sv == nil_state, nil_id, sv).astype(np.uint64)
+    full = (bits[:, 0] << np.uint64(b)) | ps
+    if bits.shape[1] > 1:
+        full = full | (bits[:, 1] << np.uint64(32 + b))
+    lo = np.full(cap, KEY_FILL, np.uint32)
+    lo[:n] = (full & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    if not key_hi:
+        return lo, None
+    hi = np.full(cap, KEY_FILL, np.uint32)
+    hi[:n] = (full >> np.uint64(32)).astype(np.uint32)
+    return lo, hi

@@ -130,28 +130,133 @@ def http_json(method: str, url: str, body=None, timeout: float = 5.0,
         return status, raw
 
 
+class WireIndeterminate(ConnectionError):
+    """The connection died mid-exchange — after the request may have
+    reached the server. The op's outcome is INDETERMINATE: clients
+    must complete it ``:info``, never ``:fail`` (an op recorded
+    ``:fail`` is excluded from the search, so a ``:fail`` that
+    actually applied makes the checker unsound — SURVEY.md and the
+    reference's client contract, etcd.clj:112-125)."""
+
+
+class ReconnectExhausted(ConnectionError):
+    """The bounded reconnect budget ran out before a connection was
+    re-established. Raised BEFORE any request is sent, so the op
+    never reached the server — clients may complete it ``:fail``."""
+
+
 class SocketIO:
     """Buffered exact-read over a stream socket — the framing loop every
-    wire client needs (one shared copy instead of one per protocol)."""
+    wire client needs (one shared copy instead of one per protocol) —
+    plus BOUNDED RECONNECT with exponential backoff: constructed with a
+    ``connect`` factory, a dead connection is re-established at the
+    next op (never mid-exchange: silently re-sending a request that
+    may already have applied could double-apply a mutator). A send or
+    read that fails mid-exchange marks the connection dead and raises
+    :class:`WireIndeterminate`; the NEXT op's :meth:`ensure_connected`
+    runs the retry/backoff ladder (protocols with a session handshake
+    re-run it via the True return — see suites.zkwire).
 
-    def __init__(self, sock):
+    ``JEPSEN_TPU_WIRE_RETRIES`` / ``JEPSEN_TPU_WIRE_BACKOFF_S``
+    override the per-instance defaults (doc/env.md)."""
+
+    def __init__(self, sock=None, *, connect=None, retries=None,
+                 backoff=None):
+        from jepsen_tpu.util import env_float, env_int
+
         self.sock = sock
+        self._connect = connect
+        self.retries = retries if retries is not None else \
+            env_int("JEPSEN_TPU_WIRE_RETRIES", 4)
+        self.backoff = backoff if backoff is not None else \
+            env_float("JEPSEN_TPU_WIRE_BACKOFF_S", 0.05)
+        self.reconnects = 0
+        self.buf = b""
+        if self.sock is None and connect is not None:
+            self.ensure_connected()
+
+    def ensure_connected(self) -> bool:
+        """Connect (or reconnect) if the connection is dead; bounded
+        retries with exponential backoff. Returns True when a FRESH
+        socket was established (the caller re-runs any session
+        handshake), False when the existing connection stands. Raises
+        :class:`ReconnectExhausted` when the budget runs out."""
+        import time
+
+        if self.sock is not None:
+            return False
+        if self._connect is None:
+            raise ReconnectExhausted(
+                "connection closed and no reconnect factory")
+        delay = self.backoff
+        last: Exception | None = None
+        for attempt in range(self.retries + 1):
+            try:
+                self.sock = self._connect()
+                self.buf = b""
+                self.reconnects += 1
+                return True
+            except OSError as e:
+                last = e
+                if attempt < self.retries:
+                    time.sleep(delay)
+                    delay *= 2
+        raise ReconnectExhausted(
+            f"reconnect budget ({self.retries + 1} attempts) "
+            f"exhausted: {last!r}")
+
+    def mark_dead(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
         self.buf = b""
 
     def read_exact(self, n: int) -> bytes:
-        while len(self.buf) < n:
-            chunk = self.sock.recv(65536)
-            if not chunk:
-                raise ConnectionError("connection closed")
-            self.buf += chunk
+        if self.sock is None:
+            # Marked dead by an earlier op. Reconnect is the CLIENT's
+            # per-op job (ensure_connected + its session handshake);
+            # raising a ConnectionError subclass here keeps factory-
+            # less legacy clients on their pre-reconnect behavior
+            # (suites catch ConnectionError, not AttributeError).
+            raise ReconnectExhausted(
+                "connection closed (reconnect via ensure_connected)")
+        try:
+            while len(self.buf) < n:
+                chunk = self.sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError("connection closed")
+                self.buf += chunk
+        except (ConnectionError, OSError) as e:
+            # Mid-exchange death: a request is in flight, so the op
+            # outcome is indeterminate. The connection is marked dead
+            # so the NEXT op reconnects.
+            self.mark_dead()
+            raise WireIndeterminate(
+                f"connection lost awaiting reply: {e!r}") from e
         out, self.buf = self.buf[:n], self.buf[n:]
         return out
 
     def send(self, data: bytes) -> None:
-        self.sock.sendall(data)
+        if self.sock is None:
+            # See read_exact: never silently re-dial here — a raw
+            # reconnect would skip the protocol's session handshake.
+            raise ReconnectExhausted(
+                "connection closed (reconnect via ensure_connected)")
+        try:
+            self.sock.sendall(data)
+        except (ConnectionError, OSError) as e:
+            # A partial sendall may still have delivered the request:
+            # indeterminate, same as a lost reply.
+            self.mark_dead()
+            raise WireIndeterminate(
+                f"connection lost sending request: {e!r}") from e
 
     def close(self) -> None:
-        self.sock.close()
+        if self.sock is not None:
+            self.sock.close()
 
 
 class GatedClient(client_ns.Client):

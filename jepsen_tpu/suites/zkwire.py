@@ -20,7 +20,7 @@ import socket
 import struct
 
 from jepsen_tpu import client as client_ns
-from jepsen_tpu.suites.common import SocketIO
+from jepsen_tpu.suites.common import SocketIO, WireIndeterminate
 
 # Op codes (zookeeper.h)
 OP_CREATE = 1
@@ -62,8 +62,13 @@ def _s(b: bytes) -> bytes:
 class ZkClient:
     def __init__(self, host: str, port: int = 2181,
                  timeout: float = 10.0, session_timeout_ms: int = 10000):
-        self.io = SocketIO(
-            socket.create_connection((host, port), timeout=timeout))
+        # Reconnect factory: a connection lost mid-op marks the socket
+        # dead (that op completes :info — see ZkRegisterClient.invoke);
+        # the NEXT op re-dials with SocketIO's bounded backoff and
+        # re-runs the session handshake below (_ensure_session).
+        self._session_timeout_ms = session_timeout_ms
+        self.io = SocketIO(connect=lambda: socket.create_connection(
+            (host, port), timeout=timeout))
         self.xid = 0
         self._connect(session_timeout_ms)
 
@@ -88,7 +93,15 @@ class ZkClient:
             raise ZkError(-112, "connect")  # session expired/refused
         self.session_id = session
 
+    def _ensure_session(self) -> None:
+        """Reconnect + fresh session handshake when the previous
+        connection died (a ZK session does not survive the socket)."""
+        if self.io.ensure_connected():
+            self.xid = 0
+            self._connect(self._session_timeout_ms)
+
     def _call(self, op: int, body: bytes, name: str) -> bytes:
+        self._ensure_session()
         self.xid += 1
         self._send_frame(struct.pack(">ii", self.xid, op) + body)
         while True:
@@ -202,7 +215,17 @@ class ZkRegisterClient(client_ns.Client):
         except ZkError as e:
             return op.replace(type="fail" if op.f == "read" else "info",
                               error=str(e))
+        except WireIndeterminate as e:
+            # The connection died AFTER the request may have reached
+            # the server (including a reconnect budget exhausted
+            # mid-op): the outcome is indeterminate and must complete
+            # :info, never :fail — a :fail that actually applied makes
+            # the checker unsound.
+            return op.replace(type="info", error=repr(e))
         except (OSError, ConnectionError) as e:
+            # Pre-send failures (dial/reconnect exhausted before the
+            # request went out): the op never reached the server, so
+            # :fail is sound for reads; mutators stay conservative.
             return op.replace(type="fail" if op.f == "read" else "info",
                               error=repr(e))
         return op.replace(type="fail", error=f"unknown f {op.f}")

@@ -225,6 +225,24 @@ def progress() -> int:
     return _progress
 
 
+def env_int(name: str, default: int) -> int:
+    """Integer env knob with a default (empty/unset -> default). The
+    engines re-read knobs per check so monkeypatch.setenv and
+    ``env VAR=...`` always take effect — doc/env.md tables them all."""
+    import os
+
+    v = os.environ.get(name, "")
+    return int(v) if v else default
+
+
+def env_float(name: str, default: float) -> float:
+    """Float twin of :func:`env_int`."""
+    import os
+
+    v = os.environ.get(name, "")
+    return float(v) if v else default
+
+
 def stat_bump(stats: dict, key: str, n: int = 1) -> None:
     """Accumulate an integer observability counter in a stats dict
     (host-row executor episode/dispatch/pass/waste counters — see

@@ -25,3 +25,55 @@ jax.config.update("jax_platforms", "cpu")
 from jepsen_tpu.util import enable_compile_cache  # noqa: E402
 
 enable_compile_cache()
+
+# --- quick-tier no-compile enforcement --------------------------------------
+# The quick tier's promise (pyproject marker, CLAUDE.md) is "no XLA
+# compiles": ~1 min wall even on one core. That promise was
+# unenforced; here every true backend compile (a persistent-cache MISS
+# reaching XLA — cache hits load in milliseconds and keep the promise)
+# is counted, and a `quick`-marked test that triggers one FAILS unless
+# it carries the registered `compiles` marker (the handful of quick
+# engine tests that intentionally compile tiny .jax_cache-resident
+# programs). JEPSEN_TPU_QUICK_NO_COMPILE=0 disables;
+# JEPSEN_TPU_QUICK_COMPILE_REPORT=1 reports instead of failing (used
+# to find offenders).
+
+import pytest  # noqa: E402
+
+_xla_compiles = {"n": 0}
+try:
+    import jax._src.compiler as _jax_compiler
+
+    _real_backend_compile = _jax_compiler.backend_compile
+
+    def _counting_backend_compile(*a, **kw):
+        _xla_compiles["n"] += 1
+        return _real_backend_compile(*a, **kw)
+
+    _jax_compiler.backend_compile = _counting_backend_compile
+except (ImportError, AttributeError):  # pragma: no cover - jax skew
+    _jax_compiler = None
+
+
+@pytest.fixture(autouse=True)
+def _quick_no_compile(request):
+    before = _xla_compiles["n"]
+    yield
+    compiled = _xla_compiles["n"] - before
+    if not compiled:
+        return
+    if request.node.get_closest_marker("quick") is None:
+        return
+    if request.node.get_closest_marker("compiles") is not None:
+        return
+    if os.environ.get("JEPSEN_TPU_QUICK_NO_COMPILE", "1") == "0":
+        return
+    msg = (f"quick-tier test triggered {compiled} XLA compile(s): the "
+           "-m quick tier promises no compiles (CLAUDE.md). Either "
+           "shrink the test below compile thresholds, drop the quick "
+           "marker, or — for a test that deliberately compiles tiny "
+           "cached programs — add @pytest.mark.compiles.")
+    if os.environ.get("JEPSEN_TPU_QUICK_COMPILE_REPORT") == "1":
+        print(f"\n[quick-compile] {request.node.nodeid}: {msg}")
+        return
+    pytest.fail(msg)

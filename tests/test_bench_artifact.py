@@ -84,6 +84,68 @@ def test_watchdog_kills_stalled_child(bench, tmp_path):
     assert dt < 30, f"stall detection took {dt:.1f}s, not ~2s"
 
 
+def test_stall_kill_escalates_and_records(bench, tmp_path,
+                                          monkeypatch):
+    # A child that ignores SIGTERM (wedged inside the TPU runtime)
+    # must be SIGKILLed after the grace window, with the escalation
+    # AND the last heartbeat progress recorded in the probe JSON —
+    # the old bare kill() could race a wedged teardown and leave the
+    # child alive, the event invisible.
+    monkeypatch.setattr(bench, "KILL_GRACE_S", 1)
+    argv = _child(tmp_path, "unkillable.py", """
+        import signal, time
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        print("HB 41", flush=True)
+        while True:
+            time.sleep(0.2)
+            print("HB 41", flush=True)   # alive but NOT progressing
+    """)
+    r, why = bench._run_probe_subprocess("x", timeout=60, argv=argv,
+                                         stall_s=2)
+    assert why == "stall"
+    k = r["kill"]
+    assert k["why"] == "stall"
+    assert k["sigkill"] is True, "SIGTERM-immune child needs SIGKILL"
+    assert k["last_hb"] == 41
+    assert "unkillable" not in k
+
+
+def test_stall_kill_records_sigterm_sufficient(bench, tmp_path):
+    # A stalled child that honors SIGTERM: the record shows no SIGKILL
+    # was needed, and the last progress value is preserved.
+    argv = _child(tmp_path, "stall2.py", """
+        import time
+        print("HB 7", flush=True)
+        while True:
+            time.sleep(0.3)
+            print("HB 7", flush=True)
+    """)
+    r, why = bench._run_probe_subprocess("x", timeout=60, argv=argv,
+                                         stall_s=2)
+    assert why == "stall"
+    assert r["kill"]["sigkill"] is False
+    assert r["kill"]["last_hb"] == 7
+
+
+def test_completed_result_recovered_from_wedged_teardown(bench,
+                                                        tmp_path):
+    # A child that PRINTS its result and then wedges in teardown:
+    # the answer wins over the kill, and the teardown kill is
+    # recorded on it instead of an error replacing it.
+    argv = _child(tmp_path, "teardown.py", """
+        import json, time
+        print("HB 1", flush=True)
+        print(json.dumps({"verdict": True, "seconds": 0.5}), flush=True)
+        while True:
+            time.sleep(0.3)   # wedged teardown, HB thread gone
+    """)
+    r, why = bench._run_probe_subprocess("x", timeout=60, argv=argv,
+                                         stall_s=2)
+    assert why is None
+    assert r["verdict"] is True
+    assert r["teardown_kill"]["why"] == "stall"
+
+
 def test_watchdog_spares_progressing_child(bench, tmp_path):
     # Advancing heartbeat values reset the stall clock: a slow but
     # progressing probe survives a stall_s shorter than its runtime.

@@ -1,0 +1,143 @@
+"""Frontier checkpoint/resume parity (the round-8 tentpole acceptance
+criterion): a search killed at an episode boundary and resumed from its
+checkpoint must produce a verdict, death row, AND final-paths identical
+to the uninterrupted run — fuzzed against the lin/cpu.py oracle on the
+window-34 pair-band witness shape (the scaled-down literal config-5
+class; the 5k/window-25 shapes do not exercise the host-row machinery
+at all, CLAUDE.md round-5 lore).
+
+Soundness rests on the checkpoint carrying an EXACT committed frontier
+at a row boundary: the continuation re-runs the identical deterministic
+dispatch sequence, so nothing about the search tree changes — these
+tests are the executable form of that argument."""
+
+import os
+import threading
+
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu.lin import bfs, cpu, prepare, supervise, synth
+
+# Same compiled shapes as tests/test_lin_crashdom_witness.py (shared
+# .jax_cache programs); `compiles` exempts the cold-cache compile from
+# the quick tier's no-compile enforcement.
+pytestmark = [pytest.mark.quick, pytest.mark.compiles]
+
+
+@pytest.fixture(scope="module")
+def witness_packed():
+    h = synth.generate_partitioned_register_history(
+        140, concurrency=40, seed=0, partition_every=60,
+        partition_len=20, max_crashes=10)
+    return prepare.prepare(m.cas_register(),
+                           synth.corrupt_history(h, seed=3))
+
+
+KW = dict(cap_schedule=(8,), host_caps=(64, 4096), explain=True)
+
+
+def _paths_key(result):
+    return sorted(repr(sorted(od["index"] for od in fp["path"]))
+                  for fp in result["final-paths"])
+
+
+def test_resume_parity_on_witness_shape(witness_packed, tmp_path):
+    p = witness_packed
+    # The shape must land in the pair-key crash-dom band, or the
+    # host-row machinery (whose episode boundaries are what we
+    # checkpoint) is not what decides here.
+    assert p.window + max(len(p.unintern), 2).bit_length() > 31
+    assert len(p.crashed_ops) > 0
+
+    full = bfs.check_packed(p, **KW)
+    assert full["valid?"] is False and full["final-paths"]
+
+    # Kill the search right after the first HOST episode-boundary
+    # checkpoint write (the on_save hook is the simulated kill; a real
+    # kill -9 leaves exactly this file state, since writes are atomic).
+    ck = str(tmp_path / "witness.ckpt.npz")
+    ckpt = supervise.Checkpointer(ck, supervise.history_fingerprint(p),
+                                  every_s=0)
+    cancel = threading.Event()
+    saves = []
+
+    def on_save(kind, row):
+        saves.append((kind, row))
+        if kind == "host":
+            cancel.set()
+
+    ckpt.on_save = on_save
+    killed = bfs.check_packed(p, cancel=cancel, checkpoint=ckpt, **KW)
+    assert killed["valid?"] == "unknown"
+    assert os.path.exists(ck), "interrupted run must keep its checkpoint"
+    assert any(kind == "host" for kind, _ in saves)
+
+    resumed = bfs.check_packed(p, checkpoint=ck, **KW)
+    assert resumed["valid?"] is False
+    assert resumed["resumed-from-row"] == saves[-1][1]
+    # Verdict + death row + final-paths equal the uninterrupted run.
+    assert resumed["op"] == full["op"]
+    assert resumed["dead-row"] == full["dead-row"]
+    assert _paths_key(resumed) == _paths_key(full)
+    # ... and both agree with the CPU oracle (the executable spec).
+    want = cpu.check_packed(p)
+    assert want["valid?"] is False and resumed["op"] == want["op"]
+    # A definite verdict deletes the checkpoint: a later fresh run
+    # must not resume a finished search.
+    assert not os.path.exists(ck)
+
+
+def test_chunk_kind_resume_on_valid_history(tmp_path):
+    # The chunk-loop checkpoint kind, on a history that DECIDES VALID:
+    # resume mid-history and the verdict + frontier size must match.
+    h = synth.generate_register_history(400, concurrency=5, seed=11,
+                                        value_range=5)
+    p = prepare.prepare(m.cas_register(), h)
+    full = bfs.check_packed(p, chunk=64)
+    assert full["valid?"] is True
+
+    ck = str(tmp_path / "chunk.ckpt.npz")
+    ckpt = supervise.Checkpointer(ck, supervise.history_fingerprint(p),
+                                  every_s=0)
+    cancel = threading.Event()
+    saves = []
+
+    def on_save(kind, row):
+        saves.append((kind, row))
+        if len(saves) == 2:
+            cancel.set()
+
+    ckpt.on_save = on_save
+    killed = bfs.check_packed(p, chunk=64, cancel=cancel,
+                              checkpoint=ckpt)
+    assert killed["valid?"] == "unknown" and os.path.exists(ck)
+    assert saves and all(kind == "chunk" for kind, _ in saves)
+
+    resumed = bfs.check_packed(p, chunk=64, checkpoint=ck)
+    assert resumed["valid?"] is True
+    assert resumed["resumed-from-row"] == saves[-1][1] > 0
+    assert resumed["final-frontier-size"] == full["final-frontier-size"]
+    assert not os.path.exists(ck)
+
+
+def test_mismatched_history_rejects_checkpoint(tmp_path):
+    # A checkpoint from one history must NEVER seed another: the
+    # fingerprint gate degrades the resume to a fresh (correct) run.
+    h1 = synth.generate_register_history(200, concurrency=5, seed=1,
+                                         value_range=5)
+    h2 = synth.generate_register_history(200, concurrency=5, seed=2,
+                                         value_range=5)
+    p1 = prepare.prepare(m.cas_register(), h1)
+    p2 = prepare.prepare(m.cas_register(), h2)
+    ck = str(tmp_path / "mismatch.ckpt.npz")
+    ckpt = supervise.Checkpointer(ck, supervise.history_fingerprint(p1),
+                                  every_s=0)
+    cancel = threading.Event()
+    ckpt.on_save = lambda kind, row: cancel.set()
+    bfs.check_packed(p1, chunk=64, cancel=cancel, checkpoint=ckpt)
+    assert os.path.exists(ck)
+
+    r = bfs.check_packed(p2, chunk=64, checkpoint=ck)
+    assert r["valid?"] is True
+    assert "resumed-from-row" not in r

@@ -22,7 +22,10 @@ import pytest
 from jepsen_tpu import models as m
 from jepsen_tpu.lin import bfs, cpu, prepare, synth
 
-pytestmark = pytest.mark.quick
+# quick (seconds-scale, .jax_cache-resident programs) but it DOES
+# compile tiny XLA programs on a cold cache — exempt from the
+# conftest no-compile enforcement via the registered `compiles` marker.
+pytestmark = [pytest.mark.quick, pytest.mark.compiles]
 
 
 def _pair_band_history():

@@ -21,8 +21,11 @@ from jepsen_tpu.lin import bfs, prepare, synth
 # Only the second-scale small-band tests ride the quick tier
 # (CLAUDE.md bills it as the ~1 min no-compile tier); the pair-band
 # witness parity test compiles the K-row program at the big caps and
-# runs in the default (not-slow) tier instead.
+# runs in the default (not-slow) tier instead. The small-band tests
+# still compile tiny cached programs on a cold cache, hence the
+# `compiles` exemption from the conftest no-compile enforcement.
 quick = pytest.mark.quick
+pytestmark = pytest.mark.compiles
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,403 @@
+"""Supervised checker runtime (lin/supervise.py): the dispatch
+watchdog, the fault-shape quarantine ledger, the frontier checkpoint
+codec, and their integration into the host-row executor's fallback
+ladder.
+
+The unit tests are pure host Python (quick, no XLA); the end-to-end
+ladder tests drive the real engine on the small crash-dom band and are
+marked ``compiles`` (tiny .jax_cache-resident programs, the
+test_lin_hostrow_wave precedent)."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.lin import supervise
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(autouse=True)
+def _clean_injections():
+    supervise._injected.clear()
+    yield
+    supervise._injected.clear()
+
+
+@pytest.fixture()
+def ledger(tmp_path, monkeypatch):
+    path = str(tmp_path / "quarantine.json")
+    monkeypatch.setenv("JEPSEN_TPU_QUARANTINE", path)
+    return path
+
+
+# --- dispatch watchdog ------------------------------------------------------
+
+
+def test_call_passes_through_value_and_exceptions():
+    assert supervise.call("t", lambda: 42, deadline_s=5) == 42
+    with pytest.raises(ValueError):
+        supervise.call("t", lambda: (_ for _ in ()).throw(ValueError()),
+                       deadline_s=5)
+
+
+def test_wedge_detected_within_deadline_and_retried():
+    # One injected wedge: detection takes ~the configured deadline,
+    # the retry runs the REAL thunk, the trip is recorded in stats.
+    supervise.inject_wedge("t", 1, deadline_s=0.2)
+    stats: dict = {}
+    t0 = time.monotonic()
+    out = supervise.call("t", lambda: "real", deadline_s=9, stats=stats)
+    dt = time.monotonic() - t0
+    assert out == "real"
+    assert 0.15 <= dt < 2.0, f"detection took {dt:.2f}s, not ~0.2s"
+    assert stats["watchdog_trips"] == 1
+    assert stats["supervise_events"] == [{"site": "t", "kind": "wedge"}]
+
+
+def test_wedge_budget_exhaustion_raises():
+    supervise.inject_wedge("t", 5, deadline_s=0.05)
+    stats: dict = {}
+    with pytest.raises(supervise.WedgedDispatch):
+        supervise.call("t", lambda: "never", deadline_s=9, retries=1,
+                       stats=stats)
+    assert stats["watchdog_trips"] == 2      # initial attempt + 1 retry
+
+
+def test_env_wedge_hook_parses_site_count_deadline(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_WEDGE", "a:2:0.05,b")
+    supervise._env_wedge_loaded = None
+    assert supervise._consume_injection("a") == 0.05
+    assert supervise._consume_injection("a") == 0.05
+    assert supervise._consume_injection("a") is None
+    assert supervise._consume_injection("b") == -1.0
+    assert supervise._consume_injection("b") is None
+
+
+def test_disabled_supervision_runs_inline(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_SUPERVISE", "0")
+    supervise.inject_wedge("t", 1)
+    # Injection is never consumed when disabled: plain passthrough.
+    assert supervise.call("t", lambda: threading.current_thread(),
+                          deadline_s=0.01) is threading.main_thread()
+
+
+# --- quarantine ledger ------------------------------------------------------
+
+
+def test_ledger_record_load_clear_delta(ledger):
+    key = supervise.shape_key("host-wave", rows=4, cap=4096, window=34,
+                              kernel="cas-register")
+    assert supervise.quarantined(key) is None
+    before = dict(supervise.load_ledger())
+    e = supervise.record_fault(key, "fault", detail="boom")
+    assert e["count"] == 1 and e["reason"] == "fault"
+    # Re-record bumps the count, keeps first-seen.
+    e2 = supervise.record_fault(key, "wedge")
+    assert e2["count"] == 2 and e2["reason"] == "wedge"
+    assert e2["first"] == e["first"]
+    got = supervise.quarantined(key)
+    assert got is not None and got["count"] == 2
+    # Delta vs the pre-fault snapshot names the shape.
+    delta = supervise.ledger_delta(before)
+    assert set(delta) == {key}
+    # Clear by key, then fully.
+    other = supervise.shape_key("spike", rows=32, cap=262144, window=49,
+                                kernel="cas-register")
+    supervise.record_fault(other, "fault")
+    assert supervise.clear_ledger(keys=[key]) == 1
+    assert supervise.quarantined(key) is None
+    assert supervise.quarantined(other) is not None
+    assert supervise.clear_ledger() == 1
+    assert supervise.load_ledger() == {}
+
+
+def test_single_wedge_tolerated_fault_quarantines(ledger):
+    # The quarantine gate: one wedge is environmental-stall tolerance,
+    # an in-window STREAK of WEDGE_QUARANTINE_COUNT wedges is
+    # evidence, a FAULT quarantines immediately.
+    wk = supervise.shape_key("host-wave", rows=4, cap=4096, window=34,
+                             kernel="cas-register")
+    supervise.record_fault(wk, "wedge")
+    assert supervise.quarantined(wk) is None
+    supervise.record_fault(wk, "wedge")
+    assert supervise.quarantined(wk) is not None
+    fk = supervise.shape_key("host-pass", cap=4096, window=34,
+                             kernel="cas-register")
+    supervise.record_fault(fk, "fault")
+    assert supervise.quarantined(fk) is not None
+
+
+def test_wedge_streak_resets_outside_window(ledger):
+    # Two isolated environmental stalls far apart must NOT quarantine:
+    # the streak resets when the previous wedge is outside the window.
+    import time
+
+    key = "host-wave|rows4|cap4096|w34|cas-register"
+    supervise.record_fault(key, "wedge")
+    shapes = dict(supervise.load_ledger())
+    old = time.strftime(supervise._TS_FMT, time.gmtime(
+        time.time() - 2 * supervise.WEDGE_STREAK_WINDOW_S))
+    shapes[key] = dict(shapes[key], last=old)
+    supervise._write_ledger(supervise.ledger_path(), shapes)
+    e = supervise.record_fault(key, "wedge")   # a week/hours later
+    assert e["streak"] == 1 and e["count"] == 2
+    assert supervise.quarantined(key) is None
+    e = supervise.record_fault(key, "wedge")   # back-to-back: evidence
+    assert e["streak"] == 2
+    assert supervise.quarantined(key) is not None
+
+
+def test_ledger_corruption_never_blocks(ledger):
+    with open(ledger, "w") as fh:
+        fh.write("{not json")
+    assert supervise.load_ledger() == {}
+    # Recording over a corrupt ledger repairs it.
+    supervise.record_fault("k", "fault")
+    assert supervise.quarantined("k") is not None
+
+
+def test_ledger_disabled(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_QUARANTINE", "0")
+    assert supervise.ledger_path() is None
+    assert supervise.record_fault("k", "fault") is None
+    assert supervise.quarantined("k") is None
+
+
+# --- checkpoint codec -------------------------------------------------------
+
+
+def test_checkpoint_roundtrip_and_fingerprint_gate(tmp_path):
+    path = str(tmp_path / "c.npz")
+    ck = supervise.Checkpointer(path, "fp1", every_s=0)
+    seen = []
+    ck.on_save = lambda kind, row: seen.append((kind, row))
+    lo = np.arange(7, dtype=np.uint32)
+    ck.save("host", 42, 7, {"lo": lo},
+            {"key_hi": False, "b": 3, "nil_id": 2, "nw": 1,
+             "sticky_lvl": 1})
+    assert seen == [("host", 42)]
+    rd = supervise.load_checkpoint(path, "fp1")
+    assert rd is not None
+    assert rd["kind"] == "host" and rd["row"] == 42 and rd["count"] == 7
+    assert rd["meta"]["sticky_lvl"] == 1
+    np.testing.assert_array_equal(rd["lo"], lo)
+    # A different history fingerprint must reject the checkpoint (a
+    # resume onto the wrong search input would be unsound).
+    assert supervise.load_checkpoint(path, "fp2") is None
+    ck.clear()
+    assert not os.path.exists(path)
+    assert supervise.load_checkpoint(path, "fp1") is None
+
+
+def test_checkpoint_corruption_degrades_to_fresh(tmp_path):
+    path = str(tmp_path / "c.npz")
+    with open(path, "wb") as fh:
+        fh.write(b"not an npz")
+    assert supervise.load_checkpoint(path, "fp") is None
+
+
+def test_checkpoint_interval_gating(tmp_path):
+    ck = supervise.Checkpointer(str(tmp_path / "c.npz"), "fp",
+                                every_s=3600)
+    assert ck.due()
+    ck.save("chunk", 1, 1, {"bits": np.zeros((1, 1), np.uint32),
+                            "state": np.zeros((1, 1), np.int32)}, {})
+    assert not ck.due()
+
+
+# --- numpy packed-key codec -------------------------------------------------
+
+
+@pytest.mark.parametrize("key_hi,b,nw", [(False, 3, 1), (True, 5, 2)])
+def test_np_key_codec_roundtrip(key_hi, b, nw):
+    nil_state = -1
+    nil_id = (1 << b) - 1
+    rng = np.random.default_rng(7)
+    n = 17
+    # The packed form is (bits << b | sid) in 64 (pair) / 32 bits:
+    # bitset width is bounded by 64 - b (engine bound: window <= 60).
+    width = (64 - b if key_hi else 31 - b)
+    bits = np.zeros((n, nw), np.uint32)
+    for w in range(nw):
+        hi_bits = max(0, min(32, width - 32 * w))
+        if hi_bits:
+            bits[:, w] = rng.integers(0, 1 << hi_bits, n, np.uint32,
+                                      endpoint=False)
+    state = rng.integers(0, nil_id, (n, 1)).astype(np.int32)
+    state[::5] = nil_state
+    lo, hi = supervise.np_pack_keys(bits, state, b, nil_id, key_hi,
+                                    nil_state, cap=n + 3)
+    assert (lo[n:] == supervise.KEY_FILL).all()
+    b2, s2 = supervise.np_unpack_keys(lo, hi, n, b, nil_id, nw, key_hi,
+                                      nil_state)
+    np.testing.assert_array_equal(b2, bits)
+    np.testing.assert_array_equal(s2, state)
+
+
+# --- cli subcommand ---------------------------------------------------------
+
+
+def test_cli_quarantine_list_clear_diff(ledger, tmp_path, capsys):
+    from jepsen_tpu import cli
+
+    key = supervise.shape_key("host-fixpoint", cap=65536, window=49,
+                              kernel="cas-register")
+    supervise.record_fault(key, "wedge")
+    assert cli.run([cli.quarantine_cmd()], ["quarantine", "list"]) == 0
+    out = capsys.readouterr().out
+    assert key in out and "reason=wedge" in out
+
+    # diff against a pre-fault snapshot names the new shape; against
+    # the current ledger it is empty.
+    empty = tmp_path / "before.json"
+    empty.write_text(json.dumps({"shapes": {}}))
+    assert cli.run([cli.quarantine_cmd()],
+                   ["quarantine", "diff", "--before", str(empty)]) == 0
+    assert key in capsys.readouterr().out
+    now = tmp_path / "now.json"
+    now.write_text(open(ledger).read())
+    assert cli.run([cli.quarantine_cmd()],
+                   ["quarantine", "diff", "--before", str(now)]) == 0
+    assert "none" in capsys.readouterr().out
+
+    assert cli.run([cli.quarantine_cmd()], ["quarantine", "clear"]) == 0
+    assert "cleared 1" in capsys.readouterr().out
+    assert supervise.load_ledger() == {}
+
+
+# --- end-to-end: the fallback ladder on the real engine ---------------------
+
+
+@pytest.fixture(scope="module")
+def small_band_packed():
+    from jepsen_tpu import models as m
+    from jepsen_tpu.lin import prepare, synth
+
+    h = synth.generate_register_history(60, concurrency=6, seed=1,
+                                        crash_prob=0.25)
+    return prepare.prepare(m.cas_register(), h)
+
+
+def _check(p, **kw):
+    from jepsen_tpu.lin import bfs
+
+    return bfs.check_packed(p, cap_schedule=(1,), host_caps=(8, 64, 512),
+                            **kw)
+
+
+@pytest.mark.compiles
+def test_wedged_dispatch_detected_retried_and_visible(ledger,
+                                                      small_band_packed):
+    # Acceptance: a simulated wedged dispatch (test hook) is detected
+    # within the configured deadline, retried per the ladder, and the
+    # event appears in host-stats — no manual kill required.
+    supervise.inject_wedge("host-fixpoint", 1, deadline_s=0.3)
+    t0 = time.monotonic()
+    r = _check(small_band_packed)
+    assert r["valid?"] is True
+    s = r["host-stats"]
+    assert s["watchdog_trips"] == 1
+    assert s["supervise_events"][0] == {"site": "host-fixpoint",
+                                        "kind": "wedge"}
+    # Detection cost ~one 0.3s deadline, nowhere near a stall window.
+    assert time.monotonic() - t0 < 60
+
+
+@pytest.mark.compiles
+def test_exhausted_wedges_quarantine_and_fall_back(ledger,
+                                                   small_band_packed):
+    # Every fused attempt wedges: the ladder falls to the unfused rung
+    # (same verdict), and the fused shape lands in the ledger.
+    supervise.inject_wedge("host-fixpoint", 500, deadline_s=0.1)
+    r = _check(small_band_packed)
+    supervise._injected.clear()
+    assert r["valid?"] is True
+    assert r["host-stats"]["watchdog_trips"] >= 2
+    led = supervise.load_ledger()
+    assert any(k.startswith("host-fixpoint|") for k in led)
+    assert all(e["reason"] == "wedge" for e in led.values())
+
+    # Wedge-quarantine policy: a SINGLE wedge of a shape is tolerated
+    # (tunnel stalls are often environmental); an in-window STREAK is
+    # evidence (WEDGE_QUARANTINE_COUNT).
+    for k, e in led.items():
+        assert (supervise.quarantined(k) is None) == \
+            (e.get("streak", 0) < supervise.WEDGE_QUARANTINE_COUNT)
+
+    # Push every shape over the threshold: the next fresh check
+    # (fresh-process equivalent: the ledger is re-read from disk)
+    # routes straight to the fallback rung without re-wedging.
+    for k in list(led):
+        supervise.record_fault(k, "wedge")
+    r3 = _check(small_band_packed)
+    s3 = r3["host-stats"]
+    assert r3["valid?"] is True
+    assert s3["quarantine_skips"] >= 1
+    assert s3["watchdog_trips"] == 0
+
+
+@pytest.mark.compiles
+def test_cpu_oracle_rung_when_everything_is_quarantined(
+        ledger, small_band_packed):
+    # Quarantine BOTH device rungs at every host cap: rows must decide
+    # on the CPU-oracle rung with the same verdict.
+    p = small_band_packed
+    W = p.window
+    for cap in (8, 64, 512):
+        for site in ("host-fixpoint", "host-pass"):
+            supervise.record_fault(
+                supervise.shape_key(site, cap=cap, window=W,
+                                    kernel="cas-register"), "fault")
+    r = _check(p)
+    assert r["valid?"] is True
+    s = r["host-stats"]
+    assert s["cpu_rows"] >= 1
+    assert s["quarantine_skips"] >= 1
+
+
+@pytest.mark.compiles
+def test_dispatch_fault_reports_honest_unknown_and_records(
+        ledger, small_band_packed, monkeypatch):
+    # A dispatch FAULT (dead worker / XLA runtime error) at the base
+    # chunk rung must never escape as a raw exception: honest
+    # `overflow: fault` unknown, the shape in the ledger, the event in
+    # host-stats.
+    from jepsen_tpu.lin import bfs
+
+    def boom(*a, **kw):
+        raise RuntimeError("XLA worker died (injected)")
+
+    monkeypatch.setattr(bfs, "_search_chunk", boom)
+    r = bfs.check_packed(small_band_packed, cap_schedule=(1,),
+                         host_caps=(8, 64, 512))
+    assert r["valid?"] == "unknown"
+    assert r["overflow"] == "fault"
+    assert r["host-stats"]["faults"] >= 1
+    assert any(k.startswith("chunk") for k in supervise.load_ledger())
+
+
+@pytest.mark.compiles
+def test_wave_quarantine_routes_to_per_row(ledger, small_band_packed,
+                                           monkeypatch):
+    # A quarantined K-row wave shape must skip the wave program
+    # entirely (multi_dispatches == 0) and still decide per-row.
+    monkeypatch.setenv("JEPSEN_TPU_HOST_STICKY", "1")
+    monkeypatch.setenv("JEPSEN_TPU_HOST_ROWS_K", "4")
+    p = small_band_packed
+    for cap in (8, 64, 512):
+        for kn in (2, 3, 4):
+            supervise.record_fault(
+                supervise.shape_key("host-wave", rows=kn, cap=cap,
+                                    window=p.window,
+                                    kernel="cas-register"), "fault")
+    r = _check(p)
+    assert r["valid?"] is True
+    s = r["host-stats"]
+    assert s["multi_dispatches"] == 0
+    assert s["quarantine_skips"] >= 1
+    assert s["rows"] > 0

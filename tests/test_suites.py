@@ -54,6 +54,10 @@ def test_unknown_suite():
 
 # --- tier 2: fake runs come back valid --------------------------------------
 
+# `compiles`: the end-to-end fake runs hand real histories to the
+# checker stack, which compiles a few tiny cached XLA programs on a
+# cold cache — exempt from the conftest quick no-compile enforcement.
+@pytest.mark.compiles
 @pytest.mark.parametrize("name,opts", [
     ("etcd", {}),
     ("consul", {}),

@@ -24,6 +24,10 @@ class FakeZkServer:
 
     def __init__(self):
         self.nodes: dict[str, tuple[bytes, int]] = {}
+        # Fault hook: when > 0, the next setData APPLIES server-side
+        # and then drops the connection without replying — the
+        # indeterminate-outcome case wire clients must complete :info.
+        self.drop_after_apply = 0
         self.srv = socket.socket()
         self.srv.bind(("127.0.0.1", 0))
         self.srv.listen(4)
@@ -120,6 +124,9 @@ class FakeZkServer:
                         reply(ZBADVERSION)
                         continue
                     self.nodes[path] = (data, version + 1)
+                    if self.drop_after_apply > 0:
+                        self.drop_after_apply -= 1
+                        return       # applied, but the reply is lost
                     reply(0, self._stat(version + 1))
                 elif op == -11:                  # close
                     return
@@ -172,6 +179,74 @@ class TestZkWire:
         assert r.is_fail
         assert cl.invoke(None, Op("invoke", "read", None, 0)).value == 4
         cl.close(None)
+        zk.close()
+
+    def test_mid_request_drop_completes_info_and_reconnects(self):
+        # The server APPLIES a write, then drops the connection before
+        # replying. The completion must be :info (indeterminate) —
+        # never :fail — and the next op must come back through the
+        # bounded-reconnect ladder with a fresh session.
+        from jepsen_tpu import models as m
+        from jepsen_tpu.history import (History, Op, fail_op, info_op,
+                                        invoke_op, ok_op)
+        from jepsen_tpu.lin import analysis
+
+        zk = FakeZkServer()
+        cl = ZkRegisterClient(ZkClient("127.0.0.1", zk.port))
+        cl.conn.create("/jepsen-register", b"")
+        zk.drop_after_apply = 1
+        r = cl.invoke(None, Op("invoke", "write", 7, 0))
+        assert r.type == "info", \
+            f"indeterminate write completed {r.type!r}"
+        # Reconnect + fresh session handshake on the NEXT op; the
+        # applied-but-unacknowledged write is visible.
+        r2 = cl.invoke(None, Op("invoke", "read", None, 1))
+        assert r2.is_ok and r2.value == 7
+        assert cl.conn.io.reconnects >= 2    # initial dial + reconnect
+
+        # Checker soundness of the completion: with :info the observed
+        # history is linearizable; completing the SAME op :fail would
+        # (correctly) be flagged invalid — the exact unsoundness the
+        # :info contract exists to prevent.
+        sound = History.of(
+            invoke_op(0, "write", 7), invoke_op(1, "read", None),
+            ok_op(1, "read", 7), info_op(0, "write", 7))
+        assert analysis(m.cas_register(), sound,
+                        algorithm="cpu")["valid?"] is True
+        unsound = History.of(
+            invoke_op(0, "write", 7), invoke_op(1, "read", None),
+            ok_op(1, "read", 7), fail_op(0, "write", 7))
+        assert analysis(m.cas_register(), unsound,
+                        algorithm="cpu")["valid?"] is False
+        cl.close(None)
+        zk.close()
+
+    def test_reconnect_budget_exhausts_as_info_for_mutators(
+            self, monkeypatch):
+        # Server gone for good: the bounded backoff ladder runs out.
+        # A mutator completes :info (conservative), a read :fail —
+        # and the budget bounds the wall cost (no infinite retry).
+        from jepsen_tpu.history import Op
+
+        monkeypatch.setenv("JEPSEN_TPU_WIRE_RETRIES", "2")
+        monkeypatch.setenv("JEPSEN_TPU_WIRE_BACKOFF_S", "0.01")
+        zk = FakeZkServer()
+        cl = ZkRegisterClient(ZkClient("127.0.0.1", zk.port))
+        cl.conn.create("/jepsen-register", b"")
+        zk.drop_after_apply = 1
+        assert cl.invoke(None, Op("invoke", "write", 1, 0)).type == "info"
+        # Point the reconnect factory at a port nothing listens on
+        # (closing the fake's listener is not enough: CPython keeps
+        # the fd alive while the accept thread blocks on it).
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead_port = probe.getsockname()[1]
+        probe.close()
+        cl.conn.io._connect = lambda: socket.create_connection(
+            ("127.0.0.1", dead_port), timeout=0.5)
+        r = cl.invoke(None, Op("invoke", "write", 2, 0))
+        assert r.type == "info"
+        assert cl.invoke(None, Op("invoke", "read", None, 0)).is_fail
         zk.close()
 
 
