@@ -1,6 +1,7 @@
 # jepsen_tpu development targets.
 
-.PHONY: test test-quick integration integration-local bench probe-config5
+.PHONY: test test-quick integration integration-local bench \
+	probe-config5 serve-smoke
 
 # Unit + parity suite on the virtual 8-device CPU mesh (no cluster).
 # Hardware note: ~8 min on a 4-core box; the compile-heavy lin parity
@@ -51,6 +52,18 @@ bench:
 # After the run the quarantine-ledger DELTA is printed (cli.py
 # quarantine diff), so an engine change that newly faults a shape is
 # visible in this one command; the probe's exit code is preserved.
+# Checker-daemon smoke (doc/service.md): start an in-process daemon on
+# the forced-CPU mesh, submit 3 histories over a real socket, assert
+# verdicts vs the CPU oracle, clean shutdown. Part of the quick-tier
+# habit next to probe-config5: run it after touching the service, the
+# wire layer, or lin/batched. Timeout-guarded (cold .jax_cache compiles
+# a few small programs; warm runs take seconds) and chip-free, so it
+# composes with anything.
+SERVE_SMOKE_TIMEOUT ?= 600
+serve-smoke:
+	timeout -k 15 $(SERVE_SMOKE_TIMEOUT) \
+		python -m jepsen_tpu.service.smoke
+
 PROBE_CONFIG5_TIMEOUT ?= 5400
 # Frontier checkpoint: a probe killed by the timeout (or a fault)
 # leaves .jax_cache/probe_config5.ckpt.npz, and the NEXT probe-config5

@@ -63,7 +63,8 @@ TARGET_SECONDS = 60.0
 # inside the driver's budget instead of losing the artifact to an
 # external timeout (BENCH_r05: rc=124, parsed=null).
 PROBE_ORDER = (("mutex_c30", 600), ("wide_window_c30", 600),
-               ("independent_keys", 900), ("partitioned_c30", 5300))
+               ("independent_keys", 900), ("service_c30", 900),
+               ("partitioned_c30", 5300))
 WORKER_RESTART_S = 75
 # Overall bench wall budget the partitioned probe must fit inside
 # (env-overridable for driver environments with different budgets).
@@ -325,11 +326,115 @@ def _probe_independent_keys():
             "ops_per_sec": round(n_ops / dt, 1)}
 
 
+def _probe_service_c30():
+    """Checker-as-a-service throughput (ROADMAP open item): N small
+    mixed-shape histories — the majority binnable into shared vmapped
+    programs, a minority odd shapes exercising the per-request
+    fallthrough — queued through an IN-PROCESS daemon over real
+    sockets by concurrent clients. Reports histories/s end to end
+    (submit -> verdict on the wire) with p50/p99 latency plus the
+    daemon's own stats (batch occupancy proves the bins actually
+    batched; the XLA compile meter shows the warm-worker
+    amortization)."""
+    import threading as _th
+
+    from jepsen_tpu.lin import synth
+    from jepsen_tpu.service.daemon import CheckerService
+    from jepsen_tpu.service.protocol import CheckerClient
+
+    n_clients = 8
+    jobs: list[tuple[str, object]] = []
+    # Majority bin: one traced shape (same concurrency/length bucket).
+    for i in range(90):
+        jobs.append(("cas-register", synth.generate_register_history(
+            100, concurrency=4, seed=9000 + i, value_range=5,
+            crash_prob=0.01, max_crashes=2)))
+    # Second bin: mutex histories (different kernel, still binnable).
+    for i in range(20):
+        jobs.append(("mutex", synth.generate_mutex_history(
+            80, concurrency=4, seed=500 + i)))
+    # Odd shapes: wide-window registers past the dense plan — the
+    # slow-path fallthrough the scheduler must attribute, not hide.
+    for i in range(10):
+        jobs.append(("cas-register", synth.generate_register_history(
+            120, concurrency=24, seed=100 + i, value_range=5)))
+    n_jobs = len(jobs)
+
+    svc = CheckerService("127.0.0.1", 0, flush_ms_=40).start()
+    lock = _th.Lock()
+    latencies: list[float] = []
+    verdicts = {"true": 0, "false": 0, "unknown": 0}
+    job_iter = iter(list(enumerate(jobs)))
+
+    def client_loop():
+        c = CheckerClient("127.0.0.1", svc.port)
+        while True:
+            with lock:
+                nxt = next(job_iter, None)
+            if nxt is None:
+                break
+            i, (model_name, h) = nxt
+            t1 = time.time()
+            r = c.submit(model_name, h, req_id=i)
+            dt = time.time() - t1
+            v = r.get("valid?")
+            with lock:
+                latencies.append(dt)
+                verdicts["true" if v is True else
+                         "false" if v is False else "unknown"] += 1
+        c.close()
+
+    # Warm pass: one of each bin shape compiles its programs so the
+    # timed pass measures the amortized steady state the daemon
+    # actually serves (cold-compile numbers are in xla_compile_s).
+    warm = CheckerClient("127.0.0.1", svc.port)
+    for model_name, h in (jobs[0], jobs[90], jobs[110]):
+        warm.submit(model_name, h)
+    warm.close()
+
+    t0 = time.time()
+    threads = [_th.Thread(target=client_loop) for _ in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.time() - t0
+    stats = None
+    try:
+        c = CheckerClient("127.0.0.1", svc.port)
+        stats = c.stats()
+        c.close()
+    finally:
+        svc.stop()
+    # One percentile definition for the artifact AND the daemon's own
+    # stats (they must never silently diverge).
+    def pct(q):
+        p = CheckerService._percentile(latencies, q)
+        return None if p is None else round(p, 4)
+
+    out = {"n_histories": n_jobs, "n_clients": n_clients,
+           "seconds": round(wall, 2),
+           "histories_per_sec": round(n_jobs / wall, 1),
+           "latency_p50_s": pct(0.50), "latency_p99_s": pct(0.99),
+           "verdicts": verdicts, "service_stats": stats}
+    # All inputs are linearizable by construction; any False is a
+    # checker bug, any unknown an undecided/failed request.
+    out["verdict"] = (True if verdicts["false"] == 0
+                      and verdicts["unknown"] == 0 else
+                      "unknown" if verdicts["false"] == 0 else False)
+    occ = (stats or {}).get("avg_occupancy")
+    if not occ or occ <= 1:
+        out["note"] = ("batch occupancy <= 1: bins did not share "
+                       "device programs (vacuous batching)")
+    return out
+
+
 PROBES = {"ping": _probe_ping, "mutex_c30": _probe_mutex_c30,
           "wide_window_c30": _probe_wide_window_c30,
           "partitioned_c30": _probe_partitioned_c30,
           "independent_keys": _probe_independent_keys,
-          "wave_smoke": _probe_wave_smoke}
+          "wave_smoke": _probe_wave_smoke,
+          "service_c30": _probe_service_c30}
 
 
 def _run_probe_subprocess(key: str, timeout: int, env_extra=None,

@@ -30,6 +30,35 @@ EXIT_UNKNOWN = 2
 EXIT_ERROR = 254
 EXIT_USAGE = 255
 
+# --- declarative command registry -------------------------------------------
+# The standard (non-suite-specific) subcommands as one table instead of
+# call-site lists that drifted per entry point: each entry is a no-arg
+# factory returning a command spec {name, parser, run, help,
+# description?}. ``standard_commands()`` instantiates them in
+# registration order; ``run()`` wires ``description`` into the
+# subparser so every command's ``--help`` explains itself.
+# ``single_test_cmd`` stays a parameterized factory (it needs the
+# suite's test_fn) and composes with the registry via
+# ``suite_commands``.
+
+_REGISTRY: dict[str, Callable[[], dict]] = {}
+
+
+def command(factory: Callable[[], dict]) -> Callable[[], dict]:
+    """Register a standard-command factory (decorator). The command
+    name comes from the spec the factory builds, so the table cannot
+    disagree with the parser."""
+    _REGISTRY[factory()["name"]] = factory
+    return factory
+
+
+def standard_commands(names=None) -> list[dict]:
+    """Instantiate the registered standard commands (all, or ``names``
+    in registry order) — what every suite ``-main`` and the bare
+    ``jepsen-tpu`` entry point share."""
+    return [f() for n, f in _REGISTRY.items()
+            if names is None or n in names]
+
 
 def add_test_opts(p: argparse.ArgumentParser) -> None:
     """The standard test option set (cli.clj:52-87)."""
@@ -140,12 +169,15 @@ def suite_commands(test_fn: Callable[[dict], dict],
         if opt_spec:
             opt_spec(p)
 
-    return [single_test_cmd(test_fn, opt_spec=spec), serve_cmd(),
-            analyze_cmd(), quarantine_cmd()]
+    return [single_test_cmd(test_fn, opt_spec=spec)] \
+        + standard_commands()
 
 
+@command
 def serve_cmd() -> dict:
-    """Run the results web server (cli.clj:278-293)."""
+    """Run the results web server (cli.clj:278-293). NOT the checker
+    daemon — that is ``serve-checker`` (two different sockets, two
+    different jobs; the names say which)."""
 
     def build_parser(p: argparse.ArgumentParser):
         p.add_argument("--port", "-p", type=int, default=8080)
@@ -159,9 +191,126 @@ def serve_cmd() -> dict:
         return EXIT_OK
 
     return {"name": "serve", "parser": build_parser, "run": run_cmd,
-            "help": "serve the results browser"}
+            "help": "serve the results web browser (NOT the checker "
+                    "daemon: see serve-checker)",
+            "description":
+                "HTTP browser over the store/ results directory "
+                "(runs table, file previews, zip downloads). The "
+                "linearizability checker daemon is the separate "
+                "`serve-checker` command."}
 
 
+@command
+def serve_checker_cmd() -> dict:
+    """Run the checker daemon (jepsen_tpu.service): the persistent
+    shape-binned batch checker amortizing the warm chip across queued
+    histories."""
+
+    def build_parser(p: argparse.ArgumentParser):
+        p.add_argument("--port", "-p", type=int, default=None,
+                       help="listen port (default: "
+                            "JEPSEN_TPU_SERVICE_PORT or 8642; 0 = "
+                            "ephemeral)")
+        p.add_argument("--host", "-b", default="127.0.0.1")
+        p.add_argument("--queue-bound", type=int, default=None,
+                       help="admission queue bound (backpressure past "
+                            "it); default JEPSEN_TPU_SERVICE_QUEUE")
+        p.add_argument("--flush-ms", type=float, default=None,
+                       help="bin max-wait before a partial batch "
+                            "flushes; default JEPSEN_TPU_SERVICE_"
+                            "FLUSH_MS")
+        p.add_argument("--max-batch", type=int, default=None,
+                       help="histories per vmapped device program; "
+                            "default JEPSEN_TPU_SERVICE_MAX_BATCH")
+        p.add_argument("--deadline", type=float, default=None,
+                       help="per-request decide deadline, seconds; "
+                            "default JEPSEN_TPU_SERVICE_DEADLINE_S")
+        p.add_argument("--stats-file", default=None,
+                       help="stats snapshot path (web.py /service "
+                            "page); default JEPSEN_TPU_SERVICE_STATS")
+
+    def run_cmd(opts: argparse.Namespace) -> int:
+        from jepsen_tpu.service.daemon import serve_checker
+
+        serve_checker(host=opts.host, port=opts.port,
+                      bound=opts.queue_bound,
+                      flush_ms_=opts.flush_ms,
+                      max_batch_=opts.max_batch,
+                      deadline_s=opts.deadline,
+                      stats_file=opts.stats_file)
+        return EXIT_OK
+
+    return {"name": "serve-checker", "parser": build_parser,
+            "run": run_cmd,
+            "help": "run the checker daemon (shape-binned batch "
+                    "checking on a warm chip)",
+            "description":
+                "Persistent linearizability-checker daemon "
+                "(doc/service.md): accepts histories over the wire, "
+                "bins them by traced shape, and decides same-shape "
+                "bins as single vmapped device programs. The results "
+                "web browser is the separate `serve` command."}
+
+
+@command
+def service_stats_cmd() -> dict:
+    """Print the checker daemon's stats: live over the wire when the
+    daemon answers, else the last stats snapshot it wrote
+    (JEPSEN_TPU_SERVICE_STATS) — so the command works during AND after
+    a run."""
+
+    def build_parser(p: argparse.ArgumentParser):
+        p.add_argument("--host", default="127.0.0.1")
+        p.add_argument("--port", "-p", type=int, default=None)
+        p.add_argument("--file", help="read this stats snapshot "
+                                      "instead of asking a live "
+                                      "daemon")
+
+    def run_cmd(opts: argparse.Namespace) -> int:
+        import json
+
+        from jepsen_tpu.service import daemon as service_daemon
+
+        if not opts.file:
+            try:
+                from jepsen_tpu.service.protocol import CheckerClient
+
+                port = opts.port if opts.port is not None \
+                    else service_daemon.default_port()
+                client = CheckerClient(opts.host, port, timeout=5.0)
+                stats = client.stats()
+                client.close()
+                print(json.dumps({"source": "live",
+                                  "addr": f"{opts.host}:{port}",
+                                  "stats": stats}, indent=1,
+                                 sort_keys=True))
+                return EXIT_OK
+            except (ConnectionError, OSError):
+                pass   # no live daemon: fall back to the snapshot
+        path = opts.file or service_daemon.stats_path()
+        try:
+            with open(path) as fh:
+                snap = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"no live daemon and no readable stats snapshot "
+                  f"at {path!r}: {e}", file=sys.stderr)
+            return EXIT_ERROR
+        print(json.dumps({"source": "snapshot", "path": path,
+                          "stats": snap}, indent=1, sort_keys=True))
+        return EXIT_OK
+
+    return {"name": "service-stats", "parser": build_parser,
+            "run": run_cmd,
+            "help": "print checker-daemon stats (live, or the last "
+                    "snapshot)",
+            "description":
+                "Checker-daemon observability: queue depth, per-bin "
+                "depths, batch occupancy, verdict counters, latency "
+                "p50/p99, XLA compile meter. Tries the live daemon "
+                "first, then the stats snapshot file."}
+
+
+@command
 def analyze_cmd() -> dict:
     """Re-run a checker offline on a saved history — the TPU build's
     first-class path: record once, re-check on device (the seam noted in
@@ -206,9 +355,13 @@ def analyze_cmd() -> dict:
                 EXIT_INVALID if valid is False else EXIT_UNKNOWN)
 
     return {"name": "analyze", "parser": build_parser, "run": run_cmd,
-            "help": "re-check a saved history (optionally on device)"}
+            "help": "re-check a saved history (optionally on device)",
+            "description":
+                "Re-run a linearizability checker offline on a saved "
+                "run's history, on the cpu/tpu/competition engines."}
 
 
+@command
 def quarantine_cmd() -> dict:
     """Manage the fault-shape quarantine ledger
     (jepsen_tpu.lin.supervise): the persistent record of traced program
@@ -276,7 +429,12 @@ def quarantine_cmd() -> dict:
 
     return {"name": "quarantine", "parser": build_parser,
             "run": run_cmd,
-            "help": "list/clear/diff the fault-shape quarantine ledger"}
+            "help": "list/clear/diff the fault-shape quarantine ledger",
+            "description":
+                "Manage the persistent record of traced program "
+                "shapes that faulted/wedged the TPU runtime "
+                "(.jax_cache/quarantine.json; doc/env.md "
+                "JEPSEN_TPU_QUARANTINE)."}
 
 
 def run(commands, argv=None) -> int:
@@ -290,7 +448,9 @@ def run(commands, argv=None) -> int:
     parser = argparse.ArgumentParser(prog="jepsen-tpu")
     subs = parser.add_subparsers(dest="subcommand")
     for cmd in commands:
-        sp = subs.add_parser(cmd["name"], help=cmd.get("help"))
+        sp = subs.add_parser(
+            cmd["name"], help=cmd.get("help"),
+            description=cmd.get("description", cmd.get("help")))
         cmd["parser"](sp)
         sp.set_defaults(_run=cmd["run"])
 
@@ -348,9 +508,9 @@ def _demo_test_fn(options: dict) -> dict:
 
 def main_default(argv=None) -> None:
     """The bare `jepsen-tpu` console script (pyproject entry point):
-    demo test + serve + analyze, like `python -m jepsen_tpu.cli`."""
-    main([single_test_cmd(_demo_test_fn), serve_cmd(), analyze_cmd(),
-          quarantine_cmd()], argv)
+    demo test + every registered standard command, like
+    `python -m jepsen_tpu.cli`."""
+    main([single_test_cmd(_demo_test_fn)] + standard_commands(), argv)
 
 
 if __name__ == "__main__":

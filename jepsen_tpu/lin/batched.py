@@ -11,6 +11,8 @@ into a tensor batch axis.
 
 from __future__ import annotations
 
+from dataclasses import dataclass, field
+
 import numpy as np
 
 from jepsen_tpu import util
@@ -20,10 +22,40 @@ from jepsen_tpu.models.kernels import F_NOOP
 
 BATCH_CAP_SCHEDULE = (64, 1024)
 
+
+@dataclass
+class Decline:
+    """Why a key group could NOT batch — the shape axis that failed.
+
+    The batch helpers used to return a bare ``None`` on any unsupported
+    shape, which made the service scheduler's fallthrough decision
+    unexplainable ("the bin went to the slow path" with no why). A
+    Decline names the failing axis so schedulers/stats can attribute
+    it; it is FALSY so ``result or fallback`` call sites keep working.
+
+    axis: "prepare" (history unpackable), "kernel" (model has no device
+    kernel), "dense-plan" (outside the dense engine's bounds),
+    "rows" / "bitmap-words" / "table-cells" (dense batch resource
+    ceilings), "window" (past the sparse bitset), "frontier-overflow"
+    (the vmapped sparse search overflowed its top capacity).
+    """
+
+    axis: str
+    detail: str = ""
+    keys: list = field(default_factory=list)
+
+    def __bool__(self) -> bool:
+        return False
+
+    def as_dict(self) -> dict:
+        return {"axis": self.axis, "detail": self.detail,
+                "keys": [repr(k) for k in self.keys[:8]]}
+
 # Dense-batch resource ceilings: one vmapped dispatch carries K bitmaps
-# of 2**w words plus [K, r_pad, w] tables; past these bounds return None
-# so the caller can fall back (sparse batch / per-key checks) instead of
-# an XLA allocation error escaping the checker.
+# of 2**w words plus [K, r_pad, w] tables; past these bounds a Decline
+# names the failing axis so the caller can fall back (sparse batch /
+# per-key checks) instead of an XLA allocation error escaping the
+# checker.
 MAX_BATCH_BITMAP_WORDS = 1 << 24      # 64 MiB of frontier bitmaps
 MAX_BATCH_TABLE_CELLS = 1 << 27       # [K, r_pad, w] table budget
 MAX_BATCH_ROWS = 1 << 14
@@ -49,14 +81,15 @@ def _result_rows(packed, ks, dead, r_done, analyzer) -> dict:
     return results
 
 
-def _try_dense_batch(packed: dict) -> dict | None:
+def _try_dense_batch(packed: dict) -> dict | Decline:
     """Batch all keys through the dense bitmap engine: one vmapped chunk
     over a leading key axis. Per-key history length (n_rows), state
     count (nil_id), and initial state ride the batch as vectors, so no
     identity-row padding is needed and crashed-op keys cost nothing.
-    Returns {key: result} or None when any key falls outside the dense
-    bounds or the batch exceeds the resource ceilings (caller tries the
-    sparse batch, then per-key host checks)."""
+    Returns {key: result}, or a falsy :class:`Decline` naming the shape
+    axis when any key falls outside the dense bounds or the batch
+    exceeds the resource ceilings (caller tries the sparse batch, then
+    per-key host checks)."""
     import jax
     import jax.numpy as jnp
 
@@ -66,7 +99,10 @@ def _try_dense_batch(packed: dict) -> dict | None:
     for k, p in packed.items():
         pl = dense.plan(p)
         if pl is None:
-            return None
+            return Decline(
+                "dense-plan",
+                f"window {p.window} / state shape outside the dense "
+                f"engine bounds", keys=[k])
         plans[k] = pl
 
     w = max(pl[0] for pl in plans.values())
@@ -75,9 +111,19 @@ def _try_dense_batch(packed: dict) -> dict | None:
     r_pad = 1 << max(4, (r_max - 1).bit_length())
     ks = sorted(packed, key=repr)
     K = len(ks)
-    if r_pad > MAX_BATCH_ROWS or K * (1 << w) > MAX_BATCH_BITMAP_WORDS \
-            or K * r_pad * w > MAX_BATCH_TABLE_CELLS:
-        return None
+    if r_pad > MAX_BATCH_ROWS:
+        return Decline("rows", f"r_pad {r_pad} > {MAX_BATCH_ROWS}",
+                       keys=ks)
+    if K * (1 << w) > MAX_BATCH_BITMAP_WORDS:
+        return Decline(
+            "bitmap-words",
+            f"{K} keys x 2^{w} words > {MAX_BATCH_BITMAP_WORDS}",
+            keys=ks)
+    if K * r_pad * w > MAX_BATCH_TABLE_CELLS:
+        return Decline(
+            "table-cells",
+            f"{K} x {r_pad} x {w} cells > {MAX_BATCH_TABLE_CELLS}",
+            keys=ks)
 
     F0 = np.zeros((K, 1 << w), np.uint32)
     n_rows = np.zeros(K, np.int32)
@@ -136,23 +182,35 @@ def _pad_to(p: PackedHistory, r_pad: int, w_pad: int, nw: int):
     return ret_slot, active, slot_f, slot_v, pure, pred_bit
 
 
-def try_check_batch(model, subs: dict) -> dict | None:
+def try_check_batch(model, subs: dict, declines: list | None = None) \
+        -> dict | None:
     """Check keys' subhistories in vmapped device searches. Keys are
     GROUPED by (step function, state shape) — one stacked batch must be
     homogeneous, but history-sized kernels (set/queue widths differ per
     key) used to de-batch the whole key set on the first mismatch; now
     each homogeneous group batches independently. Returns {key: result}
     covering every key that batched (possibly a subset — the caller
-    checks leftovers per key), or None when nothing could batch."""
+    checks leftovers per key), or None when nothing could batch.
+
+    ``declines``, when given a list, collects one :class:`Decline` per
+    key/group that could NOT batch (the shape axis that failed), so a
+    caller routing leftovers to a slow path can log WHY each bin fell
+    through instead of a bare None."""
     if not subs:
         return {}
     packed: dict = {}
     for k, sub in subs.items():
         try:
             p = prepare.prepare(model, sub)
-        except prepare.UnsupportedHistory:
+        except prepare.UnsupportedHistory as e:
+            if declines is not None:
+                declines.append(Decline("prepare", str(e), keys=[k]))
             continue
         if p.kernel is None:
+            if declines is not None:
+                declines.append(Decline(
+                    "kernel", "model/history has no device kernel",
+                    keys=[k]))
             continue
         packed[k] = p
 
@@ -165,26 +223,34 @@ def try_check_batch(model, subs: dict) -> dict | None:
     for group in groups.values():
         r = _check_group(group)
         util.progress_tick()   # liveness: one tick per decided group
-        if r is not None:
-            results.update(r)
+        if isinstance(r, Decline):
+            if declines is not None:
+                declines.append(r)
+            continue
+        results.update(r)
     return results or None
 
 
-def _check_group(packed: dict) -> dict | None:
+def _check_group(packed: dict) -> dict | Decline:
     """One homogeneous (shared step fn + state shape) key group through
-    the dense batch, then the sparse batch. None when the group can't
-    run on device (window overflow, resource ceilings, or frontier
-    overflow at max capacity)."""
+    the dense batch, then the sparse batch. A falsy :class:`Decline`
+    when the group can't run on device (window overflow, resource
+    ceilings, or frontier overflow at max capacity)."""
     import jax
     import jax.numpy as jnp
 
     dense_res = _try_dense_batch(packed)
-    if dense_res is not None:
+    if not isinstance(dense_res, Decline):
         return dense_res
+    dense_decline = dense_res
 
     w_pad = max(p.window for p in packed.values())
     if w_pad + 1 > bfs.MAX_DEVICE_WINDOW:
-        return None
+        return Decline(
+            "window",
+            f"padded window {w_pad + 1} > device bitset "
+            f"{bfs.MAX_DEVICE_WINDOW} (dense declined: "
+            f"{dense_decline.axis})", keys=sorted(packed, key=repr))
     r_max = max((p.R for p in packed.values()), default=0)
     if r_max == 0:
         return {k: {"valid?": True, "analyzer": "tpu-bfs-batch"}
@@ -223,7 +289,11 @@ def _check_group(packed: dict) -> dict | None:
         if not bool(jnp.any(overflow)):
             break
     if bool(jnp.any(overflow)):
-        return None
+        return Decline(
+            "frontier-overflow",
+            f"vmapped sparse search overflowed cap "
+            f"{BATCH_CAP_SCHEDULE[-1]} (dense declined: "
+            f"{dense_decline.axis})", keys=ks)
 
     return _result_rows(packed, ks, np.asarray(dead | overflow),
                         np.asarray(rows), "tpu-bfs-batch")

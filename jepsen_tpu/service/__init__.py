@@ -1,0 +1,34 @@
+"""Checker-as-a-service: a persistent shape-binned batch daemon.
+
+The survey's north star is the chip deciding histories as fast as the
+hardware allows — but one process per history pays the 15-70 s XLA
+compiles and the ~100 ms tunnel dispatch per run. Production scale is
+the opposite shape: thousands of SMALL queued histories from many
+concurrent test runs. This package amortizes the warm chip across them:
+
+- :mod:`jepsen_tpu.service.protocol` — length-prefixed JSON framing
+  over :class:`jepsen_tpu.suites.common.SocketIO` (the same framing
+  loop every wire suite uses), plus :class:`CheckerClient` with the
+  suites' indeterminate semantics: a connection lost after a request
+  may have reached the daemon completes ``valid? "unknown"`` — never a
+  definite verdict that wasn't computed.
+- :mod:`jepsen_tpu.service.daemon` — :class:`CheckerService`: bounded
+  admission queue with backpressure, a scheduler that fingerprints and
+  bins requests by traced shape (window bucket, state/NS, model
+  kernel, engine route — the :mod:`jepsen_tpu.lin.supervise` shape-key
+  codec), continuous batching (max-batch / max-wait flush), and a warm
+  single-chip worker: same-shape bins decide as ONE vmapped
+  :mod:`jepsen_tpu.lin.batched` program; odd shapes fall through to
+  ``lin.device_check_packed`` under the supervision ladder with a
+  per-request deadline. A worker fault costs the in-flight bin (one
+  requeue, then an honest failure), never the daemon.
+- :mod:`jepsen_tpu.service.smoke` — the ``make serve-smoke`` start →
+  submit → assert → shutdown proof on the forced-CPU mesh.
+
+Entry points: ``python -m jepsen_tpu.cli serve-checker`` and
+``cli.py service-stats``; all ``JEPSEN_TPU_SERVICE_*`` knobs are
+tabled in ``doc/env.md``; protocol and capacity planning in
+``doc/service.md``.
+"""
+
+from jepsen_tpu.service.protocol import CheckerClient  # noqa: F401

@@ -1,0 +1,770 @@
+"""The checker daemon: shape-binned continuous batching on a warm chip.
+
+Pipeline (each stage its own thread(s), queues between them):
+
+1. **Admission** — one handler thread per client connection reads
+   framed requests (protocol.py), packs the history host-side
+   (``prepare.prepare``), fingerprints it, computes its shape-bin key,
+   and admits it under the IN-FLIGHT BOUND (admitted and not yet
+   answered; bounding only the queue would leak, since the scheduler
+   drains it into necessarily-unbounded shape bins). Past the bound a
+   request is answered ``overload`` immediately — backpressure, never
+   a silent drop, never an unbounded buffer that hides the capacity
+   problem. A client that disconnects mid-request costs nothing: its
+   in-flight verdicts are discarded on the dead connection
+   (``dropped_responses``) and the daemon keeps serving.
+2. **Scheduler** — drains admissions into per-shape bins and flushes a
+   bin to the worker when it reaches ``max_batch`` OR its oldest
+   request has waited ``flush_ms`` (continuous batching: a full bin
+   never waits, a lone request waits at most the flush window).
+3. **Worker** — one thread owning the device. A flushed bin of
+   same-shape histories decides as ONE vmapped
+   :func:`jepsen_tpu.lin.batched.try_check_batch` program (duplicate
+   fingerprints decide once and fan out; the key axis is optionally
+   padded to a power of two so each (shape, K-bucket) program compiles
+   exactly once — zero retrace after warmup). Keys the batch declines
+   (:class:`jepsen_tpu.lin.batched.Decline` names the axis) fall
+   through to per-request ``lin.device_check_packed`` under the PR 5
+   supervision ladder with a per-request deadline: a WEDGE becomes an
+   honest ``overflow: wedge`` unknown, a FAULT requeues the in-flight
+   requests ONCE (as singles, off the suspect batch program) and then
+   fails honestly — the daemon itself never dies with the worker.
+
+The quarantine ledger records faulting service shapes under the
+``service-batch`` / ``service-check`` sites (observability, like the
+base engine rungs — the in-daemon routing is the requeue policy, and
+the engine-internal sites keep their own ledger routing).
+
+Every knob is tabled in doc/env.md (`JEPSEN_TPU_SERVICE_*`); stats are
+served on the wire (``stats`` message / ``cli.py service-stats``) and
+snapshotted to ``JEPSEN_TPU_SERVICE_STATS`` for ``web.py``'s
+``/service`` page.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from jepsen_tpu import util
+from jepsen_tpu.service import protocol
+from jepsen_tpu.suites.common import SocketIO
+
+_REQUEUE_MAX = 1       # fault requeues per request, then honest fail
+_LATENCY_RING = 1024   # recent end-to-end latencies kept for p50/p99
+_STATS_WRITE_EVERY_S = 10.0
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_port() -> int:
+    return util.env_int("JEPSEN_TPU_SERVICE_PORT", protocol.DEFAULT_PORT)
+
+
+def queue_bound() -> int:
+    return util.env_int("JEPSEN_TPU_SERVICE_QUEUE", 1024)
+
+
+def flush_ms() -> float:
+    return util.env_float("JEPSEN_TPU_SERVICE_FLUSH_MS", 50.0)
+
+
+def max_batch() -> int:
+    return util.env_int("JEPSEN_TPU_SERVICE_MAX_BATCH", 256)
+
+
+def request_deadline_s() -> float:
+    return util.env_float("JEPSEN_TPU_SERVICE_DEADLINE_S", 600.0)
+
+
+def pad_pow2() -> bool:
+    return os.environ.get("JEPSEN_TPU_SERVICE_PAD_POW2", "1") != "0"
+
+
+def stats_path() -> str:
+    return os.environ.get("JEPSEN_TPU_SERVICE_STATS", "") or os.path.join(
+        _repo_root(), ".jax_cache", "service_stats.json")
+
+
+@dataclass(eq=False)
+class Request:
+    """One queued check: wire identity + packed shape + reply route.
+    ``eq=False``: requests are identities, never compared by value
+    (packed carries numpy arrays)."""
+
+    rid: Any
+    model_name: str
+    model: Any
+    history: list
+    packed: Any                    # PackedHistory | None (unpackable)
+    bin: str                       # shape-bin key (supervise codec)
+    fingerprint: str               # history identity (supervise codec)
+    respond: Callable[[dict], None]
+    t_enqueue: float = field(default_factory=time.monotonic)
+    attempts: int = 0              # fault requeues consumed
+    no_batch: bool = False         # post-fault: keep off the batch path
+    done: bool = False             # answered (guards double-finish)
+
+
+def bin_key(packed) -> str:
+    """The traced-shape bin of a packed history: engine route x window
+    bucket x state width x row bucket x kernel — reusing the
+    supervision layer's shape-key codec so ledger entries, service
+    stats, and triage all speak one shape language. Two histories in
+    one bin batch into one vmapped program with (at most) one compile
+    per occupancy bucket."""
+    from jepsen_tpu.lin import dense, supervise
+
+    kern = packed.kernel.name if packed.kernel is not None else "none"
+    r_pad = 1 << max(4, (packed.R - 1).bit_length()) if packed.R else 16
+    plan = dense.plan(packed)
+    if plan is not None:
+        w, ns, _, _ = plan
+        return supervise.shape_key("svc-dense", cap=ns, window=w,
+                                   kernel=kern, rows=r_pad)
+    w_bucket = 1 << max(3, (packed.window - 1).bit_length())
+    return supervise.shape_key("svc-sparse",
+                               cap=int(packed.state_width),
+                               window=w_bucket, kernel=kern, rows=r_pad)
+
+
+class CheckerService:
+    """The daemon. ``start()`` binds and spawns the pipeline;
+    ``serve_forever()`` blocks; ``stop()`` drains and joins.
+
+    ``check_fn`` / ``batch_fn`` are test hooks replacing the device
+    paths (default ``lin.device_check_packed`` /
+    ``lin.batched.try_check_batch``)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int | None = None,
+                 *, bound: int | None = None,
+                 flush_ms_: float | None = None,
+                 max_batch_: int | None = None,
+                 deadline_s: float | None = None,
+                 stats_file: str | None = None,
+                 check_fn: Callable | None = None,
+                 batch_fn: Callable | None = None):
+        self.host = host
+        self.port = port if port is not None else default_port()
+        self.bound = bound if bound is not None else queue_bound()
+        self.flush_s = (flush_ms_ if flush_ms_ is not None
+                        else flush_ms()) / 1000.0
+        self.max_batch = max_batch_ if max_batch_ is not None \
+            else max_batch()
+        self.deadline_s = deadline_s if deadline_s is not None \
+            else request_deadline_s()
+        self.stats_file = stats_file if stats_file is not None \
+            else stats_path()
+        self._check_fn = check_fn
+        self._batch_fn = batch_fn
+
+        # The admission queue itself is unbounded; the BOUND is on
+        # requests IN FLIGHT (admitted, not yet answered) — bounding
+        # only the queue would leak, since the scheduler immediately
+        # drains it into (necessarily unbounded) shape bins.
+        self._queue: queue.Queue[Request] = queue.Queue()
+        self._inflight = 0
+        self._work: queue.Queue = queue.Queue()
+        self._bins: dict[str, list[Request]] = {}
+        self._bins_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._stop_lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._listener: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self._worker_t: threading.Thread | None = None
+
+        self._stats_lock = threading.Lock()
+        self._stats: dict = {"decline_axes": {}, "bin_decide_s": {},
+                             "bin_requests": {}}
+        self._latencies: list[float] = []   # ring, _LATENCY_RING cap
+        self._last_stats_write = 0.0
+
+    # --- observability ------------------------------------------------------
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            util.stat_bump(self._stats, key, n)
+
+    def _note_latency(self, seconds: float) -> None:
+        with self._stats_lock:
+            self._latencies.append(seconds)
+            if len(self._latencies) > _LATENCY_RING:
+                del self._latencies[:len(self._latencies)
+                                    - _LATENCY_RING]
+
+    @staticmethod
+    def _percentile(xs: list[float], q: float) -> float | None:
+        if not xs:
+            return None
+        s = sorted(xs)
+        return s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))]
+
+    def stats(self) -> dict:
+        """Snapshot: counters + queue/bin depths + latency percentiles
+        + the process-wide XLA compile meter."""
+        with self._stats_lock:
+            # dict(self._stats) first (one C-level copy): the
+            # supervision layer inserts keys into this dict WITHOUT
+            # our lock (supervise._note_event is deliberately
+            # lock-free), and a Python-level comprehension over the
+            # live dict could see it resize mid-iteration.
+            items = dict(self._stats)
+            out = util.round_stats(
+                {k: (dict(v) if isinstance(v, dict) else v)
+                 for k, v in items.items()}, 3)
+            lats = list(self._latencies)
+        with self._bins_lock:
+            out["bin_depths"] = {k: len(v)
+                                 for k, v in self._bins.items() if v}
+        out["queue_depth"] = self._queue.qsize()
+        out["queue_bound"] = self.bound
+        with self._stats_lock:
+            out["in_flight"] = self._inflight
+        batches = out.get("batches", 0)
+        out["avg_occupancy"] = round(
+            out.get("batched_requests", 0) / batches, 2) if batches \
+            else None
+        out["latency_p50_s"] = self._percentile(lats, 0.50)
+        out["latency_p99_s"] = self._percentile(lats, 0.99)
+        out["latency_samples"] = len(lats)
+        out.update(_compile_meter_snapshot())
+        return protocol.jsonable(out)
+
+    def _write_stats_snapshot(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_stats_write \
+                < _STATS_WRITE_EVERY_S:
+            return
+        self._last_stats_write = now
+        path = self.stats_file
+        if not path:
+            return
+        try:
+            snap = dict(self.stats())
+            snap["written_at"] = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+            snap["addr"] = f"{self.host}:{self.port}"
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(snap, fh, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except Exception:  # noqa: BLE001 - monitoring-grade: a stats
+            pass   # write must never take the scheduler thread down
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "CheckerService":
+        from jepsen_tpu.util import enable_compile_cache
+
+        enable_compile_cache()   # the warm worker's whole point
+        _install_compile_meter()
+        self._listener = socket.create_server(
+            (self.host, self.port), reuse_port=False)
+        # Closing a socket does NOT wake a thread blocked in accept()
+        # on Linux; poll with a timeout so stop() takes ~0.5 s, not a
+        # join timeout.
+        self._listener.settimeout(0.5)
+        self.port = self._listener.getsockname()[1]
+        # Worker FIRST: the scheduler's liveness check dereferences
+        # self._worker_t on its first iteration.
+        self._spawn_worker()
+        for name, fn in (("accept", self._accept_loop),
+                         ("scheduler", self._scheduler_loop)):
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"svc-{name}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _spawn_worker(self) -> None:
+        self._worker_t = threading.Thread(
+            target=self._worker_loop, daemon=True, name="svc-worker")
+        self._worker_t.start()
+
+    def serve_forever(self) -> None:
+        while not self._stop.wait(0.5):
+            pass
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Drain-and-stop: admissions close, queued bins flush and
+        decide, stats snapshot written. Idempotent AND blocking: a
+        second caller waits for the first stop to finish (the shutdown
+        wire message races the client's own svc.stop())."""
+        with self._stop_lock:
+            first = not self._stop.is_set()
+            self._stop.set()
+        if not first:
+            self._stopped.wait(timeout)
+            return
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout)
+        # The scheduler flushed every bin before exiting; the sentinel
+        # queues BEHIND them, so the worker drains all pending work.
+        self._work.put(None)
+        if self._worker_t is not None:
+            self._worker_t.join(timeout)
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._write_stats_snapshot(force=True)
+        self._stopped.set()
+
+    # --- admission ----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _addr = self._listener.accept()
+            except socket.timeout:
+                continue   # poll tick: re-check the stop flag
+            except OSError:
+                return   # listener closed (stop)
+            sock.settimeout(None)   # conns block; accept polls
+            with self._conns_lock:
+                self._conns.add(sock)
+            t = threading.Thread(target=self._handle_conn,
+                                 args=(sock,), daemon=True,
+                                 name="svc-conn")
+            t.start()
+
+    def _handle_conn(self, sock) -> None:
+        io = SocketIO(sock)
+        wlock = threading.Lock()
+        alive = {"ok": True}
+
+        def respond(msg: dict) -> None:
+            with wlock:
+                if not alive["ok"]:
+                    self._bump("dropped_responses")
+                    return
+                try:
+                    protocol.send_msg(io, msg)
+                except (ConnectionError, OSError):
+                    alive["ok"] = False
+                    self._bump("dropped_responses")
+
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = protocol.read_msg(io)
+                except (ConnectionError, OSError):
+                    break   # client done/dropped; daemon unaffected
+                mtype = msg.get("type")
+                if mtype == "ping":
+                    respond({"type": "pong"})
+                elif mtype == "stats":
+                    respond({"type": "stats", "stats": self.stats()})
+                elif mtype == "shutdown":
+                    respond({"type": "ok"})
+                    threading.Thread(target=self.stop,
+                                     daemon=True).start()
+                    break
+                elif mtype == "check":
+                    self._admit(msg, respond)
+                else:
+                    respond({"type": "error", "id": msg.get("id"),
+                             "error": f"unknown message type {mtype!r}"})
+        finally:
+            alive["ok"] = False
+            with self._conns_lock:
+                self._conns.discard(sock)
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _admit(self, msg: dict, respond: Callable) -> None:
+        from jepsen_tpu.lin import prepare, supervise
+
+        rid = msg.get("id")
+        self._bump("submitted")
+        try:
+            model = protocol.model_by_name(msg.get("model"))
+            history = protocol.history_from_wire(
+                msg.get("history") or [])
+        except (ValueError, TypeError, KeyError) as e:
+            self._bump("bad_requests")
+            respond({"type": "error", "id": rid, "error": str(e)})
+            return
+        try:
+            packed = prepare.prepare(model, history)
+            key = bin_key(packed)
+            fp = supervise.history_fingerprint(packed)
+        except prepare.UnsupportedHistory as e:
+            # Window past the device bitset etc.: still a legitimate
+            # check (lin.analysis routes it to the unbounded host
+            # search) — it just never bins.
+            packed, key = None, f"svc-cpu|{e.kind}"
+            fp = f"unpacked:{rid}:{time.monotonic()}"
+        req = Request(rid=rid, model_name=msg.get("model"),
+                      model=model, history=history, packed=packed,
+                      bin=key, fingerprint=fp, respond=respond)
+        with self._stats_lock:
+            admit = self._inflight < self.bound
+            if admit:
+                self._inflight += 1
+        if not admit:
+            # Backpressure, not buffering: the client learns NOW that
+            # the daemon is at capacity (the check never started, so
+            # retrying later is sound).
+            self._bump("overloads")
+            respond({"type": "error", "id": rid,
+                     "error": f"overload: {self.bound} requests in "
+                              f"flight (bound)"})
+            return
+        self._queue.put(req)
+
+    # --- scheduler ----------------------------------------------------------
+
+    def _scheduler_loop(self) -> None:
+        oldest: dict[str, float] = {}
+        poll = max(0.002, min(0.05, self.flush_s / 2))
+        while True:
+            stopping = self._stop.is_set()
+            req = None
+            try:
+                req = self._queue.get(timeout=poll)
+            except queue.Empty:
+                if stopping:
+                    break
+            if req is not None:
+                with self._bins_lock:
+                    self._bins.setdefault(req.bin, []).append(req)
+                oldest.setdefault(req.bin, time.monotonic())
+            now = time.monotonic()
+            flush: list[list[Request]] = []
+            with self._bins_lock:
+                for key, reqs in list(self._bins.items()):
+                    if not reqs:
+                        continue
+                    if len(reqs) >= self.max_batch or stopping or \
+                            now - oldest.get(key, now) >= self.flush_s:
+                        flush.append(reqs[:self.max_batch])
+                        rest = reqs[self.max_batch:]
+                        if rest:
+                            self._bins[key] = rest
+                            oldest[key] = now
+                        else:
+                            del self._bins[key]
+                            oldest.pop(key, None)
+            for batch in flush:
+                self._work.put(batch)
+            if not self._worker_t.is_alive() and not stopping:
+                # A worker thread must never die silently (its loop
+                # catches per-batch); if it somehow did, respawn so
+                # queued work is not stranded.
+                self._bump("worker_respawns")
+                self._spawn_worker()
+            self._write_stats_snapshot()
+        # Drain-and-stop: everything still queued flushes to the
+        # worker, THEN the sentinel (stop() enqueues it after joining
+        # this thread).
+        with self._bins_lock:
+            for reqs in self._bins.values():
+                if reqs:
+                    self._work.put(list(reqs))
+            self._bins.clear()
+        while True:
+            try:
+                self._work.put([self._queue.get_nowait()])
+            except queue.Empty:
+                break
+
+    # --- worker -------------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            batch = self._work.get()
+            if batch is None:
+                return
+            try:
+                self._process_batch(batch)
+            except Exception:  # noqa: BLE001 - the daemon must survive
+                self._bump("worker_respawns")
+                import traceback
+
+                # Only the requests NOT already answered mid-batch:
+                # _finish guards double-finish too, but re-answering an
+                # answered connection would desync its synchronous
+                # client (an unsolicited frame becomes the next
+                # submit's "verdict").
+                for req in batch:
+                    if not req.done:
+                        self._finish(req, {
+                            "valid?": "unknown",
+                            "error": "service worker error: "
+                                     + traceback.format_exc(limit=3)},
+                            batch_n=len(batch), t0=time.monotonic())
+
+    def _process_batch(self, reqs: list[Request]) -> None:
+        from jepsen_tpu.lin import supervise
+
+        t0 = time.monotonic()
+        singles: list[Request] = []
+        batchable: list[Request] = []
+        for r in reqs:
+            if r.no_batch or r.packed is None \
+                    or r.packed.kernel is None:
+                singles.append(r)
+            else:
+                batchable.append(r)
+
+        if len(batchable) >= 2:
+            # Duplicate fingerprints (same history resubmitted, e.g. a
+            # retried client) decide once and fan out. The batch is
+            # keyed by FINGERPRINT, never by the client-chosen rid:
+            # two clients' auto-ids collide routinely (each counts
+            # 1, 2, ...), and a rid-keyed dict would silently drop one
+            # request on the floor.
+            by_fp: dict[str, list[Request]] = {}
+            for r in batchable:
+                by_fp.setdefault(r.fingerprint, []).append(r)
+            subs = {fp: reqs_fp[0].history
+                    for fp, reqs_fp in by_fp.items()}
+            self._bump("dedup_hits", len(batchable) - len(by_fp))
+            pad_ids = []
+            if pad_pow2() and len(subs) > 1:
+                # Pad the key axis to the next power of two so each
+                # (shape, K-bucket) vmapped program compiles once —
+                # zero retrace across varying bin occupancies.
+                want = 1 << (len(subs) - 1).bit_length()
+                proto_hist = next(iter(subs.values()))
+                for i in range(want - len(subs)):
+                    pad_ids.append(f"__svc_pad_{i}__")
+                    subs[pad_ids[-1]] = proto_hist
+                self._bump("pad_keys", len(pad_ids))
+            declines: list = []
+            # run_guarded's deadline is scale x the base dispatch
+            # deadline; express the service's per-request deadline in
+            # that currency so the batch site honors the same budget.
+            scale = self.deadline_s / max(supervise.base_deadline_s(),
+                                          1e-6)
+            outcome, res = supervise.run_guarded(
+                "service-batch", reqs[0].bin,
+                lambda: self._batch(reqs[0].model, subs, declines),
+                scale=scale, stats=self._supervise_stats())
+            if outcome == "ok":
+                res = res or {}
+                covered = 0
+                for fp, reqs_fp in by_fp.items():
+                    if fp in res:
+                        covered += 1
+                        for r in reqs_fp:
+                            self._finish(r, res[fp],
+                                         batch_n=len(subs), t0=t0)
+                    else:
+                        singles.extend(reqs_fp)
+                if covered:
+                    with self._stats_lock:
+                        util.stat_bump(self._stats, "batches")
+                        util.stat_bump(self._stats, "batched_requests",
+                                       covered)
+                        self._stats["max_occupancy"] = max(
+                            self._stats.get("max_occupancy", 0),
+                            covered)
+                        util.stat_time(self._stats, "bin_decide_s",
+                                       reqs[0].bin,
+                                       time.monotonic() - t0)
+                for d in declines:
+                    with self._stats_lock:
+                        util.stat_bump(self._stats["decline_axes"],
+                                       d.axis, len(d.keys) or 1)
+            elif outcome == "wedge":
+                # The watchdog already retried inside run_guarded; a
+                # still-wedged batch reports honestly rather than
+                # tarpitting the queue behind a second full deadline.
+                for r in batchable:
+                    self._finish(r, {"valid?": "unknown",
+                                     "overflow": "wedge",
+                                     "error": f"service-batch: {res}"},
+                                 batch_n=len(subs), t0=t0)
+            else:   # fault — requeue once as singles, off the batch
+                # (run_guarded already noted the fault event and
+                # recorded the bin shape in the quarantine ledger.)
+                self._requeue_or_fail(batchable, res, t0)
+        else:
+            singles.extend(batchable)
+
+        for r in singles:
+            self._check_single(r)
+
+    def _batch(self, model, subs: dict, declines: list):
+        from jepsen_tpu.lin import batched
+
+        fn = self._batch_fn or batched.try_check_batch
+        res = fn(model, subs, declines=declines)
+        res = dict(res or {})
+        for k in list(res):
+            if isinstance(k, str) and k.startswith("__svc_pad_"):
+                del res[k]
+        return res
+
+    def _check_single(self, req: Request) -> None:
+        from jepsen_tpu.lin import supervise
+
+        t0 = time.monotonic()
+        self._bump("single_requests")
+
+        def thunk():
+            if self._check_fn is not None:
+                return self._check_fn(req.packed, req.model,
+                                      req.history)
+            from jepsen_tpu import lin
+
+            if req.packed is None:
+                # Unpackable shape (e.g. window past the device
+                # bitset): lin.analysis routes it to the unbounded
+                # host search.
+                return lin.analysis(req.model, req.history)
+            return lin.device_check_packed(req.packed)
+
+        try:
+            r = supervise.call("service-check", thunk,
+                               deadline_s=self.deadline_s, retries=0,
+                               stats=self._supervise_stats())
+            self._finish(req, r, batch_n=1, t0=t0)
+        except supervise.WedgedDispatch as e:
+            self._bump("wedged_requests")
+            supervise.record_fault(req.bin, "wedge")
+            self._finish(req, {"valid?": "unknown",
+                               "overflow": "wedge",
+                               "error": str(e)}, batch_n=1, t0=t0)
+        except (RuntimeError, OSError) as e:
+            supervise.note_fault(self._supervise_stats(),
+                                 "service-check", repr(e))
+            supervise.record_fault(req.bin, "fault", repr(e))
+            self._requeue_or_fail([req], e, t0)
+
+    def _requeue_or_fail(self, reqs: list[Request], err, t0) -> None:
+        """The fault policy: each in-flight request rides ONE requeue
+        (as a single, off the suspect batch program); a second fault
+        fails honestly. The daemon never dies with the worker."""
+        for r in reqs:
+            if r.attempts < _REQUEUE_MAX:
+                r.attempts += 1
+                r.no_batch = True
+                self._bump("requeues")
+                if self._stop.is_set():
+                    # Drain-and-stop: the scheduler that would pick
+                    # the requeue off the admission queue is gone (or
+                    # going) — run the retry inline so the one-retry
+                    # promise holds for in-flight work at shutdown.
+                    self._check_single(r)
+                else:
+                    # Still in flight (admission already counted it),
+                    # so the requeue consumes no fresh admission slot.
+                    self._queue.put(r)
+            else:
+                self._bump("honest_fails")
+                self._finish(r, {"valid?": "unknown",
+                                 "overflow": "fault",
+                                 "error": f"fault (after requeue): "
+                                          f"{err!r}"},
+                             batch_n=1, t0=t0)
+
+    def _supervise_stats(self) -> dict:
+        # supervise._note_event writes watchdog_trips/faults/
+        # supervise_events keys; share the service stats dict under
+        # the lock-free GIL-atomic increments it uses.
+        return self._stats
+
+    def _finish(self, req: Request, result: dict, *, batch_n: int,
+                t0: float) -> None:
+        if req.done:   # never answer (or account) a request twice
+            return
+        req.done = True
+        now = time.monotonic()
+        wait = t0 - req.t_enqueue
+        valid = result.get("valid?")
+        self._bump("decided")
+        self._bump("verdict_true" if valid is True else
+                   "verdict_false" if valid is False else
+                   "verdict_unknown")
+        with self._stats_lock:
+            self._inflight -= 1
+            util.stat_bump(self._stats["bin_requests"], req.bin)
+            self._stats["queue_wait_s_sum"] = round(
+                self._stats.get("queue_wait_s_sum", 0.0) + wait, 4)
+            self._stats["decide_s_sum"] = round(
+                self._stats.get("decide_s_sum", 0.0) + (now - t0), 4)
+        self._note_latency(now - req.t_enqueue)
+        req.respond({"type": "verdict", "id": req.rid,
+                     "result": protocol.jsonable(result),
+                     "timings": {"queue_wait_s": round(wait, 4),
+                                 "decide_s": round(now - t0, 4),
+                                 "batch_n": batch_n,
+                                 "attempts": req.attempts}})
+
+
+# --- process-wide XLA compile meter ----------------------------------------
+# The service's whole value proposition is amortizing compiles; count
+# them (and their wall time) the same way tests/conftest.py counts the
+# quick tier's — wrapping jax's backend_compile — so service-stats can
+# show compiles trending to zero as the cache warms.
+
+_compile_meter = {"installed": False, "n": 0, "seconds": 0.0}
+
+
+def _install_compile_meter() -> None:
+    if _compile_meter["installed"]:
+        return
+    _compile_meter["installed"] = True
+    try:
+        import jax._src.compiler as _jc
+
+        real = _jc.backend_compile
+
+        def metered(*a, **kw):
+            t0 = time.monotonic()
+            try:
+                return real(*a, **kw)
+            finally:
+                _compile_meter["n"] += 1
+                _compile_meter["seconds"] += time.monotonic() - t0
+
+        _jc.backend_compile = metered
+    except (ImportError, AttributeError):  # pragma: no cover - jax skew
+        pass
+
+
+def _compile_meter_snapshot() -> dict:
+    return {"xla_compiles": _compile_meter["n"],
+            "xla_compile_s": round(_compile_meter["seconds"], 2)}
+
+
+def serve_checker(host: str = "127.0.0.1", port: int | None = None,
+                  **kw) -> None:
+    """Run the daemon until interrupted (the ``serve-checker`` CLI)."""
+    svc = CheckerService(host, port, **kw).start()
+    print(f"checker daemon on {svc.host}:{svc.port} "
+          f"(queue bound {svc.bound}, flush "
+          f"{svc.flush_s * 1000:.0f} ms, max batch {svc.max_batch})",
+          flush=True)
+    try:
+        svc.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        svc.stop()

@@ -1,0 +1,171 @@
+"""Checker-service wire protocol: framing, messages, client.
+
+Framing is the simplest thing that every wire suite already speaks
+(suites/common.py SocketIO): a 4-byte big-endian length prefix followed
+by a JSON payload encoded with :mod:`jepsen_tpu.codec` (which carries
+the non-JSON values Jepsen histories actually use — tuples, sets,
+bytes). One message per frame, request/response with client-chosen ids
+so responses may arrive out of submission order (the daemon decides
+whole bins at once).
+
+Messages (all dicts with a ``"type"`` key):
+
+- ``{"type": "check", "id": I, "model": NAME, "history": [op dicts]}``
+  → ``{"type": "verdict", "id": I, "result": {...}, "timings": {...}}``
+  ``result`` is the checker verdict (``valid?`` / ``analyzer`` / ...);
+  ``timings`` carries ``queue_wait_s`` / ``decide_s`` / ``batch_n``
+  (how many histories shared the request's device program).
+- ``{"type": "ping"}`` → ``{"type": "pong"}``
+- ``{"type": "stats"}`` → ``{"type": "stats", "stats": {...}}``
+- ``{"type": "shutdown"}`` → ``{"type": "ok"}`` then the daemon stops
+  (the service is a trusted-network tool, like the results browser).
+
+**Indeterminate semantics** (the wire suites' client contract,
+suites/common.py): a connection lost after ``submit`` sent its frame is
+INDETERMINATE — the daemon may have decided the history and the reply
+was lost. The client completes such a submit with ``valid?
+"unknown"``, never a definite verdict it did not receive, and never
+retries the request in-place (the daemon would decide it twice;
+harmless for a pure check but wrong for queue/occupancy accounting).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+import numpy as np
+
+from jepsen_tpu import codec
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites.common import (ReconnectExhausted, SocketIO,
+                                      WireIndeterminate)
+
+DEFAULT_PORT = 8642
+
+# Registry of wire model names -> model factories: every shipped model
+# family with a device or CPU checker formulation (models/kernels.py
+# PACKED_STATE_KERNELS plus the history-sized set/queue kernels).
+MODEL_NAMES = ("cas-register", "register", "mutex", "set",
+               "unordered-queue", "fifo-queue")
+
+
+def model_by_name(name: str):
+    """Instantiate a fresh model from its wire name."""
+    from jepsen_tpu import models as m
+
+    factories = {"cas-register": m.cas_register, "register": m.register,
+                 "mutex": m.mutex, "set": m.set_model,
+                 "unordered-queue": m.unordered_queue,
+                 "fifo-queue": m.fifo_queue}
+    if name not in factories:
+        raise ValueError(
+            f"unknown model {name!r}; known: {', '.join(MODEL_NAMES)}")
+    return factories[name]()
+
+
+def jsonable(v):
+    """Recursively convert a verdict/stats structure to codec-safe
+    values: numpy scalars -> Python numbers, numpy arrays -> lists,
+    anything else unserializable -> repr (verdicts carry LinOp-shaped
+    dicts and host-stats; no consumer round-trips those as objects)."""
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return [jsonable(x) for x in v.tolist()]
+    if isinstance(v, dict):
+        return {(k if isinstance(k, str) else repr(k)): jsonable(x)
+                for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [jsonable(x) for x in v]
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    return repr(v)
+
+
+def send_msg(io: SocketIO, msg: dict) -> None:
+    payload = codec.encode(msg)
+    io.send(struct.pack(">I", len(payload)) + payload)
+
+
+def read_msg(io: SocketIO) -> dict:
+    (n,) = struct.unpack(">I", io.read_exact(4))
+    return codec.decode(io.read_exact(n))
+
+
+def history_to_wire(history) -> list[dict]:
+    return [op.to_dict() if isinstance(op, Op) else dict(op)
+            for op in history]
+
+
+def history_from_wire(ops: list[dict]) -> list[Op]:
+    return [Op.from_dict(d) for d in ops]
+
+
+class CheckerClient:
+    """Synchronous client for the checker daemon.
+
+    One in-flight request per client instance; concurrency = more
+    clients (each holds one connection; the daemon interleaves bins
+    across connections). ``submit`` returns the verdict dict, or an
+    ``{"valid?": "unknown", "error": ...}`` indeterminate when the
+    connection died after the request may have reached the daemon.
+    """
+
+    def __init__(self, host: str = "127.0.0.1",
+                 port: int = DEFAULT_PORT, timeout: float = 600.0):
+        self.io = SocketIO(connect=lambda: socket.create_connection(
+            (host, port), timeout=timeout))
+        self._next_id = 0
+
+    def _rpc(self, msg: dict) -> dict:
+        self.io.ensure_connected()
+        send_msg(self.io, msg)
+        return read_msg(self.io)
+
+    def submit(self, model_name: str, history, req_id=None) -> dict:
+        """Submit one history for checking; blocks for the verdict.
+        Returns the result dict; ``_timings`` carries the daemon-side
+        queue-wait/decide/batch-occupancy observability."""
+        self._next_id += 1
+        rid = req_id if req_id is not None else self._next_id
+        try:
+            resp = self._rpc({"type": "check", "id": rid,
+                              "model": model_name,
+                              "history": history_to_wire(history)})
+            # One request in flight per client, but be defensive about
+            # a stray frame (e.g. a daemon-side bug double-answering):
+            # never attribute another request's verdict to this one.
+            while resp.get("type") == "verdict" \
+                    and resp.get("id") != rid:
+                resp = read_msg(self.io)
+        except WireIndeterminate as e:
+            # The request may have reached (and been decided by) the
+            # daemon; only the REPLY is known lost. Indeterminate.
+            return {"valid?": "unknown",
+                    "error": f"indeterminate: {e}"}
+        if resp.get("type") == "error":
+            return {"valid?": "unknown",
+                    "error": resp.get("error", "daemon error")}
+        out = dict(resp.get("result") or {})
+        if resp.get("timings"):
+            out["_timings"] = resp["timings"]
+        return out
+
+    def ping(self) -> bool:
+        try:
+            return self._rpc({"type": "ping"}).get("type") == "pong"
+        except (WireIndeterminate, ReconnectExhausted, OSError):
+            return False
+
+    def stats(self) -> dict:
+        return self._rpc({"type": "stats"}).get("stats", {})
+
+    def shutdown(self) -> None:
+        try:
+            self._rpc({"type": "shutdown"})
+        except (WireIndeterminate, ReconnectExhausted, OSError):
+            pass  # the daemon may close before/while acking
+
+    def close(self) -> None:
+        self.io.close()

@@ -1,0 +1,74 @@
+"""`make serve-smoke`: start daemon -> submit -> assert -> shutdown.
+
+A FRESH-process proof (the dryrun_multichip contract: it forces the
+CPU platform itself, before any backend init) that the checker daemon
+round-trips real verdicts: an ephemeral-port daemon on the 8-device
+CPU mesh, three histories of different models submitted over a real
+socket, verdicts asserted against the CPU oracle, clean shutdown.
+Prints one JSON result line and exits 0/1 — timeout-guarded by the
+Makefile so a wedge cannot hold the shell.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    # CPU mesh BEFORE any jax backend init (CLAUDE.md: the TPU plugin
+    # force-selects its platform; the smoke must never take the chip).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu.lin import cpu, prepare, synth
+    from jepsen_tpu.service.daemon import CheckerService
+    from jepsen_tpu.service.protocol import CheckerClient
+
+    svc = CheckerService("127.0.0.1", 0, flush_ms_=20).start()
+    out = {"port": svc.port, "checks": []}
+    ok = True
+    try:
+        client = CheckerClient("127.0.0.1", svc.port)
+        cases = [
+            ("cas-register", m.cas_register(),
+             synth.generate_register_history(
+                 60, concurrency=4, seed=1, crash_prob=0.05,
+                 max_crashes=3)),
+            ("register", m.register(),
+             synth.corrupt_history(synth.generate_register_history(
+                 40, concurrency=3, seed=2, fs=("read", "write")),
+                 seed=2)),
+            ("mutex", m.mutex(),
+             synth.generate_mutex_history(40, concurrency=4, seed=3)),
+        ]
+        for name, model, h in cases:
+            want = cpu.check_packed(prepare.prepare(model, h))["valid?"]
+            got = client.submit(name, h)
+            rec = {"model": name, "want": want,
+                   "got": got.get("valid?"),
+                   "analyzer": got.get("analyzer"),
+                   "timings": got.get("_timings")}
+            out["checks"].append(rec)
+            ok = ok and got.get("valid?") == want
+        out["stats"] = {k: v for k, v in client.stats().items()
+                        if k in ("submitted", "decided", "batches",
+                                 "avg_occupancy", "xla_compiles")}
+        client.shutdown()
+        client.close()
+    finally:
+        svc.stop()
+    out["ok"] = ok
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

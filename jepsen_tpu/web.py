@@ -5,6 +5,13 @@ the ``store/`` directory — a home table of runs with valid?-colored rows
 (web.clj:116-128), a directory browser with text/image previews
 (web.clj:194-229), and zip downloads of whole runs (web.clj:250-271).
 Python's http.server replaces http-kit/ring/hiccup.
+
+``/service`` renders the checker daemon's latest stats snapshot (the
+daemon writes it to ``JEPSEN_TPU_SERVICE_STATS`` on a cadence and at
+shutdown) — queue depths, batch occupancy, verdict counters, latency
+percentiles — so the browser shows the serving side next to the runs
+it decided, without the web process holding a wire connection to the
+daemon.
 """
 
 from __future__ import annotations
@@ -68,6 +75,7 @@ def home_html(base: Path) -> str:
             "body{font-family:sans-serif} table{border-collapse:collapse}"
             "td,th{padding:4px 12px;border:1px solid #ccc}"
             "</style></head><body><h1>jepsen-tpu results</h1>"
+            '<p><a href="/service">checker service stats</a></p>'
             "<table><tr><th>test</th><th>run</th><th>valid?</th>"
             "<th>download</th></tr>" + "".join(rows) +
             "</table></body></html>")
@@ -92,6 +100,50 @@ def dir_html(base: Path, rel: str) -> str:
             "</ul></body></html>")
 
 
+def service_html(stats_file: str | None = None) -> str:
+    """The /service page: the checker daemon's last stats snapshot
+    rendered as tables (scalars, then the per-bin dicts), with the raw
+    JSON below for anything a table flattens badly."""
+    from jepsen_tpu.service import daemon as service_daemon
+
+    path = stats_file or service_daemon.stats_path()
+    head = ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            "<title>checker service</title><style>"
+            "body{font-family:sans-serif} table{border-collapse:collapse;"
+            "margin-bottom:1em} td,th{padding:3px 10px;"
+            "border:1px solid #ccc} th{text-align:left}"
+            "</style></head><body><h1>checker service</h1>"
+            '<p><a href="/">home</a></p>')
+    try:
+        with open(path) as fh:
+            snap = json.load(fh)
+    except (OSError, ValueError) as e:
+        return (head + f"<p>no stats snapshot at "
+                f"<code>{_html.escape(str(path))}</code> "
+                f"({_html.escape(str(e))}) — is the daemon running "
+                f"(<code>cli.py serve-checker</code>)?</p>"
+                "</body></html>")
+
+    def table(title, items):
+        rows = "".join(
+            f"<tr><th>{_html.escape(str(k))}</th>"
+            f"<td>{_html.escape(str(v))}</td></tr>"
+            for k, v in items)
+        return f"<h2>{_html.escape(title)}</h2><table>{rows}</table>"
+
+    scalars = sorted((k, v) for k, v in snap.items()
+                     if not isinstance(v, (dict, list)))
+    parts = [head, table("counters & gauges", scalars)]
+    for k in sorted(k for k, v in snap.items() if isinstance(v, dict)):
+        if snap[k]:
+            parts.append(table(k, sorted(snap[k].items())))
+    parts.append("<h2>raw</h2><pre>"
+                 + _html.escape(json.dumps(snap, indent=1,
+                                           sort_keys=True))
+                 + "</pre></body></html>")
+    return "".join(parts)
+
+
 def zip_run(base: Path, rel: str) -> bytes:
     """Zip a run directory in memory (web.clj:250-271 streams; runs are
     small enough to buffer)."""
@@ -107,6 +159,7 @@ def zip_run(base: Path, rel: str) -> bytes:
 
 class _Handler(BaseHTTPRequestHandler):
     base: Path = Path("store")
+    stats_file: str | None = None   # None -> the daemon's default path
 
     def log_message(self, fmt, *args):  # route through logging
         log.debug(fmt, *args)
@@ -132,6 +185,9 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if path == "/" or path == "":
                 self._send(200, home_html(self.base).encode())
+            elif path == "/service":
+                self._send(200,
+                           service_html(self.stats_file).encode())
             elif path.startswith("/zip/"):
                 rel = self._safe_rel(path[len("/zip/"):].strip("/"))
                 if rel is None:
@@ -173,9 +229,10 @@ class _Handler(BaseHTTPRequestHandler):
                 pass
 
 
-def make_server(host="0.0.0.0", port=8080, base="store") \
-        -> ThreadingHTTPServer:
-    handler = type("Handler", (_Handler,), {"base": Path(base)})
+def make_server(host="0.0.0.0", port=8080, base="store",
+                stats_file: str | None = None) -> ThreadingHTTPServer:
+    handler = type("Handler", (_Handler,),
+                   {"base": Path(base), "stats_file": stats_file})
     return ThreadingHTTPServer((host, port), handler)
 
 

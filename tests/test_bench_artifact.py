@@ -191,6 +191,55 @@ def test_stall_retries_once_and_records(bench, tmp_path, monkeypatch):
     assert "stalled" in r["first_attempt"]["error"]
 
 
+def test_service_probe_in_order_and_registry(bench):
+    # The checker-service probe is a first-class artifact citizen:
+    # registered, and ordered BEFORE the long/dangerous partitioned
+    # probe (safe-first) so a config-5 fault can never shadow the
+    # service throughput number.
+    keys = [k for k, _t in bench.PROBE_ORDER]
+    assert "service_c30" in keys
+    assert keys.index("service_c30") < keys.index("partitioned_c30")
+    assert "service_c30" in bench.PROBES
+
+
+def test_service_probe_result_passes_through_with_kill_record(
+        bench, monkeypatch, capsys):
+    # The artifact contract for service_c30: the parent re-emits after
+    # the probe (loss-proof), and the probe's throughput/latency keys
+    # and any teardown kill record reach detail verbatim — the parent
+    # must never strip or reshape them.
+    monkeypatch.setattr(bench, "PROBE_ORDER",
+                        (("service_c30", 60),
+                         ("partitioned_c30", 100)))
+    service_result = {
+        "n_histories": 120, "histories_per_sec": 41.7,
+        "latency_p50_s": 0.12, "latency_p99_s": 1.9,
+        "verdict": True,
+        "service_stats": {"avg_occupancy": 3.9, "batches": 27},
+        "teardown_kill": {"why": "stall", "sigkill": False,
+                          "last_hb": 9}}
+
+    def fake_probe(key, timeout, env_extra=None, stall_s=None):
+        if key == "service_c30":
+            return dict(service_result)
+        return {"verdict": True, "probe": key}
+
+    monkeypatch.setattr(bench, "_run_probe", fake_probe)
+    out = {"metric": "m", "value": 1, "detail": {}}
+    bench._wide_probes(out["detail"], out, time.time())
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.splitlines() if ln.strip()]
+    # Re-emitted the moment the service probe completed: the FIRST
+    # line already carries it (an external kill before the partitioned
+    # probe keeps the service numbers).
+    assert "service_c30" in lines[0]["detail"]
+    got = out["detail"]["service_c30"]
+    assert got["histories_per_sec"] == 41.7
+    assert got["latency_p50_s"] == 0.12 and got["latency_p99_s"] == 1.9
+    assert got["service_stats"]["avg_occupancy"] == 3.9
+    assert got["teardown_kill"]["why"] == "stall"
+
+
 def test_wide_probes_reemit_after_every_probe(bench, monkeypatch,
                                               capsys):
     # The loss-proof contract: the full result line is re-printed after

@@ -1,0 +1,684 @@
+"""Checker-as-a-service tests (jepsen_tpu.service).
+
+Three layers, mirroring the subsystem's pipeline:
+
+- Unit: shape-bin keys, the batch-decline reasons (lin.batched.Decline),
+  worker batch/fault semantics via fabricated requests — no sockets,
+  no device (stub check/batch fns), quick tier.
+- Wire: in-process daemon over real sockets with stub device paths —
+  client drop mid-request, backpressure, wedge-hook injection,
+  requeue-once-then-honest-fail — quick tier.
+- Device: round-trip verdict parity vs lin/cpu.py for every shipped
+  model kernel, and the mixed-shape batching acceptance shape
+  (occupancy > 1) — real traces, `compiles`-marked.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import pytest
+
+# Engine modules imported at COLLECTION time: bfs/dense build tiny
+# module-level jnp constants whose one-off compiles must land outside
+# the quick tier's per-test no-compile window (tests/conftest.py).
+import jepsen_tpu.lin.batched   # noqa: F401
+import jepsen_tpu.lin.dense     # noqa: F401
+
+pytestmark = pytest.mark.quick
+
+
+def _mk_service(tmp_path, monkeypatch, **kw):
+    from jepsen_tpu.service.daemon import CheckerService
+
+    monkeypatch.setenv("JEPSEN_TPU_QUARANTINE",
+                       str(tmp_path / "quarantine.json"))
+    kw.setdefault("stats_file", str(tmp_path / "service_stats.json"))
+    kw.setdefault("flush_ms_", 10)
+    return CheckerService("127.0.0.1", 0, **kw)
+
+
+def _stub_check(packed, model, history):
+    return {"valid?": True, "analyzer": "stub-single"}
+
+
+def _stub_batch(model, subs, declines=None):
+    return {rid: {"valid?": True, "analyzer": "stub-batch"}
+            for rid in subs}
+
+
+def _hist(n=20, concurrency=3, seed=0, **kw):
+    from jepsen_tpu.lin import synth
+
+    return synth.generate_register_history(
+        n, concurrency=concurrency, seed=seed, value_range=3, **kw)
+
+
+class TestBinKey:
+    def test_same_shape_same_bin(self):
+        from jepsen_tpu import models as m
+        from jepsen_tpu.lin import prepare
+        from jepsen_tpu.service.daemon import bin_key
+
+        k1 = bin_key(prepare.prepare(m.cas_register(), _hist(seed=1)))
+        k2 = bin_key(prepare.prepare(m.cas_register(), _hist(seed=2)))
+        assert k1 == k2
+        assert k1.startswith("svc-dense|")
+
+    def test_shape_axes_split_bins(self):
+        from jepsen_tpu import models as m
+        from jepsen_tpu.lin import prepare
+        from jepsen_tpu.service.daemon import bin_key
+
+        base = bin_key(prepare.prepare(m.cas_register(), _hist(seed=1)))
+        # Different kernel -> different bin.
+        from jepsen_tpu.lin import synth
+
+        mu = bin_key(prepare.prepare(m.mutex(),
+                                     synth.generate_mutex_history(
+                                         20, concurrency=3, seed=1)))
+        assert mu != base and "mutex" in mu
+        # Much longer history -> different row bucket.
+        long = bin_key(prepare.prepare(m.cas_register(),
+                                       _hist(n=400, seed=1)))
+        assert long != base
+        # Wide window -> sparse route (deterministic window-24
+        # cas-chain spike, past the dense bound 20).
+        from jepsen_tpu.history import History, invoke_op, ok_op
+
+        ops = [invoke_op(0, "write", 0), ok_op(0, "write", 0)]
+        ops += [invoke_op(i + 1, "cas", [i, i + 1]) for i in range(24)]
+        ops += [ok_op(i + 1, "cas", [i, i + 1]) for i in range(24)]
+        wide = bin_key(prepare.prepare(m.cas_register(),
+                                       History.of(*ops)))
+        assert wide.startswith("svc-sparse|")
+
+
+class TestBatchDeclines:
+    """lin.batched's structured decline reasons (the satellite): the
+    service scheduler must see WHY a bin fell through, not a bare
+    None."""
+
+    def test_dense_rows_ceiling_names_axis(self, monkeypatch):
+        from jepsen_tpu import models as m
+        from jepsen_tpu.lin import batched, prepare
+
+        monkeypatch.setattr(batched, "MAX_BATCH_ROWS", 4)
+        packed = {k: prepare.prepare(m.cas_register(), _hist(seed=k))
+                  for k in range(2)}
+        d = batched._try_dense_batch(packed)
+        assert isinstance(d, batched.Decline)
+        assert not d                       # falsy: `or` chains keep working
+        assert d.axis == "rows"
+        assert "MAX" not in d.detail or d.detail  # human-readable detail
+        assert d.keys == [0, 1]
+
+    def test_no_kernel_declines_per_key(self):
+        from jepsen_tpu import models as m
+        from jepsen_tpu.history import History, invoke_op, ok_op
+        from jepsen_tpu.lin import batched
+
+        # A set history with a None element has no device kernel.
+        h = History.of(invoke_op(0, "add", None),
+                       ok_op(0, "add", None))
+        declines: list = []
+        res = batched.try_check_batch(m.SetModel(), {"k": h},
+                                      declines=declines)
+        assert res is None
+        assert [d.axis for d in declines] == ["kernel"]
+
+    def test_unpackable_history_declines(self):
+        from jepsen_tpu import models as m
+        from jepsen_tpu.history import History, invoke_op
+        from jepsen_tpu.lin import batched
+
+        # 70 concurrent pending invokes: window > MAX_WINDOW (64).
+        h = History.of(*[invoke_op(i, "write", 1) for i in range(70)])
+        declines: list = []
+        res = batched.try_check_batch(m.cas_register(), {"k": h},
+                                      declines=declines)
+        assert res is None
+        assert [d.axis for d in declines] == ["prepare"]
+
+    def test_window_overflow_declines_group(self):
+        from jepsen_tpu import models as m
+        from jepsen_tpu.history import History, invoke_op, ok_op
+        from jepsen_tpu.lin import batched
+
+        # Window exactly 64 packs (MAX_WINDOW) but the sparse batch
+        # needs window+1 pad slots > MAX_DEVICE_WINDOW: group declines
+        # on the window axis before any device work.
+        ops = [invoke_op(i, "write", 1) for i in range(64)]
+        ops += [ok_op(i, "write", 1) for i in range(64)]
+        declines: list = []
+        res = batched.try_check_batch(m.cas_register(),
+                                      {"k": History.of(*ops)},
+                                      declines=declines)
+        assert res is None
+        assert [d.axis for d in declines] == ["window"]
+        assert "dense declined" in declines[0].detail
+
+
+class TestWorkerSemantics:
+    """_process_batch directly, with fabricated requests — the batch/
+    fallthrough/fault state machine without socket timing."""
+
+    def _reqs(self, svc, n, out, model=None, **hist_kw):
+        from jepsen_tpu import models as m
+        from jepsen_tpu.lin import prepare, supervise
+        from jepsen_tpu.service.daemon import Request, bin_key
+
+        model = model or m.cas_register()
+        reqs = []
+        for i in range(n):
+            h = _hist(seed=i, **hist_kw)
+            p = prepare.prepare(model, h)
+            reqs.append(Request(
+                rid=i, model_name="cas-register", model=model,
+                history=h, packed=p, bin=bin_key(p),
+                fingerprint=supervise.history_fingerprint(p),
+                respond=lambda msg, i=i: out.append((i, msg))))
+        return reqs
+
+    def test_same_bin_decides_as_one_batch(self, tmp_path,
+                                           monkeypatch):
+        calls = []
+
+        def batch_fn(model, subs, declines=None):
+            calls.append(dict(subs))
+            return _stub_batch(model, subs)
+
+        svc = _mk_service(tmp_path, monkeypatch, batch_fn=batch_fn,
+                          check_fn=_stub_check)
+        out: list = []
+        svc._process_batch(self._reqs(svc, 4, out))
+        assert len(calls) == 1, "one vmapped program for the bin"
+        assert len(out) == 4
+        assert all(msg["result"]["analyzer"] == "stub-batch"
+                   for _i, msg in out)
+        assert all(msg["timings"]["batch_n"] >= 4 for _i, msg in out)
+        st = svc.stats()
+        assert st["batches"] == 1 and st["batched_requests"] == 4
+        assert st["max_occupancy"] == 4 and st["avg_occupancy"] == 4
+
+    def test_colliding_client_rids_both_answered(self, tmp_path,
+                                                 monkeypatch):
+        # Two clients' auto-ids collide routinely (each instance
+        # counts 1, 2, ...): two same-bin requests with EQUAL rids but
+        # different histories must both decide — the batch is keyed by
+        # fingerprint, never by the client-chosen rid.
+        from jepsen_tpu import models as m
+        from jepsen_tpu.lin import prepare, supervise
+        from jepsen_tpu.service.daemon import Request, bin_key
+
+        def batch_fn(model, subs, declines=None):
+            return {fp: {"valid?": True, "analyzer": "stub-batch",
+                         "fp": fp} for fp in subs}
+
+        svc = _mk_service(tmp_path, monkeypatch, batch_fn=batch_fn,
+                          check_fn=_stub_check)
+        out: list = []
+        model = m.cas_register()
+        reqs = []
+        for i in range(2):
+            h = _hist(seed=i)          # different histories...
+            p = prepare.prepare(model, h)
+            reqs.append(Request(
+                rid=1,                 # ...same client-chosen rid
+                model_name="cas-register", model=model, history=h,
+                packed=p, bin=bin_key(p),
+                fingerprint=supervise.history_fingerprint(p),
+                respond=lambda msg, i=i: out.append((i, msg))))
+        assert reqs[0].bin == reqs[1].bin
+        assert reqs[0].fingerprint != reqs[1].fingerprint
+        svc._process_batch(reqs)
+        assert len(out) == 2, "a rid collision must not drop a request"
+        # Each got ITS OWN history's verdict, not the collision twin's.
+        answered_fps = {msg["result"]["fp"] for _i, msg in out}
+        assert answered_fps == {r.fingerprint for r in reqs}
+
+    def test_batch_pads_key_axis_to_pow2(self, tmp_path, monkeypatch):
+        seen = {}
+
+        def batch_fn(model, subs, declines=None):
+            seen["n"] = len(subs)
+            return _stub_batch(model, subs)
+
+        svc = _mk_service(tmp_path, monkeypatch, batch_fn=batch_fn,
+                          check_fn=_stub_check)
+        out: list = []
+        svc._process_batch(self._reqs(svc, 5, out))
+        assert seen["n"] == 8, "key axis padded 5 -> 8 (zero retrace)"
+        assert len(out) == 5   # pad keys never answered
+        assert svc.stats()["pad_keys"] == 3
+
+    def test_batch_decline_falls_through_with_reason(self, tmp_path,
+                                                     monkeypatch):
+        from jepsen_tpu.lin.batched import Decline
+
+        def batch_fn(model, subs, declines=None):
+            declines.append(Decline("window", "too wide",
+                                    keys=list(subs)))
+            return None
+
+        svc = _mk_service(tmp_path, monkeypatch, batch_fn=batch_fn,
+                          check_fn=_stub_check)
+        out: list = []
+        svc._process_batch(self._reqs(svc, 3, out))
+        assert len(out) == 3
+        assert all(msg["result"]["analyzer"] == "stub-single"
+                   for _i, msg in out)
+        st = svc.stats()
+        assert st["decline_axes"] == {"window": 4}  # padded to 4 keys
+        assert st["single_requests"] == 3
+        assert st.get("batches") is None or st["batches"] == 0
+
+    def test_batch_fault_requeues_once_as_singles(self, tmp_path,
+                                                  monkeypatch):
+        def batch_fn(model, subs, declines=None):
+            raise RuntimeError("kernel fault")
+
+        svc = _mk_service(tmp_path, monkeypatch, batch_fn=batch_fn,
+                          check_fn=_stub_check)
+        out: list = []
+        reqs = self._reqs(svc, 3, out)
+        svc._process_batch(reqs)
+        # Nothing answered yet: every request rode its one requeue.
+        assert out == []
+        requeued = []
+        while not svc._queue.empty():
+            requeued.append(svc._queue.get_nowait())
+        assert len(requeued) == 3
+        assert all(r.attempts == 1 and r.no_batch for r in requeued)
+        assert svc.stats()["requeues"] == 3
+        # The requeued batch goes down the SINGLES path (off the
+        # suspect batch program) and decides.
+        svc._process_batch(requeued)
+        assert len(out) == 3
+        assert all(msg["result"]["analyzer"] == "stub-single"
+                   for _i, msg in out)
+
+    def test_second_fault_fails_honestly(self, tmp_path, monkeypatch):
+        def bad_check(packed, model, history):
+            raise RuntimeError("still faulting")
+
+        def batch_fn(model, subs, declines=None):
+            raise RuntimeError("kernel fault")
+
+        svc = _mk_service(tmp_path, monkeypatch, batch_fn=batch_fn,
+                          check_fn=bad_check)
+        out: list = []
+        svc._process_batch(self._reqs(svc, 2, out))
+        requeued = []
+        while not svc._queue.empty():
+            requeued.append(svc._queue.get_nowait())
+        svc._process_batch(requeued)
+        assert len(out) == 2
+        for _i, msg in out:
+            assert msg["result"]["valid?"] == "unknown"
+            assert msg["result"]["overflow"] == "fault"
+        assert svc.stats()["honest_fails"] == 2
+
+    def test_fault_records_bin_shape_in_ledger(self, tmp_path,
+                                               monkeypatch):
+        from jepsen_tpu.lin import supervise
+
+        def batch_fn(model, subs, declines=None):
+            raise RuntimeError("kernel fault")
+
+        svc = _mk_service(tmp_path, monkeypatch, batch_fn=batch_fn,
+                          check_fn=_stub_check)
+        out: list = []
+        reqs = self._reqs(svc, 2, out)
+        svc._process_batch(reqs)
+        ledger = supervise.load_ledger()
+        assert reqs[0].bin in ledger
+        assert ledger[reqs[0].bin]["reason"] == "fault"
+
+
+class TestWire:
+    """Real sockets, stub device paths."""
+
+    def _start(self, tmp_path, monkeypatch, **kw):
+        kw.setdefault("check_fn", _stub_check)
+        kw.setdefault("batch_fn", _stub_batch)
+        svc = _mk_service(tmp_path, monkeypatch, **kw).start()
+        return svc
+
+    def test_round_trip_and_stats(self, tmp_path, monkeypatch):
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        svc = self._start(tmp_path, monkeypatch)
+        try:
+            c = CheckerClient("127.0.0.1", svc.port)
+            assert c.ping()
+            r = c.submit("cas-register", _hist())
+            assert r["valid?"] is True
+            assert r["_timings"]["batch_n"] >= 1
+            st = c.stats()
+            assert st["submitted"] == 1 and st["decided"] == 1
+            c.close()
+        finally:
+            svc.stop()
+
+    def test_unknown_model_is_error_not_crash(self, tmp_path,
+                                              monkeypatch):
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        svc = self._start(tmp_path, monkeypatch)
+        try:
+            c = CheckerClient("127.0.0.1", svc.port)
+            r = c.submit("no-such-model", _hist())
+            assert r["valid?"] == "unknown"
+            assert "unknown model" in r["error"]
+            # The daemon is still serving.
+            assert c.submit("cas-register", _hist())["valid?"] is True
+            c.close()
+        finally:
+            svc.stop()
+
+    def test_client_drop_mid_request_daemon_survives(self, tmp_path,
+                                                     monkeypatch):
+        from jepsen_tpu.service import protocol
+        from jepsen_tpu.service.protocol import CheckerClient
+        from jepsen_tpu.suites.common import SocketIO
+
+        decided = threading.Event()
+
+        def slow_check(packed, model, history):
+            time.sleep(0.3)
+            decided.set()
+            return {"valid?": True, "analyzer": "stub-single"}
+
+        svc = self._start(tmp_path, monkeypatch, check_fn=slow_check,
+                          batch_fn=lambda m, s, declines=None: None)
+        try:
+            # Raw wire client: submit, then DROP before the verdict.
+            io = SocketIO(socket.create_connection(
+                ("127.0.0.1", svc.port), timeout=5))
+            protocol.send_msg(io, {
+                "type": "check", "id": 1, "model": "cas-register",
+                "history": protocol.history_to_wire(_hist())})
+            io.close()
+            assert decided.wait(10), "daemon must still decide"
+            # The daemon survived: a fresh client round-trips, and the
+            # dropped reply is visible in stats, not a crash.
+            c = CheckerClient("127.0.0.1", svc.port)
+            assert c.submit("cas-register", _hist())["valid?"] is True
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if c.stats().get("dropped_responses", 0) >= 1:
+                    break
+                time.sleep(0.05)
+            assert c.stats()["dropped_responses"] >= 1
+            c.close()
+        finally:
+            svc.stop()
+
+    def test_backpressure_overload_response(self, tmp_path,
+                                            monkeypatch):
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        gate = threading.Event()
+
+        def gated_check(packed, model, history):
+            gate.wait(10)
+            return {"valid?": True, "analyzer": "stub-single"}
+
+        svc = self._start(tmp_path, monkeypatch, bound=1,
+                          check_fn=gated_check,
+                          batch_fn=lambda m, s, declines=None: None,
+                          flush_ms_=5)
+        try:
+            results: dict = {}
+
+            def submit(tag):
+                c = CheckerClient("127.0.0.1", svc.port)
+                results[tag] = c.submit("cas-register", _hist())
+                c.close()
+
+            threads = [threading.Thread(target=submit, args=(i,))
+                       for i in range(6)]
+            for t in threads:
+                t.start()
+                time.sleep(0.05)
+            # With the worker gated and bound=1, some submits must see
+            # the overload answer immediately (not hang).
+            deadline = time.time() + 5
+            while time.time() < deadline and not any(
+                    "overload" in str(r.get("error", ""))
+                    for r in results.values()):
+                time.sleep(0.05)
+            gate.set()
+            for t in threads:
+                t.join(10)
+            assert any("overload" in str(r.get("error", ""))
+                       for r in results.values())
+            assert all(r["valid?"] in (True, "unknown")
+                       for r in results.values())
+        finally:
+            gate.set()
+            svc.stop()
+
+    def test_wedge_hook_costs_bin_not_daemon(self, tmp_path,
+                                             monkeypatch):
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        # The supervise injection fake-wedges the NEXT service-check
+        # dispatch (0.2 s injected deadline); retries=0 at the service
+        # site => honest `overflow: wedge` unknown for that request,
+        # and the daemon keeps serving.
+        monkeypatch.setenv("JEPSEN_TPU_WEDGE", "service-check:1:0.2")
+        svc = self._start(tmp_path, monkeypatch, deadline_s=0.2,
+                          batch_fn=lambda m, s, declines=None: None)
+        try:
+            c = CheckerClient("127.0.0.1", svc.port)
+            r = c.submit("cas-register", _hist())
+            assert r["valid?"] == "unknown"
+            assert r["overflow"] == "wedge"
+            # Injection consumed: the next request decides normally.
+            assert c.submit("cas-register", _hist())["valid?"] is True
+            st = c.stats()
+            assert st["wedged_requests"] == 1
+            assert st["watchdog_trips"] >= 1
+            c.close()
+        finally:
+            svc.stop()
+
+    def test_shutdown_message_stops_daemon(self, tmp_path,
+                                           monkeypatch):
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        svc = self._start(tmp_path, monkeypatch)
+        c = CheckerClient("127.0.0.1", svc.port)
+        assert c.submit("cas-register", _hist())["valid?"] is True
+        c.shutdown()
+        c.close()
+        deadline = time.time() + 10
+        while time.time() < deadline and not svc._stop.is_set():
+            time.sleep(0.05)
+        assert svc._stop.is_set()
+        svc.stop()   # idempotent
+        # Stats snapshot written at stop (the /service page's source).
+        import json
+
+        snap = json.loads((tmp_path / "service_stats.json").read_text())
+        assert "submitted" in snap and "addr" in snap
+
+
+class TestServiceWebAndCli:
+    def test_web_service_page_renders_snapshot(self, tmp_path):
+        import json
+        import urllib.request
+
+        from jepsen_tpu import web
+
+        stats = tmp_path / "service_stats.json"
+        stats.write_text(json.dumps(
+            {"submitted": 7, "avg_occupancy": 3.5,
+             "bin_depths": {"svc-dense|rows32|cap8|w4|cas-register": 2}}))
+        srv = web.make_server(host="127.0.0.1", port=0,
+                              base=str(tmp_path),
+                              stats_file=str(stats))
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/service"
+            with urllib.request.urlopen(url, timeout=5) as r:
+                body = r.read().decode()
+            assert "avg_occupancy" in body and "3.5" in body
+            assert "svc-dense|rows32|cap8|w4|cas-register" in body
+            # Home page links to it.
+            with urllib.request.urlopen(
+                    url.rsplit("/", 1)[0] + "/", timeout=5) as r:
+                assert "/service" in r.read().decode()
+        finally:
+            srv.shutdown()
+
+    def test_web_service_page_without_snapshot(self, tmp_path):
+        from jepsen_tpu import web
+
+        html = web.service_html(str(tmp_path / "missing.json"))
+        assert "no stats snapshot" in html
+
+    def test_cli_service_stats_snapshot_fallback(self, tmp_path,
+                                                 capsys):
+        import json
+
+        from jepsen_tpu import cli
+
+        snap = tmp_path / "stats.json"
+        snap.write_text(json.dumps({"submitted": 3}))
+        rc = cli.run(cli.standard_commands(),
+                     ["service-stats", "--file", str(snap)])
+        assert rc == cli.EXIT_OK
+        out = json.loads(capsys.readouterr().out)
+        assert out["source"] == "snapshot"
+        assert out["stats"]["submitted"] == 3
+
+    def test_cli_registry_names_and_help(self):
+        from jepsen_tpu import cli
+
+        names = [c["name"] for c in cli.standard_commands()]
+        assert "serve" in names and "serve-checker" in names
+        assert "service-stats" in names and "quarantine" in names
+        # The two daemons disambiguate each other in their help text.
+        by_name = {c["name"]: c for c in cli.standard_commands()}
+        assert "serve-checker" in by_name["serve"]["help"]
+        assert "daemon" in by_name["serve-checker"]["help"]
+        # Suite command sets carry the registry too.
+        suite = [c["name"] for c in cli.suite_commands(lambda o: o)]
+        assert "serve-checker" in suite and "quarantine" in suite
+
+
+@pytest.mark.compiles
+class TestDeviceParity:
+    """Real engines on the CPU mesh: wire round-trip verdict parity vs
+    the lin/cpu.py oracle for every shipped model kernel family, and
+    the mixed-shape acceptance shape (>=100 histories, occupancy > 1)."""
+
+    def _cases(self):
+        from jepsen_tpu import models as m
+        from jepsen_tpu.lin import synth
+
+        return [
+            ("cas-register", m.cas_register,
+             _hist(n=30, seed=1, crash_prob=0.05, max_crashes=2)),
+            ("register", m.register,
+             synth.corrupt_history(
+                 _hist(n=24, seed=2, fs=("read", "write")), seed=2)),
+            ("mutex", m.mutex,
+             synth.generate_mutex_history(24, concurrency=3, seed=3)),
+            ("set", m.set_model,
+             synth.generate_set_history(24, concurrency=3, seed=4)),
+            ("unordered-queue", m.unordered_queue,
+             synth.generate_queue_history(24, concurrency=3, seed=5)),
+            ("fifo-queue", m.fifo_queue,
+             synth.generate_queue_history(24, concurrency=3, seed=6,
+                                          fifo=True)),
+        ]
+
+    def test_round_trip_parity_every_kernel(self, tmp_path,
+                                            monkeypatch):
+        from jepsen_tpu.lin import cpu, prepare
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        svc = _mk_service(tmp_path, monkeypatch).start()
+        try:
+            c = CheckerClient("127.0.0.1", svc.port)
+            for name, factory, h in self._cases():
+                want = cpu.check_packed(
+                    prepare.prepare(factory(), h))["valid?"]
+                got = c.submit(name, h)
+                assert got["valid?"] == want, (name, got)
+            c.close()
+        finally:
+            svc.stop()
+
+    def test_hundred_mixed_histories_batch_with_parity(self, tmp_path,
+                                                       monkeypatch):
+        """The ISSUE acceptance shape: >=100 queued mixed-shape
+        histories, verdicts parity-equal to lin/cpu.py, same-shape
+        bins demonstrably batched (occupancy > 1)."""
+        from jepsen_tpu import models as m
+        from jepsen_tpu.lin import cpu, prepare, synth
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        jobs = []
+        for i in range(84):
+            jobs.append(("cas-register", m.cas_register,
+                         _hist(n=24, seed=100 + i, crash_prob=0.02,
+                               max_crashes=2)))
+        for i in range(12):
+            jobs.append(("mutex", m.mutex,
+                         synth.generate_mutex_history(
+                             20, concurrency=3, seed=i)))
+        for i in range(8):
+            h = _hist(n=24, seed=200 + i, fs=("read", "write"))
+            if i % 2:
+                h = synth.corrupt_history(h, seed=i)
+            jobs.append(("register", m.register, h))
+        assert len(jobs) >= 100
+
+        svc = _mk_service(tmp_path, monkeypatch, flush_ms_=40).start()
+        results: dict = {}
+        lock = threading.Lock()
+        it = iter(list(enumerate(jobs)))
+
+        def client_loop():
+            c = CheckerClient("127.0.0.1", svc.port)
+            while True:
+                with lock:
+                    nxt = next(it, None)
+                if nxt is None:
+                    break
+                i, (name, _f, h) = nxt
+                r = c.submit(name, h, req_id=i)
+                with lock:
+                    results[i] = r
+            c.close()
+
+        try:
+            threads = [threading.Thread(target=client_loop)
+                       for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(300)
+            stats_client = CheckerClient("127.0.0.1", svc.port)
+            st = stats_client.stats()
+            stats_client.close()
+        finally:
+            svc.stop()
+
+        assert len(results) == len(jobs)
+        for i, (name, factory, h) in enumerate(jobs):
+            want = cpu.check_packed(
+                prepare.prepare(factory(), h))["valid?"]
+            assert results[i]["valid?"] == want, (i, name, results[i])
+        # Same-shape bins demonstrably batched.
+        assert st["batches"] >= 1
+        assert st["max_occupancy"] > 1, st
+        assert st["avg_occupancy"] > 1, st
