@@ -1,13 +1,15 @@
 # jepsen_tpu development targets.
 
 .PHONY: test test-quick integration integration-local bench \
-	probe-config5 serve-smoke
+	probe-config5 serve-smoke txn-smoke
 
 # Unit + parity suite on the virtual 8-device CPU mesh (no cluster).
 # Hardware note: ~8 min on a 4-core box; the compile-heavy lin parity
 # tests make a 1-core box take well over an hour (use test-quick there).
+# Tier-1: slow-marked acceptance-scale runs (the 100k-op txn twin) are
+# excluded here and from test-quick; run them with -m slow.
 test:
-	python -m pytest tests/ -q
+	python -m pytest tests/ -q -m "not slow"
 
 # Fast tier: the no-XLA-compile tests (history/generator/nemesis math,
 # wire-protocol fakes, suite maps, checkers on hand histories) — about
@@ -19,7 +21,7 @@ test:
 TEST_QUICK_TIMEOUT ?= 900
 test-quick:
 	timeout -k 15 $(TEST_QUICK_TIMEOUT) \
-		python -m pytest tests/ -q -m quick
+		python -m pytest tests/ -q -m "quick and not slow"
 
 # Cluster integration matrix against the dockerized 1-control + 5-node
 # environment: brings the compose cluster up, then runs the per-suite
@@ -63,6 +65,17 @@ SERVE_SMOKE_TIMEOUT ?= 600
 serve-smoke:
 	timeout -k 15 $(SERVE_SMOKE_TIMEOUT) \
 		python -m jepsen_tpu.service.smoke
+
+# Txn-checker smoke (doc/txn.md): chip-free generate -> pack -> check
+# -> classify round trip on the forced CPU mesh — a healthy concurrent
+# list-append history decides valid on device, and every seeded
+# anomaly corpus (G0/G1c/G-single/G2-item/G1a) is found and classified
+# identically by the device engine and the CPU oracle. Run it after
+# touching jepsen_tpu/txn/, the txn workloads, or the checker wiring.
+TXN_SMOKE_TIMEOUT ?= 600
+txn-smoke:
+	timeout -k 15 $(TXN_SMOKE_TIMEOUT) \
+		python -m jepsen_tpu.txn.smoke
 
 PROBE_CONFIG5_TIMEOUT ?= 5400
 # Frontier checkpoint: a probe killed by the timeout (or a fault)

@@ -29,6 +29,9 @@ nothing extra. Secondary probes cover BASELINE configs 3-5:
   concurrency 30 (window ~26) — the class knossos DNFs on.
 - ``independent_keys``: 1k keys' subhistories decided in one vmapped
   device batch (config 4, independent.clj:246-296).
+- ``txn_c30``: 100k-op list-append transactional history through the
+  txn dependency-graph checker (jepsen_tpu.txn) — healthy leg plus a
+  spliced-anomaly leg with oracle parity (edges/s, anomaly counts).
 - ``partitioned_c30``: the literal config-5 shape — a 100k-op
   partition-nemesis history, 24 crashed mutators, window 49.
 
@@ -64,7 +67,7 @@ TARGET_SECONDS = 60.0
 # external timeout (BENCH_r05: rc=124, parsed=null).
 PROBE_ORDER = (("mutex_c30", 600), ("wide_window_c30", 600),
                ("independent_keys", 900), ("service_c30", 900),
-               ("partitioned_c30", 5300))
+               ("txn_c30", 900), ("partitioned_c30", 5300))
 WORKER_RESTART_S = 75
 # Overall bench wall budget the partitioned probe must fit inside
 # (env-overridable for driver environments with different budgets).
@@ -429,7 +432,71 @@ def _probe_service_c30():
     return out
 
 
+def _probe_txn_c30():
+    """Transactional anomaly checking at the 100k-op scale (ISSUE 9 /
+    ROADMAP scenario diversity): a concurrency-30 list-append history
+    checked for serializability by the txn dependency-graph engine
+    (jepsen_tpu.txn, doc/txn.md). Two legs: the HEALTHY history (the
+    backward-edge window proves acyclicity host-side — measures
+    edge-inference + pack throughput), then the SAME history with
+    spliced anomalies, whose cycles the device SCC program must find
+    and classify with oracle parity (the real device leg; its cost and
+    tier stats ride in the artifact)."""
+    from jepsen_tpu import txn
+    from jepsen_tpu.txn import synth
+
+    n_txns = 50_000
+    h = synth.generate_list_append_history(
+        n_txns, concurrency=30, keys=32, seed=7, crash_prob=0.0005)
+    n_ops = len(h)
+
+    t0 = time.time()
+    healthy = txn.check(h, consistency="serializable", algorithm="tpu")
+    healthy_s = time.time() - t0
+
+    bad = synth.splice_anomaly(
+        synth.splice_anomaly(h, "G2-item", seed=3, n=2),
+        "G-single", seed=5)
+    t0 = time.time()
+    seeded = txn.check(bad, consistency="serializable", algorithm="tpu")
+    seeded_s = time.time() - t0
+    t0 = time.time()
+    oracle_r = txn.check(bad, consistency="serializable",
+                         algorithm="cpu")
+    oracle_s = time.time() - t0
+
+    stats = seeded.get("device-stats") or {}
+    edges = stats.get("edges") or 0
+    found = sorted(seeded.get("anomaly-types") or [])
+    parity = found == sorted(oracle_r.get("anomaly-types") or []) \
+        and seeded.get("anomalies") == oracle_r.get("anomalies")
+    out = {
+        "n_ops": n_ops, "n_txns": n_txns, "edges": edges,
+        "healthy_verdict": healthy.get("valid?"),
+        "healthy_seconds": round(healthy_s, 2),
+        "edges_per_sec": round(edges / seeded_s, 1) if seeded_s else None,
+        "seeded_verdict": seeded.get("valid?"),
+        "seeded_seconds": round(seeded_s, 2),
+        "oracle_seconds": round(oracle_s, 2),
+        "anomaly_types": found,
+        "anomaly_counts": {k: len(v) for k, v in
+                           (seeded.get("anomalies") or {}).items()},
+        "witness_parity": parity,
+        "device_stats": stats,
+        "fallbacks": seeded.get("fallbacks")}
+    # Contract: healthy decides valid, every spliced anomaly class is
+    # found, and the device classification matches the oracle.
+    out["verdict"] = (healthy.get("valid?") is True
+                      and seeded.get("valid?") is False
+                      and {"G2-item", "G-single"} <= set(found)
+                      and parity)
+    if not out["verdict"]:
+        out["error"] = "txn probe contract failed (see fields)"
+    return out
+
+
 PROBES = {"ping": _probe_ping, "mutex_c30": _probe_mutex_c30,
+          "txn_c30": _probe_txn_c30,
           "wide_window_c30": _probe_wide_window_c30,
           "partitioned_c30": _probe_partitioned_c30,
           "independent_keys": _probe_independent_keys,

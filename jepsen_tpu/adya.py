@@ -51,12 +51,48 @@ def g2_checker() -> checker_ns.Checker:
         oks = [op for op in history if op.is_ok and op.f == "insert"]
         if len(oks) > 1:
             return {checker_ns.VALID: False,
+                    "insert-count": len(oks),
                     "error": f"Both inserts completed: "
                              f"{[op.value for op in oks]}"}
         # Like the reference: a key where *neither* insert succeeded tells
         # us nothing — flag it so the composed result can report coverage.
         return {checker_ns.VALID: True,
                 "insert-count": len(oks)}
+
+    return checker_ns.FnChecker(check)
+
+
+def g2_coverage_checker(inner: checker_ns.Checker) -> checker_ns.Checker:
+    """Compose the per-key G2 results into a coverage-aware top-level
+    verdict. The independent lift reports per-key ``insert-count``
+    only, so a run where NO key's race was ever exercised (every pair
+    failed, or the generator starved) reads as a clean pass — invisibly
+    vacuous. This wrapper aggregates: how many keys decided the race
+    (exactly one insert won), how many saw the anomaly (both won), how
+    many said nothing (no insert committed) — and degrades a
+    zero-coverage "valid" to an honest ``"unknown"``."""
+
+    def check(test, model, history, opts):
+        r = dict(checker_ns.check_safe(inner, test, model, history,
+                                       opts or {}))
+        results = r.get("results") or {}
+        counts = [v.get("insert-count", 0) for v in results.values()
+                  if isinstance(v, dict)]
+        exercised = sum(1 for c in counts if c == 1)
+        anomalous = sum(1 for c in counts if c > 1)
+        r["keys-total"] = len(counts)
+        r["keys-exercised"] = exercised
+        r["keys-anomalous"] = anomalous
+        r["keys-empty"] = sum(1 for c in counts if c == 0)
+        from jepsen_tpu.util import fraction
+
+        r["coverage"] = fraction(exercised + anomalous,
+                                 max(1, len(counts)))
+        if r.get(checker_ns.VALID) is True and not exercised:
+            r[checker_ns.VALID] = "unknown"
+            r["error"] = ("no key exercised the G2 race (no insert "
+                          "ever committed) — the pass is vacuous")
+        return r
 
     return checker_ns.FnChecker(check)
 
@@ -97,8 +133,45 @@ class _FakeG2Client:
 
 def workload(keys=None, faulty=None) -> dict:
     """Generator + checker + fake client for a G2 test over independent
-    keys (the workload-map shape of jepsen_tpu.suites.workloads)."""
+    keys (the workload-map shape of jepsen_tpu.suites.workloads). The
+    independent lift is wrapped in :func:`g2_coverage_checker` so the
+    top-level verdict carries race coverage, not just per-key counts."""
     return {"generator": gen.clients(g2_gen(keys)),
             "client": _FakeG2Client(faulty=faulty),
-            "checker": independent.checker(g2_checker(),
-                                           batch_device=False)}
+            "checker": g2_coverage_checker(
+                independent.checker(g2_checker(), batch_device=False))}
+
+
+def history_to_txn(history) -> list[Op]:
+    """Express a G2 history in the txn checker's list-append dialect —
+    the parity witness wiring of jepsen_tpu.txn.oracle: each insert is
+    a transaction that read the OTHER row's list (observing it empty —
+    the precondition its commit asserted) and appended its own row. A
+    history where both inserts of a pair committed becomes a 2-cycle of
+    anti-dependencies, which the txn checker must classify G2-item; a
+    serializable history converts to a valid one (parity-tested in
+    tests/test_txn_oracle.py)."""
+    out: list[Op] = []
+    for op in history:
+        if op.f != "insert":
+            continue
+        v = op.value
+        k, payload = (v[0], v[1]) if independent.is_tuple(v) else (None, v)
+        if k is None:
+            # Bare (un-lifted) values carry their key in the payload;
+            # collapsing every key onto the "None:*" namespace would
+            # alias different keys' rows into fabricated
+            # duplicate-elements convictions.
+            k = payload.get("key")
+        i = payload["id"]
+        own, other = f"{k}:{i}", f"{k}:{1 - i}"
+        invoked = [["r", other, None], ["append", own, i]]
+        if op.is_ok:
+            # The commit asserted the other row's absence: its read
+            # observed the empty list at the serialization point.
+            out.append(op.replace(f="txn",
+                                  value=[["r", other, []],
+                                         ["append", own, i]]))
+        else:
+            out.append(op.replace(f="txn", value=invoked))
+    return out

@@ -157,6 +157,34 @@ def linearizable(algorithm: str = "competition",
     return ck
 
 
+def txn_cycles(anomalies=None, consistency: str = "serializable",
+               algorithm: str = "tpu", realtime: bool | None = None) \
+        -> Checker:
+    """Validates transactional isolation of list-append histories by
+    dependency-graph cycle search (:mod:`jepsen_tpu.txn` — Elle's
+    analysis in Adya's formalization; the SQL suites' checker).
+
+    ``anomalies`` — explicit anomaly tuple (e.g. ``("G0", "G1c")``), or
+    None to derive from ``consistency`` ("serializable",
+    "snapshot-isolation", "strict-serializable", "read-committed").
+    ``algorithm`` — ``"tpu"`` (the device SCC engine with its host
+    fallback ladder) or ``"cpu"`` (the oracle).
+    ``realtime`` — force realtime edges on/off (default: on exactly for
+    strict-serializable)."""
+
+    def check(test, model, history, opts):
+        from jepsen_tpu import txn
+
+        return txn.check(list(history), anomalies=anomalies,
+                         consistency=consistency, realtime=realtime,
+                         algorithm=algorithm)
+
+    ck = FnChecker(check)
+    ck.is_txn_cycles = True
+    ck.algorithm = algorithm
+    return ck
+
+
 def queue() -> Checker:
     """Every dequeue must come from somewhere: assume every non-failing
     enqueue succeeded and only OK dequeues succeeded, then fold the model

@@ -2488,11 +2488,14 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     step_fn = p.kernel.step
 
     # Single-u32-key dedup packing: possible when the one-word state's
-    # values (interned ids or 0/1 flags; NIL remapped to nil_id) fit next
-    # to the W-bit bitset under the bit-31 invalid flag. Only the register
-    # and mutex families qualify — other one-word states (e.g. a
-    # single-value unordered-queue count) range past the intern table.
-    from jepsen_tpu.models.kernels import PACKED_STATE_KERNELS
+    # values (interned ids, 0/1 flags, or a set's element bitmask; NIL
+    # remapped to nil_id) fit next to the W-bit bitset under the bit-31
+    # invalid flag. packed_state_bound is the shared definition of that
+    # range (register/mutex bound by the intern table, one-word sets by
+    # their own state_bound) — other one-word states (e.g. a
+    # single-value unordered-queue count) stay multiword.
+    from jepsen_tpu.models.kernels import (PACKED_STATE_KERNELS,
+                                           packed_state_bound)
 
     from jepsen_tpu.models.kernels import READ_VALUE_MATCH_KERNELS
 
@@ -2503,7 +2506,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     key_hi = False
     if S == 1 and p.kernel.name in PACKED_STATE_KERNELS \
             and packed_keys is not False:
-        nid = max(len(p.unintern), 2)
+        nid = packed_state_bound(p.kernel, len(p.unintern))
         b = nid.bit_length()
         if p.window + b <= 31:
             state_bits, nil_id = b, nid

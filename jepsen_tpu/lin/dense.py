@@ -70,7 +70,8 @@ _NS_BUCKETS = (4, 8, 16, 32)
 def plan(p: PackedHistory):
     """Dense-searchability test. Returns ``(w, ns, nil_id, init_id)`` with
     bucketed w/ns, or None when this history needs the sparse engine."""
-    from jepsen_tpu.models.kernels import PACKED_STATE_KERNELS
+    from jepsen_tpu.models.kernels import (PACKED_STATE_KERNELS,
+                                           packed_state_bound)
 
     if p.kernel is None or p.kernel.name not in PACKED_STATE_KERNELS:
         return None
@@ -78,7 +79,7 @@ def plan(p: PackedHistory):
         return None
     from jepsen_tpu.models.kernels import NIL
 
-    nid = max(len(p.unintern), 2)
+    nid = packed_state_bound(p.kernel, len(p.unintern))
     if nid + 1 > MAX_DENSE_STATES:
         return None
     w = next(b for b in _W_BUCKETS if b >= p.window)

@@ -368,7 +368,8 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
 
     from jepsen_tpu.lin.bfs import reduction_bit_tables
     from jepsen_tpu.models.kernels import (PACKED_STATE_KERNELS,
-                                           READ_VALUE_MATCH_KERNELS)
+                                           READ_VALUE_MATCH_KERNELS,
+                                           packed_state_bound)
 
     # Packed-u32 keys when the window plus state id fit 31 bits: the
     # collective dedup then all_gathers ONE u32 array instead of bits +
@@ -379,7 +380,7 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
     state_bits = nil_id = None
     if p.init_state.shape[0] == 1 \
             and p.kernel.name in PACKED_STATE_KERNELS:
-        nid = max(len(p.unintern), 2)
+        nid = packed_state_bound(p.kernel, len(p.unintern))
         bb = nid.bit_length()
         if p.window + bb <= 31:
             state_bits, nil_id = bb, nid

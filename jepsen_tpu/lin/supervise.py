@@ -366,12 +366,8 @@ def quarantined(key: str, path: str | None = None) -> dict | None:
 
 def _write_ledger(path: str, shapes: dict) -> None:
     global _ledger_cache
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as fh:
-        json.dump({"version": LEDGER_VERSION, "shapes": shapes}, fh,
-                  indent=1, sort_keys=True)
-    os.replace(tmp, path)
+    util.write_json_atomic(path,
+                           {"version": LEDGER_VERSION, "shapes": shapes})
     _ledger_cache = None
 
 

@@ -49,11 +49,30 @@ F_IDS = {"read": F_READ, "write": F_WRITE, "cas": F_CAS,
 # Sentinel for nil/unknown values. Never produced by interning.
 NIL = np.int32(-(2 ** 31))
 
-# Kernel families whose one-word state ranges over interned ids (NIL
-# remapped to a dedicated id): eligible for the dense config-space bitmap
-# engine (lin/dense.py) and the sparse engine's packed-u32 sort keys
-# (lin/bfs.py). Keep the two engines' routing in sync via this constant.
-PACKED_STATE_KERNELS = ("cas-register", "register", "mutex")
+# Kernel families whose one-word state is a bounded non-negative int
+# (interned ids or a bitmask; NIL remapped to a dedicated id): eligible
+# for the dense config-space bitmap engine (lin/dense.py) and the
+# sparse engine's packed-u32 sort keys (lin/bfs.py). The register and
+# mutex families range over the intern table; a one-word set ranges
+# over element-bitmask values, so its bound rides on the kernel itself
+# (state_bound) — :func:`packed_state_bound` is the ONE definition of
+# the state-value range all three engines must share (dense plan,
+# bfs packed keys, sharded collective dedup). Keep the engines'
+# routing in sync via these two names.
+PACKED_STATE_KERNELS = ("cas-register", "register", "mutex", "set")
+
+
+def packed_state_bound(kernel: "KernelModel", n_intern: int) -> int:
+    """Exclusive upper bound of a PACKED_STATE_KERNELS kernel's
+    one-word state values. The NIL sentinel is remapped to the bound
+    itself (nil_id), so packed state ids live in [0, bound] and need
+    ``bound.bit_length()`` bits. Intern-ranged kernels (register /
+    mutex) bound by the intern table; bitmask kernels (a one-word set)
+    carry their own ``state_bound`` (2**n_elements — their state never
+    equals NIL, so the remap id is simply never produced)."""
+    if kernel.state_bound is not None:
+        return kernel.state_bound
+    return max(n_intern, 2)
 
 # Kernels whose F_READ legality is EXACTLY "v == NIL or v == state[0]"
 # (see _cas_register_step/_register_step). The sparse engine's pure-op
@@ -76,6 +95,10 @@ class KernelModel:
     init_state: Callable[[], np.ndarray]  # initial packed state (host)
     step: Callable  # (i32[S], i32, i32[VW]) -> (bool_, i32[S])
     value_width: int = VALUE_WIDTH  # words per op value (VW)
+    # Exclusive upper bound of one-word state values for kernels whose
+    # state is NOT intern-ranged (see packed_state_bound); None for
+    # intern-ranged and multiword kernels.
+    state_bound: int | None = None
 
 
 # --- cas-register (reference model.clj:21-40) -------------------------------
@@ -156,7 +179,12 @@ def set_kernel(n_elements: int, initial_ids=()) -> KernelModel:
         return st
 
     return KernelModel("set", n_words, init, _set_step_fn(n_words),
-                       value_width=max(VALUE_WIDTH, n_words))
+                       value_width=max(VALUE_WIDTH, n_words),
+                       # One-word sets pack into the dense/sparse
+                       # engines' state ids: the word ranges over the
+                       # element bitmask, not the intern table.
+                       state_bound=(1 << n_elements) if n_words == 1
+                       else None)
 
 
 # --- unordered-queue (reference model.clj:73-85) ----------------------------

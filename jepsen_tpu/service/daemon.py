@@ -43,7 +43,6 @@ snapshotted to ``JEPSEN_TPU_SERVICE_STATS`` for ``web.py``'s
 
 from __future__ import annotations
 
-import json
 import os
 import queue
 import socket
@@ -256,11 +255,7 @@ class CheckerService:
             snap["written_at"] = time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
             snap["addr"] = f"{self.host}:{self.port}"
-            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "w") as fh:
-                json.dump(snap, fh, indent=1, sort_keys=True)
-            os.replace(tmp, path)
+            util.write_json_atomic(path, snap)
         except Exception:  # noqa: BLE001 - monitoring-grade: a stats
             pass   # write must never take the scheduler thread down
 

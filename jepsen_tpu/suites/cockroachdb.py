@@ -692,6 +692,116 @@ class G2Client(client_ns.Client):
             self.conn.close()
 
 
+class TxnClient(client_ns.Client):
+    """List-append transactions over pgwire (the Elle workload,
+    doc/txn.md): each op's value is a micro-op list executed inside one
+    BEGIN/COMMIT — append = INSERT .. ON CONFLICT DO UPDATE concat,
+    read = SELECT, the observed list parsed back into the completion.
+    An ambiguous COMMIT completes ``:info`` (the txn may have applied —
+    checker soundness depends on it); serialization aborts retry then
+    fail (definitely not applied). Shared by cockroachdb and
+    postgres-rds (construct with the RDS conn parameters)."""
+
+    TABLE = "jepsen_txn"
+
+    def __init__(self, conn: PgClient | None = None, port: int = PORT,
+                 user: str = "root", database: str = "jepsen",
+                 password: str = "", host: str | None = None,
+                 admin_database: str = "system"):
+        self.conn = conn
+        self.port, self.user, self.database = port, user, database
+        self.password, self.host = password, host
+        self.admin_database = admin_database
+
+    def _connect(self, node):
+        return PgClient(self.host or node, port=self.port,
+                        user=self.user, database=self.database,
+                        password=self.password)
+
+    def open(self, test, node):
+        c = TxnClient(self._connect(node), port=self.port,
+                      user=self.user, database=self.database,
+                      password=self.password, host=self.host,
+                      admin_database=self.admin_database)
+        return c
+
+    def _setup_stmts(self) -> list[str]:
+        """Dialect-aware DDL: CockroachDB (admin db "system") takes the
+        db-qualified STRING form; stock PostgreSQL (the RDS path) has
+        neither `CREATE DATABASE IF NOT EXISTS`, db-qualified names
+        (they parse as schemas), nor a STRING type — unqualified TEXT.
+        The per-op SQL in _mop is common to both dialects."""
+        if self.admin_database == "system":
+            return ["CREATE DATABASE IF NOT EXISTS jepsen",
+                    f"CREATE TABLE IF NOT EXISTS "
+                    f"{self.database}.{self.TABLE} "
+                    f"(k INT PRIMARY KEY, vals STRING)"]
+        return [f"CREATE TABLE IF NOT EXISTS {self.TABLE} "
+                f"(k INT PRIMARY KEY, vals TEXT)"]
+
+    def setup(self, test) -> None:
+        conn = PgClient(self.host or test["nodes"][0], port=self.port,
+                        user=self.user, database=self.admin_database,
+                        password=self.password)
+        try:
+            for stmt in self._setup_stmts():
+                conn.query(stmt)
+        finally:
+            conn.close()
+
+    def _mop(self, f, k, v):
+        if f == "append":
+            self.conn.query(
+                f"INSERT INTO {self.TABLE} (k, vals) VALUES "
+                f"({int(k)}, '{int(v)}') ON CONFLICT (k) DO UPDATE "
+                f"SET vals = concat({self.TABLE}.vals, ',{int(v)}')")
+            return ["append", k, v]
+        rows = self.conn.query(
+            f"SELECT vals FROM {self.TABLE} WHERE k = {int(k)}")
+        obs = [] if not rows or rows[0][0] in (None, "") \
+            else [int(x) for x in str(rows[0][0]).split(",")]
+        return ["r", k, obs]
+
+    def invoke(self, test, op: Op) -> Op:
+        if op.f != "txn":
+            return op.replace(type="fail", error=f"unknown f {op.f}")
+        try:
+            for attempt in range(5):
+                try:
+                    self.conn.query("BEGIN")
+                    # The workload asserts serializability, so demand
+                    # it: stock Postgres (the RDS path) defaults to
+                    # READ COMMITTED, where healthy write skew would be
+                    # convicted as G2 (the RdsBankClient precedent);
+                    # CockroachDB accepts the statement as a no-op.
+                    self.conn.query(
+                        "SET TRANSACTION ISOLATION LEVEL SERIALIZABLE")
+                    try:
+                        done = [self._mop(*m) for m in op.value]
+                        self.conn.query("COMMIT")
+                    except PgError:
+                        try:
+                            self.conn.query("ROLLBACK")
+                        except (PgError, OSError):
+                            pass
+                        raise
+                    return op.replace(type="ok", value=done)
+                except PgError as e:
+                    if e.ambiguous:
+                        # COMMIT outcome unknown: the txn may have
+                        # applied (client.clj:183-230) — never "fail".
+                        return op.replace(type="info", error=str(e))
+                    if not (e.retryable and attempt < 4):
+                        return op.replace(type="fail", error=str(e))
+        except (OSError, ConnectionError) as e:
+            return op.replace(type="info", error=repr(e))
+        return op.replace(type="fail", error="retries exhausted")
+
+    def close(self, test) -> None:
+        if self.conn is not None:
+            self.conn.close()
+
+
 # --- nemesis registry (cockroach/nemesis.clj) -------------------------------
 
 
@@ -934,6 +1044,7 @@ def tests_registry() -> dict:
         "sets": lambda: workloads.set_workload(),
         "sequential": lambda: workloads.sequential_workload(),
         "g2": lambda: adya.workload(),
+        "txn": lambda: workloads.txn_workload(),
     }
 
 
@@ -962,6 +1073,7 @@ def test(opts: dict | None = None) -> dict:
         "sequential": SequentialClient,
         "comments": CommentsClient,
         "g2": G2Client,
+        "txn": TxnClient,
     }
     client = client_factories.get(wname)
     os_name = opts.pop("os", "ubuntu")

@@ -214,6 +214,76 @@ class FakeBank:
             return True
 
 
+class FakeTxnStore:
+    """List-append registers executed transactionally (the txn/Elle
+    workload, doc/txn.md). Healthy mode runs each transaction under one
+    lock — serializable by construction.
+
+    faulty modes:
+
+    - ``"write-skew"`` (alias ``"si"``): snapshot-read two-phase
+      execution with a rendezvous — a transaction that reads one key
+      and appends another waits briefly at its phase boundary for a
+      concurrent partner, then both apply against their stale
+      snapshots: the classic SI write skew, a guaranteed G2-item pair
+      under a concurrent workload.
+    - ``"aborted-read"``: every 5th appending transaction APPLIES its
+      appends, then reports failure — later reads observe values whose
+      transaction aborted (G1a).
+    """
+
+    RENDEZVOUS_S = 0.05
+
+    def __init__(self, faulty: str | None = None):
+        self.lists: dict = {}
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.faulty = faulty
+        self._n = 0
+        self._waiting = 0
+
+    def _apply(self, mops, snapshot=None):
+        done = []
+        for f, k, v in mops:
+            if f == "append":
+                self.lists.setdefault(k, []).append(v)
+                done.append(["append", k, v])
+            else:
+                src = snapshot if snapshot is not None else self.lists
+                done.append(["r", k, list(src.get(k, []))])
+        return done
+
+    def txn(self, mops) -> tuple[bool, list]:
+        """Execute micro-ops atomically; (committed, completed mops)."""
+        mops = [tuple(m) for m in mops]
+        skew = self.faulty in ("write-skew", "si") \
+            and any(m[0] == "r" for m in mops) \
+            and any(m[0] == "append" for m in mops)
+        with self.cond:
+            self._n += 1
+            if self.faulty == "aborted-read" \
+                    and any(m[0] == "append" for m in mops) \
+                    and self._n % 5 == 0:
+                self._apply(mops)
+                return False, mops
+            if not skew:
+                return True, self._apply(mops)
+            # Write skew: snapshot now, rendezvous, apply appends late.
+            snapshot = {k: list(v) for k, v in self.lists.items()}
+            reads = self._apply([m for m in mops if m[0] == "r"],
+                                snapshot)
+            self._waiting += 1
+            if self._waiting % 2 == 1:
+                self.cond.wait(self.RENDEZVOUS_S)   # wait for a partner
+            else:
+                self.cond.notify()                  # release the partner
+            appends = self._apply([m for m in mops if m[0] == "append"])
+            out = []
+            for f, _k, _v in mops:
+                out.append((reads if f == "r" else appends).pop(0))
+            return True, out
+
+
 class FakeTable:
     """Append-only table of (id, committed) rows for the dirty-read /
     monotonic / sequential / comments workloads.

@@ -75,7 +75,7 @@ def main(argv=None) -> None:
 
     def opt_spec(p):
         p.add_argument("--workload", default="bank",
-                       choices=["bank", "dirty-reads"])
+                       choices=["bank", "dirty-reads", "txn"])
 
     cli.main(cli.suite_commands(test, opt_spec=opt_spec), argv)
 

@@ -244,11 +244,71 @@ class CounterClient(_SqlClient):
         return op.replace(type="fail", error=f"unknown f {op.f}")
 
 
+class TxnAppendClient(_SqlClient):
+    """List-append transactions in MySQL dialect (the Elle workload,
+    doc/txn.md; tidb + galera): micro-ops inside one BEGIN/COMMIT —
+    append = INSERT .. ON DUPLICATE KEY UPDATE CONCAT, read = SELECT.
+    These stores claim snapshot isolation at best (TiDB rejects
+    ``SET ... SERIALIZABLE`` outright; Galera/InnoDB runs REPEATABLE
+    READ), so the suites register ``txn_workload(consistency=
+    "snapshot-isolation")`` — asserting serializability here would
+    convict healthy write skew the store never promised to prevent.
+    Errors raised by the COMMIT itself (or a dropped connection after
+    writes) complete ``:info`` — the txn may have applied; statement
+    errors inside the txn roll back and fail definitely."""
+
+    TABLE = f"{DB}.jepsen_txn"
+    CREATE = (f"CREATE TABLE IF NOT EXISTS {TABLE} "
+              f"(k INT PRIMARY KEY, vals TEXT)",)
+
+    def _mop(self, f, k, v):
+        if f == "append":
+            self.conn.query(
+                f"INSERT INTO {self.TABLE} (k, vals) VALUES "
+                f"({int(k)}, '{int(v)}') ON DUPLICATE KEY UPDATE "
+                f"vals = CONCAT(vals, ',{int(v)}')")
+            return ["append", k, v]
+        rows = self.conn.query(
+            f"SELECT vals FROM {self.TABLE} WHERE k = {int(k)}")
+        obs = [] if not rows or rows[0][0] in (None, "") \
+            else [int(x) for x in str(rows[0][0]).split(",")]
+        return ["r", k, obs]
+
+    def invoke(self, test, op: Op) -> Op:
+        if op.f != "txn":
+            return op.replace(type="fail", error=f"unknown f {op.f}")
+        try:
+            self.conn.query("BEGIN")
+            try:
+                done = [self._mop(*m) for m in op.value]
+            except MyError as e:
+                try:
+                    self.conn.query("ROLLBACK")
+                except (MyError, OSError):
+                    pass
+                return op.replace(type="fail", error=str(e))
+            try:
+                self.conn.query("COMMIT")
+            except (MyError, OSError, ConnectionError) as e:
+                # The commit's fate is unknown: it may have applied.
+                return op.replace(type="info", error=repr(e))
+            return op.replace(type="ok", value=done)
+        except MyError as e:
+            # Only BEGIN can land here (statements and COMMIT have
+            # their own handlers above): nothing applied — fail.
+            return op.replace(type="fail", error=str(e))
+        except (OSError, ConnectionError) as e:
+            return op.replace(type="info", error=repr(e))
+
+
 def bank_or_dirty_reads(name: str, port: int = PORT):
     """(workload, client) for the galera/percona workload registry: the
-    shared bank/dirty-reads mapping both suites expose."""
+    shared bank/dirty-reads/txn mapping both suites expose."""
     from jepsen_tpu.suites import workloads
 
     if name == "bank":
         return workloads.bank_workload(), BankClient(port=port)
+    if name == "txn":
+        return (workloads.txn_workload(consistency="snapshot-isolation"),
+                TxnAppendClient(port=port))
     return workloads.dirty_read_workload(), TableClient(port=port)

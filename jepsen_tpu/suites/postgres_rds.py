@@ -88,8 +88,23 @@ class RdsBankClient(client_ns.Client):
 
 def test(opts: dict | None = None) -> dict:
     """The postgres-rds test map (postgres_rds.clj:238-293): no DB/OS
-    hooks, no nemesis — just clients and the bank checker."""
+    hooks, no nemesis — just clients and the checker. ``workload``
+    picks bank (default) or txn (list-append transactions checked by
+    the dependency-graph cycle checker, jepsen_tpu.txn/doc/txn.md)."""
     opts = dict(opts or {})
+    name = opts.pop("workload", None) or "bank"
+    if name == "txn":
+        from jepsen_tpu.suites.cockroachdb import TxnClient
+
+        o = opts
+        client = TxnClient(
+            port=int(o.get("port", 5432)), user=o.get("user", "jepsen"),
+            database=o.get("dbname", "jepsen"),
+            password=o.get("password", ""), host=o.get("host"),
+            admin_database=o.get("dbname", "jepsen"))
+        return common.suite_test("postgres-rds txn", opts,
+                                 workload=workloads.txn_workload(),
+                                 client=client)
     return common.suite_test(
         "postgres-rds", opts,
         workload=workloads.bank_workload(),
@@ -100,6 +115,8 @@ def main(argv=None) -> None:
     from jepsen_tpu import cli
 
     def opt_spec(p):
+        p.add_argument("--workload", default="bank",
+                       choices=["bank", "txn"])
         p.add_argument("--host", help="RDS endpoint hostname")
         p.add_argument("--user", default="jepsen")
         p.add_argument("--db-password", dest="password", default="")

@@ -90,6 +90,12 @@ def test(opts: dict | None = None) -> dict:
     elif name == "bank":
         wl = workloads.bank_workload()
         client = mysql_clients.BankClient(port=4000)
+    elif name == "txn":
+        # List-append transactions checked by the dependency-graph
+        # cycle checker (jepsen_tpu.txn, doc/txn.md). TiDB claims
+        # snapshot isolation, not serializability (see TxnAppendClient).
+        wl = workloads.txn_workload(consistency="snapshot-isolation")
+        client = mysql_clients.TxnAppendClient(port=4000)
     else:
         wl = workloads.set_workload()
         client = mysql_clients.SetClient(port=4000)
@@ -108,7 +114,7 @@ def main(argv=None) -> None:
 
     def opt_spec(p):
         p.add_argument("--workload", default="register",
-                       choices=["register", "bank", "sets"])
+                       choices=["register", "bank", "sets", "txn"])
 
     cli.main(cli.suite_commands(test, opt_spec=opt_spec), argv)
 

@@ -123,6 +123,63 @@ def set_workload(n: int = 100, stagger: float = 1 / 10, faulty=None) -> dict:
     }
 
 
+# --- txn (list-append, Elle) ------------------------------------------------
+
+def txn_gen(keys: int = 8, mops_per_txn: tuple = (1, 4),
+            read_frac: float = 0.5):
+    """Elle-style list-append transactions: 1-4 micro-ops, each an
+    ``["append", k, v]`` (v unique per history — traceability is what
+    makes the dependency graph inferable) or an ``["r", k, None]``
+    completed with the observed list (doc/txn.md)."""
+    state = {"n": 0}
+    lock = threading.Lock()
+
+    def go(test, process):
+        n_mops = random.randint(*mops_per_txn)
+        mops = []
+        for _ in range(n_mops):
+            k = random.randrange(keys)
+            if random.random() < read_frac:
+                mops.append(["r", k, None])
+            else:
+                with lock:
+                    state["n"] += 1
+                    v = state["n"]
+                mops.append(["append", k, v])
+        return {"type": "invoke", "f": "txn", "value": mops}
+
+    return gen.gen(go)
+
+
+class TxnClient(fakes.FakeClient):
+    """Micro-op transactions over :class:`fakes.FakeTxnStore`."""
+
+    def invoke(self, test, op: Op) -> Op:
+        if op.f != "txn":
+            return op.replace(type="fail", error=f"unknown f {op.f}")
+        committed, done = self.store.txn(op.value)
+        if not committed:
+            return op.replace(type="fail", error="aborted")
+        return op.replace(type="ok", value=done)
+
+
+def txn_workload(n: int = 200, keys: int = 8, stagger: float = 1 / 30,
+                 consistency: str = "serializable", algorithm: str = "tpu",
+                 faulty=None) -> dict:
+    """List-append transactions checked for dependency-graph cycle
+    anomalies (checker.txn_cycles -> jepsen_tpu.txn) — the SQL suites'
+    transactional workload (cockroachdb/tidb/galera/postgres-rds)."""
+    store = fakes.FakeTxnStore(faulty=faulty)
+    return {
+        "generator": gen.clients(gen.limit(n, gen.stagger(
+            stagger, txn_gen(keys=keys)))),
+        "client": TxnClient(store),
+        "checker": checker_ns.txn_cycles(consistency=consistency,
+                                         algorithm=algorithm),
+        "model": None,
+    }
+
+
 # --- queue -------------------------------------------------------------------
 
 def queue_workload(n: int = 100, stagger: float = 1 / 10,
@@ -561,6 +618,7 @@ def comments_workload(n: int = 200, stagger: float = 1 / 20,
 REGISTRY = {
     "register": register,
     "single-register": single_register,
+    "txn": txn_workload,
     "set": set_workload,
     "queue": queue_workload,
     "counter": counter_workload,

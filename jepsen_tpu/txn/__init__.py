@@ -1,0 +1,58 @@
+"""Transaction dependency-graph anomaly checking — Elle on device.
+
+The transactional workload family for the SQL suites (cockroachdb,
+tidb, galera, postgres-rds): histories of list-append transactions are
+checked for snapshot-isolation / serializability violations by cycle
+search over the inferred wr/ww/rw(/realtime) dependency graph
+(Kingsbury & Alvaro, *Elle*, VLDB 2020; Adya, *Weak Consistency*, MIT
+1999). See doc/txn.md.
+
+- :mod:`jepsen_tpu.txn.oracle` — the executable CPU spec (the
+  `lin/cpu.py` role): edge inference, Tarjan SCC, G0/G1c/G-single/
+  G2-item classification with canonical minimal witness cycles.
+- :mod:`jepsen_tpu.txn.pack`   — packed codec: sorted flat edge arrays
+  edge lists (the `lin/prepare.py` role, same ``:info`` conventions).
+- :mod:`jepsen_tpu.txn.device` — the device engine: trim + min-label
+  SCC propagation inside a ``lax.while_loop`` (iteration ceiling
+  in-program, supervised dispatches, quarantine-ledger recorded).
+- :mod:`jepsen_tpu.txn.synth`  — history generators + seeded-anomaly
+  corpora.
+
+``checker.txn_cycles(...)`` is the suite-facing checker;
+``make txn-smoke`` is the chip-free round-trip proof.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu.txn import oracle as _oracle
+from jepsen_tpu.txn.oracle import (CONSISTENCY_MODELS,  # noqa: F401
+                                   CYCLE_ANOMALIES, DIRECT_ANOMALIES,
+                                   TxnGraph, UnsupportedTxnHistory)
+
+
+def check(history, anomalies=None, consistency: str = "serializable",
+          realtime: bool | None = None, algorithm: str = "tpu") -> dict:
+    """Decide transactional consistency of a list-append history.
+
+    ``algorithm``: ``"tpu"`` packs the dependency graph and runs the
+    device SCC engine (:mod:`jepsen_tpu.txn.device`; falls back tier
+    by tier to the host on faults/wedges/quarantine); ``"cpu"`` runs
+    the oracle end to end. Both classify with the same shared code and
+    report identical verdicts + witness cycles (parity-fuzzed).
+    """
+    if algorithm == "cpu":
+        return _oracle.check(history, anomalies=anomalies,
+                             consistency=consistency, realtime=realtime)
+    if algorithm != "tpu":
+        raise ValueError(f"unknown txn algorithm {algorithm!r}")
+    from jepsen_tpu.txn import device, pack
+
+    requested, rt = _oracle.resolve_anomalies(anomalies, consistency,
+                                              realtime)
+    try:
+        pt = pack.pack(history, realtime=rt)
+    except UnsupportedTxnHistory as e:
+        return {"valid?": "unknown", "analyzer": "txn-pack",
+                "error": str(e)}
+    return device.check_packed(pt, anomalies=requested,
+                               consistency=consistency, realtime=rt)

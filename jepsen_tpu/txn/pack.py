@@ -1,0 +1,76 @@
+"""Host-side packing for the transaction dependency-graph checker.
+
+Converts a list-append history (vector of ``txn`` ops whose values are
+micro-op lists, :mod:`jepsen_tpu.txn.oracle`) into the dense int-array
+form the device SCC engine consumes — the :mod:`jepsen_tpu.lin.prepare`
+role for the transactional workload family, following its conventions:
+
+- **Pairing / indeterminacy**: ``fail`` txns are dropped (their appends
+  kept only to convict G1a reads); ``info`` txns stay with their
+  invocation micro-ops and contribute writes only when observed
+  (recoverable-write rule) — the ``:info``-completion contract of the
+  wire suites (an op that may have applied must constrain, not be
+  assumed away).
+- ``edge_src/edge_dst/edge_typ`` — the inferred dependency edges
+  (``oracle.WR/WW/RW/RT``), deduplicated, sorted by (src, dst, typ):
+  the flat arrays the device SCC program's scatter formulation consumes
+  directly (it builds no CSR — degree counts and label propagation are
+  ``at[].add/min/max`` scatters over these).
+
+The graph inference itself lives in :mod:`jepsen_tpu.txn.oracle` — pack
+is a codec around the oracle's graph, never a second implementation.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+import numpy as np
+
+from jepsen_tpu.txn import oracle
+
+
+@dataclass
+class PackedTxnHistory:
+    """Dense arrays driving the device SCC search; module docstring."""
+
+    graph: oracle.TxnGraph
+    n: int                       # transactions (graph nodes)
+    edge_src: np.ndarray         # i32[E]
+    edge_dst: np.ndarray         # i32[E]
+    edge_typ: np.ndarray         # i8[E]
+    realtime: bool = False
+
+    @property
+    def n_edges(self) -> int:
+        return int(len(self.edge_src))
+
+    def fingerprint(self) -> str:
+        """Identity of the packed graph (the supervise checkpoint /
+        ledger convention: same shape+content -> same key)."""
+        h = hashlib.sha256()
+        h.update(f"txn|{self.n}|{self.n_edges}|{self.realtime}".encode())
+        for a in (self.edge_src, self.edge_dst, self.edge_typ):
+            arr = np.ascontiguousarray(np.asarray(a))
+            h.update(str(arr.shape).encode())
+            h.update(arr.tobytes())
+        return h.hexdigest()
+
+
+def pack(history=None, graph: oracle.TxnGraph | None = None,
+         realtime: bool = False) -> PackedTxnHistory:
+    """Pack a list-append history (or a pre-inferred graph) for the
+    device checker."""
+    if graph is None:
+        graph = oracle.infer(history, realtime=realtime)
+
+    src, dst, typ = graph.src, graph.dst, graph.typ
+    order = np.lexsort((typ, dst, src)) if len(src) else \
+        np.zeros(0, np.int64)
+    return PackedTxnHistory(
+        graph=graph, n=graph.n,
+        edge_src=src[order].astype(np.int32),
+        edge_dst=dst[order].astype(np.int32),
+        edge_typ=typ[order].astype(np.int8),
+        realtime=realtime)

@@ -1,0 +1,98 @@
+"""`make txn-smoke`: generate -> pack -> check -> classify, chip-free.
+
+The serve-smoke habit for the txn subsystem: a FRESH-process proof on
+the forced 8-device CPU mesh that the whole txn path round-trips —
+a healthy concurrent list-append history decides valid on device, and
+every seeded anomaly corpus (G0 / G1c / G-single / G2-item / G1a) is
+found AND classified identically by the device engine and the CPU
+oracle, witness cycles included. Prints one JSON result line and exits
+0/1 — timeout-guarded by the Makefile so a wedge cannot hold the
+shell.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    # CPU mesh BEFORE any jax backend init (CLAUDE.md: the TPU plugin
+    # force-selects its platform; the smoke must never take the chip).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from jepsen_tpu.util import enable_compile_cache
+
+    enable_compile_cache()
+
+    from jepsen_tpu import txn
+    from jepsen_tpu.txn import oracle, synth
+
+    out: dict = {"checks": []}
+    ok = True
+
+    # 1. Healthy concurrent history: valid on device, parity with cpu.
+    h = synth.generate_list_append_history(
+        800, concurrency=10, keys=8, seed=7, crash_prob=0.01,
+        max_crashes=6)
+    t0 = time.time()
+    dev = txn.check(h, consistency="serializable", algorithm="tpu")
+    cpu = txn.check(h, consistency="serializable", algorithm="cpu")
+    rec = {"case": "healthy", "ops": len(h),
+           "edges": (dev.get("device-stats") or {}).get("edges"),
+           "device": dev.get("valid?"), "cpu": cpu.get("valid?"),
+           "seconds": round(time.time() - t0, 2)}
+    good = dev.get("valid?") is True and cpu.get("valid?") is True \
+        and not dev.get("fallbacks")
+    rec["ok"] = good
+    ok = ok and good
+    out["checks"].append(rec)
+
+    # 2. Seeded anomalies: found + classified identically, witnesses
+    # included (the acceptance contract of ISSUE 9).
+    for kind in ("G0", "G1c", "G-single", "G2-item", "G1a"):
+        h = synth.seeded_anomaly_history(kind)
+        dev = txn.check(h, consistency="serializable", algorithm="tpu")
+        cpu = txn.check(h, consistency="serializable", algorithm="cpu")
+        good = (dev.get("valid?") is False
+                and kind in dev.get("anomaly-types", [])
+                and dev.get("anomaly-types") == cpu.get("anomaly-types")
+                and dev.get("anomalies") == cpu.get("anomalies"))
+        out["checks"].append({"case": kind,
+                              "device": dev.get("anomaly-types"),
+                              "cpu": cpu.get("anomaly-types"),
+                              "ok": good})
+        ok = ok and good
+
+    # 3. A spliced anomaly inside a bigger healthy history.
+    h = synth.splice_anomaly(
+        synth.generate_list_append_history(400, concurrency=8, seed=3),
+        "G2-item", seed=3)
+    dev = txn.check(h, consistency="serializable", algorithm="tpu")
+    si = txn.check(h, consistency="snapshot-isolation", algorithm="tpu")
+    good = dev.get("valid?") is False \
+        and "G2-item" in dev.get("anomaly-types", []) \
+        and si.get("valid?") is True   # SI admits pure write skew
+    out["checks"].append({"case": "spliced-G2",
+                          "serializable": dev.get("anomaly-types"),
+                          "snapshot-isolation": si.get("valid?"),
+                          "ok": good})
+    ok = ok and good
+
+    out["graph_stats"] = oracle.infer(
+        synth.generate_list_append_history(200, seed=1)).stats
+    out["ok"] = ok
+    print(json.dumps(out, default=str))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -243,6 +243,21 @@ def env_float(name: str, default: float) -> float:
     return float(v) if v else default
 
 
+def write_json_atomic(path: str, obj, default=None) -> None:
+    """Atomic JSON file write: pid-suffixed tmp + ``os.replace`` (the
+    quarantine-ledger / service-stats / txn-snapshot pattern — last
+    writer wins, readers never see a torn file). Raises on failure;
+    observability-grade callers swallow at their own site."""
+    import json
+    import os
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(obj, fh, indent=1, sort_keys=True, default=default)
+    os.replace(tmp, path)
+
+
 def stat_bump(stats: dict, key: str, n: int = 1) -> None:
     """Accumulate an integer observability counter in a stats dict
     (host-row executor episode/dispatch/pass/waste counters — see

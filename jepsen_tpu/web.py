@@ -75,7 +75,8 @@ def home_html(base: Path) -> str:
             "body{font-family:sans-serif} table{border-collapse:collapse}"
             "td,th{padding:4px 12px;border:1px solid #ccc}"
             "</style></head><body><h1>jepsen-tpu results</h1>"
-            '<p><a href="/service">checker service stats</a></p>'
+            '<p><a href="/service">checker service stats</a> · '
+            '<a href="/txn">txn anomaly panel</a></p>'
             "<table><tr><th>test</th><th>run</th><th>valid?</th>"
             "<th>download</th></tr>" + "".join(rows) +
             "</table></body></html>")
@@ -144,6 +145,65 @@ def service_html(stats_file: str | None = None) -> str:
     return "".join(parts)
 
 
+def txn_html(stats_file: str | None = None) -> str:
+    """The /txn anomaly panel: the txn checker's last snapshot
+    (written by jepsen_tpu.txn.device on every check to
+    ``JEPSEN_TPU_TXN_STATS``) — verdict, anomaly counts by Adya class,
+    dependency-edge counts, device tier stats — so the browser shows
+    the transactional side next to the runs it decided."""
+    # txn.device.stats_path() without the import (pulling the device
+    # module would drag jax into the web process).
+    path = stats_file or os.environ.get(
+        "JEPSEN_TPU_TXN_STATS",
+        os.path.join(".jax_cache", "txn_stats.json"))
+    head = ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            "<title>txn anomalies</title><style>"
+            "body{font-family:sans-serif} table{border-collapse:collapse;"
+            "margin-bottom:1em} td,th{padding:3px 10px;"
+            "border:1px solid #ccc} th{text-align:left}"
+            "</style></head><body><h1>txn anomaly checker</h1>"
+            '<p><a href="/">home</a></p>')
+    try:
+        with open(path) as fh:
+            snap = json.load(fh)
+    except (OSError, ValueError) as e:
+        return (head + f"<p>no txn snapshot at "
+                f"<code>{_html.escape(str(path))}</code> "
+                f"({_html.escape(str(e))}) — run a txn check "
+                f"(<code>make txn-smoke</code>)?</p></body></html>")
+
+    color = VALID_COLORS.get(snap.get("verdict"), "#FFFFFF")
+    parts = [head,
+             f'<p>verdict: <span style="background:{color};'
+             f'padding:2px 8px">'
+             f"{_html.escape(str(snap.get('verdict')))}</span> "
+             f"({_html.escape(str(snap.get('consistency', '?')))}, "
+             f"updated {_html.escape(str(snap.get('updated', '?')))})"
+             "</p>"]
+
+    def table(title, items):
+        rows = "".join(
+            f"<tr><th>{_html.escape(str(k))}</th>"
+            f"<td>{_html.escape(str(v))}</td></tr>"
+            for k, v in items)
+        return f"<h2>{_html.escape(title)}</h2><table>{rows}</table>"
+
+    counts = snap.get("anomaly_counts") or {}
+    parts.append(table("anomalies",
+                       sorted(counts.items()) or [("none found", "-")]))
+    for key, title in (("edge_counts", "dependency edges"),
+                       ("graph", "graph"), ("device", "device")):
+        if isinstance(snap.get(key), dict) and snap[key]:
+            parts.append(table(
+                title, sorted((k, v) for k, v in snap[key].items()
+                              if not isinstance(v, (dict, list)))))
+    parts.append("<h2>raw</h2><pre>"
+                 + _html.escape(json.dumps(snap, indent=1,
+                                           sort_keys=True, default=str))
+                 + "</pre></body></html>")
+    return "".join(parts)
+
+
 def zip_run(base: Path, rel: str) -> bytes:
     """Zip a run directory in memory (web.clj:250-271 streams; runs are
     small enough to buffer)."""
@@ -160,6 +220,7 @@ def zip_run(base: Path, rel: str) -> bytes:
 class _Handler(BaseHTTPRequestHandler):
     base: Path = Path("store")
     stats_file: str | None = None   # None -> the daemon's default path
+    txn_stats_file: str | None = None   # None -> txn.device default
 
     def log_message(self, fmt, *args):  # route through logging
         log.debug(fmt, *args)
@@ -188,6 +249,8 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/service":
                 self._send(200,
                            service_html(self.stats_file).encode())
+            elif path == "/txn":
+                self._send(200, txn_html(self.txn_stats_file).encode())
             elif path.startswith("/zip/"):
                 rel = self._safe_rel(path[len("/zip/"):].strip("/"))
                 if rel is None:
@@ -230,9 +293,11 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def make_server(host="0.0.0.0", port=8080, base="store",
-                stats_file: str | None = None) -> ThreadingHTTPServer:
+                stats_file: str | None = None,
+                txn_stats_file: str | None = None) -> ThreadingHTTPServer:
     handler = type("Handler", (_Handler,),
-                   {"base": Path(base), "stats_file": stats_file})
+                   {"base": Path(base), "stats_file": stats_file,
+                    "txn_stats_file": txn_stats_file})
     return ThreadingHTTPServer((host, port), handler)
 
 

@@ -191,6 +191,74 @@ def test_stall_retries_once_and_records(bench, tmp_path, monkeypatch):
     assert "stalled" in r["first_attempt"]["error"]
 
 
+def test_txn_probe_in_order_and_registry(bench):
+    # The txn probe contract (ISSUE 9): registered, ordered BEFORE the
+    # long/dangerous partitioned probe so a txn fault (or a config-5
+    # fault) can never shadow the other's number.
+    keys = [k for k, _t in bench.PROBE_ORDER]
+    assert "txn_c30" in keys
+    assert keys.index("txn_c30") < keys.index("partitioned_c30")
+    assert "txn_c30" in bench.PROBES
+
+
+def test_txn_probe_stats_pass_through(bench, monkeypatch, capsys):
+    # edges/s, verdict, anomaly counts, and the device tier stats must
+    # reach detail verbatim and be re-emitted the moment the probe
+    # completes (loss-proof: an external kill during partitioned keeps
+    # the txn numbers).
+    monkeypatch.setattr(bench, "PROBE_ORDER",
+                        (("txn_c30", 60), ("partitioned_c30", 100)))
+    txn_result = {
+        "n_ops": 99984, "edges": 180876, "edges_per_sec": 61234.5,
+        "healthy_verdict": True, "seeded_verdict": False,
+        "anomaly_types": ["G-single", "G2-item"],
+        "anomaly_counts": {"G2-item": 2, "G-single": 1},
+        "witness_parity": True, "verdict": True,
+        "device_stats": {"tiers": {"full": {"core": 4}}}}
+
+    def fake_probe(key, timeout, env_extra=None, stall_s=None):
+        if key == "txn_c30":
+            return dict(txn_result)
+        return {"verdict": True, "probe": key}
+
+    monkeypatch.setattr(bench, "_run_probe", fake_probe)
+    out = {"metric": "m", "value": 1, "detail": {}}
+    bench._wide_probes(out["detail"], out, time.time())
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.splitlines() if ln.strip()]
+    assert "txn_c30" in lines[0]["detail"]
+    got = out["detail"]["txn_c30"]
+    assert got["edges_per_sec"] == 61234.5
+    assert got["anomaly_counts"] == {"G2-item": 2, "G-single": 1}
+    assert got["witness_parity"] is True
+    assert got["device_stats"]["tiers"]["full"]["core"] == 4
+
+
+def test_txn_probe_fault_cannot_shadow_headline(bench, monkeypatch):
+    # FAULT ISOLATION: a txn probe error must recover the worker and
+    # still run the remaining probes — the partitioned headline (and
+    # every later number) survives a txn fault, and vice versa.
+    monkeypatch.setattr(bench, "PROBE_ORDER",
+                        (("txn_c30", 60), ("partitioned_c30", 100)))
+    recoveries = []
+
+    def fake_probe(key, timeout, env_extra=None, stall_s=None):
+        if key == "txn_c30":
+            return {"error": "probe exited rc=1: kernel fault"}
+        return {"verdict": True, "probe": key}
+
+    monkeypatch.setattr(bench, "_run_probe", fake_probe)
+    monkeypatch.setattr(bench, "_verify_recovery",
+                        lambda: recoveries.append(1) or True)
+    detail = {}
+    bench._wide_probes(detail, {"metric": "m", "value": 1,
+                                "detail": detail}, time.time())
+    assert "error" in detail["txn_c30"]
+    assert detail["txn_c30"]["worker_recovered"] is True
+    assert recoveries == [1]
+    assert detail["partitioned_c30"]["verdict"] is True
+
+
 def test_service_probe_in_order_and_registry(bench):
     # The checker-service probe is a first-class artifact citizen:
     # registered, and ordered BEFORE the long/dangerous partitioned

@@ -118,6 +118,31 @@ class TestWeb:
         except urllib.error.HTTPError as e:
             assert e.code in (403, 404)
 
+    def test_txn_panel_renders_snapshot(self, tmp_path):
+        from jepsen_tpu import web
+
+        snap = tmp_path / "txn_stats.json"
+        snap.write_text(json.dumps({
+            "verdict": False, "consistency": "serializable",
+            "anomaly_counts": {"G2-item": 2},
+            "edge_counts": {"wr": 10, "ww": 5, "rw": 3, "rt": 0},
+            "device": {"seconds": 0.2}, "updated": "2026-01-01"}))
+        html = web.txn_html(str(snap))
+        assert "G2-item" in html and "serializable" in html
+        assert "False" in html
+
+    def test_txn_panel_missing_snapshot_degrades(self, tmp_path):
+        from jepsen_tpu import web
+
+        html = web.txn_html(str(tmp_path / "missing.json"))
+        assert "txn-smoke" in html       # points at the habit command
+
+    def test_txn_panel_served_and_linked(self, server):
+        status, body = self.get(server + "/txn")
+        assert status == 200
+        status, home = self.get(server + "/")
+        assert b"/txn" in home
+
     def test_missing_file_404(self, server):
         import urllib.error
 

@@ -1,0 +1,140 @@
+"""Set model on the packed device engines (ISSUE 9 satellite).
+
+The Set kernel is promoted into models/kernels.py:PACKED_STATE_KERNELS:
+a one-word set's state ranges over element-bitmask values, bounded by
+the kernel's own ``state_bound`` (packed_state_bound is the shared
+definition), so small-window set histories route through the dense
+config-space bitmap engine and the sparse engine's packed-u32 sort
+keys — parity-fuzzed against the lin/cpu.py spec here.
+"""
+
+import random
+
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu.history import Op
+from jepsen_tpu.lin import bfs, cpu, dense, prepare
+from jepsen_tpu.models import kernels as K
+
+# Quick tier; the engines deliberately compile tiny cached programs.
+pytestmark = [pytest.mark.quick, pytest.mark.compiles]
+
+
+def gen_set_history(n_adds, n_reads, concurrency, seed, corrupt=False):
+    """Concurrent adds + reads against an apply-at-invoke store
+    (linearizable by construction); ``corrupt`` makes some reads
+    observe a wrong set (dropped or phantom element)."""
+    rng = random.Random(seed)
+    items: set = set()
+    hist, inflight = [], []
+    procs = list(range(concurrency))
+    nv = [0]
+    events = ["add"] * n_adds + ["read"] * n_reads
+    rng.shuffle(events)
+    for ev in events:
+        while not procs:
+            p, comp = inflight.pop(0)
+            hist.append(comp)
+            procs.append(p)
+        p = procs.pop(rng.randrange(len(procs)))
+        if ev == "add":
+            nv[0] += 1
+            v = nv[0]
+            hist.append(Op("invoke", "add", v, p))
+            items.add(v)
+            comp = Op("ok", "add", v, p)
+        else:
+            hist.append(Op("invoke", "read", None, p))
+            snap = sorted(items)
+            if corrupt and rng.random() < 0.6 and snap:
+                snap = snap[:-1] + [snap[-1] + 1] \
+                    if rng.random() < 0.5 else snap[:-1]
+            comp = Op("ok", "read", snap, p)
+        if rng.random() < 0.5:
+            inflight.append((p, comp))
+        else:
+            hist.append(comp)
+            procs.append(p)
+    for _p, comp in inflight:
+        hist.append(comp)
+    return hist
+
+
+class TestStateBound:
+    def test_set_in_packed_registry_with_bound(self):
+        assert "set" in K.PACKED_STATE_KERNELS
+        k = K.set_kernel(3)
+        assert k.state_bound == 8
+        assert K.packed_state_bound(k, 99) == 8
+
+    def test_multiword_set_has_no_bound(self):
+        k = K.set_kernel(40)      # 2 words
+        assert k.state_bound is None
+
+    def test_register_bound_unchanged(self):
+        k = K.cas_register_kernel()
+        assert k.state_bound is None
+        assert K.packed_state_bound(k, 5) == 5
+        assert K.packed_state_bound(k, 0) == 2
+
+
+class TestDensePlan:
+    def test_small_set_plans_dense(self):
+        h = gen_set_history(4, 4, 3, 0)
+        p = prepare.prepare(m.set_model(), h)
+        pl = dense.plan(p)
+        assert pl is not None
+        w, ns, nil_id, init_id = pl
+        # nil_id = 2**n_elements; never a reachable mask.
+        assert nil_id == 1 << max(1, len(p.unintern))
+        assert ns >= nil_id + 1
+
+    def test_bigger_set_declines_dense_keeps_sparse_keys(self):
+        h = gen_set_history(8, 4, 3, 1)
+        p = prepare.prepare(m.set_model(), h)
+        assert dense.plan(p) is None       # 2**8 states > dense bound
+        r = bfs.check_packed(p)
+        assert r["valid?"] is cpu.check_packed(p)["valid?"]
+
+
+class TestParityFuzz:
+    @pytest.mark.parametrize("corrupt", [False, True])
+    def test_dense_and_sparse_match_cpu(self, corrupt):
+        mismatches = []
+        dense_ran = 0
+        for seed in range(10):
+            h = gen_set_history(4, 4, 3, seed, corrupt)
+            p = prepare.prepare(m.set_model(), h)
+            assert p.kernel is not None and p.kernel.name == "set"
+            want = cpu.check_packed(p)["valid?"]
+            if dense.plan(p) is not None:
+                dense_ran += 1
+                got = dense.check_packed(p)["valid?"]
+                if got is not want:
+                    mismatches.append(("dense", seed, want, got))
+            got = bfs.check_packed(p)["valid?"]
+            if got is not want:
+                mismatches.append(("sparse", seed, want, got))
+        assert not mismatches, mismatches
+        # Phantom-element corruption can intern a 5th element (33
+        # states — past the dense bound) on some seeds; most still
+        # plan dense, and every one that does must agree.
+        assert dense_ran >= 7
+
+    def test_sparse_packed_wider_sets(self):
+        for seed in range(6):
+            h = gen_set_history(7, 5, 4, 50 + seed, seed % 2 == 0)
+            p = prepare.prepare(m.set_model(), h)
+            want = cpu.check_packed(p)["valid?"]
+            got = bfs.check_packed(p)["valid?"]
+            assert got is want, (seed, want, got)
+
+    def test_device_routing_picks_an_engine(self):
+        from jepsen_tpu.lin import device_check_packed
+
+        h = gen_set_history(4, 3, 3, 3)
+        p = prepare.prepare(m.set_model(), h)
+        r = device_check_packed(p)
+        assert r["valid?"] is True
+        assert r["analyzer"] in ("tpu-dense", "tpu-bfs")

@@ -1,0 +1,363 @@
+"""txn/device.py — device SCC engine vs the oracle (doc/txn.md).
+
+Parity fuzz over random dependency graphs AND seeded-anomaly corpora:
+verdict, anomaly classification, and witness cycles must be identical
+(the oracle Tarjans the full graph; the device trims + min-labels and
+peels the residue — genuinely different decompositions feeding the
+same shared classifier). Plus the fault discipline: iteration-ceiling
+overflow, wedge injection, quarantine routing, and the honest-unknown
+bound all exercise the supervised fallback ladder.
+"""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.lin import supervise
+from jepsen_tpu.txn import device, oracle, pack, synth
+
+# Quick tier, but the SCC program is a real (tiny, cached) XLA compile.
+pytestmark = [pytest.mark.quick, pytest.mark.compiles]
+
+ALL = oracle.CYCLE_ANOMALIES
+
+
+def _random_graph(rng, n_max=40, e_max=120):
+    n = rng.randrange(2, n_max)
+    E = rng.randrange(1, e_max)
+    src, dst, typ = [], [], []
+    for _ in range(E):
+        a, b = rng.randrange(n), rng.randrange(n)
+        if a == b:
+            continue
+        src.append(a)
+        dst.append(b)
+        typ.append(rng.choice((oracle.WR, oracle.WW, oracle.RW)))
+    return oracle.TxnGraph(
+        n=n, src=np.asarray(src, np.int32), dst=np.asarray(dst, np.int32),
+        typ=np.asarray(typ, np.int8))
+
+
+def _device_check(g, anomalies=ALL, **kw):
+    return device.check_packed(pack.pack(graph=g), anomalies=anomalies,
+                               snapshot=False, **kw)
+
+
+class TestParityFuzz:
+    def test_random_graphs(self):
+        rng = random.Random(42)
+        for i in range(40):
+            g = _random_graph(rng)
+            want = oracle.check_graph(g, ALL)
+            got = _device_check(g)
+            assert got["valid?"] == want["valid?"], (i, got, want)
+            assert got["anomaly-types"] == want["anomaly-types"], i
+            assert got["anomalies"] == want["anomalies"], i
+            assert not got.get("fallbacks"), (i, got)
+
+    def test_dense_cyclic_graphs(self):
+        # Mostly-cyclic graphs: the min-label/flag phases do the work
+        # (the residue peel must stay empty or exact).
+        rng = random.Random(7)
+        for i in range(15):
+            n = rng.randrange(4, 20)
+            src, dst, typ = [], [], []
+            for v in range(n):            # a ring + random chords
+                src.append(v)
+                dst.append((v + 1) % n)
+                typ.append(oracle.WW)
+            for _ in range(n):
+                a, b = rng.randrange(n), rng.randrange(n)
+                if a != b:
+                    src.append(a)
+                    dst.append(b)
+                    typ.append(rng.choice((oracle.WR, oracle.RW)))
+            g = oracle.TxnGraph(n=n, src=np.asarray(src, np.int32),
+                                dst=np.asarray(dst, np.int32),
+                                typ=np.asarray(typ, np.int8))
+            want = oracle.check_graph(g, ALL)
+            got = _device_check(g)
+            assert got["anomalies"] == want["anomalies"], i
+
+    @pytest.mark.parametrize("kind",
+                             ["G0", "G1c", "G-single", "G2-item", "G1a"])
+    def test_seeded_corpora(self, kind):
+        from jepsen_tpu import txn
+
+        h = synth.seeded_anomaly_history(kind)
+        got = txn.check(h, algorithm="tpu")
+        want = txn.check(h, algorithm="cpu")
+        assert got["valid?"] is False
+        assert kind in got["anomaly-types"]
+        assert got["anomaly-types"] == want["anomaly-types"]
+        assert got["anomalies"] == want["anomalies"]
+
+    def test_spliced_history_parity(self):
+        from jepsen_tpu import txn
+
+        h = synth.splice_anomaly(
+            synth.generate_list_append_history(300, seed=9),
+            "G2-item", seed=9, n=2)
+        got = txn.check(h, algorithm="tpu")
+        want = txn.check(h, algorithm="cpu")
+        assert got["valid?"] is False and want["valid?"] is False
+        assert got["anomalies"] == want["anomalies"]
+
+    def test_healthy_short_circuits_forward_order(self):
+        from jepsen_tpu import txn
+
+        h = synth.generate_list_append_history(200, seed=1)
+        got = txn.check(h, algorithm="tpu")
+        assert got["valid?"] is True
+        tiers = got["device-stats"]["tiers"]
+        assert all(t.get("short_circuit") == "forward-order"
+                   for t in tiers.values()), tiers
+
+    def test_realtime_packed_checked_serializable_parity(self):
+        # Regression (review finding): a realtime-PACKED history
+        # checked as plain serializable must exclude rt edges from the
+        # device tiers. Polluted tiers merge extra nodes into the SCC
+        # via rt edges; the merged SCC's min node then reaches the real
+        # ww cycle only through rt, the rt-blind shared classifier
+        # finds no witness, and a genuine G0 silently passes.
+        def _t(h, proc, mops, obs=None):
+            from jepsen_tpu.history import Op
+            h.append(Op("invoke", "txn", [list(m) for m in mops], proc))
+            h.append(Op("ok", "txn",
+                        [list(m) for m in (obs or mops)], proc))
+
+        h = []
+        # Sequential txns => rt chain T0->T1->T2->T3. Reads pin key
+        # orders a:[10,20] (ww T1->T2), b:[21,11] (ww T2->T1: the G0
+        # cycle), c:[31,30] (ww T2->T0: the back-edge that drags T0
+        # into the rt-polluted SCC with no outgoing ww).
+        _t(h, 0, [["append", "c", 30]])
+        _t(h, 1, [["append", "a", 10], ["append", "b", 11]])
+        _t(h, 2, [["append", "a", 20], ["append", "b", 21],
+                  ["append", "c", 31]])
+        _t(h, 3, [["r", "a", None], ["r", "b", None], ["r", "c", None]],
+           [["r", "a", [10, 20]], ["r", "b", [21, 11]],
+            ["r", "c", [31, 30]]])
+        pt = pack.pack(h, realtime=True)
+        got = device.check_packed(pt, consistency="serializable",
+                                  snapshot=False)
+        want = oracle.check(h, consistency="serializable")
+        assert want["valid?"] is False and "G0" in want["anomaly-types"]
+        assert got["valid?"] == want["valid?"], got
+        assert got["anomaly-types"] == want["anomaly-types"]
+        assert got["anomalies"] == want["anomalies"]
+        # The same packed history decides strict-serializable too (rt
+        # edges now requested AND packed).
+        strict = device.check_packed(pt, consistency="strict-serializable",
+                                     snapshot=False)
+        assert strict["valid?"] is False
+
+
+class TestAcceptanceScale:
+    def _scale_run(self, n_txns):
+        from jepsen_tpu import txn
+
+        h = synth.splice_anomaly(
+            synth.splice_anomaly(
+                synth.generate_list_append_history(
+                    n_txns, concurrency=30, keys=32, seed=7,
+                    crash_prob=0.0005),
+                "G2-item", seed=3, n=2),
+            "G-single", seed=5)
+        got = txn.check(h, consistency="serializable", algorithm="tpu")
+        want = txn.check(h, consistency="serializable", algorithm="cpu")
+        assert got["valid?"] is False and want["valid?"] is False
+        assert {"G2-item", "G-single"} <= set(got["anomaly-types"])
+        # Verdict AND witness-cycle parity (the ISSUE 9 acceptance).
+        assert got["anomaly-types"] == want["anomaly-types"]
+        assert got["anomalies"] == want["anomalies"]
+        assert not got.get("fallbacks"), got.get("fallbacks")
+        return got
+
+    def test_5k_txn_parity(self):
+        # The tier-1-sized slice of the acceptance shape; the literal
+        # 100k-op run is the slow twin below (and bench's txn_c30).
+        self._scale_run(2500)
+
+    @pytest.mark.slow
+    def test_100k_op_acceptance_parity(self):
+        got = self._scale_run(50_000)
+        assert got["device-stats"]["edges"] > 100_000
+
+
+class TestFaultDiscipline:
+    def _cyclic_graph(self):
+        return oracle.TxnGraph(
+            n=6,
+            src=np.asarray([0, 3, 1, 4], np.int32),
+            dst=np.asarray([3, 0, 4, 1], np.int32),
+            typ=np.asarray([oracle.WW] * 4, np.int8))
+
+    def test_iteration_ceiling_overflow_falls_back_honestly(
+            self, monkeypatch, tmp_path):
+        monkeypatch.setenv("JEPSEN_TPU_TXN_IT_MAX", "1")
+        monkeypatch.setenv("JEPSEN_TPU_QUARANTINE",
+                           str(tmp_path / "q.json"))
+        g = self._cyclic_graph()
+        got = _device_check(g)
+        # Verdict still exact (host Tarjan rung), overflow attributed.
+        assert got["valid?"] is False
+        assert got["anomalies"] == oracle.check_graph(g, ALL)["anomalies"]
+        assert got["fallbacks"].get("ww") == "overflow: budget"
+        assert all(v == "overflow: budget"
+                   for v in got["fallbacks"].values())
+        assert got["device-stats"].get("overflows", 0) >= 1
+
+    def test_cpu_bound_reports_honest_unknown(self, monkeypatch,
+                                              tmp_path):
+        monkeypatch.setenv("JEPSEN_TPU_TXN_IT_MAX", "1")
+        monkeypatch.setenv("JEPSEN_TPU_TXN_CPU_MAX", "0")
+        monkeypatch.setenv("JEPSEN_TPU_QUARANTINE",
+                           str(tmp_path / "q.json"))
+        got = _device_check(self._cyclic_graph())
+        assert got["valid?"] == "unknown"
+        assert "overflow" in got
+        assert "JEPSEN_TPU_TXN_CPU_MAX" in got["error"]
+
+    def test_wedge_injection_retries_then_falls_back(self, monkeypatch,
+                                                     tmp_path):
+        monkeypatch.setenv("JEPSEN_TPU_QUARANTINE",
+                           str(tmp_path / "q.json"))
+        monkeypatch.setenv("JEPSEN_TPU_DISPATCH_RETRIES", "0")
+        supervise.inject_wedge("txn-scc", 3, 0.05)
+        try:
+            g = self._cyclic_graph()
+            got = _device_check(g, anomalies=("G0",))
+            # The tier wedged -> host rung; verdict exact; watchdog
+            # trip + ledger record visible.
+            assert got["valid?"] is False
+            assert got["fallbacks"] == {"ww": "wedge"}
+            assert got["device-stats"]["watchdog_trips"] >= 1
+            ledger = supervise.load_ledger(str(tmp_path / "q.json"))
+            assert any(k.startswith("txn-scc|") for k in ledger), ledger
+        finally:
+            supervise._injected.clear()
+
+    def test_quarantined_shape_routes_to_host(self, monkeypatch,
+                                              tmp_path):
+        qpath = str(tmp_path / "q.json")
+        monkeypatch.setenv("JEPSEN_TPU_QUARANTINE", qpath)
+        g = self._cyclic_graph()
+        key = supervise.shape_key(
+            "txn-scc", cap=device.MIN_EDGE_PAD, window=0,
+            kernel="txn-ww", rows=device.MIN_NODE_PAD)
+        supervise.record_fault(key, "fault", path=qpath)
+        got = _device_check(g, anomalies=("G0",))
+        assert got["valid?"] is False
+        assert got["fallbacks"] == {"ww": "quarantined"}
+        assert got["device-stats"]["quarantine_skips"] == 1
+
+    def test_stats_snapshot_written(self, monkeypatch, tmp_path):
+        snap_path = tmp_path / "txn_stats.json"
+        monkeypatch.setenv("JEPSEN_TPU_TXN_STATS", str(snap_path))
+        from jepsen_tpu import txn
+
+        r = txn.check(synth.seeded_anomaly_history("G0"),
+                      algorithm="tpu")
+        assert r["valid?"] is False
+        snap = json.loads(snap_path.read_text())
+        assert snap["verdict"] is False
+        assert snap["anomaly_counts"].get("G0") == 1
+        assert "device" in snap and "edge_counts" in snap
+
+
+class TestWorkload:
+    def test_txn_workload_fake_client_round_trip(self):
+        from jepsen_tpu.history import Op
+        from jepsen_tpu.suites import fakes, workloads
+
+        store = fakes.FakeTxnStore()
+        client = workloads.TxnClient(store)
+        op = Op("invoke", "txn", [["append", 0, 1], ["r", 0, None]], 0)
+        done = client.invoke(None, op)
+        assert done.type == "ok"
+        assert done.value == [["append", 0, 1], ["r", 0, [1]]]
+
+    def test_write_skew_store_produces_g2(self):
+        import threading
+
+        from jepsen_tpu import txn
+        from jepsen_tpu.history import Op
+        from jepsen_tpu.suites import fakes, workloads
+
+        store = fakes.FakeTxnStore(faulty="write-skew")
+        client = workloads.TxnClient(store)
+        h = []
+        lock = threading.Lock()
+
+        def run(proc, read_k, append_k):
+            op = Op("invoke", "txn",
+                    [["r", read_k, None], ["append", append_k, proc + 1]],
+                    proc)
+            done = client.invoke(None, op)
+            with lock:
+                h.append(op)
+                h.append(done)
+
+        ts = [threading.Thread(target=run, args=(0, "x", "y")),
+              threading.Thread(target=run, args=(1, "y", "x"))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        # A later reader pins both version orders.
+        op = Op("invoke", "txn", [["r", "x", None], ["r", "y", None]], 2)
+        h.append(op)
+        h.append(client.invoke(None, op))
+        r = txn.check(h, consistency="serializable", algorithm="cpu")
+        assert r["valid?"] is False
+        assert "G2-item" in r["anomaly-types"], r
+        # ...and snapshot isolation admits exactly this.
+        si = txn.check(h, consistency="snapshot-isolation",
+                       algorithm="cpu")
+        assert si["valid?"] is True, si
+
+    def test_aborted_read_store_produces_g1a(self):
+        from jepsen_tpu import txn
+        from jepsen_tpu.history import Op
+        from jepsen_tpu.suites import fakes, workloads
+
+        store = fakes.FakeTxnStore(faulty="aborted-read")
+        client = workloads.TxnClient(store)
+        h = []
+        for i in range(5):     # the 5th appending txn aborts-but-applies
+            op = Op("invoke", "txn", [["append", "k", i]], i)
+            h.append(op)
+            h.append(client.invoke(None, op))
+        op = Op("invoke", "txn", [["r", "k", None]], 9)
+        h.append(op)
+        h.append(client.invoke(None, op))
+        r = txn.check(h, algorithm="cpu")
+        assert r["valid?"] is False
+        assert "G1a" in r["anomaly-types"], r
+
+    def test_workload_registry_and_checker_wiring(self):
+        from jepsen_tpu.suites import workloads
+
+        wl = workloads.REGISTRY["txn"]()
+        assert wl["checker"].is_txn_cycles
+        assert wl["model"] is None
+
+    def test_healthy_workload_end_to_end(self):
+        import random as random_mod
+
+        from jepsen_tpu import core
+        from jepsen_tpu.suites import common, workloads
+
+        random_mod.seed(5)
+        wl = workloads.txn_workload(n=40, stagger=0.0, algorithm="cpu")
+        t = common.suite_test("txn-fake",
+                              {"time-limit": 5, "concurrency": 4,
+                               "fake": True},
+                              workload=wl)
+        t["name"] = None
+        res = core.run(t)["results"]
+        r = res.get("workload", res)
+        assert r.get("valid?") is True, r

@@ -1,0 +1,415 @@
+"""txn/oracle.py — edge inference, classification, witnesses, and the
+adya G2 bridge (doc/txn.md). Pure host: the oracle is the executable
+spec the device engine is parity-fuzzed against (test_txn_device.py).
+"""
+
+import pytest
+
+from jepsen_tpu.history import Op
+from jepsen_tpu.txn import oracle, synth
+
+# Quick tier: no XLA compiles (the oracle never touches jax).
+pytestmark = pytest.mark.quick
+
+
+def _txn(h, proc, inv, done=None, typ="ok"):
+    h.append(Op("invoke", "txn", [list(m) for m in inv], proc))
+    if typ != "info":
+        h.append(Op(typ, "txn",
+                    [list(m) for m in (done if done is not None else inv)],
+                    proc))
+
+
+class TestInference:
+    def test_wr_ww_rw_edges(self):
+        h = []
+        _txn(h, 0, [["append", "x", 1]])
+        _txn(h, 1, [["append", "x", 2]])
+        _txn(h, 2, [["r", "x", None]], [["r", "x", [1]]])
+        _txn(h, 3, [["r", "x", None]], [["r", "x", [1, 2]]])
+        g = oracle.infer(h)
+        edges = {(int(s), int(d), int(t))
+                 for s, d, t in zip(g.src, g.dst, g.typ)}
+        # ww: writer(1) -> writer(2); wr: writer(1) -> T2 (last elem),
+        # writer(2) -> T3; rw: T2 (prefix [1]) -> writer(2).
+        assert (0, 1, oracle.WW) in edges
+        assert (0, 2, oracle.WR) in edges
+        assert (1, 3, oracle.WR) in edges
+        assert (2, 1, oracle.RW) in edges
+        assert g.anomalies == {}
+
+    def test_empty_read_antidepends_on_first_writer(self):
+        h = []
+        _txn(h, 0, [["r", "x", None]], [["r", "x", []]])
+        _txn(h, 1, [["append", "x", 1]])
+        _txn(h, 2, [["r", "x", None]], [["r", "x", [1]]])
+        g = oracle.infer(h)
+        edges = {(int(s), int(d), int(t))
+                 for s, d, t in zip(g.src, g.dst, g.typ)}
+        assert (0, 1, oracle.RW) in edges
+
+    def test_info_append_counts_only_when_observed(self):
+        # Recoverable-write rule: an :info txn's append constrains the
+        # order iff some read observed it.
+        h = []
+        _txn(h, 0, [["append", "x", 1]], typ="info")   # observed below
+        _txn(h, 1, [["append", "y", 7]], typ="info")   # never observed
+        _txn(h, 2, [["r", "x", None]], [["r", "x", [1]]])
+        g = oracle.infer(h)
+        edges = {(int(s), int(d), int(t))
+                 for s, d, t in zip(g.src, g.dst, g.typ)}
+        assert (0, 2, oracle.WR) in edges
+        assert not any(int(s) == 1 or int(d) == 1
+                       for s, d in zip(g.src, g.dst))
+        assert g.stats["info_txns"] == 2
+        assert g.anomalies == {}           # an observed info write is fine
+
+    def test_failed_append_read_is_g1a(self):
+        h = []
+        _txn(h, 0, [["append", "x", 9]], typ="fail")
+        _txn(h, 1, [["r", "x", None]], [["r", "x", [9]]])
+        r = oracle.check(h)
+        assert r["valid?"] is False
+        assert r["anomaly-types"] == ["G1a"]
+
+    def test_incompatible_order(self):
+        h = []
+        _txn(h, 0, [["append", "x", 1]])
+        _txn(h, 1, [["append", "x", 2]])
+        _txn(h, 2, [["r", "x", None]], [["r", "x", [1, 2]]])
+        _txn(h, 3, [["r", "x", None]], [["r", "x", [2]]])   # not a prefix
+        r = oracle.check(h)
+        assert r["valid?"] is False
+        assert "incompatible-order" in r["anomaly-types"]
+
+    def test_duplicate_elements(self):
+        h = []
+        _txn(h, 0, [["append", "x", 1]])
+        _txn(h, 1, [["append", "x", 1]])    # same (k, v) twice
+        r = oracle.check(h)
+        assert "duplicate-elements" in r["anomaly-types"]
+
+    def test_garbage_read_convicted(self):
+        # Regression (review finding): a read observing a value NO
+        # transaction ever appended (not even a failed one — that
+        # would be G1a) is store corruption; it maps to no writer and
+        # forms no cycle, so it must be reported directly.
+        h = []
+        _txn(h, 0, [["append", "x", 1]])
+        _txn(h, 1, [["r", "x", None]], [["r", "x", [1, 999]]])
+        r = oracle.check(h)
+        assert r["valid?"] is False
+        assert "garbage-read" in r["anomaly-types"]
+        w = r["anomalies"]["garbage-read"][0]
+        assert w["key"] == "x" and w["value"] == 999
+        assert oracle.infer(h).stats["garbage"] == 1
+
+    def test_fail_txn_dropped_from_graph(self):
+        h = []
+        _txn(h, 0, [["append", "x", 1]])
+        _txn(h, 1, [["append", "x", 2]], typ="fail")
+        g = oracle.infer(h)
+        assert g.n == 1
+
+    def test_unsupported_microop_raises(self):
+        h = []
+        _txn(h, 0, [["cas", "x", 1]])
+        with pytest.raises(oracle.UnsupportedTxnHistory):
+            oracle.infer(h)
+        assert oracle.check(h)["valid?"] == "unknown"
+
+    def test_realtime_frontier_reduction(self):
+        # A completes, then B runs, then C: rt edges A->B, B->C, A->C
+        # is implied (A left the frontier when B completed) — the
+        # reduction keeps A->B and B->C only.
+        h = []
+        _txn(h, 0, [["append", "x", 1]])
+        _txn(h, 1, [["append", "x", 2]])
+        _txn(h, 2, [["r", "x", None]], [["r", "x", [1, 2]]])
+        g = oracle.infer(h, realtime=True)
+        rt = {(int(s), int(d)) for s, d, t in zip(g.src, g.dst, g.typ)
+              if int(t) == oracle.RT}
+        assert rt == {(0, 1), (1, 2)}
+
+
+class TestClassification:
+    @pytest.mark.parametrize("kind",
+                             ["G0", "G1c", "G-single", "G2-item", "G1a"])
+    def test_seeded_anomaly_found(self, kind):
+        r = oracle.check(synth.seeded_anomaly_history(kind))
+        assert r["valid?"] is False
+        assert kind in r["anomaly-types"], r["anomaly-types"]
+        w = r["anomalies"][kind][0]
+        if kind != "G1a":
+            # Witness cycle: nodes + edge types + op summaries.
+            assert len(w["nodes"]) == len(w["edges"]) >= 2
+            assert "ops" in w and w["ops"]
+
+    def test_witness_rw_counts(self):
+        r = oracle.check(synth.seeded_anomaly_history("G-single"))
+        assert r["anomalies"]["G-single"][0]["rw-count"] == 1
+        r = oracle.check(synth.seeded_anomaly_history("G2-item"))
+        assert r["anomalies"]["G2-item"][0]["rw-count"] >= 2
+        r = oracle.check(synth.seeded_anomaly_history("G0"))
+        assert set(r["anomalies"]["G0"][0]["edges"]) == {"ww"}
+
+    def test_consistency_models(self):
+        g2 = synth.seeded_anomaly_history("G2-item")
+        assert oracle.check(g2, consistency="serializable")["valid?"] \
+            is False
+        # SI admits pure write skew...
+        assert oracle.check(
+            g2, consistency="snapshot-isolation")["valid?"] is True
+        # ...but not read skew.
+        gs = synth.seeded_anomaly_history("G-single")
+        assert oracle.check(
+            gs, consistency="snapshot-isolation")["valid?"] is False
+        # Read committed admits both anti-dependency shapes.
+        assert oracle.check(
+            gs, consistency="read-committed")["valid?"] is True
+        with pytest.raises(ValueError):
+            oracle.check(g2, consistency="nope")
+
+    def test_explicit_anomaly_tuple(self):
+        g0 = synth.seeded_anomaly_history("G0")
+        assert oracle.check(g0, anomalies=("G1c",))["valid?"] is True
+        assert oracle.check(g0, anomalies=("G0",))["valid?"] is False
+
+    def test_rw_only_request_searches_wwr_coincident_scc(self):
+        # Regression (review finding): an SCC whose node set exactly
+        # equals a wwr SCC still holds rw-bearing cycles; an explicit
+        # rw-classes-only request must find them, not skip the SCC as
+        # "already explained" by classes nobody requested.
+        import numpy as np
+
+        g = oracle.TxnGraph(
+            n=2,
+            src=np.asarray([0, 1, 0], np.int32),
+            dst=np.asarray([1, 0, 1], np.int32),
+            typ=np.asarray([oracle.WW, oracle.WR, oracle.RW], np.int8))
+        r = oracle.check_graph(g, ("G-single",))
+        assert r["valid?"] is False
+        assert r["anomaly-types"] == ["G-single"]
+        # ...and per Adya a 1-rw cycle is also a G2 (superset class).
+        r2 = oracle.check_graph(g, ("G2-item",))
+        assert r2["valid?"] is False
+        assert r2["anomaly-types"] == ["G2-item"]
+        # The strongest-explanation skip still applies when the ww/wr
+        # classes ARE requested.
+        r3 = oracle.check_graph(g, ("G1c", "G-single"))
+        assert r3["anomaly-types"] == ["G1c"]
+
+    def test_skip_requires_covering_class_actually_reported(self):
+        # Regression (review finding): the strongest-explanation skip
+        # must fire only for SCCs actually REPORTED under G0/G1c. Here
+        # the covering wwr SCC is a pure wr cycle — with G0 requested
+        # but G1c not, nothing reports it, and the requested G2-item
+        # (the rw cycles inside the same node set) must not vanish.
+        import numpy as np
+
+        g = oracle.TxnGraph(
+            n=2,
+            src=np.asarray([0, 1, 0, 1], np.int32),
+            dst=np.asarray([1, 0, 1, 0], np.int32),
+            typ=np.asarray([oracle.WR, oracle.WR,
+                            oracle.RW, oracle.RW], np.int8))
+        r = oracle.check_graph(g, ("G0", "G2-item"))
+        assert r["valid?"] is False
+        assert r["anomaly-types"] == ["G2-item"]
+
+    def test_skip_requires_g1c_witness_not_just_request(self):
+        # The covering wwr SCC cycles via ww ONLY (no internal wr), so
+        # a G1c request reports nothing for it — its rw cycle must
+        # still be searched under the requested rw class.
+        import numpy as np
+
+        g = oracle.TxnGraph(
+            n=2,
+            src=np.asarray([0, 1, 0], np.int32),
+            dst=np.asarray([1, 0, 1], np.int32),
+            typ=np.asarray([oracle.WW, oracle.WW, oracle.RW], np.int8))
+        r = oracle.check_graph(g, ("G1c", "G2-item"))
+        assert r["valid?"] is False
+        assert r["anomaly-types"] == ["G2-item"]
+        # With G0 requested the SCC IS reported there and the skip is
+        # legitimate: strongest explanation wins.
+        r2 = oracle.check_graph(g, ("G0", "G2-item"))
+        assert r2["anomaly-types"] == ["G0"]
+
+    def test_healthy_generator_valid(self):
+        h = synth.generate_list_append_history(
+            600, concurrency=8, keys=6, seed=11, crash_prob=0.02,
+            max_crashes=5)
+        r = oracle.check(h, consistency="serializable")
+        assert r["valid?"] is True, r
+        assert r["stats"]["edges"] > 0
+
+    def test_healthy_strict_serializable_valid(self):
+        h = synth.generate_list_append_history(
+            300, concurrency=6, keys=4, seed=5)
+        r = oracle.check(h, consistency="strict-serializable")
+        assert r["valid?"] is True, r
+        assert r["stats"]["edge_counts"]["rt"] > 0
+
+    def test_spliced_anomaly_found_in_big_history(self):
+        h = synth.splice_anomaly(
+            synth.generate_list_append_history(400, seed=2),
+            "G-single", seed=2, n=2)
+        r = oracle.check(h)
+        assert r["valid?"] is False
+        assert "G-single" in r["anomaly-types"]
+
+    def test_witness_is_canonical_and_minimal(self):
+        # The witness for the 2-cycle seeds is exactly the 2-cycle
+        # through the smallest node — deterministic across runs.
+        r1 = oracle.check(synth.seeded_anomaly_history("G1c"))
+        r2 = oracle.check(synth.seeded_anomaly_history("G1c"))
+        w = r1["anomalies"]["G1c"][0]
+        assert w["nodes"] == r2["anomalies"]["G1c"][0]["nodes"]
+        assert len(w["nodes"]) == 2 and w["nodes"][0] == min(w["nodes"])
+
+
+class TestTarjan:
+    def test_matches_bruteforce_components(self):
+        import numpy as np
+        import random
+
+        rng = random.Random(4)
+        for _ in range(25):
+            n = rng.randrange(2, 12)
+            edges = {(rng.randrange(n), rng.randrange(n))
+                     for _ in range(rng.randrange(1, 3 * n))}
+            edges = [(a, b) for a, b in edges if a != b]
+            src = np.array([a for a, _ in edges], np.int32)
+            dst = np.array([b for _, b in edges], np.int32)
+            got = oracle.tarjan(n, src, dst)
+            # Brute force: reachability closure.
+            reach = [[False] * n for _ in range(n)]
+            for a, b in edges:
+                reach[a][b] = True
+            for k in range(n):
+                for i in range(n):
+                    for j in range(n):
+                        reach[i][j] = reach[i][j] or (reach[i][k]
+                                                      and reach[k][j])
+            comps = {}
+            for v in range(n):
+                rep = min([v] + [u for u in range(n)
+                                 if reach[v][u] and reach[u][v]])
+                comps.setdefault(rep, set()).add(v)
+            want = sorted(sorted(c) for c in comps.values()
+                          if len(c) > 1)
+            assert sorted(got) == want, (edges, got, want)
+
+
+class TestAdyaBridge:
+    def _g2_history(self, both: bool):
+        from jepsen_tpu import independent
+
+        kv = independent.tuple_
+        h = [Op("invoke", "insert", kv(1, {"key": 1, "id": 0}), 0),
+             Op("invoke", "insert", kv(1, {"key": 1, "id": 1}), 1),
+             Op("ok", "insert", kv(1, {"key": 1, "id": 0}), 0),
+             Op("ok" if both else "fail", "insert",
+                kv(1, {"key": 1, "id": 1}), 1)]
+        return h
+
+    def test_double_insert_classifies_g2_item(self):
+        from jepsen_tpu import adya
+
+        th = adya.history_to_txn(self._g2_history(both=True))
+        r = oracle.check(th, consistency="serializable")
+        assert r["valid?"] is False
+        assert "G2-item" in r["anomaly-types"], r
+
+    def test_serializable_g2_run_converts_valid(self):
+        from jepsen_tpu import adya
+
+        th = adya.history_to_txn(self._g2_history(both=False))
+        r = oracle.check(th, consistency="serializable")
+        assert r["valid?"] is True, r
+
+    def test_workload_fake_parity(self):
+        # The fake G2 client's own histories, bridged: faulty="g2"
+        # must be a txn G2-item; the serializable fake must convert
+        # valid — the 104-line probe and the general checker agree.
+        from jepsen_tpu import adya
+
+        for faulty, valid in (("g2", False), (None, True)):
+            client = adya._FakeG2Client(faulty=faulty)
+            h = []
+            for pid in (0, 1):
+                c = client.open(None, "n1")
+                op = Op("invoke", "insert", {"key": 5, "id": pid}, pid)
+                h.append(op)
+                h.append(c.invoke(None, op))
+            r = oracle.check(adya.history_to_txn(h))
+            assert r["valid?"] is valid, (faulty, r)
+
+    def test_bare_values_keep_their_keys(self):
+        # Regression (review finding): bare (un-lifted) op values must
+        # take their key from the payload — collapsing every key onto
+        # the "None:*" namespace aliased different keys' winning rows
+        # into fabricated duplicate-elements convictions.
+        from jepsen_tpu import adya
+
+        h = []
+        for pid, key in ((0, 1), (1, 2)):    # two keys, one winner each
+            client = adya._FakeG2Client(faulty=None)
+            c = client.open(None, "n1")
+            op = Op("invoke", "insert", {"key": key, "id": 0}, pid)
+            h.append(op)
+            h.append(c.invoke(None, op))
+        th = adya.history_to_txn(h)
+        assert all(m[1].startswith(("1:", "2:"))
+                   for o in th for m in o.value)
+        r = oracle.check(th)
+        assert r["valid?"] is True, r
+
+
+class TestG2Coverage:
+    def _independent_history(self, outcomes):
+        from jepsen_tpu import independent
+
+        kv = independent.tuple_
+        h = []
+        for k, (a, b) in enumerate(outcomes):
+            for pid, typ in ((2 * k, a), (2 * k + 1, b)):
+                i = pid % 2
+                h.append(Op("invoke", "insert",
+                            kv(k, {"key": k, "id": i}), pid))
+                h.append(Op(typ, "insert",
+                            kv(k, {"key": k, "id": i}), pid))
+        return h
+
+    def test_coverage_aggregation(self):
+        from jepsen_tpu import adya
+
+        ck = adya.workload()["checker"]
+        # key 0: race decided (one winner); key 1: vacuous; key 2: G2.
+        r = ck.check(None, None, self._independent_history(
+            [("ok", "fail"), ("fail", "fail"), ("ok", "ok")]), {})
+        assert r["valid?"] is False
+        assert r["keys-total"] == 3
+        assert r["keys-exercised"] == 1
+        assert r["keys-anomalous"] == 1
+        assert r["keys-empty"] == 1
+
+    def test_vacuous_pass_degrades_to_unknown(self):
+        from jepsen_tpu import adya
+
+        ck = adya.workload()["checker"]
+        r = ck.check(None, None, self._independent_history(
+            [("fail", "fail"), ("fail", "fail")]), {})
+        assert r["valid?"] == "unknown"
+        assert r["keys-exercised"] == 0
+        assert "vacuous" in r["error"]
+
+    def test_clean_coverage_stays_valid(self):
+        from jepsen_tpu import adya
+
+        ck = adya.workload()["checker"]
+        r = ck.check(None, None, self._independent_history(
+            [("ok", "fail"), ("fail", "ok")]), {})
+        assert r["valid?"] is True
+        assert r["keys-exercised"] == 2

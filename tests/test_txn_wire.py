@@ -1,0 +1,342 @@
+"""List-append txn wire clients against in-process fake SQL servers
+(the house pattern, test_crdb_sql_clients.py): the pgwire TxnClient
+(cockroachdb + postgres-rds) and the mysqlwire TxnAppendClient
+(tidb + galera) execute micro-op transactions against a tiny
+list-append SQL engine behind the REAL wire protocols — framing,
+BEGIN/COMMIT, retry, and the `:info`-on-ambiguous-commit soundness
+contract all run for real.
+"""
+
+import re
+import socket
+import struct
+import threading
+
+import pytest
+
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import cockroachdb as cr
+
+from test_crdb_sql_clients import PgWireServer
+from test_mysqlwire import NONCE, _greeting, _packet, _read_packet
+
+# Quick tier: no XLA compiles (the cpu oracle checks the histories).
+pytestmark = pytest.mark.quick
+
+
+class MiniTxnEngine:
+    """List-append SQL in both dialects: INSERT .. ON CONFLICT/ON
+    DUPLICATE KEY concat, SELECT vals. Staged writes are visible to
+    the transaction's own reads and apply at COMMIT. Knobs:
+    ``abort_commits`` raises 40001 on the first N commits (retry
+    path); ``ambiguous_commits`` raises XXA00 AFTER applying (the
+    commit-fate-unknown path — the client must complete ``:info``)."""
+
+    def __init__(self, abort_commits: int = 0, ambiguous_commits: int = 0):
+        self.lists: dict = {}
+        self.glock = threading.RLock()
+        self.abort_commits = abort_commits
+        self.ambiguous_commits = ambiguous_commits
+
+    def execute(self, sql: str, txn):
+        s = " ".join(sql.split())
+        if s in ("BEGIN", "COMMIT", "ROLLBACK"):
+            return self._txn_ctl(s, txn)
+        with self.glock:
+            if s.startswith("CREATE") or s.startswith("SET TRANSACTION"):
+                return []
+            m = re.match(r"INSERT INTO (\S+) \(k, vals\) VALUES "
+                         r"\((\d+), '(\d+)'\) ON ", s)
+            if m:
+                _t, k, v = m.groups()
+                txn.setdefault("appends", []).append((int(k), int(v)))
+                return []
+            m = re.match(r"SELECT vals FROM (\S+) WHERE k = (\d+)$", s)
+            if m:
+                k = int(m.group(2))
+                vals = list(self.lists.get(k, []))
+                vals += [v for kk, v in txn.get("appends", [])
+                         if kk == k]
+                return [(",".join(str(v) for v in vals) or None,)]
+        raise ValueError(f"unhandled sql {s!r}")
+
+    def _txn_ctl(self, s, txn):
+        if s == "BEGIN":
+            txn["open"] = True
+            txn["appends"] = []
+            return []
+        if s == "ROLLBACK":
+            txn["open"] = False
+            txn["appends"] = []
+            return []
+        with self.glock:
+            try:
+                if self.abort_commits > 0 and txn.get("appends"):
+                    self.abort_commits -= 1
+                    raise KeyError("40001", "restart transaction")
+                for k, v in txn.get("appends", []):
+                    self.lists.setdefault(k, []).append(v)
+                if self.ambiguous_commits > 0 and txn.get("appends"):
+                    self.ambiguous_commits -= 1
+                    # Applied, but the client cannot know that.
+                    raise KeyError("XXA00", "ambiguous commit result")
+            finally:
+                txn["open"] = False
+                txn["appends"] = []
+            return []
+
+
+def _pg_client(engine):
+    srv = PgWireServer(engine)
+    client = cr.TxnClient(port=srv.port).open(None, "127.0.0.1")
+    return srv, client
+
+
+def _txn_op(mops, proc=0):
+    return Op("invoke", "txn", [list(m) for m in mops], proc)
+
+
+class TestPgTxnClient:
+    def test_round_trip_and_checker_valid(self):
+        srv, c = _pg_client(MiniTxnEngine())
+        try:
+            h = []
+            for mops in ([["append", 1, 1], ["r", 1, None]],
+                         [["append", 1, 2]],
+                         [["r", 1, None], ["append", 2, 3]],
+                         [["r", 1, None], ["r", 2, None]]):
+                op = _txn_op(mops)
+                h.append(op)
+                h.append(c.invoke(None, op))
+            done = h[-1]
+            assert done.type == "ok"
+            assert done.value == [["r", 1, [1, 2]], ["r", 2, [3]]]
+            # Own staged append visible to the txn's later read.
+            assert h[1].value == [["append", 1, 1], ["r", 1, [1]]]
+
+            from jepsen_tpu import txn
+
+            r = txn.check(h, algorithm="cpu")
+            assert r["valid?"] is True, r
+        finally:
+            c.close(None)
+            srv.close()
+
+    def test_serialization_abort_retries(self):
+        srv, c = _pg_client(MiniTxnEngine(abort_commits=1))
+        try:
+            done = c.invoke(None, _txn_op([["append", 5, 9]]))
+            assert done.type == "ok"           # retried past the 40001
+        finally:
+            c.close(None)
+            srv.close()
+
+    def test_ambiguous_commit_completes_info_never_fail(self):
+        engine = MiniTxnEngine(ambiguous_commits=1)
+        srv, c = _pg_client(engine)
+        try:
+            done = c.invoke(None, _txn_op([["append", 7, 1]]))
+            assert done.type == "info"         # applied; fail = unsound
+            assert engine.lists[7] == [1]
+            # A later read observes it — the checker must stay valid
+            # because the :info txn's write is recoverable.
+            h = [_txn_op([["append", 7, 1]]),
+                 done.replace(type="info"),
+                 _txn_op([["r", 7, None]], 1),
+                 Op("ok", "txn", [["r", 7, [1]]], 1)]
+            from jepsen_tpu import txn
+
+            assert txn.check(h, algorithm="cpu")["valid?"] is True
+        finally:
+            c.close(None)
+            srv.close()
+
+
+# --- mysql: engine-backed fake server over the real wire protocol -----------
+
+
+def _my_err(code: str, msg: str) -> bytes:
+    return (b"\xff" + struct.pack("<H", 1213)
+            + b"#" + code.encode() + msg.encode())
+
+
+class MyWireServer:
+    """Handshake + COM_QUERY dispatch into MiniTxnEngine (auth
+    accepted unconditionally; result sets are one string column)."""
+
+    def __init__(self, engine: MiniTxnEngine):
+        self.engine = engine
+        self.srv = socket.socket()
+        self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(8)
+        self.port = self.srv.getsockname()[1]
+        self.alive = True
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while self.alive:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        txn: dict = {"open": False, "appends": []}
+        buf = bytearray()
+        try:
+            conn.sendall(_packet(0, _greeting(NONCE)))
+            _read_packet(conn, buf)            # handshake response
+            conn.sendall(_packet(2, b"\x00\x00\x00\x02\x00\x00\x00"))
+            while True:
+                cmd = _read_packet(conn, buf)
+                if not cmd or cmd[:1] == b"\x01":          # COM_QUIT
+                    return
+                if cmd[:1] != b"\x03":
+                    conn.sendall(_packet(1, b"\x00\x00\x00\x02\x00"
+                                         b"\x00\x00"))
+                    continue
+                sql = cmd[1:].decode()
+                try:
+                    rows = self.engine.execute(sql, txn)
+                except KeyError as e:
+                    code, msg = e.args
+                    conn.sendall(_packet(1, _my_err(code, msg)))
+                    continue
+                except ValueError as e:
+                    conn.sendall(_packet(1, _my_err("42000", str(e))))
+                    continue
+                if not rows:
+                    conn.sendall(_packet(1, b"\x00\x00\x00\x02\x00"
+                                         b"\x00\x00"))
+                    continue
+                pkts = [b"\x01", b"\x03def",
+                        b"\xfe\x00\x00\x02\x00"]
+                for row in rows:
+                    cell = row[0]
+                    if cell is None:
+                        pkts.append(b"\xfb")
+                    else:
+                        cb = str(cell).encode()
+                        pkts.append(bytes([len(cb)]) + cb)
+                pkts.append(b"\xfe\x00\x00\x02\x00")
+                for i, p in enumerate(pkts):
+                    conn.sendall(_packet(1 + i, p))
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self.alive = False
+        self.srv.close()
+
+
+def _my_client(engine):
+    from jepsen_tpu.suites import mysql_clients
+
+    srv = MyWireServer(engine)
+    client = mysql_clients.TxnAppendClient(port=srv.port) \
+        .open(None, "127.0.0.1")
+    return srv, client
+
+
+class TestMysqlTxnClient:
+    def test_round_trip_and_checker_valid(self):
+        srv, c = _my_client(MiniTxnEngine())
+        try:
+            h = []
+            for mops in ([["append", 1, 1]],
+                         [["r", 1, None], ["append", 1, 2]],
+                         [["r", 1, None]]):
+                op = _txn_op(mops)
+                h.append(op)
+                h.append(c.invoke(None, op))
+            assert h[-1].type == "ok"
+            assert h[-1].value == [["r", 1, [1, 2]]]
+
+            from jepsen_tpu import txn
+
+            assert txn.check(h, algorithm="cpu")["valid?"] is True
+        finally:
+            c.close(None)
+            srv.close()
+
+    def test_commit_error_completes_info(self):
+        engine = MiniTxnEngine(ambiguous_commits=1)
+        srv, c = _my_client(engine)
+        try:
+            done = c.invoke(None, _txn_op([["append", 3, 4]]))
+            assert done.type == "info"
+            assert engine.lists[3] == [4]      # applied — fail = unsound
+        finally:
+            c.close(None)
+            srv.close()
+
+    def test_statement_error_fails_definitely(self):
+        srv, c = _my_client(MiniTxnEngine())
+        try:
+            done = c.invoke(
+                None, Op("invoke", "weird", [["r", 1, None]], 0))
+            assert done.type == "fail"
+        finally:
+            c.close(None)
+            srv.close()
+
+
+class TestSuiteWiring:
+    def test_all_four_sql_suites_expose_txn(self):
+        from jepsen_tpu.suites import workloads
+
+        # cockroachdb: registry + client factory.
+        assert "txn" in cr.tests_registry()
+        assert cr.tests_registry()["txn"]()["checker"].is_txn_cycles
+        t = cr.test({"workload": "txn", "fake": False, "nodes": ["n1"]})
+        assert isinstance(t["client"], cr.TxnClient)
+        assert isinstance(t["generator"], object)
+
+        # The fake-mode map carries the workload's fake txn client.
+        t = cr.test({"workload": "txn", "fake": True, "nodes": ["n1"]})
+        assert isinstance(t["client"], workloads.TxnClient)
+
+        # tidb routes the mysql-dialect client.
+        from jepsen_tpu.suites import mysql_clients, tidb
+
+        t = tidb.test({"workload": "txn", "fake": False,
+                       "nodes": ["n1"]})
+        assert isinstance(t["client"], mysql_clients.TxnAppendClient)
+
+        # galera via the shared registry helper.
+        wl, client = mysql_clients.bank_or_dirty_reads("txn")
+        assert wl["checker"].is_txn_cycles
+        assert isinstance(client, mysql_clients.TxnAppendClient)
+
+        # postgres-rds txn reuses the pgwire client with RDS params.
+        from jepsen_tpu.suites import postgres_rds
+
+        t = postgres_rds.test({"workload": "txn", "fake": False,
+                               "nodes": ["n1"], "host": "db.example",
+                               "dbname": "jep"})
+        assert isinstance(t["client"], cr.TxnClient)
+        assert t["client"].host == "db.example"
+        assert t["client"].admin_database == "jep"
+
+    def test_txn_setup_ddl_is_dialect_aware(self):
+        # Regression (review finding): stock PostgreSQL has no
+        # `CREATE DATABASE IF NOT EXISTS`, no db-qualified table names
+        # (they parse as schemas), and no STRING type — the RDS-shaped
+        # client must emit unqualified TEXT DDL, while the CockroachDB
+        # default keeps its dialect.
+        crdb = cr.TxnClient()
+        stmts = crdb._setup_stmts()
+        assert any("CREATE DATABASE" in s for s in stmts)
+        assert any("jepsen.jepsen_txn" in s and "STRING" in s
+                   for s in stmts)
+
+        rds = cr.TxnClient(user="jepsen", database="jep",
+                           admin_database="jep", host="db.example")
+        (stmt,) = rds._setup_stmts()
+        assert "CREATE TABLE IF NOT EXISTS jepsen_txn" in stmt
+        assert "TEXT" in stmt and "." not in stmt.split("EXISTS")[1]
