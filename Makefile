@@ -1,7 +1,7 @@
 # jepsen_tpu development targets.
 
 .PHONY: test test-quick integration integration-local bench \
-	probe-config5 serve-smoke txn-smoke
+	probe-config5 serve-smoke txn-smoke trace-smoke
 
 # Unit + parity suite on the virtual 8-device CPU mesh (no cluster).
 # Hardware note: ~8 min on a 4-core box; the compile-heavy lin parity
@@ -77,12 +77,32 @@ txn-smoke:
 	timeout -k 15 $(TXN_SMOKE_TIMEOUT) \
 		python -m jepsen_tpu.txn.smoke
 
+# Flight-recorder smoke (doc/observability.md): chip-free CPU-mesh
+# check of a small sparse-engine history with JEPSEN_TPU_TRACE=1 —
+# asserts the attribution report renders, the Chrome export is valid
+# trace-event JSON, the /run telemetry page renders from the registry
+# snapshot, and the traced verdict equals the CPU oracle. Run it after
+# touching jepsen_tpu/obs/ or any span call site (supervise, the bfs
+# executors, the service, txn). Artifacts land in .jax_cache/ so
+# `python -m jepsen_tpu.cli trace report --file
+# .jax_cache/trace_smoke.trace.jsonl` works immediately after.
+TRACE_SMOKE_TIMEOUT ?= 600
+trace-smoke:
+	timeout -k 15 $(TRACE_SMOKE_TIMEOUT) \
+		python -m jepsen_tpu.obs.smoke
+
 PROBE_CONFIG5_TIMEOUT ?= 5400
 # Frontier checkpoint: a probe killed by the timeout (or a fault)
 # leaves .jax_cache/probe_config5.ckpt.npz, and the NEXT probe-config5
 # run resumes the decide mid-history (resumed_from_row in its JSON)
 # instead of restarting from op 0.
 PROBE_CONFIG5_CKPT ?= .jax_cache/probe_config5.ckpt.npz
+# Flight recorder: the probe runs traced, spilling the span timeline
+# next to the checkpoint — `cli.py trace report --file
+# $(PROBE_CONFIG5_TRACE)` prints where the seconds went (per-site x
+# per-cap dispatch wall, compile, wasted rungs) and the trace summary
+# rides in the probe JSON (doc/observability.md).
+PROBE_CONFIG5_TRACE ?= .jax_cache/probe_config5.trace.jsonl
 probe-config5:
 	@mkdir -p .jax_cache
 	@cp .jax_cache/quarantine.json /tmp/jepsen_tpu_q5_before.json \
@@ -90,6 +110,8 @@ probe-config5:
 		> /tmp/jepsen_tpu_q5_before.json
 	timeout -k 30 $(PROBE_CONFIG5_TIMEOUT) \
 		env JEPSEN_TPU_CKPT=$(PROBE_CONFIG5_CKPT) \
+		JEPSEN_TPU_TRACE=1 \
+		JEPSEN_TPU_TRACE_FILE=$(PROBE_CONFIG5_TRACE) \
 		python bench.py --probe partitioned_c30; rc=$$?; \
 	python -m jepsen_tpu.cli quarantine diff \
 		--before /tmp/jepsen_tpu_q5_before.json; exit $$rc

@@ -864,6 +864,28 @@ def _probe_main(key: str) -> None:
     except Exception:
         r = {"error": traceback.format_exc(limit=4)}
     stop.set()
+    # Flight recorder: a probe run under JEPSEN_TPU_TRACE=1 attaches
+    # its attribution summary (per-site wall seconds, compile time,
+    # tunnel estimate) to the JSON artifact and flushes the JSONL
+    # spill so `cli.py trace report` reads the finished run
+    # (doc/observability.md; `make probe-config5` sets this up).
+    try:
+        from jepsen_tpu.obs import report as obs_report
+        from jepsen_tpu.obs import trace as obs_trace
+
+        if obs_trace.enabled() and isinstance(r, dict) \
+                and (obs_trace.spilled() or obs_trace.events()):
+            # The event guard keeps a zero-span run (e.g. an error
+            # before the first dispatch) from attaching a PREVIOUS
+            # run's stale spill file as its own attribution.
+            spill = obs_trace.flush()
+            evs = obs_report.load(spill) if spill \
+                else obs_trace.events()
+            r["trace"] = obs_report.summary(evs)
+            if spill:
+                r["trace"]["file"] = spill
+    except Exception:  # noqa: BLE001 - observability must not cost
+        pass           # the probe result
     with lock:
         print(json.dumps(r))
         sys.stdout.flush()

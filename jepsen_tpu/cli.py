@@ -265,10 +265,15 @@ def service_stats_cmd() -> dict:
         p.add_argument("--file", help="read this stats snapshot "
                                       "instead of asking a live "
                                       "daemon")
+        p.add_argument("--json", action="store_true",
+                       help="print the BARE stats dict (machine "
+                            "consumers; default output wraps it with "
+                            "source/addr provenance)")
 
     def run_cmd(opts: argparse.Namespace) -> int:
         import json
 
+        from jepsen_tpu.obs import load_json_snapshot
         from jepsen_tpu.service import daemon as service_daemon
 
         if not opts.file:
@@ -280,23 +285,22 @@ def service_stats_cmd() -> dict:
                 client = CheckerClient(opts.host, port, timeout=5.0)
                 stats = client.stats()
                 client.close()
-                print(json.dumps({"source": "live",
-                                  "addr": f"{opts.host}:{port}",
-                                  "stats": stats}, indent=1,
-                                 sort_keys=True))
+                out = stats if opts.json else {
+                    "source": "live", "addr": f"{opts.host}:{port}",
+                    "stats": stats}
+                print(json.dumps(out, indent=1, sort_keys=True))
                 return EXIT_OK
             except (ConnectionError, OSError):
                 pass   # no live daemon: fall back to the snapshot
         path = opts.file or service_daemon.stats_path()
-        try:
-            with open(path) as fh:
-                snap = json.load(fh)
-        except (OSError, ValueError) as e:
+        snap, err = load_json_snapshot(path)
+        if snap is None:
             print(f"no live daemon and no readable stats snapshot "
-                  f"at {path!r}: {e}", file=sys.stderr)
+                  f"at {path!r}: {err}", file=sys.stderr)
             return EXIT_ERROR
-        print(json.dumps({"source": "snapshot", "path": path,
-                          "stats": snap}, indent=1, sort_keys=True))
+        out = snap if opts.json else {"source": "snapshot",
+                                      "path": path, "stats": snap}
+        print(json.dumps(out, indent=1, sort_keys=True))
         return EXIT_OK
 
     return {"name": "service-stats", "parser": build_parser,
@@ -435,6 +439,146 @@ def quarantine_cmd() -> dict:
                 "shapes that faulted/wedged the TPU runtime "
                 "(.jax_cache/quarantine.json; doc/env.md "
                 "JEPSEN_TPU_QUARANTINE)."}
+
+
+@command
+def host_stats_cmd() -> dict:
+    """Print a (running or finished) check's host-row executor stats
+    and run telemetry from the obs registry snapshot — the CLAUDE.md
+    triage habit ("check host-stats and quarantine list BEFORE the env
+    knobs") as a first-class command instead of digging the verdict
+    dict out of an artifact."""
+
+    def build_parser(p: argparse.ArgumentParser):
+        p.add_argument("--file", help="run-telemetry snapshot path "
+                                      "(default: the engines' "
+                                      "JEPSEN_TPU_OBS_SNAPSHOT "
+                                      "resolution)")
+        p.add_argument("--json", action="store_true",
+                       help="print the raw snapshot JSON")
+
+    def run_cmd(opts: argparse.Namespace) -> int:
+        import json
+
+        from jepsen_tpu.obs import load_json_snapshot, metrics
+
+        path = opts.file or metrics.snapshot_path()
+        snap, err = load_json_snapshot(path)
+        if snap is None:
+            print(f"no readable run-telemetry snapshot at {path!r}: "
+                  f"{err} — run a check with the engines loaded "
+                  f"(the snapshot writes every "
+                  f"JEPSEN_TPU_OBS_EVERY_S seconds)", file=sys.stderr)
+            return EXIT_ERROR
+        if opts.json:
+            print(json.dumps(snap, indent=1, sort_keys=True,
+                             default=str))
+            return EXIT_OK
+        run = snap.get("run") or {}
+        print(f"run: {run.get('run', '?')}  updated "
+              f"{snap.get('updated', '?')}  pid {snap.get('pid')}")
+        row, total = run.get("row"), run.get("total_rows")
+        if row is not None:
+            pct = f" ({100.0 * row / total:.1f}%)" if total else ""
+            print(f"  row {row}/{total or '?'}{pct}  "
+                  f"frontier {run.get('frontier', '?')}  "
+                  f"rows/s {run.get('rows_per_sec', '?')}  "
+                  f"eta_s {run.get('eta_s', '?')}")
+        print(f"  xla compiles {snap.get('xla_compiles', 0)} "
+              f"({snap.get('xla_compile_s', 0)} s)")
+        for name in sorted(snap.get("views") or {}):
+            print(f"[{name}]")
+            for k, v in sorted((snap["views"][name] or {}).items()):
+                print(f"  {k} = {v}")
+        events = snap.get("events") or []
+        if events:
+            print("[events]")
+            for e in events[-16:]:
+                rest = {k: v for k, v in e.items()
+                        if k not in ("t", "kind")}
+                print(f"  {e.get('t')} {e.get('kind')} {rest}")
+        return EXIT_OK
+
+    return {"name": "host-stats", "parser": build_parser,
+            "run": run_cmd,
+            "help": "print a run's host-stats + telemetry (from the "
+                    "obs registry snapshot)",
+            "description":
+                "Engine observability (doc/observability.md): the "
+                "host-row executor's episode/dispatch/waste counters, "
+                "run progress gauges (row, frontier, rows/s, ETA), "
+                "and the watchdog/quarantine event feed, read from "
+                "the run-telemetry snapshot the engines write "
+                "(JEPSEN_TPU_OBS_SNAPSHOT). web.py /run renders the "
+                "same file."}
+
+
+@command
+def trace_cmd() -> dict:
+    """The flight recorder's attribution outputs (doc/observability.md):
+    ``trace report`` prints the where-did-the-time-go table from a
+    traced run's JSONL spill; ``trace export --chrome`` converts it to
+    Chrome/Perfetto trace-event JSON."""
+
+    def build_parser(p: argparse.ArgumentParser):
+        p.add_argument("action", choices=["report", "export"])
+        p.add_argument("--file", help="trace JSONL path (default: the "
+                                      "tracer's JEPSEN_TPU_TRACE_FILE "
+                                      "resolution)")
+        p.add_argument("--json", action="store_true",
+                       help="report as JSON instead of the table")
+        p.add_argument("--chrome", action="store_true",
+                       help="export format: Chrome/Perfetto "
+                            "trace-event JSON (the only format today; "
+                            "the flag names it for forward compat)")
+        p.add_argument("--out", "-o",
+                       help="export output path (default: stdout)")
+
+    def run_cmd(opts: argparse.Namespace) -> int:
+        import json
+
+        from jepsen_tpu.obs import report, trace
+
+        path = opts.file or trace.trace_file()
+        if path is None:
+            print("tracing file disabled (JEPSEN_TPU_TRACE_FILE=0) "
+                  "and no --file given", file=sys.stderr)
+            return EXIT_ERROR
+        events = report.load(path)
+        if not events:
+            print(f"no trace events at {path!r} — run with "
+                  f"JEPSEN_TPU_TRACE=1 first (doc/observability.md)",
+                  file=sys.stderr)
+            return EXIT_ERROR
+        if opts.action == "report":
+            agg = report.attribution(events)
+            if opts.json:
+                print(json.dumps(agg, indent=1, sort_keys=True))
+            else:
+                print(f"trace file: {path}")
+                print(report.render(agg))
+            return EXIT_OK
+        # export (--chrome is the only format; accepted for clarity)
+        chrome = report.to_chrome(events)
+        if opts.out:
+            with open(opts.out, "w") as fh:
+                json.dump(chrome, fh)
+            print(f"wrote {len(chrome['traceEvents'])} trace events "
+                  f"to {opts.out} (load in ui.perfetto.dev or "
+                  f"chrome://tracing)")
+        else:
+            print(json.dumps(chrome))
+        return EXIT_OK
+
+    return {"name": "trace", "parser": build_parser, "run": run_cmd,
+            "help": "report/export a traced run's timeline "
+                    "(JEPSEN_TPU_TRACE=1)",
+            "description":
+                "Flight-recorder attribution (doc/observability.md): "
+                "`trace report` prints per-site x per-cap wall "
+                "seconds, compile time, tunnel-overhead estimate and "
+                "wasted-rung cost; `trace export --chrome` emits "
+                "Perfetto-loadable trace-event JSON."}
 
 
 def run(commands, argv=None) -> int:

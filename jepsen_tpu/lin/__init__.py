@@ -90,6 +90,7 @@ def device_check_packed(packed: PackedHistory, cancel=None, **kw) -> dict:
     fit its bounds — including every crashed-op history within them — else
     the sparse sort-dedup frontier (:mod:`jepsen_tpu.lin.bfs`)."""
     from jepsen_tpu.lin import bfs, dense
+    from jepsen_tpu.obs import trace as _trace
 
     known = {"chunk", "cap_schedule", "explain", "checkpoint", "resume"}
     if kw.keys() - known:
@@ -99,8 +100,19 @@ def device_check_packed(packed: PackedHistory, cancel=None, **kw) -> dict:
         # checkpoint/resume are sparse-engine options (dense histories
         # decide in seconds; there is nothing worth resuming).
         dkw = {k: v for k, v in kw.items() if k in ("chunk", "explain")}
-        return dense.check_packed(packed, cancel=cancel, **dkw)
-    return bfs.check_packed(packed, cancel=cancel, **kw)
+        # The top-level "check" span anchors time attribution: every
+        # dispatch/compile span nests inside it, and the trace report's
+        # per-site rows sum against its wall time (doc/observability.md).
+        with _trace.span("check", engine="dense", rows=int(packed.R),
+                         window=int(packed.window)) as sp:
+            r = dense.check_packed(packed, cancel=cancel, **dkw)
+            sp.note(verdict=str(r.get("valid?")))
+            return r
+    with _trace.span("check", engine="sparse", rows=int(packed.R),
+                     window=int(packed.window)) as sp:
+        r = bfs.check_packed(packed, cancel=cancel, **kw)
+        sp.note(verdict=str(r.get("valid?")))
+        return r
 
 
 def _competition(packed: PackedHistory, cancel=None, **kw) -> dict:

@@ -19,6 +19,7 @@ from jepsen_tpu import util
 from jepsen_tpu.lin import bfs, prepare
 from jepsen_tpu.lin.prepare import PackedHistory
 from jepsen_tpu.models.kernels import F_NOOP
+from jepsen_tpu.obs import trace as obs_trace
 
 BATCH_CAP_SCHEDULE = (64, 1024)
 
@@ -221,7 +222,10 @@ def try_check_batch(model, subs: dict, declines: list | None = None) \
 
     results: dict = {}
     for group in groups.values():
-        r = _check_group(group)
+        with obs_trace.span("dispatch", site="batched-group",
+                            keys=len(group)) as sp:
+            r = _check_group(group)
+            sp.note(outcome="ok", declined=isinstance(r, Decline))
         util.progress_tick()   # liveness: one tick per decided group
         if isinstance(r, Decline):
             if declines is not None:

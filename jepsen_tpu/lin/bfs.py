@@ -55,6 +55,8 @@ from jax import lax
 from jepsen_tpu import util
 from jepsen_tpu.lin import psort, supervise
 from jepsen_tpu.lin.prepare import PackedHistory
+from jepsen_tpu.obs import metrics as obs_metrics
+from jepsen_tpu.obs import trace as obs_trace
 
 # Caps for the nested-while chunked engine. 131072 is the largest level
 # at which a full 512-row chunk program holds up on the axon TPU
@@ -2038,6 +2040,8 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
                 util.stat_bump(stats, "dispatches")
                 util.stat_bump(stats, "multi_dispatches")
                 util.stat_bump(stats, "passes", it_tot)
+                obs_trace.tail_note(row=r, rows=kn, passes=it_tot,
+                                    count=cnt)
                 if dbg:
                     print(f"[host] r={r} cap={cap} wave kn={kn} "
                           f"done={done} clean={clean} dead={dead_f} "
@@ -2062,6 +2066,7 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
                         sticky_lvl -= 1
                 r += kn
                 save_ckpt(r, lo, hi, count_i)
+                obs_metrics.REGISTRY.progress(row=r, frontier=count_i)
                 if r - r0 >= min_rows and count_i <= dropback:
                     break
                 continue
@@ -2072,6 +2077,18 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
             # where escalation, the overflow taxonomy, and death
             # snapshot anchoring live.
             util.stat_bump(stats, "multi_trips")
+            # The tripped batch's dispatch wall is thrown away with it
+            # — the residual-waste profile the attribution report
+            # prices (wasted_seconds per cap; wave-trip trace event).
+            wave_s = _time.monotonic() - t0
+            util.stat_time(stats, "wasted_seconds", cap, wave_s)
+            # A wedged/faulted wave's wall is already priced by its
+            # non-ok dispatch span; carrying it on the instant too
+            # would double-count wasted_s in the attribution report.
+            obs_trace.instant("wave-trip", row=r, cap=cap, kn=kn,
+                              outcome=tripped or "trip",
+                              seconds=round(wave_s, 3)
+                              if tripped is None else 0.0)
             if tripped is None:
                 util.stat_bump(stats, "wasted_passes", it_tot)
             lo, hi, count, lvl = entry
@@ -2096,6 +2113,8 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
             cap = caps[lvl]
             top_used = max(top_used, cap)
             lo, hi = _fit_keys(lo, hi, cap)
+            rung_s = 0.0   # this rung's dispatch wall (wasted if it
+            #                overflows and escalates)
             util.progress_tick()
             run_fused = row_fused
             if run_fused and supervise.quarantined(
@@ -2130,10 +2149,12 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
                     continue
                 lo, hi, flags = val
                 conv, ov, it, cnt, pk = (int(x) for x in flags)
-                util.stat_time(stats, "cap_seconds", cap,
-                               _time.monotonic() - t0)
+                dt = _time.monotonic() - t0
+                util.stat_time(stats, "cap_seconds", cap, dt)
+                rung_s += dt
                 stats["dispatches"] += 1
                 stats["passes"] += it
+                obs_trace.tail_note(row=r, passes=it, count=cnt)
                 count = jnp.int32(cnt)
                 ovf = not conv
                 budget_out = bool(ovf and not ov)
@@ -2177,11 +2198,13 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
                         break
                     lo, hi, count, flags = val
                     ch, ov, cnt = (int(x) for x in flags)
-                    util.stat_time(stats, "cap_seconds", cap,
-                                   _time.monotonic() - t0)
+                    dt = _time.monotonic() - t0
+                    util.stat_time(stats, "cap_seconds", cap, dt)
+                    rung_s += dt
                     it += 1
                     stats["dispatches"] += 1
                     stats["passes"] += 1
+                    obs_trace.tail_note(row=r, count=cnt)
                     pk_att = max(pk_att, cnt)
                     if dbg:
                         print(f"[host] r={r} cap={cap} it={it} "
@@ -2207,8 +2230,12 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
             if not ovf:
                 break
             # The failed rung's passes were thrown away — the waste
-            # the sticky cap exists to cut.
+            # the sticky cap exists to cut (and the attribution
+            # report prices: wasted_seconds per cap + trace event).
             util.stat_bump(stats, "wasted_passes", it)
+            util.stat_time(stats, "wasted_seconds", cap, rung_s)
+            obs_trace.instant("wasted-rung", row=r, cap=cap,
+                              passes=it, seconds=round(rung_s, 3))
             if lvl + 1 >= len(caps):
                 # Overflow of the last host cap: hand back the row's
                 # ENTRY frontier (the escalation restart point — the
@@ -2285,6 +2312,7 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
             count = jnp.int32(n2)
             count_i = n2
             save_ckpt(r, lo, hi, count_i)
+            obs_metrics.REGISTRY.progress(row=r, frontier=count_i)
             if r - r0 >= min_rows and count_i <= dropback:
                 break
             continue
@@ -2313,6 +2341,7 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
             bits, state = unpack(lo, hi, count, cap)
             return bits, state, 0, r, True, False, False, top_used
         save_ckpt(r, lo, hi, count_i)
+        obs_metrics.REGISTRY.progress(row=r, frontier=count_i)
         if r - r0 >= min_rows and count_i <= dropback:
             break
     bits, state = unpack(lo, hi, count, lo.shape[0])
@@ -2564,7 +2593,14 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                         "multi_rows": 0, "multi_dispatches": 0,
                         "multi_trips": 0, "watchdog_trips": 0,
                         "faults": 0, "quarantine_skips": 0,
-                        "cpu_rows": 0, "cap_seconds": {}}
+                        "cpu_rows": 0, "cap_seconds": {},
+                        "wasted_seconds": {}}
+    # Flight recorder: host-stats becomes a live named view of the obs
+    # registry (one snapshot codec for every stats dict), and the run
+    # gauges/sparkline behind web.py /run start here.
+    obs_metrics.REGISTRY.view("host-stats", host_stats)
+    obs_metrics.REGISTRY.start_run("lin-sparse", total=int(p.R),
+                                   window=int(p.window))
     level = 0
     cap = cap_schedule[level]
     bits = jnp.zeros((cap, nw), jnp.uint32)
@@ -2611,8 +2647,9 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                                        m.get("sticky_lvl"))
                         start_row = resumed_from = rd["row"]
                         for k, v in (m.get("host_stats") or {}).items():
-                            if k == "cap_seconds" and isinstance(v,
-                                                                 dict):
+                            if k in ("cap_seconds",
+                                     "wasted_seconds") \
+                                    and isinstance(v, dict):
                                 # JSON stringified the int cap
                                 # buckets; restore them or stat_time
                                 # appends duplicate '4096'/4096 keys
@@ -2750,7 +2787,6 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     classic_until = -1
     _dbg = os.environ.get("JEPSEN_TPU_HOST_DEBUG") == "1"
     if _dbg:
-        import time as _time
         _t0 = _time.time()
 
         def _dlog(msg):
@@ -2768,6 +2804,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             host_stats["episodes"] += 1
             hdrop = min(spike_dropback,
                         (max_tier or cap_schedule[-1]) // TIER_MARGIN)
+            _ep0 = _time.monotonic()
             spiked = _host_rows(
                 p, base, jnp.asarray(rbits), jnp.asarray(rstate),
                 jnp.int32(rcount),
@@ -2778,6 +2815,9 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                 use_psort=use_psort, key_hi=key_hi, crash_dom=crash_dom,
                 cancel=cancel, snapshots=snapshots, stats=host_stats,
                 ckpt=ckpt, sticky0=rsticky)
+            obs_trace.complete("host-episode", _ep0,
+                               _time.monotonic() - _ep0, row=base,
+                               resumed=True, next_row=spiked[3])
             act_, payload = _consume_spiked(spiked, host_caps[-1])
             if act_ == "return":
                 return payload
@@ -2854,6 +2894,8 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             if not fl[:, :2].any():
                 cnt = int(fl[-1, 2])
                 _dlog(f"fast batch -> base {base} count {cnt}")
+                obs_trace.tail_note(row=base, count=cnt)
+                obs_metrics.REGISTRY.progress(row=base, frontier=cnt)
                 if ckpt is not None and ckpt.due():
                     ckpt.save("chunk", base, cnt,
                               {"bits": np.asarray(bits)[:max(cnt, 1)],
@@ -2978,6 +3020,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                     else:
                         n_pre = 0  # extremely rare: spike at first row
                 _dlog(f"recovered; host/spike from {base + n_pre}")
+                _ep0 = _time.monotonic()
                 if host_mode:
                     # Dropback clamped so the handed-back frontier fits
                     # the capped in-chunk tiers with selection margin.
@@ -3010,6 +3053,10 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                         use_psort=use_psort, exp_h=exp_h, key_hi=key_hi,
                         crash_dom=crash_dom, cand_max=cand_max,
                         stats=host_stats)
+                obs_trace.complete(
+                    "host-episode" if host_mode else "spike-episode",
+                    _ep0, _time.monotonic() - _ep0, row=base + n_pre,
+                    next_row=spiked[3])
                 spike_top = sp_caps[-1]
                 break
             # Retry this chunk from its entry frontier at the next cap.
@@ -3030,14 +3077,15 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             return _dead_verdict(base + int(r_done) - 1)
         bits, state, count = b2, s2, c2
         base += n
+        cnt = int(count)
         if ckpt is not None and ckpt.due():
-            cnt = int(count)
             ckpt.save("chunk", base, cnt,
                       {"bits": np.asarray(bits)[:max(cnt, 1)],
                        "state": np.asarray(state)[:max(cnt, 1)]}, {})
+        obs_metrics.REGISTRY.progress(row=base, frontier=cnt)
         # Frontier is compacted to the front, so a shrunken frontier can
         # drop back to a smaller (faster) program by slicing.
-        while level > 0 and int(count) * 4 <= cap_schedule[level - 1]:
+        while level > 0 and cnt * 4 <= cap_schedule[level - 1]:
             level -= 1
             cap = cap_schedule[level]
             bits = bits[:cap]

@@ -44,6 +44,7 @@ vs. the reference's JVM graph search with a 32 GB heap
 from __future__ import annotations
 
 from functools import partial
+from time import monotonic as _monotonic
 
 import jax
 import jax.numpy as jnp
@@ -52,6 +53,7 @@ from jax import lax
 
 from jepsen_tpu import util
 from jepsen_tpu.lin.prepare import PackedHistory
+from jepsen_tpu.obs import trace as obs_trace
 
 # Largest window the dense representation will take: 2**20 words = 4 MiB
 # bitmaps (x2 transient for the shift) — far below HBM, compile-bounded.
@@ -289,6 +291,7 @@ def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
                 snapshots.append((base, F))
             else:
                 snapshots[:] = [(base, F)]
+        _d0 = _monotonic()
         if use_pallas:
             # Bucket the kernel grid to the chunk's actual row count so a
             # short final chunk doesn't pay for thousands of no-op steps
@@ -315,7 +318,12 @@ def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
                                   w_cur)),
                 w=w_cur, ns=ns, step_fn=step_fn)
         util.progress_tick()   # liveness: one tick per decided chunk
-        if bool(dead):
+        dead_b = bool(dead)    # forces the dispatch; time it honestly
+        obs_trace.complete("dispatch", _d0, _monotonic() - _d0,
+                           site="dense-pallas" if use_pallas
+                           else "dense-chunk", rows=int(n),
+                           outcome="ok")
+        if dead_b:
             r = base + int(r_done) - 1
             ret = p.ops[int(p.ret_op[r])]
             out = {"valid?": False, "analyzer": "tpu-dense",

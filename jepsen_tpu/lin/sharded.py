@@ -36,6 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jepsen_tpu import util
 from jepsen_tpu.lin import supervise
 from jepsen_tpu.lin.bfs import KEY_FILL, _expand_keys, _pad_rows
+from jepsen_tpu.obs import metrics as obs_metrics
 
 # The sparse sharded frontier keeps single-word bitsets (the all_gather
 # dedup keys stay u32); wider windows fall back to the single-chip engine.
@@ -496,6 +497,12 @@ def _run_packed_chunks(p, mesh, axis, tables_h, cap_schedule, *, b,
     n_escalations = 0
     peak_total = 1
     sup_stats: dict = {"watchdog_trips": 0, "faults": 0}
+    # mesh-stats as a live registry view (the host-stats precedent):
+    # the snapshot shows the dispatch/escalation profile of a running
+    # mesh decide next to the run gauges web.py /run renders.
+    _mesh_view = obs_metrics.REGISTRY.view("mesh-stats", {})
+    obs_metrics.REGISTRY.start_run("lin-sharded", total=int(p.R),
+                                   window=int(p.window))
 
     def mesh_stats():
         # Observability twin of the single-chip engine's host-stats:
@@ -591,6 +598,9 @@ def _run_packed_chunks(p, mesh, axis, tables_h, cap_schedule, *, b,
         base += n
         n_chunks += 1
         peak_total = max(peak_total, int(total))
+        _mesh_view.clear()
+        _mesh_view.update(mesh_stats())
+        obs_metrics.REGISTRY.progress(row=base, frontier=int(total))
         # Shrink back to a smaller (faster) program when the global
         # frontier has room to spare; survivors are globally packed to
         # the front, so slicing each device's prefix keeps them all.

@@ -57,17 +57,14 @@ from typing import Callable
 import numpy as np
 
 from jepsen_tpu import util
+from jepsen_tpu.obs import metrics as _obs_metrics
+from jepsen_tpu.obs import trace as _obs_trace
 
 CKPT_VERSION = 1
 LEDGER_VERSION = 1
 # Events kept in the in-stats trip log (monitoring-grade; the ledger
 # holds the durable record).
 MAX_EVENTS = 8
-
-
-def _repo_root() -> str:
-    return os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))))
 
 
 def enabled() -> bool:
@@ -159,6 +156,9 @@ def _consume_injection(site: str):
 
 def _note_event(stats: dict | None, site: str, kind: str,
                 detail: str = "") -> None:
+    # The obs event feed (web.py /run, cli.py host-stats) sees every
+    # trip regardless of whether the call site passed a stats dict.
+    _obs_metrics.REGISTRY.event(kind, site=site)
     if stats is None:
         return
     util.stat_bump(stats, "watchdog_trips" if kind == "wedge"
@@ -180,7 +180,7 @@ def note_fault(stats: dict | None, site: str, detail: str = "") -> None:
 
 def call(site: str, thunk: Callable, *, scale: float = 1.0,
          deadline_s: float | None = None, retries: int | None = None,
-         stats: dict | None = None):
+         stats: dict | None = None, shape: str | None = None):
     """Run one engine dispatch thunk under the watchdog.
 
     The thunk is dispatched from a daemon worker thread and joined
@@ -204,57 +204,78 @@ def call(site: str, thunk: Callable, *, scale: float = 1.0,
     Exceptions from the thunk propagate unchanged (fault
     classification and ledger recording are the call site's job — it
     knows the program shape; see :func:`run_guarded`). Raises
-    :class:`WedgedDispatch` when the budget is exhausted."""
-    if not enabled():
-        return thunk()
+    :class:`WedgedDispatch` when the budget is exhausted.
+
+    ``shape`` is observability only: the traced-program shape key
+    recorded on the flight-recorder span (this function is the single
+    choke point every engine dispatch passes through, so one span here
+    instruments them all). The tracer observes; it never routes."""
     deadline = deadline_s if deadline_s is not None \
         else base_deadline_s() * scale
-    attempts = max(1, (retries if retries is not None
-                       else retry_budget()) + 1)
-    for _attempt in range(attempts):
-        fn = thunk
-        join_deadline = deadline
-        inj = _consume_injection(site)
-        if inj is not None:
-            # Fake wedge: blocks past the deadline without running the
-            # real dispatch (racing an abandoned REAL dispatch against
-            # its retry would touch device state twice). An injection-
-            # carried deadline applies to this attempt only.
-            if inj > 0:
-                join_deadline = inj
-            fn = lambda: threading.Event().wait(  # noqa: E731
-                join_deadline * 10)
-        result: list = []
-        err: list = []
+    sp = _obs_trace.span("dispatch", site=site, shape=shape,
+                         deadline_s=round(deadline, 1)) \
+        if _obs_trace.enabled() else _obs_trace.NULL_SPAN
+    with sp:
+        if not enabled():
+            r = thunk()
+            sp.note(outcome="ok", supervised=False)
+            return r
+        attempts = max(1, (retries if retries is not None
+                           else retry_budget()) + 1)
+        wedges = 0
+        for _attempt in range(attempts):
+            fn = thunk
+            join_deadline = deadline
+            inj = _consume_injection(site)
+            if inj is not None:
+                # Fake wedge: blocks past the deadline without running
+                # the real dispatch (racing an abandoned REAL dispatch
+                # against its retry would touch device state twice).
+                # An injection-carried deadline applies to this
+                # attempt only.
+                if inj > 0:
+                    join_deadline = inj
+                fn = lambda: threading.Event().wait(  # noqa: E731
+                    join_deadline * 10)
+            result: list = []
+            err: list = []
 
-        def run(fn=fn):
-            try:
-                result.append(fn())
-            except BaseException as e:  # noqa: BLE001 - reported below
-                err.append(e)
+            def run(fn=fn):
+                try:
+                    result.append(fn())
+                except BaseException as e:  # noqa: BLE001 - below
+                    err.append(e)
 
-        t = threading.Thread(target=run, daemon=True,
-                             name=f"supervised-{site}")
-        t.start()
-        t.join(join_deadline)
-        if t.is_alive():
-            # Grace join: harvest a just-late completion instead of
-            # racing a second dispatch against it (see docstring).
-            t.join(min(join_deadline * 0.25, 60.0))
-        if t.is_alive():
-            _note_event(stats, site, "wedge")
-            # Liveness: detection and the retry ARE forward progress.
-            # Without this tick bench's parent stall watchdog (whose
-            # windows are sized like these deadlines) would kill the
-            # child at the same moment the in-library ladder starts —
-            # making the recovery paths unreachable exactly where
-            # they matter.
-            util.progress_tick()
-            continue
-        if err:
-            raise err[0]
-        return result[0]
-    raise WedgedDispatch(site, deadline, attempts)
+            t = threading.Thread(target=run, daemon=True,
+                                 name=f"supervised-{site}")
+            t.start()
+            t.join(join_deadline)
+            if t.is_alive():
+                # Grace join: harvest a just-late completion instead
+                # of racing a second dispatch against it (docstring).
+                t.join(min(join_deadline * 0.25, 60.0))
+            if t.is_alive():
+                wedges += 1
+                _note_event(stats, site, "wedge")
+                # Liveness: detection and the retry ARE forward
+                # progress. Without this tick bench's parent stall
+                # watchdog (whose windows are sized like these
+                # deadlines) would kill the child at the same moment
+                # the in-library ladder starts — making the recovery
+                # paths unreachable exactly where they matter.
+                util.progress_tick()
+                continue
+            if err:
+                if isinstance(err[0], (RuntimeError, OSError)):
+                    sp.note(outcome="fault",
+                            error=type(err[0]).__name__)
+                raise err[0]
+            sp.note(outcome="ok")
+            if wedges:
+                sp.note(wedges=wedges, attempts=_attempt + 1)
+            return result[0]
+        sp.note(outcome="wedge", attempts=attempts, wedges=wedges)
+        raise WedgedDispatch(site, deadline, attempts)
 
 
 def run_guarded(site: str, key: str, thunk: Callable, *,
@@ -269,7 +290,7 @@ def run_guarded(site: str, key: str, thunk: Callable, *,
     shape recorded. Other exceptions (programming errors) propagate."""
     try:
         return "ok", call(site, thunk, scale=scale, stats=stats,
-                          retries=retries)
+                          retries=retries, shape=key)
     except WedgedDispatch as e:
         record_fault(key, "wedge")
         return "wedge", e
@@ -291,7 +312,7 @@ def ledger_path() -> str | None:
         return None
     if env:
         return env
-    return os.path.join(_repo_root(), ".jax_cache", "quarantine.json")
+    return os.path.join(util.cache_dir(), "quarantine.json")
 
 
 def shape_key(site: str, *, cap: int, window: int, kernel: str,
@@ -396,6 +417,7 @@ def record_fault(key: str, reason: str, detail: str = "",
         e["detail"] = detail[:500]
     shapes[key] = e
     _write_ledger(path, shapes)
+    _obs_metrics.REGISTRY.event("quarantine", key=key, reason=reason)
     return e
 
 

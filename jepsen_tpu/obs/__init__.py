@@ -1,0 +1,27 @@
+"""Flight recorder: span tracing, metrics registry, time attribution.
+
+The checker stack's observability layer (doc/observability.md). Three
+pieces, all chip-free and jax-free at import time (web.py and the CLI
+load them without dragging a backend in):
+
+- :mod:`jepsen_tpu.obs.trace` — the thread-safe span tracer threaded
+  through every engine dispatch choke point (``supervise.call``), the
+  chunk/host-row/spike executors, the checker daemon, and the txn
+  tiers. ``JEPSEN_TPU_TRACE=1`` turns it on; off, ``span()`` returns a
+  shared null object and records nothing.
+- :mod:`jepsen_tpu.obs.metrics` — the typed metrics registry the
+  engines' stats dicts (host-stats / mesh-stats / service stats / txn
+  stats) register into as named views, plus run-progress gauges and
+  the event feed behind ``web.py /run`` and ``cli.py host-stats``.
+- :mod:`jepsen_tpu.obs.report` — time attribution: the
+  where-did-the-time-go table (``cli.py trace report``), the
+  Chrome/Perfetto trace-event export (``cli.py trace export``), and
+  the compact summary bench probes attach to their JSON artifacts.
+
+The tracer OBSERVES; it never routes — soundness-critical paths are
+untouched whether tracing is on or off.
+"""
+
+from jepsen_tpu.obs import metrics, report, trace  # noqa: F401
+from jepsen_tpu.obs.metrics import REGISTRY, load_json_snapshot  # noqa: F401
+from jepsen_tpu.obs.trace import enabled, span, tail_note  # noqa: F401

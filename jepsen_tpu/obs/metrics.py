@@ -1,0 +1,221 @@
+"""Typed metrics registry: one snapshot for every stats dict.
+
+Before this module, the checker stack grew three divergent dict
+conventions for the same job — the host-row executor's ``host-stats``,
+sharded's ``mesh-stats``, and the service daemon's stats — each with
+its own snapshot writer and reader. Here they become named VIEWS of
+one registry: the engines register their live stats dicts (still
+plain dicts, still bumped via :func:`jepsen_tpu.util.stat_bump` /
+``stat_time`` so verdict shapes are unchanged), and the registry
+serializes them all through one codec (``util.round_stats`` +
+``util.write_json_atomic``) into one snapshot file.
+
+On top of the views the registry carries RUN telemetry:
+
+- gauges (current row, total rows, frontier size),
+- a bounded sample ring of ``(elapsed_s, row, frontier)`` — the
+  rows/s, ETA, and frontier sparkline behind ``web.py /run``,
+- a bounded event feed (watchdog wedges, faults, quarantine records —
+  pushed by ``lin/supervise``) so a wedged config-5 run is diagnosable
+  from the snapshot file without attaching a debugger,
+- the process-wide XLA compile meter (``util.compile_meter``).
+
+``progress()`` is the engines' one call per committed row boundary; it
+is cheap (dict stores + a deque append) and interval-gates the
+snapshot write (``JEPSEN_TPU_OBS_EVERY_S``, default 5 s) so short runs
+and tests write nothing. ``JEPSEN_TPU_OBS_SNAPSHOT=0`` disables the
+file entirely.
+
+jax-free at import time: web.py and the CLI load this module without
+dragging a backend in.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from jepsen_tpu import util
+
+MAX_SAMPLES = 256
+MAX_EVENTS = 64
+
+
+def snapshot_path() -> str | None:
+    """The run-telemetry snapshot file (``web.py /run``, ``cli.py
+    host-stats``); ``JEPSEN_TPU_OBS_SNAPSHOT=0`` disables it."""
+    env = os.environ.get("JEPSEN_TPU_OBS_SNAPSHOT", "")
+    if env == "0":
+        return None
+    if env:
+        return env
+    return os.path.join(util.cache_dir(), "run_telemetry.json")
+
+
+def snapshot_every_s() -> float:
+    return util.env_float("JEPSEN_TPU_OBS_EVERY_S", 5.0)
+
+
+def load_json_snapshot(path) -> tuple[dict | None, str | None]:
+    """THE shared snapshot-file loader: ``(snap, None)`` on success,
+    ``(None, reason)`` on a missing/corrupt file. web.py's /service,
+    /txn, and /run pages and the CLI's service-stats / host-stats
+    commands all read snapshots through this one helper instead of
+    hand-rolling open/load/fallback at each site."""
+    try:
+        with open(path) as fh:
+            return json.load(fh), None
+    except (OSError, ValueError, TypeError) as e:
+        return None, str(e)
+
+
+class Registry:
+    """The process-wide metrics registry (module-level ``REGISTRY``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._views: dict[str, dict] = {}
+        self._gauges: dict = {}
+        self._counters: dict = {}
+        self._samples: deque = deque(maxlen=MAX_SAMPLES)
+        self._events: deque = deque(maxlen=MAX_EVENTS)
+        self._run_t0: float | None = None
+        # Gate the FIRST interval too: a run must live past
+        # JEPSEN_TPU_OBS_EVERY_S before anything hits disk — the
+        # "short runs and tests write nothing" promise.
+        self._last_write = time.monotonic()
+
+    # --- views --------------------------------------------------------------
+
+    def view(self, name: str, stats: dict | None = None) -> dict:
+        """Register (or fetch) a named view. ``stats`` is held by
+        LIVE reference — the engine keeps bumping its own dict and the
+        snapshot sees the current values; re-registering a name swaps
+        the reference (each check run registers its fresh stats)."""
+        with self._lock:
+            if stats is not None:
+                self._views[name] = stats
+            return self._views.setdefault(name, {})
+
+    # --- typed accessors ----------------------------------------------------
+
+    def counter(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            util.stat_bump(self._counters, key, n)
+
+    def gauge(self, key: str, value) -> None:
+        with self._lock:
+            self._gauges[key] = value
+
+    def timer(self, view: str, key: str, bucket, seconds: float) -> None:
+        """``stat_time`` into a named view (creates the view)."""
+        with self._lock:
+            util.stat_time(self._views.setdefault(view, {}), key,
+                           bucket, seconds)
+
+    def event(self, kind: str, **fields) -> None:
+        """Append to the bounded event feed (watchdog trips, faults,
+        quarantine records — the /run page's triage column)."""
+        with self._lock:
+            e = {"t": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                    time.gmtime()),
+                 "kind": kind}
+            e.update(fields)
+            self._events.append(e)
+
+    # --- run progress -------------------------------------------------------
+
+    def start_run(self, name: str, total: int | None = None,
+                  **gauges) -> None:
+        """Reset run telemetry at the top of a check (the engines call
+        this once per ``check_packed``); views persist across runs."""
+        with self._lock:
+            self._run_t0 = time.monotonic()
+            self._last_write = self._run_t0
+            self._samples.clear()
+            self._gauges = {"run": name,
+                            "started": time.strftime(
+                                "%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+            if total is not None:
+                self._gauges["total_rows"] = int(total)
+            self._gauges.update(gauges)
+
+    def progress(self, row: int | None = None,
+                 frontier: int | None = None, **gauges) -> None:
+        """One committed-row-boundary tick: update gauges, append a
+        sparkline sample, and (interval-gated) write the snapshot."""
+        with self._lock:
+            if self._run_t0 is None:
+                self._run_t0 = time.monotonic()
+            if row is not None:
+                self._gauges["row"] = int(row)
+            if frontier is not None:
+                self._gauges["frontier"] = int(frontier)
+            self._gauges.update(gauges)
+            self._samples.append(
+                (round(time.monotonic() - self._run_t0, 2),
+                 None if row is None else int(row),
+                 None if frontier is None else int(frontier)))
+        self.write_snapshot()
+
+    # --- snapshot -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            gauges = dict(self._gauges)
+            samples = [list(s) for s in self._samples]
+            events = [dict(e) for e in self._events]
+            counters = dict(self._counters)
+            views = {name: util.round_stats(dict(d), 3)
+                     for name, d in self._views.items() if d}
+        run = dict(gauges)
+        rowed = [s for s in samples if s[1] is not None]
+        if len(rowed) >= 2:
+            dt = rowed[-1][0] - rowed[0][0]
+            drow = rowed[-1][1] - rowed[0][1]
+            if dt > 0 and drow > 0:
+                rps = drow / dt
+                run["rows_per_sec"] = round(rps, 2)
+                total = gauges.get("total_rows")
+                if total:
+                    run["eta_s"] = round(
+                        max(0, total - rowed[-1][1]) / rps, 1)
+        out = {"updated": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time.gmtime()),
+               "pid": os.getpid(), "run": run, "samples": samples,
+               "events": events, "views": views}
+        if counters:
+            out["counters"] = counters
+        out.update(util.compile_meter())
+        return out
+
+    def write_snapshot(self, path: str | None = None,
+                       force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_write < snapshot_every_s():
+            return
+        p = path or snapshot_path()
+        if p is None:
+            return
+        self._last_write = now
+        try:
+            util.write_json_atomic(p, self.snapshot(), default=str)
+        except Exception:  # noqa: BLE001 - observability must never
+            pass           # take an engine run down
+
+    def reset(self) -> None:
+        """Tests only: drop every view, gauge, sample, and event."""
+        with self._lock:
+            self._views.clear()
+            self._gauges = {}
+            self._counters = {}
+            self._samples.clear()
+            self._events.clear()
+            self._run_t0 = None
+            self._last_write = time.monotonic()
+
+
+REGISTRY = Registry()

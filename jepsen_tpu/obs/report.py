@@ -1,0 +1,248 @@
+"""Time attribution: where did the 3217 seconds go?
+
+Consumes the tracer's events (live via ``trace.events()`` or a
+finished run's JSONL spill via :func:`load`) and answers the question
+every ROADMAP rung starts with: how much of a check's wall time is
+device dispatch vs XLA compile vs host work, per call site and per
+capacity level, and how much was wasted on failed escalation rungs.
+
+Three outputs:
+
+- :func:`attribution` / :func:`render` — the where-did-the-time-go
+  table (``cli.py trace report``); per-site x per-cap wall seconds,
+  the tunnel-overhead estimate (the ~100 ms/dispatch lore constant,
+  CLAUDE.md), compile time, and wasted-rung cost. The number the mesh
+  PR will be judged against.
+- :func:`to_chrome` — Chrome/Perfetto trace-event JSON of the run
+  timeline (``cli.py trace export --chrome``): complete ("X") events
+  in microseconds, one row per thread, loadable in ui.perfetto.dev.
+- :func:`summary` — the compact dict bench probes attach to their
+  JSON artifacts.
+"""
+
+from __future__ import annotations
+
+import json
+
+# The shared-chip tunnel costs ~100 ms per dispatch (CLAUDE.md lore);
+# the tunnel-overhead estimate is dispatches x this constant.
+TUNNEL_S_PER_DISPATCH = 0.1
+
+
+def load(path: str) -> list[dict]:
+    """Events from a JSONL spill file (malformed lines skipped — a
+    killed run's last line can be torn)."""
+    out: list[dict] = []
+    try:
+        with open(path) as fh:
+            for ln in fh:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    ev = json.loads(ln)
+                except ValueError:
+                    continue
+                if isinstance(ev, dict):
+                    out.append(ev)
+    except OSError:
+        pass
+    return out
+
+
+def _shape_cap(shape: str | None):
+    """The capacity coordinate of a supervise shape key
+    (``site|rowsR|capC|wW|kernel``), or None."""
+    if not shape:
+        return None
+    for part in str(shape).split("|"):
+        if part.startswith("cap"):
+            try:
+                return int(part[3:])
+            except ValueError:
+                return None
+    return None
+
+
+def attribution(events: list[dict]) -> dict:
+    """Aggregate events into the attribution dict ``render`` prints.
+
+    ``total_s`` comes from the top-level "check" span(s); per-site
+    rows from "dispatch" spans (every supervised engine dispatch);
+    compile from "xla-compile"; wasted from the engines' wasted-rung /
+    wave-trip instants plus non-ok dispatch wall. ``host_other_s`` is
+    the remainder (packing, pruning bookkeeping, python) so the rows
+    sum to the check wall."""
+    sites: dict[str, dict] = {}
+    other: dict[str, dict] = {}
+    total_s = 0.0
+    check_n = 0
+    dispatch_s = 0.0
+    dispatch_n = 0
+    compile_s = 0.0
+    compile_n = 0
+    wasted_s = 0.0
+    wasted_n = 0
+    for ev in events:
+        name = ev.get("name")
+        dur = float(ev.get("dur") or 0.0)
+        args = ev.get("args") or {}
+        if name == "check" and ev.get("ph") == "X":
+            total_s += dur
+            check_n += 1
+        elif name == "xla-compile":
+            compile_s += dur
+            compile_n += 1
+        elif name == "dispatch" and ev.get("ph") == "X":
+            dispatch_s += dur
+            dispatch_n += 1
+            site = str(args.get("site") or "?")
+            s = sites.setdefault(site, {"n": 0, "wall_s": 0.0,
+                                        "ok": 0, "wedge": 0,
+                                        "fault": 0, "caps": {}})
+            s["n"] += 1
+            s["wall_s"] += dur
+            outcome = str(args.get("outcome") or "?")
+            if outcome == "ok":
+                s["ok"] += 1
+            elif outcome.startswith("wedge"):
+                s["wedge"] += 1
+                wasted_s += dur
+                wasted_n += 1
+            else:
+                s["fault"] += 1
+                wasted_s += dur
+                wasted_n += 1
+            cap = _shape_cap(args.get("shape"))
+            if cap is not None:
+                s["caps"][cap] = s["caps"].get(cap, 0.0) + dur
+        elif ev.get("ph") == "i" and name in ("wasted-rung",
+                                              "wave-trip"):
+            wasted_s += float(args.get("seconds") or 0.0)
+            wasted_n += 1
+        elif ev.get("ph") == "X" and name:
+            o = other.setdefault(str(name), {"n": 0, "wall_s": 0.0})
+            o["n"] += 1
+            o["wall_s"] += dur
+    tunnel_est = dispatch_n * TUNNEL_S_PER_DISPATCH
+    out = {
+        "events": len(events),
+        "total_s": round(total_s, 3), "checks": check_n,
+        "dispatch_s": round(dispatch_s, 3), "dispatches": dispatch_n,
+        "compile_s": round(compile_s, 3), "compiles": compile_n,
+        "wasted_s": round(wasted_s, 3), "wasted_events": wasted_n,
+        "tunnel_overhead_est_s": round(tunnel_est, 3),
+        "device_busy_est_s": round(max(0.0, dispatch_s - tunnel_est),
+                                   3),
+        "sites": {k: {**v, "wall_s": round(v["wall_s"], 3),
+                      "caps": {c: round(t, 3)
+                               for c, t in sorted(v["caps"].items())}}
+                  for k, v in sorted(sites.items())},
+        "other": {k: {"n": v["n"], "wall_s": round(v["wall_s"], 3)}
+                  for k, v in sorted(other.items())},
+    }
+    if total_s > 0:
+        out["host_other_s"] = round(max(0.0, total_s - dispatch_s), 3)
+    return out
+
+
+def render(agg: dict) -> str:
+    """The attribution table as text (``cli.py trace report``)."""
+    lines = []
+    total = agg.get("total_s") or 0.0
+    lines.append(f"trace: {agg.get('events', 0)} events, "
+                 f"{agg.get('checks', 0)} check span(s)")
+    lines.append(f"check wall total        {total:10.2f} s")
+
+    def pct(x):
+        return f"{100.0 * x / total:5.1f}%" if total > 0 else "    -"
+
+    lines.append("")
+    lines.append(f"{'site':<16}{'cap':>10}{'n':>7}{'wall s':>10}"
+                 f"{'share':>8}{'ok':>5}{'wdg':>5}{'flt':>5}")
+    for site, s in (agg.get("sites") or {}).items():
+        caps = s.get("caps") or {}
+        if caps:
+            first = True
+            for cap, t in caps.items():
+                lines.append(
+                    f"{site if first else '':<16}{cap:>10}"
+                    f"{(s['n'] if first else ''):>7}{t:>10.2f}"
+                    f"{pct(t):>8}"
+                    f"{(s['ok'] if first else ''):>5}"
+                    f"{(s['wedge'] if first else ''):>5}"
+                    f"{(s['fault'] if first else ''):>5}")
+                first = False
+        else:
+            lines.append(f"{site:<16}{'-':>10}{s['n']:>7}"
+                         f"{s['wall_s']:>10.2f}{pct(s['wall_s']):>8}"
+                         f"{s['ok']:>5}{s['wedge']:>5}{s['fault']:>5}")
+    lines.append("")
+    lines.append(f"dispatch wall           "
+                 f"{agg.get('dispatch_s', 0.0):10.2f} s "
+                 f"({agg.get('dispatches', 0)} dispatches)")
+    lines.append(f"  tunnel overhead est   "
+                 f"{agg.get('tunnel_overhead_est_s', 0.0):10.2f} s "
+                 f"(~{TUNNEL_S_PER_DISPATCH * 1000:.0f} ms/dispatch)")
+    lines.append(f"  device busy est       "
+                 f"{agg.get('device_busy_est_s', 0.0):10.2f} s")
+    lines.append(f"xla compile             "
+                 f"{agg.get('compile_s', 0.0):10.2f} s "
+                 f"({agg.get('compiles', 0)} compiles)")
+    if "host_other_s" in agg:
+        lines.append(f"host / other            "
+                     f"{agg['host_other_s']:10.2f} s "
+                     f"(packing, pruning bookkeeping, python)")
+    lines.append(f"wasted (failed rungs)   "
+                 f"{agg.get('wasted_s', 0.0):10.2f} s "
+                 f"({agg.get('wasted_events', 0)} events)")
+    if agg.get("other"):
+        lines.append("")
+        lines.append("other spans: " + ", ".join(
+            f"{k} n={v['n']} {v['wall_s']:.2f}s"
+            for k, v in agg["other"].items()))
+    return "\n".join(lines)
+
+
+def summary(events: list[dict]) -> dict:
+    """Compact attribution for bench probe artifacts: the headline
+    numbers without the per-site table bulk."""
+    agg = attribution(events)
+    keys = ("events", "total_s", "dispatch_s", "dispatches",
+            "compile_s", "compiles", "wasted_s",
+            "tunnel_overhead_est_s", "device_busy_est_s",
+            "host_other_s")
+    out = {k: agg[k] for k in keys if k in agg}
+    out["site_s"] = {k: v["wall_s"]
+                     for k, v in (agg.get("sites") or {}).items()}
+    return out
+
+
+def to_chrome(events: list[dict]) -> dict:
+    """Chrome/Perfetto trace-event JSON (the "JSON Array Format" with
+    a ``traceEvents`` wrapper): monotonic-seconds events become
+    microsecond "X" (complete) / "i" (instant) events, timestamps
+    rebased to the earliest event so Perfetto opens at t=0."""
+    if events:
+        t_base = min(float(e.get("ts") or 0.0) for e in events)
+    else:
+        t_base = 0.0
+    out = []
+    for ev in events:
+        args = dict(ev.get("args") or {})
+        name = str(ev.get("name") or "?")
+        site = args.get("site")
+        rec = {"name": f"{name}:{site}" if site else name,
+               "cat": name,
+               "ph": "i" if ev.get("ph") == "i" else "X",
+               "ts": round((float(ev.get("ts") or 0.0) - t_base) * 1e6,
+                           1),
+               "pid": int(ev.get("pid") or 0),
+               "tid": int(ev.get("tid") or 0) % 2**31,
+               "args": args}
+        if rec["ph"] == "X":
+            rec["dur"] = round(float(ev.get("dur") or 0.0) * 1e6, 1)
+        else:
+            rec["s"] = "t"
+        out.append(rec)
+    return {"traceEvents": out, "displayTimeUnit": "ms"}

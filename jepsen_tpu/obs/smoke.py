@@ -1,0 +1,101 @@
+"""`make trace-smoke`: traced check -> report -> export -> /run page.
+
+A FRESH-process, chip-free proof (the serve-smoke contract: forces the
+CPU platform itself, before any backend init) that the flight recorder
+works end to end: a small sparse-engine history decides with
+``JEPSEN_TPU_TRACE=1``, and then
+
+- the attribution report renders with the check's dispatch sites,
+- the Chrome export is structurally valid trace-event JSON,
+- the registry snapshot exists and ``web.py /run`` renders it,
+- the traced verdict matches the CPU oracle (the tracer observes, it
+  never routes).
+
+Prints one JSON result line and exits 0/1 — timeout-guarded by the
+Makefile so a wedge cannot hold the shell. Artifacts land in
+``.jax_cache/`` (trace_smoke.trace.jsonl / trace_smoke.telemetry.json)
+so ``cli.py trace report`` works on the smoke's own output.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    # CPU mesh BEFORE any jax backend init (CLAUDE.md: the TPU plugin
+    # force-selects its platform; the smoke must never take the chip).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    os.environ["JEPSEN_TPU_TRACE"] = "1"
+    os.environ.setdefault(
+        "JEPSEN_TPU_TRACE_FILE",
+        os.path.join(".jax_cache", "trace_smoke.trace.jsonl"))
+    os.environ.setdefault(
+        "JEPSEN_TPU_OBS_SNAPSHOT",
+        os.path.join(".jax_cache", "trace_smoke.telemetry.json"))
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu import web
+    from jepsen_tpu.lin import cpu, device_check_packed, prepare, synth
+    from jepsen_tpu.obs import metrics, report, trace
+    from jepsen_tpu.util import enable_compile_cache
+
+    enable_compile_cache()
+    # A wide-window register history (window ~26, past the dense
+    # engine's W<=20 bound): routes to the sparse chunked engine, so
+    # the trace carries real supervised dispatch spans (site
+    # "chunk"/"chunk-batch"), not just the top-level check span.
+    h = synth.generate_register_history(
+        500, concurrency=30, seed=7, value_range=5,
+        crash_prob=0.002, max_crashes=4)
+    p = prepare.prepare(m.cas_register(), h)
+    want = cpu.check_packed(p)["valid?"]
+    r = device_check_packed(p)
+
+    out = {"events": len(trace.events()), "verdict": r.get("valid?"),
+           "want": want}
+    ok = r.get("valid?") == want and out["events"] > 0
+
+    # Report renders and attributes the dispatch sites.
+    agg = report.attribution(trace.events())
+    text = report.render(agg)
+    out["report"] = {"total_s": agg["total_s"],
+                     "dispatches": agg["dispatches"],
+                     "sites": sorted(agg["sites"])}
+    ok = ok and agg["checks"] >= 1 and agg["dispatches"] >= 1 \
+        and "check wall total" in text
+
+    # Chrome export: structurally valid trace-event JSON.
+    chrome = report.to_chrome(trace.events())
+    out["chrome_events"] = len(chrome["traceEvents"])
+    ok = ok and chrome["traceEvents"] and all(
+        ev["ph"] in ("X", "i") and isinstance(ev["ts"], (int, float))
+        for ev in chrome["traceEvents"])
+
+    # Spill + snapshot on disk; /run renders the snapshot.
+    spill = trace.flush()
+    out["trace_file"] = spill
+    ok = ok and spill is not None and len(report.load(spill)) \
+        >= out["events"]
+    metrics.REGISTRY.write_snapshot(force=True)
+    snap_path = metrics.snapshot_path()
+    html = web.run_html(snap_path)
+    out["snapshot"] = snap_path
+    ok = ok and "run telemetry" in html and "host-stats" in html
+
+    out["ok"] = bool(ok)
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,290 @@
+"""Span tracer: the flight recorder's event source.
+
+A thread-safe, env-gated (``JEPSEN_TPU_TRACE=1``) tracer with a
+context-manager API over monotonic clocks::
+
+    from jepsen_tpu.obs import trace
+    with trace.span("dispatch", site="host-fixpoint", cap=4096) as sp:
+        ...
+        sp.note(outcome="ok", passes=7)
+
+Disabled (the default), :func:`span` returns one shared
+:data:`NULL_SPAN` object — no span object, no event, no buffer touch
+per call — so the quick tier and untraced production runs pay only an
+``os.environ`` lookup. Enabled, completed spans land in a bounded
+in-memory buffer (``JEPSEN_TPU_TRACE_BUF`` events) that SPILLS to a
+JSONL file (``JEPSEN_TPU_TRACE_FILE``, default
+``<repo>/.jax_cache/trace.jsonl``; ``0`` disables the file) instead of
+dropping — a killed run keeps everything already spilled, and
+``atexit`` flushes the tail. One process per file: the first write of
+a process truncates it, so ``cli.py trace report`` reads the most
+recent run.
+
+Event shape (one JSON object per line)::
+
+    {"name": ..., "ph": "X"|"i", "ts": <monotonic s>, "dur": <s>,
+     "pid": ..., "tid": ..., "depth": <span nesting>, "args": {...}}
+
+Timestamps are ``time.monotonic()`` seconds (relative, clock-nemesis
+immune); :func:`jepsen_tpu.obs.report.to_chrome` converts to the
+microsecond trace-event format Perfetto loads.
+
+The tracer observes — it never routes, retries, or alters engine
+behaviour.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+
+from jepsen_tpu import util
+
+
+def enabled() -> bool:
+    """Master switch: ``JEPSEN_TPU_TRACE=1``. Re-read per call (the
+    env-knob convention, doc/env.md) — one dict lookup on the disabled
+    path."""
+    return os.environ.get("JEPSEN_TPU_TRACE", "") not in ("", "0")
+
+
+def trace_file() -> str | None:
+    """The JSONL spill path; ``JEPSEN_TPU_TRACE_FILE=0`` keeps the
+    trace purely in-memory (tests)."""
+    env = os.environ.get("JEPSEN_TPU_TRACE_FILE", "")
+    if env == "0":
+        return None
+    if env:
+        return env
+    return os.path.join(util.cache_dir(), "trace.jsonl")
+
+
+def buf_cap() -> int:
+    return util.env_int("JEPSEN_TPU_TRACE_BUF", 65536)
+
+
+# Spill well before the ring cap so a configured file loses nothing;
+# without a file the buffer is a true ring (oldest events drop).
+_SPILL_BATCH = 4096
+# Batch spills keep the newest events in memory so a tail_note()
+# landing just after the boundary still reaches the file copy (the
+# final flush writes everything).
+_SPILL_KEEP = 64
+
+_lock = threading.Lock()
+_buf: list[dict] = []
+_spilled = 0
+_file_started = False
+_file_dead = False
+_atexit_on = False
+_tls = threading.local()
+
+
+class _NullSpan:
+    """The disabled-path singleton: enter/exit/note are no-ops."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def note(self, **kw):
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """One traced interval. Use via :func:`span` as a context manager;
+    ``note(**kw)`` merges attributes into the event's ``args`` (e.g.
+    the outcome, pass counts). An exception exiting the span stamps
+    ``outcome="error:<Type>"`` unless the site noted one already."""
+
+    __slots__ = ("name", "meta", "_t0")
+
+    def __init__(self, name: str, meta: dict):
+        self.name = name
+        self.meta = meta
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append(self)
+        return self
+
+    def note(self, **kw):
+        self.meta.update(kw)
+
+    def __exit__(self, et, ev, tb):
+        end = time.monotonic()
+        stack = getattr(_tls, "stack", None)
+        depth = 0
+        if stack:
+            try:
+                stack.remove(self)
+            except ValueError:
+                pass
+            depth = len(stack)
+        if et is not None and "outcome" not in self.meta:
+            self.meta["outcome"] = f"error:{et.__name__}"
+        _record({"name": self.name, "ph": "X", "ts": self._t0,
+                 "dur": end - self._t0, "pid": os.getpid(),
+                 "tid": threading.get_ident(), "depth": depth,
+                 "args": self.meta})
+        return False
+
+
+def span(name: str, **meta):
+    """A new :class:`Span` (or :data:`NULL_SPAN` when tracing is off)."""
+    if not enabled():
+        return NULL_SPAN
+    return Span(name, meta)
+
+
+def tail_note(**kw) -> None:
+    """Annotate the most recently COMPLETED event on this thread —
+    how call sites attach after-the-fact data (frontier count, pass
+    totals) to a span that ended inside a helper (supervise.call)."""
+    if not enabled():
+        return
+    ev = getattr(_tls, "last", None)
+    if ev is not None:
+        ev["args"].update(kw)
+
+
+def complete(name: str, t0: float, dur_s: float, **meta) -> None:
+    """Retro-record a completed interval (``t0`` in ``time.monotonic``
+    seconds) — for lifecycles that cross threads (the checker daemon's
+    admit->finish request path) or are measured externally (XLA
+    compiles)."""
+    if not enabled():
+        return
+    _record({"name": name, "ph": "X", "ts": t0, "dur": dur_s,
+             "pid": os.getpid(), "tid": threading.get_ident(),
+             "depth": 0, "args": meta})
+
+
+def instant(name: str, **meta) -> None:
+    """A point event (wasted escalation rung, wave trip, quarantine
+    hit)."""
+    if not enabled():
+        return
+    _record({"name": name, "ph": "i", "ts": time.monotonic(),
+             "dur": 0.0, "pid": os.getpid(),
+             "tid": threading.get_ident(), "depth": 0, "args": meta})
+
+
+def _record(ev: dict) -> None:
+    global _atexit_on
+    _tls.last = ev
+    with _lock:
+        _buf.append(ev)
+        if not _atexit_on:
+            _atexit_on = True
+            atexit.register(flush)
+        path = None if _file_dead else trace_file()
+        if path is not None:
+            if len(_buf) >= _SPILL_BATCH:
+                _flush_locked(path, keep=_SPILL_KEEP)
+        else:
+            cap = buf_cap()
+            if len(_buf) > cap:
+                del _buf[:len(_buf) - cap]
+
+
+def _flush_locked(path: str, keep: int = 0) -> None:
+    global _file_started, _spilled
+    n = len(_buf) - keep
+    if n <= 0:
+        return
+    # Serialize BEFORE touching the file, per event and exception-safe:
+    # a tail_note() from another thread can mutate an args dict mid-
+    # dumps (RuntimeError), and any failure escaping here would surface
+    # inside an engine dispatch where run_guarded reads it as a device
+    # fault and quarantines a healthy shape. Tracing must never take a
+    # run down — a still-unserializable event is dropped, not fatal.
+    lines = []
+    for ev in _buf[:n]:
+        try:
+            lines.append(json.dumps(ev, default=str))
+        except Exception:  # noqa: BLE001 - concurrent args mutation
+            try:
+                ev = dict(ev, args=dict(ev.get("args") or {}))
+                lines.append(json.dumps(ev, default=str))
+            except Exception:  # noqa: BLE001
+                pass
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        mode = "a" if _file_started else "w"
+        with open(path, mode) as fh:
+            for ln in lines:
+                fh.write(ln + "\n")
+        _file_started = True
+        _spilled += n
+        del _buf[:n]
+    except OSError:
+        # Spill failure degrades PERMANENTLY to the in-memory ring
+        # (reset() re-arms): without the latch every later _record
+        # would re-serialize the whole >=_SPILL_BATCH backlog under
+        # the lock — an O(n^2) tax inside the engine dispatch path.
+        # Tracing must never take a run down.
+        global _file_dead
+        _file_dead = True
+        cap = buf_cap()
+        if len(_buf) > cap:
+            del _buf[:len(_buf) - cap]
+
+
+def flush(path: str | None = None) -> str | None:
+    """Write buffered events to the JSONL file (atexit calls this);
+    returns the path, or None when the file is disabled."""
+    with _lock:
+        p = path or trace_file()
+        if p is not None:
+            _flush_locked(p)
+        return p
+
+
+def events() -> list[dict]:
+    """Snapshot of the in-memory buffer (NOT the spilled file — use
+    :func:`jepsen_tpu.obs.report.load` for a finished run's file)."""
+    with _lock:
+        return list(_buf)
+
+
+def spilled() -> int:
+    """Events already written to the spill file this process."""
+    return _spilled
+
+
+def reset() -> None:
+    """Drop all in-memory state (tests; the next flush truncates the
+    file again so a test's trace file holds only its own run)."""
+    global _spilled, _file_started, _file_dead
+    with _lock:
+        _buf.clear()
+        _spilled = 0
+        _file_started = False
+        _file_dead = False
+    _tls.last = None
+    _tls.stack = []
+
+
+# XLA compiles as trace events: the compile meter (util) runs the hook
+# after every true backend compile; enabled() gating lives in
+# complete().
+def _on_compile(t0: float, dur_s: float) -> None:
+    complete("xla-compile", t0, dur_s)
+
+
+util.add_compile_hook(_on_compile)

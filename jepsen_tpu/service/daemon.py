@@ -52,17 +52,14 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from jepsen_tpu import util
+from jepsen_tpu.obs import metrics as obs_metrics
+from jepsen_tpu.obs import trace as obs_trace
 from jepsen_tpu.service import protocol
 from jepsen_tpu.suites.common import SocketIO
 
 _REQUEUE_MAX = 1       # fault requeues per request, then honest fail
 _LATENCY_RING = 1024   # recent end-to-end latencies kept for p50/p99
 _STATS_WRITE_EVERY_S = 10.0
-
-
-def _repo_root() -> str:
-    return os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))))
 
 
 def default_port() -> int:
@@ -91,7 +88,7 @@ def pad_pow2() -> bool:
 
 def stats_path() -> str:
     return os.environ.get("JEPSEN_TPU_SERVICE_STATS", "") or os.path.join(
-        _repo_root(), ".jax_cache", "service_stats.json")
+        util.cache_dir(), "service_stats.json")
 
 
 @dataclass(eq=False)
@@ -266,6 +263,10 @@ class CheckerService:
 
         enable_compile_cache()   # the warm worker's whole point
         _install_compile_meter()
+        # The service stats dict as a live named view of the obs
+        # registry (doc/observability.md): one snapshot codec across
+        # host-stats / mesh-stats / service stats.
+        obs_metrics.REGISTRY.view("service", self._stats)
         self._listener = socket.create_server(
             (self.host, self.port), reuse_port=False)
         # Closing a socket does NOT wake a thread blocked in accept()
@@ -638,7 +639,8 @@ class CheckerService:
         try:
             r = supervise.call("service-check", thunk,
                                deadline_s=self.deadline_s, retries=0,
-                               stats=self._supervise_stats())
+                               stats=self._supervise_stats(),
+                               shape=req.bin)
             self._finish(req, r, batch_n=1, t0=t0)
         except supervise.WedgedDispatch as e:
             self._bump("wedged_requests")
@@ -705,6 +707,14 @@ class CheckerService:
             self._stats["decide_s_sum"] = round(
                 self._stats.get("decide_s_sum", 0.0) + (now - t0), 4)
         self._note_latency(now - req.t_enqueue)
+        # One span per request lifecycle (admit -> bin -> batch ->
+        # decide): retro-recorded here because the path crosses the
+        # handler, scheduler, and worker threads.
+        obs_trace.complete("svc-request", req.t_enqueue,
+                           now - req.t_enqueue, bin=req.bin,
+                           verdict=str(valid), batch_n=batch_n,
+                           queue_wait_s=round(wait, 4),
+                           decide_s=round(now - t0, 4))
         req.respond({"type": "verdict", "id": req.rid,
                      "result": protocol.jsonable(result),
                      "timings": {"queue_wait_s": round(wait, 4),
@@ -714,39 +724,19 @@ class CheckerService:
 
 
 # --- process-wide XLA compile meter ----------------------------------------
-# The service's whole value proposition is amortizing compiles; count
-# them (and their wall time) the same way tests/conftest.py counts the
-# quick tier's — wrapping jax's backend_compile — so service-stats can
-# show compiles trending to zero as the cache warms.
-
-_compile_meter = {"installed": False, "n": 0, "seconds": 0.0}
+# The service's whole value proposition is amortizing compiles. The
+# meter is the SHARED util wrap (util.install_compile_meter) — one
+# backend_compile wrap counting for the quick-tier enforcement
+# (tests/conftest.py), these service stats, and the obs registry,
+# instead of the three divergent private copies that predate it.
 
 
 def _install_compile_meter() -> None:
-    if _compile_meter["installed"]:
-        return
-    _compile_meter["installed"] = True
-    try:
-        import jax._src.compiler as _jc
-
-        real = _jc.backend_compile
-
-        def metered(*a, **kw):
-            t0 = time.monotonic()
-            try:
-                return real(*a, **kw)
-            finally:
-                _compile_meter["n"] += 1
-                _compile_meter["seconds"] += time.monotonic() - t0
-
-        _jc.backend_compile = metered
-    except (ImportError, AttributeError):  # pragma: no cover - jax skew
-        pass
+    util.install_compile_meter()
 
 
 def _compile_meter_snapshot() -> dict:
-    return {"xla_compiles": _compile_meter["n"],
-            "xla_compile_s": round(_compile_meter["seconds"], 2)}
+    return util.compile_meter()
 
 
 def serve_checker(host: str = "127.0.0.1", port: int | None = None,

@@ -62,6 +62,8 @@ from jax import lax
 
 from jepsen_tpu import util
 from jepsen_tpu.lin import supervise
+from jepsen_tpu.obs import metrics as obs_metrics
+from jepsen_tpu.obs import trace as obs_trace
 from jepsen_tpu.txn import oracle
 from jepsen_tpu.txn.pack import PackedTxnHistory
 
@@ -324,10 +326,15 @@ def check_packed(pt: PackedTxnHistory, anomalies=None,
                 tiers.append(t)
 
     stats: dict = {"tiers": {}}
+    # Flight recorder: the txn stats dict as a live registry view, one
+    # span per edge tier (the txn-scc dispatch span inside it comes
+    # from supervise.run_guarded).
+    obs_metrics.REGISTRY.view("txn", stats)
     t0 = time.time()
     sccs_by_tier: dict = {}
     fallbacks: dict = {}
     for tier in tiers:
+        _tier0 = time.monotonic()
         try:
             sccs, ts = _tier_device_sccs(pt, tier, stats, rt)
             sccs_by_tier[tier] = sccs
@@ -350,6 +357,9 @@ def check_packed(pt: PackedTxnHistory, anomalies=None,
             sccs_by_tier[tier] = _tier_host_sccs(pt, tier, rt)
             stats["tiers"][tier] = {"edges": None, "device": False,
                                     "fallback": f.reason}
+        obs_trace.complete("txn-tier", _tier0,
+                           time.monotonic() - _tier0, tier=tier,
+                           fallback=fallbacks.get(tier))
         util.progress_tick()
 
     out = oracle.check_graph(pt.graph, requested, realtime=rt,

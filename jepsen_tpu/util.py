@@ -276,21 +276,122 @@ def stat_time(stats: dict, key: str, bucket, seconds: float) -> None:
 
 
 def round_stats(stats: dict, ndigits: int = 2) -> dict:
-    """Artifact-ready copy of a stats dict: floats rounded (recursively
-    through one level of nested dicts — the timing histograms), other
-    values passed through. The engines accumulate raw floats so
-    precision is not lost sample by sample; verdicts and bench JSON
-    carry the rounded copy."""
-    out: dict = {}
-    for k, v in stats.items():
+    """Artifact-ready copy of a stats dict: floats rounded recursively
+    through ANY depth of nested dicts/lists (the timing histograms, the
+    supervise event trip log, the obs registry views), every other
+    value preserved as-is. The engines accumulate raw floats so
+    precision is not lost sample by sample; verdicts, bench JSON, and
+    registry snapshots carry the rounded copy. Tuples come back as
+    lists (the copy is JSON-bound anyway)."""
+
+    def rec(v):
         if isinstance(v, dict):
-            out[k] = {kk: (round(vv, ndigits) if isinstance(vv, float)
-                           else vv) for kk, vv in v.items()}
-        elif isinstance(v, float):
-            out[k] = round(v, ndigits)
-        else:
-            out[k] = v
-    return out
+            return {k: rec(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [rec(x) for x in v]
+        if isinstance(v, float):
+            return round(v, ndigits)
+        return v
+
+    return {k: rec(v) for k, v in stats.items()}
+
+
+# ---------------------------------------------------------------------------
+# Process-wide XLA compile meter.
+#
+# One shared wrap of jax's ``backend_compile`` (a TRUE compile: a
+# persistent-cache MISS reaching XLA — cache hits load in milliseconds
+# and never reach it). Three consumers used to keep divergent private
+# copies counting the same thing: tests/conftest.py's quick-tier
+# no-compile enforcement, the checker daemon's service stats, and now
+# the obs metrics registry. ``add_compile_hook`` lets the flight
+# recorder (jepsen_tpu.obs.trace) record each compile as a trace event
+# without util importing obs (the hook is registered from obs side).
+
+_compile_meter = {"installed": False, "n": 0, "seconds": 0.0,
+                  "gets": 0, "gets_wrapped": False}
+_compile_hooks: list = []
+
+
+def add_compile_hook(fn) -> None:
+    """Register ``fn(t0_monotonic, dur_s)`` to run after every true
+    XLA compile (exceptions swallowed — hooks are observability)."""
+    if fn not in _compile_hooks:
+        _compile_hooks.append(fn)
+
+
+def install_compile_meter() -> bool:
+    """Idempotently wrap ``jax._src.compiler.backend_compile`` with the
+    count/seconds meter. Returns False on jax version skew (the meter
+    then reads zeros — consumers degrade, never crash)."""
+    import time
+
+    if _compile_meter["installed"]:
+        return True
+    try:
+        import jax._src.compiler as _jc
+
+        real = _jc.backend_compile
+    except (ImportError, AttributeError):  # pragma: no cover - jax skew
+        return False
+    _compile_meter["installed"] = True
+
+    def metered(*a, **kw):
+        t0 = time.monotonic()
+        try:
+            return real(*a, **kw)
+        finally:
+            dur = time.monotonic() - t0
+            _compile_meter["n"] += 1
+            _compile_meter["seconds"] += dur
+            for fn in list(_compile_hooks):
+                try:
+                    fn(t0, dur)
+                except Exception:  # noqa: BLE001 - observability hook
+                    pass
+
+    _jc.backend_compile = metered
+    # Best-effort cache-hit meter: calls that resolve without reaching
+    # backend_compile are persistent-cache hits. Module-attr patching
+    # only sees call sites that resolve the name at call time, so this
+    # can undercount — compile_meter() reports None rather than a
+    # negative when the evidence is inconsistent.
+    try:
+        real_get = _jc.compile_or_get_cached
+
+        def counted_get(*a, **kw):
+            _compile_meter["gets"] += 1
+            return real_get(*a, **kw)
+
+        _jc.compile_or_get_cached = counted_get
+        _compile_meter["gets_wrapped"] = True
+    except AttributeError:  # pragma: no cover - jax skew
+        pass
+    return True
+
+
+def compile_meter() -> dict:
+    """Snapshot of the process-wide XLA compile meter (zeros when the
+    wrap never installed)."""
+    n = _compile_meter["n"]
+    hits = None
+    if _compile_meter["gets_wrapped"] and _compile_meter["gets"] >= n:
+        hits = _compile_meter["gets"] - n
+    return {"xla_compiles": n,
+            "xla_compile_s": round(_compile_meter["seconds"], 2),
+            "xla_cache_hits": hits}
+
+
+def cache_dir() -> str:
+    """``<repo>/.jax_cache`` — the one anchor for every on-disk
+    artifact (compile cache, quarantine ledger, service stats, trace
+    spills, telemetry snapshots). Not created here; writers makedirs
+    on first use."""
+    import os
+
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache")
 
 
 def enable_compile_cache(path: str | None = None) -> str | None:
@@ -309,9 +410,7 @@ def enable_compile_cache(path: str | None = None) -> str | None:
     import jax
 
     if path is None:
-        path = os.environ.get("JEPSEN_TPU_JAX_CACHE") or os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            ".jax_cache")
+        path = os.environ.get("JEPSEN_TPU_JAX_CACHE") or cache_dir()
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)

@@ -28,6 +28,13 @@ from urllib.parse import quote, unquote
 
 log = logging.getLogger("jepsen.web")
 
+# THE shared snapshot loader (jepsen_tpu.obs — jax-free at import):
+# /service, /txn, /run and the CLI's service-stats / host-stats all
+# read snapshot files through it instead of hand-rolled open/load.
+from jepsen_tpu.obs.metrics import (  # noqa: E402
+    load_json_snapshot as _load_snapshot,
+)
+
 VALID_COLORS = {True: "#ADF6B0", False: "#F6AEAD", "unknown": "#F3F6AD"}
 
 
@@ -76,7 +83,8 @@ def home_html(base: Path) -> str:
             "td,th{padding:4px 12px;border:1px solid #ccc}"
             "</style></head><body><h1>jepsen-tpu results</h1>"
             '<p><a href="/service">checker service stats</a> · '
-            '<a href="/txn">txn anomaly panel</a></p>'
+            '<a href="/txn">txn anomaly panel</a> · '
+            '<a href="/run">run telemetry</a></p>'
             "<table><tr><th>test</th><th>run</th><th>valid?</th>"
             "<th>download</th></tr>" + "".join(rows) +
             "</table></body></html>")
@@ -115,13 +123,11 @@ def service_html(stats_file: str | None = None) -> str:
             "border:1px solid #ccc} th{text-align:left}"
             "</style></head><body><h1>checker service</h1>"
             '<p><a href="/">home</a></p>')
-    try:
-        with open(path) as fh:
-            snap = json.load(fh)
-    except (OSError, ValueError) as e:
+    snap, err = _load_snapshot(path)
+    if snap is None:
         return (head + f"<p>no stats snapshot at "
                 f"<code>{_html.escape(str(path))}</code> "
-                f"({_html.escape(str(e))}) — is the daemon running "
+                f"({_html.escape(str(err))}) — is the daemon running "
                 f"(<code>cli.py serve-checker</code>)?</p>"
                 "</body></html>")
 
@@ -163,13 +169,11 @@ def txn_html(stats_file: str | None = None) -> str:
             "border:1px solid #ccc} th{text-align:left}"
             "</style></head><body><h1>txn anomaly checker</h1>"
             '<p><a href="/">home</a></p>')
-    try:
-        with open(path) as fh:
-            snap = json.load(fh)
-    except (OSError, ValueError) as e:
+    snap, err = _load_snapshot(path)
+    if snap is None:
         return (head + f"<p>no txn snapshot at "
                 f"<code>{_html.escape(str(path))}</code> "
-                f"({_html.escape(str(e))}) — run a txn check "
+                f"({_html.escape(str(err))}) — run a txn check "
                 f"(<code>make txn-smoke</code>)?</p></body></html>")
 
     color = VALID_COLORS.get(snap.get("verdict"), "#FFFFFF")
@@ -204,6 +208,105 @@ def txn_html(stats_file: str | None = None) -> str:
     return "".join(parts)
 
 
+def _sparkline_svg(samples: list, width=600, height=60) -> str:
+    """Inline SVG sparkline of frontier size over elapsed seconds
+    (no JS, no external assets — the page must render from a file)."""
+    pts = [(s[0], s[2]) for s in samples
+           if isinstance(s, (list, tuple)) and len(s) >= 3
+           and s[2] is not None]
+    if len(pts) < 2:
+        return "<p>(not enough samples for a sparkline yet)</p>"
+    t0, t1 = pts[0][0], pts[-1][0]
+    vmax = max(v for _, v in pts) or 1
+    dt = (t1 - t0) or 1
+    path = " ".join(
+        f"{'M' if i == 0 else 'L'}"
+        f"{(t - t0) / dt * (width - 4) + 2:.1f},"
+        f"{height - 2 - v / vmax * (height - 14):.1f}"
+        for i, (t, v) in enumerate(pts))
+    return (f'<svg width="{width}" height="{height}" '
+            f'style="border:1px solid #ccc">'
+            f'<path d="{path}" fill="none" stroke="#4078c0" '
+            f'stroke-width="1.5"/>'
+            f'<text x="4" y="12" font-size="10">frontier (max '
+            f'{vmax})</text></svg>')
+
+
+def run_html(snapshot_file: str | None = None) -> str:
+    """The /run live-telemetry page: the obs registry's run-telemetry
+    snapshot (written by the engines every JEPSEN_TPU_OBS_EVERY_S at
+    committed row boundaries) rendered as progress gauges (row, ETA,
+    rows/s), the frontier-size sparkline, the watchdog/quarantine
+    event feed, and every registered stats view — so a wedged config-5
+    run is diagnosable from a browser without attaching a debugger."""
+    from jepsen_tpu.obs import metrics as obs_metrics
+
+    path = snapshot_file or obs_metrics.snapshot_path()
+    head = ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            "<meta http-equiv='refresh' content='5'>"
+            "<title>run telemetry</title><style>"
+            "body{font-family:sans-serif} table{border-collapse:collapse;"
+            "margin-bottom:1em} td,th{padding:3px 10px;"
+            "border:1px solid #ccc} th{text-align:left}"
+            "</style></head><body><h1>run telemetry</h1>"
+            '<p><a href="/">home</a> (auto-refreshes every 5 s)</p>')
+    snap, err = _load_snapshot(path)
+    if snap is None:
+        return (head + f"<p>no run-telemetry snapshot at "
+                f"<code>{_html.escape(str(path))}</code> "
+                f"({_html.escape(str(err))}) — is an engine check "
+                f"running (doc/observability.md)?</p></body></html>")
+
+    def table(title, items):
+        rows = "".join(
+            f"<tr><th>{_html.escape(str(k))}</th>"
+            f"<td>{_html.escape(str(v))}</td></tr>"
+            for k, v in items)
+        return f"<h2>{_html.escape(title)}</h2><table>{rows}</table>"
+
+    run = snap.get("run") or {}
+    parts = [head]
+    row, total = run.get("row"), run.get("total_rows")
+    bar = ""
+    if row is not None and total:
+        pct = min(100.0, 100.0 * row / total)
+        bar = (f'<div style="width:600px;border:1px solid #ccc">'
+               f'<div style="width:{pct:.1f}%;background:#ADF6B0">'
+               f"&nbsp;{pct:.1f}%</div></div>")
+    parts.append(
+        f"<p>run <b>{_html.escape(str(run.get('run', '?')))}</b> · "
+        f"updated {_html.escape(str(snap.get('updated', '?')))} · "
+        f"pid {_html.escape(str(snap.get('pid', '?')))}</p>" + bar)
+    gauges = [(k, v) for k, v in sorted(run.items()) if k != "run"]
+    gauges += [(k, snap[k]) for k in ("xla_compiles", "xla_compile_s",
+                                      "xla_cache_hits")
+               if snap.get(k) is not None]
+    parts.append(table("progress", gauges))
+    parts.append("<h2>frontier</h2>"
+                 + _sparkline_svg(snap.get("samples") or []))
+    events = snap.get("events") or []
+    if events:
+        rows = "".join(
+            f"<tr><td>{_html.escape(str(e.get('t')))}</td>"
+            f"<td>{_html.escape(str(e.get('kind')))}</td>"
+            f"<td>{_html.escape(str({k: v for k, v in e.items() if k not in ('t', 'kind')}))}</td></tr>"
+            for e in events[-24:])
+        parts.append("<h2>events (watchdog / quarantine)</h2>"
+                     "<table><tr><th>time</th><th>kind</th>"
+                     "<th>detail</th></tr>" + rows + "</table>")
+    for name in sorted(snap.get("views") or {}):
+        view = snap["views"][name] or {}
+        parts.append(table(
+            name, sorted((k, v) for k, v in view.items()
+                         if not isinstance(v, (dict, list)))))
+    parts.append("<h2>raw</h2><pre>"
+                 + _html.escape(json.dumps(snap, indent=1,
+                                           sort_keys=True,
+                                           default=str))
+                 + "</pre></body></html>")
+    return "".join(parts)
+
+
 def zip_run(base: Path, rel: str) -> bytes:
     """Zip a run directory in memory (web.clj:250-271 streams; runs are
     small enough to buffer)."""
@@ -221,6 +324,7 @@ class _Handler(BaseHTTPRequestHandler):
     base: Path = Path("store")
     stats_file: str | None = None   # None -> the daemon's default path
     txn_stats_file: str | None = None   # None -> txn.device default
+    run_stats_file: str | None = None   # None -> obs registry default
 
     def log_message(self, fmt, *args):  # route through logging
         log.debug(fmt, *args)
@@ -251,6 +355,8 @@ class _Handler(BaseHTTPRequestHandler):
                            service_html(self.stats_file).encode())
             elif path == "/txn":
                 self._send(200, txn_html(self.txn_stats_file).encode())
+            elif path == "/run":
+                self._send(200, run_html(self.run_stats_file).encode())
             elif path.startswith("/zip/"):
                 rel = self._safe_rel(path[len("/zip/"):].strip("/"))
                 if rel is None:
@@ -294,10 +400,12 @@ class _Handler(BaseHTTPRequestHandler):
 
 def make_server(host="0.0.0.0", port=8080, base="store",
                 stats_file: str | None = None,
-                txn_stats_file: str | None = None) -> ThreadingHTTPServer:
+                txn_stats_file: str | None = None,
+                run_stats_file: str | None = None) -> ThreadingHTTPServer:
     handler = type("Handler", (_Handler,),
                    {"base": Path(base), "stats_file": stats_file,
-                    "txn_stats_file": txn_stats_file})
+                    "txn_stats_file": txn_stats_file,
+                    "run_stats_file": run_stats_file})
     return ThreadingHTTPServer((host, port), handler)
 
 

@@ -22,44 +22,38 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: the engines' per-shape programs are
 # identical across test runs; caching cuts suite time dramatically.
-from jepsen_tpu.util import enable_compile_cache  # noqa: E402
+from jepsen_tpu.util import (  # noqa: E402
+    compile_meter,
+    enable_compile_cache,
+    install_compile_meter,
+)
 
 enable_compile_cache()
 
 # --- quick-tier no-compile enforcement --------------------------------------
 # The quick tier's promise (pyproject marker, CLAUDE.md) is "no XLA
-# compiles": ~1 min wall even on one core. That promise was
-# unenforced; here every true backend compile (a persistent-cache MISS
-# reaching XLA — cache hits load in milliseconds and keep the promise)
-# is counted, and a `quick`-marked test that triggers one FAILS unless
-# it carries the registered `compiles` marker (the handful of quick
-# engine tests that intentionally compile tiny .jax_cache-resident
-# programs). JEPSEN_TPU_QUICK_NO_COMPILE=0 disables;
+# compiles": ~1 min wall even on one core. Every true backend compile
+# (a persistent-cache MISS reaching XLA — cache hits load in
+# milliseconds and keep the promise) is counted by the SHARED
+# process-wide meter (util.install_compile_meter — the same wrap the
+# checker daemon's stats and the obs registry read), and a
+# `quick`-marked test that triggers one FAILS unless it carries the
+# registered `compiles` marker (the handful of quick engine tests that
+# intentionally compile tiny .jax_cache-resident programs).
+# JEPSEN_TPU_QUICK_NO_COMPILE=0 disables;
 # JEPSEN_TPU_QUICK_COMPILE_REPORT=1 reports instead of failing (used
 # to find offenders).
 
 import pytest  # noqa: E402
 
-_xla_compiles = {"n": 0}
-try:
-    import jax._src.compiler as _jax_compiler
-
-    _real_backend_compile = _jax_compiler.backend_compile
-
-    def _counting_backend_compile(*a, **kw):
-        _xla_compiles["n"] += 1
-        return _real_backend_compile(*a, **kw)
-
-    _jax_compiler.backend_compile = _counting_backend_compile
-except (ImportError, AttributeError):  # pragma: no cover - jax skew
-    _jax_compiler = None
+install_compile_meter()
 
 
 @pytest.fixture(autouse=True)
 def _quick_no_compile(request):
-    before = _xla_compiles["n"]
+    before = compile_meter()["xla_compiles"]
     yield
-    compiled = _xla_compiles["n"] - before
+    compiled = compile_meter()["xla_compiles"] - before
     if not compiled:
         return
     if request.node.get_closest_marker("quick") is None:
