@@ -1,7 +1,7 @@
 # jepsen_tpu development targets.
 
 .PHONY: test test-quick integration integration-local bench \
-	probe-config5 serve-smoke txn-smoke trace-smoke
+	probe-config5 serve-smoke txn-smoke trace-smoke stream-smoke
 
 # Unit + parity suite on the virtual 8-device CPU mesh (no cluster).
 # Hardware note: ~8 min on a 4-core box; the compile-heavy lin parity
@@ -76,6 +76,17 @@ TXN_SMOKE_TIMEOUT ?= 600
 txn-smoke:
 	timeout -k 15 $(TXN_SMOKE_TIMEOUT) \
 		python -m jepsen_tpu.txn.smoke
+
+# Streaming-checker smoke (doc/streaming.md): chip-free CPU-mesh
+# open -> append xN -> finalize round trip, in-process AND over the
+# wire (daemon stream session), with verdict parity vs the CPU oracle
+# and the corrupted twin proving mid-feed early abort. Run it after
+# touching jepsen_tpu/stream/, the wire layer, core.py's live-checker
+# hook, or the bfs incremental entry (frontier=/partial=).
+STREAM_SMOKE_TIMEOUT ?= 600
+stream-smoke:
+	timeout -k 15 $(STREAM_SMOKE_TIMEOUT) \
+		python -m jepsen_tpu.stream.smoke
 
 # Flight-recorder smoke (doc/observability.md): chip-free CPU-mesh
 # check of a small sparse-engine history with JEPSEN_TPU_TRACE=1 —

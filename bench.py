@@ -67,7 +67,8 @@ TARGET_SECONDS = 60.0
 # external timeout (BENCH_r05: rc=124, parsed=null).
 PROBE_ORDER = (("mutex_c30", 600), ("wide_window_c30", 600),
                ("independent_keys", 900), ("service_c30", 900),
-               ("txn_c30", 900), ("partitioned_c30", 5300))
+               ("txn_c30", 900), ("stream_c30", 900),
+               ("partitioned_c30", 5300))
 WORKER_RESTART_S = 75
 # Overall bench wall budget the partitioned probe must fit inside
 # (env-overridable for driver environments with different budgets).
@@ -432,6 +433,94 @@ def _probe_service_c30():
     return out
 
 
+def _probe_stream_c30():
+    """Streaming incremental checking (ISSUE 11 / ROADMAP online-mode
+    unlock, doc/streaming.md): the 5k-op partitioned witness history
+    checked (a) one-shot post-hoc and (b) streamed in increments with
+    the frontier carried between them — same verdict, plus the numbers
+    post-hoc checking cannot have: ingest-vs-checked lag and, on a
+    corrupted twin, ABORT LATENCY (how many ops after the offending
+    completion the stream needed before latching the witness, and how
+    many ops of remaining traffic it saved). Ordered BEFORE
+    partitioned_c30 and fault-isolated in its own subprocess so a
+    stream fault cannot shadow the headline."""
+    from jepsen_tpu import models as m
+    from jepsen_tpu.lin import device_check_packed, prepare, synth
+    from jepsen_tpu.stream import StreamChecker
+
+    n_ops = 5000
+    h = list(synth.generate_partitioned_register_history(
+        n_ops, seed=7, invoke_bias=0.45))
+    p = prepare.prepare(m.cas_register(), h)
+    device_check_packed(p)                      # warm/compile
+    t0 = time.time()
+    one = device_check_packed(p)
+    oneshot_s = time.time() - t0
+
+    incr_events = 250
+    t0 = time.time()
+    sc = StreamChecker(m.cas_register(), min_rows=64)
+    max_lag = 0
+    for i in range(0, len(h), incr_events):
+        st = sc.append(h[i:i + incr_events])
+        max_lag = max(max_lag, st["settled"] - st["row"])
+    t_fin = time.time()
+    res = sc.finalize()
+    stream_s = time.time() - t0
+    finalize_s = time.time() - t_fin
+
+    # Abort latency on a corrupted twin: find the corruption, stream
+    # toward it, measure how far past it the stream ran before the
+    # latch fired.
+    bad = list(synth.corrupt_history(
+        synth.generate_partitioned_register_history(
+            n_ops, seed=7, invoke_bias=0.45), seed=3))
+    bad_at = next(i for i, (a, b) in enumerate(zip(h, bad))
+                  if a.value != b.value or a.type != b.type)
+    sc2 = StreamChecker(m.cas_register(), min_rows=64)
+    abort_after_ops = abort_s = None
+    t_bad = None
+    for i in range(0, len(bad), incr_events):
+        # Clock starts when the offending completion is HANDED to the
+        # session (before the append that carries it), so abort_s
+        # covers the increment that catches it.
+        if t_bad is None and i + incr_events > bad_at:
+            t_bad = time.time()
+        st = sc2.append(bad[i:i + incr_events])
+        if sc2.aborted:
+            abort_after_ops = i + incr_events - bad_at
+            abort_s = time.time() - t_bad
+            break
+    saved_ops = len(bad) - (bad_at + (abort_after_ops or 0))
+    resb = sc2.finalize()
+
+    out = {"n_ops": n_ops, "window": p.window,
+           "crashed": len(p.crashed_ops),
+           "oneshot_verdict": one.get("valid?"),
+           "oneshot_seconds": round(oneshot_s, 2),
+           "stream_verdict": res.get("valid?"),
+           "stream_seconds": round(stream_s, 2),
+           "finalize_seconds": round(finalize_s, 3),
+           "increments": (res.get("stream") or {}).get("increments"),
+           "max_lag_rows": max_lag,
+           "degraded": (res.get("stream") or {}).get("degraded"),
+           "abort_verdict": resb.get("valid?"),
+           "abort_after_ops": abort_after_ops,
+           "abort_seconds": None if abort_s is None
+           else round(abort_s, 3),
+           "ops_saved_by_abort": saved_ops}
+    # Contract: parity with the one-shot verdict, and the injected
+    # violation aborts the stream before the history runs out.
+    out["verdict"] = (one.get("valid?") is True
+                      and res.get("valid?") is True
+                      and resb.get("valid?") is False
+                      and abort_after_ops is not None
+                      and saved_ops > 0)
+    if not out["verdict"]:
+        out["error"] = "stream probe contract failed (see fields)"
+    return out
+
+
 def _probe_txn_c30():
     """Transactional anomaly checking at the 100k-op scale (ISSUE 9 /
     ROADMAP scenario diversity): a concurrency-30 list-append history
@@ -501,7 +590,8 @@ PROBES = {"ping": _probe_ping, "mutex_c30": _probe_mutex_c30,
           "partitioned_c30": _probe_partitioned_c30,
           "independent_keys": _probe_independent_keys,
           "wave_smoke": _probe_wave_smoke,
-          "service_c30": _probe_service_c30}
+          "service_c30": _probe_service_c30,
+          "stream_c30": _probe_stream_c30}
 
 
 def _run_probe_subprocess(key: str, timeout: int, env_extra=None,

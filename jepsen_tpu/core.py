@@ -55,9 +55,20 @@ def synchronize(test: dict) -> None:
 
 
 def conj_op(test: dict, op: Op) -> Op:
-    """Append an op to the test's history (core.clj:43-47)."""
+    """Append an op to the test's history (core.clj:43-47). With the
+    streaming checker enabled (``JEPSEN_TPU_STREAM=1``), every append
+    also feeds the live checker thread — an op enters a check increment
+    only once its completion lands here, which is exactly the ``:info``
+    contract (an op that may have applied is never checked as absent)."""
+    live = test.get("stream-live")
     with test["history-lock"]:
         test["history"].append(op)
+        if live is not None:
+            # INSIDE the lock: the stream feed must see client events
+            # in exactly the recorded history order, or an increment
+            # could check against real-time constraints the true
+            # history does not have (offer is O(1) — a deque append).
+            live.offer(op)
     return op
 
 
@@ -165,7 +176,17 @@ def _worker_loop(test, setup_barrier, process, node):
     if client is not None:
         try:
             setup_barrier.wait()
+            live = test.get("stream-live")
             while True:
+                if live is not None and live.should_abort():
+                    # Streaming early abort: an increment proved the
+                    # history invalid — stop drawing ops; the witness
+                    # is already latched (doc/streaming.md).
+                    log.warning(
+                        "stream checker aborted the run (invalid "
+                        "increment); worker %s stops generating",
+                        process)
+                    break
                 op = generator.op_and_validate(gen, test, process)
                 if op is None:
                     break
@@ -340,8 +361,29 @@ def run(test: dict) -> dict:
                         test, lambda t, n: db_ns.cycle(t["db"], t, n))
                     setup_primary(test)
 
-                    with relative_time_context():
-                        test["history"] = run_case(test)
+                    # Streaming incremental checker (env-gated,
+                    # JEPSEN_TPU_STREAM* — doc/streaming.md): a live
+                    # checker thread fed by conj_op during the run,
+                    # with early abort plumbed into the worker loops.
+                    from jepsen_tpu.stream import live_checker_for
+
+                    live = live_checker_for(test)
+                    if live is not None:
+                        test["stream-live"] = live
+                    try:
+                        with relative_time_context():
+                            test["history"] = run_case(test)
+                    finally:
+                        if live is not None:
+                            try:
+                                test["stream-results"] = live.finish()
+                            except Exception:  # noqa: BLE001 - the
+                                # live verdict is an extra, earlier
+                                # view; losing it must not lose the
+                                # run or the post-hoc check.
+                                log.warning("stream checker finalize "
+                                            "failed:\n%s",
+                                            traceback.format_exc())
                 except Exception:
                     snarf_logs(test)  # emergency log dump
                     if test.get("name"):
@@ -365,6 +407,12 @@ def run(test: dict) -> dict:
         test["history"] = history_mod.index(test["history"])
         test["results"] = checker_ns.check_safe(
             test["checker"], test, test.get("model"), test["history"])
+        if test.get("stream-results") is not None:
+            # The stream verdict rides NEXT TO the configured checker's
+            # (same history, decided earlier — equal by the parity
+            # argument in doc/streaming.md); it never overrides it.
+            test["results"] = dict(test["results"])
+            test["results"]["stream"] = test["stream-results"]
         log.info("Analysis complete")
         if test.get("name"):
             store.save_2(test)

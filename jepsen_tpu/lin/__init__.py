@@ -92,11 +92,17 @@ def device_check_packed(packed: PackedHistory, cancel=None, **kw) -> dict:
     from jepsen_tpu.lin import bfs, dense
     from jepsen_tpu.obs import trace as _trace
 
-    known = {"chunk", "cap_schedule", "explain", "checkpoint", "resume"}
+    known = {"chunk", "cap_schedule", "explain", "checkpoint", "resume",
+             "frontier", "frontier_row", "partial", "host_caps"}
     if kw.keys() - known:
         # e.g. snapshots= is dense-only: call dense.check_packed directly.
         raise TypeError(f"unknown device-check options {kw.keys() - known}")
-    if dense.plan(packed) is not None:
+    # Streaming incremental entry (frontier carry / partial verdicts,
+    # jepsen_tpu.stream): always the sparse engine — the carried
+    # frontier is in its multiword layout, which the dense config-space
+    # bitmap cannot re-enter.
+    incremental = kw.get("partial") or kw.get("frontier") is not None
+    if not incremental and dense.plan(packed) is not None:
         # checkpoint/resume are sparse-engine options (dense histories
         # decide in seconds; there is nothing worth resuming).
         dkw = {k: v for k, v in kw.items() if k in ("chunk", "explain")}

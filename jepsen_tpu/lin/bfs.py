@@ -2467,7 +2467,8 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                  spike_dropback: int = SPIKE_DROPBACK,
                  packed_keys: bool | None = None,
                  lazy: bool = True, host_caps=HOST_ROW_CAPS,
-                 checkpoint=None, resume=None) -> dict:
+                 checkpoint=None, resume=None, frontier=None,
+                 frontier_row: int = 0, partial: bool = False) -> dict:
     """Decide linearizability of a packed history on device.
 
     Host loop over CHUNK-row device dispatches; the frontier carries
@@ -2496,6 +2497,18 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     Checkpoints are deleted on a definite verdict and kept on
     unknown/cancelled/wedged ones; the verdict carries
     ``resumed-from-row`` when a resume happened.
+
+    **Incremental entry (the streaming checker,
+    :mod:`jepsen_tpu.stream`):** ``frontier`` — a carried
+    ``(bits u32[n, nw], state i32[n, S], count)`` committed frontier in
+    the multiword layout of the chunk-kind checkpoint codec — re-enters
+    the row loop at ``frontier_row`` exactly like a checkpoint resume
+    (same invariant: an exact committed frontier at a row boundary).
+    With ``partial=True`` a clean walk to ``p.R`` returns the committed
+    frontier under ``"stream-frontier"`` (numpy, host-side) instead of
+    a final run verdict, so the caller can extend the history and
+    re-enter; death/overflow/wedge verdicts are unchanged. ``frontier``
+    takes precedence over ``resume`` when both are given.
     """
     if p.kernel is None:
         return {"valid?": "unknown", "analyzer": "tpu-bfs",
@@ -2505,7 +2518,13 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                 "error": f"concurrency window {p.window} exceeds device "
                          f"bitset width {MAX_DEVICE_WINDOW}"}
     if p.R == 0:
-        return {"valid?": True, "analyzer": "tpu-bfs", "configs": []}
+        out = {"valid?": True, "analyzer": "tpu-bfs", "configs": []}
+        if partial:
+            out["stream-frontier"] = {
+                "bits": np.zeros((1, (p.window + 31) // 32), np.uint32),
+                "state": np.asarray(p.init_state, np.int32)[None, :],
+                "count": 1, "row": 0}
+        return out
 
     ret_slot_h = np.asarray(p.ret_slot)
     active_h = np.asarray(p.active)
@@ -2673,6 +2692,59 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                     count = jnp.int32(rcount)
                     start_row = resumed_from = rd["row"]
 
+    if frontier is not None:
+        # Streaming incremental entry: a carried committed frontier at
+        # a row boundary, in the multiword chunk-checkpoint layout
+        # (layout-stable under window growth and interner growth — the
+        # packed-key b is re-derived per call above). Precedence over
+        # any file resume: the caller owns the carry.
+        fb = np.ascontiguousarray(np.asarray(frontier[0],
+                                             dtype=np.uint32))
+        fs = np.ascontiguousarray(np.asarray(frontier[1],
+                                             dtype=np.int32))
+        fc = int(frontier[2])
+        if fb.ndim == 1:
+            fb = fb[:, None]
+        if fs.ndim == 1:
+            fs = fs[:, None]
+        if fs.shape[1] != S:
+            return {"valid?": "unknown", "analyzer": "tpu-bfs",
+                    "error": f"carried frontier state width "
+                             f"{fs.shape[1]} != kernel width {S}"}
+        if fc <= 0:
+            # An empty committed frontier can only follow a death row,
+            # which would have ended the stream already.
+            return {"valid?": "unknown", "analyzer": "tpu-bfs",
+                    "error": "carried stream frontier is empty"}
+        if fb.shape[1] < nw:
+            # The concurrency window crossed a 32-slot word boundary
+            # between increments; high words are zero by construction.
+            fb = np.pad(fb, ((0, 0), (0, nw - fb.shape[1])))
+        resume_host = None
+        resumed_from = None
+        start_row = int(frontier_row)
+        if fc <= cap_schedule[-1]:
+            level = next(i for i, c in enumerate(cap_schedule)
+                         if fc <= c)
+            cap = cap_schedule[level]
+            max_cap_used = max(max_cap_used, cap)
+            rb = np.zeros((cap, nw), np.uint32)
+            rs = np.zeros((cap, S), np.int32)
+            rb[:fc] = fb[:fc, :nw]
+            rs[:fc] = fs[:fc]
+            bits = jnp.asarray(rb)
+            state = jnp.asarray(rs)
+            count = jnp.int32(fc)
+        elif exp_h is not None and crash_dom:
+            # Frontier bigger than the chunked top cap: re-enter the
+            # host-row executor directly (the host-kind resume path).
+            resume_host = (fb, fs, fc, None)
+        else:
+            return {"valid?": "unknown", "analyzer": "tpu-bfs",
+                    "overflow": "capacity",
+                    "error": f"carried stream frontier {fc} exceeds "
+                             f"chunk capacity {cap_schedule[-1]}"}
+
     def _with_stats(out: dict) -> dict:
         if host_stats["episodes"] or host_stats["watchdog_trips"] \
                 or host_stats["faults"] or host_stats["quarantine_skips"] \
@@ -2680,12 +2752,27 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             out["host-stats"] = util.round_stats(host_stats)
         if resumed_from is not None:
             out["resumed-from-row"] = resumed_from
-        if ckpt is not None and out.get("valid?") in (True, False):
+        if ckpt is not None and not partial \
+                and out.get("valid?") in (True, False):
             # A finished search must not be resumed by a later fresh
             # run; an unknown/cancelled/wedged verdict keeps the
             # checkpoint so a re-run continues instead of restarting.
             ckpt.clear()
         return out
+
+    def _final_valid(fb, fs, fc) -> dict:
+        """The clean-walk-to-p.R verdict; with ``partial`` it carries
+        the committed frontier (host numpy, multiword layout) so the
+        stream session can extend the history and re-enter."""
+        out = {"valid?": True, "analyzer": "tpu-bfs", "configs": [],
+               "final-frontier-size": int(fc), "max-cap": max_cap_used}
+        if partial:
+            n = int(fc)
+            out["stream-frontier"] = {
+                "bits": np.asarray(fb)[:n].astype(np.uint32),
+                "state": np.asarray(fs)[:n].astype(np.int32),
+                "count": n, "row": int(p.R)}
+        return _with_stats(out)
 
     def chunk_tables(base):
         tables = (jnp.asarray(_chunk_slice(ret_slot_h, base, chunk)),
@@ -2757,10 +2844,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             # the executor (one row of CPU replay for explain).
             return ("dead", next_r)
         if next_r >= p.R:
-            return ("return", _with_stats(
-                {"valid?": True, "analyzer": "tpu-bfs",
-                 "configs": [], "final-frontier-size": count_i,
-                 "max-cap": max_cap_used}))
+            return ("return", _final_valid(s_bits, s_state, count_i))
         # Resume full-size chunks at the hand-back row — at the TOP
         # chunked level: the neighbourhood of a spike tends to spike
         # again, and re-climbing the whole cap ladder there costs far
@@ -3091,7 +3175,4 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             bits = bits[:cap]
             state = state[:cap]
 
-    return _with_stats({"valid?": True, "analyzer": "tpu-bfs",
-                        "configs": [],
-                        "final-frontier-size": int(count),
-                        "max-cap": max_cap_used})
+    return _final_valid(bits, state, int(count))

@@ -91,6 +91,13 @@ def stats_path() -> str:
         util.cache_dir(), "service_stats.json")
 
 
+def stream_session_bound() -> int:
+    """Concurrent stream-check sessions the daemon holds open: each
+    pins a carried frontier + packer state in memory between appends,
+    so the bound is a memory/abuse guard like the in-flight bound."""
+    return util.env_int("JEPSEN_TPU_STREAM_SESSIONS", 4)
+
+
 @dataclass(eq=False)
 class Request:
     """One queued check: wire identity + packed shape + reply route.
@@ -109,6 +116,21 @@ class Request:
     attempts: int = 0              # fault requeues consumed
     no_batch: bool = False         # post-fault: keep off the batch path
     done: bool = False             # answered (guards double-finish)
+
+
+@dataclass(eq=False)
+class StreamSession:
+    """One daemon-held streaming session (doc/streaming.md): the
+    StreamChecker (carried frontier + incremental packer) plus its
+    OWNING connection — a dropped client's sessions are reaped and
+    their slots freed; another connection can never touch them."""
+
+    sid: str
+    model_name: str
+    checker: Any
+    sock: Any
+    opened: float = field(default_factory=time.monotonic)
+    appends: int = 0
 
 
 def bin_key(packed) -> str:
@@ -181,6 +203,11 @@ class CheckerService:
         self._conns_lock = threading.Lock()
         self._worker_t: threading.Thread | None = None
 
+        self._streams: dict[str, StreamSession] = {}
+        self._streams_lock = threading.Lock()
+        self._stream_seq = 0
+        self.stream_bound = stream_session_bound()
+
         self._stats_lock = threading.Lock()
         self._stats: dict = {"decline_axes": {}, "bin_decide_s": {},
                              "bin_requests": {}}
@@ -226,6 +253,14 @@ class CheckerService:
                                  for k, v in self._bins.items() if v}
         out["queue_depth"] = self._queue.qsize()
         out["queue_bound"] = self.bound
+        with self._streams_lock:
+            out["stream_sessions_open"] = len(self._streams)
+            out["stream_session_bound"] = self.stream_bound
+            if self._streams:
+                out["stream_sessions"] = {
+                    s.sid: {"model": s.model_name, "appends": s.appends,
+                            **s.checker.status()}
+                    for s in self._streams.values()}
         with self._stats_lock:
             out["in_flight"] = self._inflight
         batches = out.get("batches", 0)
@@ -368,6 +403,23 @@ class CheckerService:
                 except (ConnectionError, OSError):
                     break   # client done/dropped; daemon unaffected
                 mtype = msg.get("type")
+                v = msg.get("v", 1)
+                if v != protocol.PROTOCOL_VERSION:
+                    # The handshake check (v2 satellite): a version-
+                    # skewed client gets ONE readable frame naming both
+                    # versions, instead of the opaque codec/KeyError a
+                    # new frame family would otherwise produce.
+                    self._bump("version_mismatches")
+                    respond({"type": "error", "id": msg.get("id"),
+                             "error": (
+                                 "protocol version mismatch: daemon "
+                                 f"speaks v{protocol.PROTOCOL_VERSION}"
+                                 f", client sent v{v} — upgrade the "
+                                 "client (the version field and the "
+                                 "stream-check frames landed in v2)"),
+                             "daemon_version":
+                                 protocol.PROTOCOL_VERSION})
+                    continue
                 if mtype == "ping":
                     respond({"type": "pong"})
                 elif mtype == "stats":
@@ -379,6 +431,14 @@ class CheckerService:
                     break
                 elif mtype == "check":
                     self._admit(msg, respond)
+                elif mtype == "stream-open":
+                    self._stream_open(msg, respond, sock)
+                elif mtype == "stream-append":
+                    self._stream_append(msg, respond, sock)
+                elif mtype == "stream-finalize":
+                    self._stream_finalize(msg, respond, sock)
+                elif mtype == "stream-abort":
+                    self._stream_abort(msg, respond, sock)
                 else:
                     respond({"type": "error", "id": msg.get("id"),
                              "error": f"unknown message type {mtype!r}"})
@@ -386,6 +446,7 @@ class CheckerService:
             alive["ok"] = False
             with self._conns_lock:
                 self._conns.discard(sock)
+            self._reap_streams(sock)
             try:
                 sock.close()
             except OSError:
@@ -431,6 +492,139 @@ class CheckerService:
                               f"flight (bound)"})
             return
         self._queue.put(req)
+
+    # --- stream-check sessions (doc/streaming.md) ---------------------------
+
+    def _stream_open(self, msg: dict, respond: Callable, sock) -> None:
+        from jepsen_tpu.stream import StreamChecker
+
+        rid = msg.get("id")
+        try:
+            model = protocol.model_by_name(msg.get("model"))
+        except (ValueError, TypeError) as e:
+            respond({"type": "error", "id": rid, "error": str(e)})
+            return
+        with self._streams_lock:
+            if len(self._streams) >= self.stream_bound:
+                self._bump("stream_overloads")
+                respond({"type": "error", "id": rid,
+                         "error": f"stream overload: "
+                                  f"{self.stream_bound} sessions open "
+                                  f"(bound)"})
+                return
+            self._stream_seq += 1
+            sid = f"s{self._stream_seq}-{os.urandom(3).hex()}"
+            # min_rows=1: over the wire the CLIENT owns the increment
+            # windowing — every append is one increment, so the state
+            # reply always reflects the appended ops.
+            sess = StreamSession(
+                sid, msg.get("model"),
+                StreamChecker(model, min_rows=1,
+                              view_name=f"stream-{sid}"), sock)
+            self._streams[sid] = sess
+        self._bump("stream_opens")
+        respond({"type": "stream-opened", "id": rid, "session": sid})
+
+    def _get_stream(self, msg: dict, sock) -> StreamSession | None:
+        with self._streams_lock:
+            sess = self._streams.get(msg.get("session"))
+        # Connection-owned: a foreign session id answers exactly like
+        # an unknown one (no cross-connection probing).
+        return sess if sess is not None and sess.sock is sock else None
+
+    def _drop_stream(self, sid: str) -> None:
+        with self._streams_lock:
+            sess = self._streams.pop(sid, None)
+        if sess is not None:
+            sess.checker.release_view()
+
+    def _reap_streams(self, sock) -> None:
+        with self._streams_lock:
+            dead = [s for s in self._streams.values()
+                    if s.sock is sock]
+            for s in dead:
+                del self._streams[s.sid]
+        for s in dead:
+            s.checker.release_view()
+        if dead:
+            self._bump("stream_reaped", len(dead))
+
+    def _stream_run(self, fn: Callable):
+        """Run session work on the WORKER thread (it owns the device;
+        stream increments must queue behind batches, not race them),
+        blocking the connection handler until done or deadline.
+        Returns (outcome, value): ("ok", r) | ("error", reason)."""
+        done = threading.Event()
+        box: dict = {}
+
+        def job():
+            try:
+                box["r"] = fn()
+            except Exception as e:  # noqa: BLE001 - reported, below
+                box["e"] = e
+            finally:
+                done.set()
+
+        self._work.put(("stream", job))
+        if not done.wait(self.deadline_s):
+            # The job still runs (the worker serializes this session's
+            # work), only this REPLY gives up — same currency as the
+            # per-request deadline.
+            return "error", (f"stream increment exceeded the "
+                             f"{self.deadline_s:.0f}s deadline")
+        if "e" in box:
+            return "error", f"stream session error: {box['e']!r}"
+        return "ok", box.get("r")
+
+    def _stream_append(self, msg: dict, respond: Callable, sock) -> None:
+        sess = self._get_stream(msg, sock)
+        if sess is None:
+            respond({"type": "error", "session": msg.get("session"),
+                     "error": "unknown stream session"})
+            return
+        try:
+            ops = protocol.history_from_wire(msg.get("ops") or [])
+        except (TypeError, KeyError) as e:
+            respond({"type": "error", "session": sess.sid,
+                     "error": f"bad ops: {e!r}"})
+            return
+        self._bump("stream_appends")
+        sess.appends += 1
+        outcome, r = self._stream_run(lambda: sess.checker.append(ops))
+        if outcome != "ok":
+            respond({"type": "error", "session": sess.sid, "error": r})
+            return
+        respond({"type": "stream-state", "session": sess.sid,
+                 **protocol.jsonable(r)})
+
+    def _stream_finalize(self, msg: dict, respond: Callable,
+                         sock) -> None:
+        sess = self._get_stream(msg, sock)
+        if sess is None:
+            respond({"type": "error", "session": msg.get("session"),
+                     "error": "unknown stream session"})
+            return
+        outcome, r = self._stream_run(sess.checker.finalize)
+        self._drop_stream(sess.sid)   # slot freed either way
+        self._bump("stream_finalizes")
+        if outcome != "ok":
+            respond({"type": "error", "session": sess.sid, "error": r})
+            return
+        respond({"type": "verdict", "id": sess.sid,
+                 "result": protocol.jsonable(r)})
+
+    def _stream_abort(self, msg: dict, respond: Callable, sock) -> None:
+        sess = self._get_stream(msg, sock)
+        if sess is None:
+            respond({"type": "error", "session": msg.get("session"),
+                     "error": "unknown stream session"})
+            return
+        # Through the worker like append/finalize: StreamChecker is not
+        # thread-safe, and an in-flight increment may be running there.
+        self._stream_run(sess.checker.abort)
+        self._drop_stream(sess.sid)
+        self._bump("stream_aborts")
+        respond({"type": "ok", "session": sess.sid})
 
     # --- scheduler ----------------------------------------------------------
 
@@ -495,6 +689,13 @@ class CheckerService:
             batch = self._work.get()
             if batch is None:
                 return
+            if isinstance(batch, tuple) and batch and \
+                    batch[0] == "stream":
+                # Stream-session job (already exception-proofed by
+                # _stream_run's wrapper): runs on this thread so
+                # increments serialize with batches on the one device.
+                batch[1]()
+                continue
             try:
                 self._process_batch(batch)
             except Exception:  # noqa: BLE001 - the daemon must survive

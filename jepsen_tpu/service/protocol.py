@@ -20,6 +20,35 @@ Messages (all dicts with a ``"type"`` key):
 - ``{"type": "shutdown"}`` → ``{"type": "ok"}`` then the daemon stops
   (the service is a trusted-network tool, like the results browser).
 
+**Stream-check family (v2, doc/streaming.md)** — a daemon-side
+:class:`jepsen_tpu.stream.StreamChecker` session holds the carried
+frontier between appends, so another process can stream a run at the
+daemon's warm chip:
+
+- ``{"type": "stream-open", "id": I, "model": NAME}``
+  → ``{"type": "stream-opened", "id": I, "session": SID}`` (or an
+  ``error`` when the session-slot bound is reached — backpressure,
+  like ``overload``).
+- ``{"type": "stream-append", "session": SID, "ops": [op dicts]}``
+  → ``{"type": "stream-state", "session": SID, "row": R, ...}``; once
+  an increment proves the history invalid the state carries
+  ``"aborted": true`` and the witness under ``"result"`` — the client
+  should stop producing.
+- ``{"type": "stream-finalize", "session": SID}`` → a ``verdict``
+  frame with the full-history result; the session slot is freed.
+- ``{"type": "stream-abort", "session": SID}`` → ``{"type": "ok"}``;
+  slot freed, no verdict.
+
+A client that disconnects mid-session is REAPED: the daemon drops its
+sessions and frees their slots (sessions are connection-owned).
+
+**Protocol version.** Every frame carries ``"v": PROTOCOL_VERSION``
+(stamped by :func:`send_msg`); the daemon checks it on every request
+and answers a structured ``error`` naming both versions on a mismatch
+— the stream frames are the first wire change since PR 6, and an old
+client against a new daemon should learn that in one readable frame,
+not via an opaque codec failure.
+
 **Indeterminate semantics** (the wire suites' client contract,
 suites/common.py): a connection lost after ``submit`` sent its frame is
 INDETERMINATE — the daemon may have decided the history and the reply
@@ -42,6 +71,11 @@ from jepsen_tpu.suites.common import (ReconnectExhausted, SocketIO,
                                       WireIndeterminate)
 
 DEFAULT_PORT = 8642
+
+# Wire protocol version: bumped to 2 when the stream-check family (and
+# this very field) landed. v1 frames carried no version; the daemon
+# treats an absent field as v1 and answers a structured mismatch error.
+PROTOCOL_VERSION = 2
 
 # Registry of wire model names -> model factories: every shipped model
 # family with a device or CPU checker formulation (models/kernels.py
@@ -84,6 +118,8 @@ def jsonable(v):
 
 
 def send_msg(io: SocketIO, msg: dict) -> None:
+    if "v" not in msg:
+        msg = {**msg, "v": PROTOCOL_VERSION}
     payload = codec.encode(msg)
     io.send(struct.pack(">I", len(payload)) + payload)
 
@@ -151,6 +187,54 @@ class CheckerClient:
         if resp.get("timings"):
             out["_timings"] = resp["timings"]
         return out
+
+    # --- stream-check sessions (doc/streaming.md) -----------------------
+
+    def stream_open(self, model_name: str) -> str:
+        """Open a daemon-side streaming session; returns its id.
+        Raises RuntimeError on refusal (bound reached, version skew)."""
+        self._next_id += 1
+        resp = self._rpc({"type": "stream-open", "id": self._next_id,
+                          "model": model_name})
+        if resp.get("type") != "stream-opened":
+            raise RuntimeError(
+                f"stream-open refused: {resp.get('error', resp)!r}")
+        return resp["session"]
+
+    def stream_append(self, session: str, ops) -> dict:
+        """Append history events to a stream session; returns the
+        session state (``aborted``/``result`` once an increment proved
+        the history invalid). A lost connection is INDETERMINATE, like
+        ``submit``: the append may have been ingested."""
+        try:
+            resp = self._rpc({"type": "stream-append",
+                              "session": session,
+                              "ops": history_to_wire(ops)})
+        except WireIndeterminate as e:
+            return {"valid?": "unknown", "error": f"indeterminate: {e}"}
+        if resp.get("type") == "error":
+            return {"valid?": "unknown",
+                    "error": resp.get("error", "daemon error")}
+        return dict(resp)
+
+    def stream_finalize(self, session: str) -> dict:
+        """Finalize a stream session; returns the full-history verdict
+        (the session slot is freed either way)."""
+        try:
+            resp = self._rpc({"type": "stream-finalize",
+                              "session": session})
+        except WireIndeterminate as e:
+            return {"valid?": "unknown", "error": f"indeterminate: {e}"}
+        if resp.get("type") == "error":
+            return {"valid?": "unknown",
+                    "error": resp.get("error", "daemon error")}
+        return dict(resp.get("result") or {})
+
+    def stream_abort(self, session: str) -> None:
+        try:
+            self._rpc({"type": "stream-abort", "session": session})
+        except (WireIndeterminate, ReconnectExhausted, OSError):
+            pass   # the daemon reaps dropped sessions anyway
 
     def ping(self) -> bool:
         try:

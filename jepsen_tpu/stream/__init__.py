@@ -1,0 +1,43 @@
+"""Streaming incremental checking — verdicts while traffic flows.
+
+Before this package, checking was strictly post-hoc: :mod:`jepsen_tpu.core`
+buffers the whole history, the run ends, then :mod:`jepsen_tpu.lin` decides
+— so a multi-hour soak under nemesis faults holds an unbounded history in
+memory and learns of a linearizability violation hours after it happened.
+Here the machinery the checker stack already built becomes ONLINE:
+
+- :mod:`jepsen_tpu.stream.incr` — :class:`IncrementalPacker`: extends the
+  packed history (prepare.py's slot walk, interner, reduction tables) in
+  SETTLED-ROW increments instead of re-packing from op 0. A return-event
+  row is *settled* once every op concurrent with it has resolved
+  (ok / fail / :info), so the row's tables — including the crashed flags
+  and canonical chains the exact reductions depend on — are final the
+  moment it is packed. The finalized tables are bit-identical to a
+  one-shot ``prepare.prepare`` of the same events (parity-tested).
+- :mod:`jepsen_tpu.stream.session` — :class:`StreamChecker`: accepts
+  completed ops in windowed increments, carries the sparse-engine
+  frontier between increments (the multiword ``bits``/``state`` arrays of
+  the PR 5 chunk-kind checkpoint codec, held in memory and optionally on
+  disk for kill/resume), dispatches each increment through
+  ``lin.device_check_packed(..., frontier=, partial=)`` under a
+  ``stream-incr`` supervision site, and ABORTS the stream the moment an
+  increment goes invalid — surfacing the witness seconds after the
+  offending completion instead of hours after the run.
+- :mod:`jepsen_tpu.stream.runner` — :class:`LiveChecker`: the
+  ``JEPSEN_TPU_STREAM``-gated checker thread :mod:`jepsen_tpu.core` feeds
+  during a run, with early abort plumbed into the generator loop.
+- The daemon side lives in :mod:`jepsen_tpu.service` (``stream-open`` /
+  ``stream-append`` / ``stream-finalize`` / ``stream-abort`` frames), so
+  a remote process can stream a run at a warm chip.
+
+Lifecycle, increment semantics, and the early-abort contract are in
+doc/streaming.md; every ``JEPSEN_TPU_STREAM_*`` knob is tabled in
+doc/env.md. ``make stream-smoke`` is the chip-free habit check.
+"""
+
+from jepsen_tpu.stream.incr import IncrementalPacker
+from jepsen_tpu.stream.session import StreamChecker
+from jepsen_tpu.stream.runner import LiveChecker, live_checker_for
+
+__all__ = ["IncrementalPacker", "StreamChecker", "LiveChecker",
+           "live_checker_for"]

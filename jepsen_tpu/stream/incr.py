@@ -1,0 +1,413 @@
+"""Incremental history packing: prepare.py's walk in settled-row steps.
+
+One-shot :func:`jepsen_tpu.lin.prepare.prepare` is a function of the
+COMPLETE history: slot assignment walks every endpoint event, crashed
+flags need to know which ops never return, and failed ops are removed
+before the walk ever sees them. Streaming cannot wait for the end — but
+it does not have to re-pack from op 0 either, because every per-row
+quantity is determined by a finite prefix of events:
+
+**Settled rows.** A return-event row ``r`` (at event position ``pos_r``)
+depends exactly on the ops invoked before ``pos_r``: which are active,
+their interned ``(f, value)``, and whether each eventually returns
+(the ``crashed`` flag the exact reductions and the dominance prune
+consume) or eventually fails (removed from the history entirely). So
+row ``r`` is *settled* — final, never to be revised — as soon as every
+op invoked before ``pos_r`` has a recorded completion (ok / fail /
+:info). With ``q_min`` = the smallest invoke position among still
+unresolved ops, the settled prefix is exactly the rows with
+``pos_r < q_min``; at finalize the dangling invokes become crashed
+(core.clj:185-217 semantics) and everything settles.
+
+The packer therefore holds the ``prepare._pack_events_py`` walk state
+(free slots, active map, interner) across increments and replays the
+endpoint-event stream in position order, never past ``q_min``. Because
+the walk and the interner see the same events in the same order as the
+one-shot pack, the finalized tables are BIT-IDENTICAL to
+``prepare.prepare`` of the same events (fuzzed in tests/test_stream.py)
+— which is what makes the streamed verdict provably equal the post-hoc
+one.
+
+**Reduction tables.** ``prepare.reduction_tables`` orders canonical
+chains by return ROW index, which is not yet assigned for an op whose
+return event lies past ``q_min``. Return rows are monotone in return
+POSITIONS, which *are* known for every resolved op — so the per-row
+chain computation here keys on positions instead, yielding the
+identical ``pred`` table (order is all the lexsort consumes). Settled
+rows' tables are final, so they are computed once per new block and
+cached; the cache is injected into each :meth:`packed` view so
+``prepare.reduction_tables`` (and everything downstream —
+``expansion_tables``, ``reduction_bit_tables``) never recomputes or,
+worse, misclassifies a live-but-unreturned op as crashed.
+
+Incremental packing (and the frontier carry that rides on it) is
+supported for the fixed-state-layout kernel families — register /
+cas-register / mutex, the streaming band that matters (the cockroach
+class). History-sized kernels (set / queue: their state layout is a
+function of the data) fall back to BUFFER mode: events accumulate and
+:class:`jepsen_tpu.stream.session.StreamChecker` runs one exact
+post-hoc check at finalize.
+
+numpy-only at import time (like :mod:`jepsen_tpu.obs`): the service
+protocol layer loads this without dragging a jax backend in.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+
+import numpy as np
+
+from jepsen_tpu import models as model_ns
+from jepsen_tpu.history import Op
+from jepsen_tpu.lin import prepare
+from jepsen_tpu.lin.prepare import LinOp, PackedHistory, UnsupportedHistory
+
+# Chain-order sentinel for ops that never return, far past any event
+# position (positions are per-session counters, bounded by fed events).
+_NEVER = np.int64(1) << 40
+
+
+class IncrementalPacker:
+    """Grow a :class:`PackedHistory` in settled-row increments.
+
+    ``feed`` raw history events (invoke / ok / fail / info, any
+    interleaving, nemesis lines ignored); ``settle`` extends the packed
+    row tables to the current settled prefix; ``packed`` returns a
+    PackedHistory view of the settled rows with the reduction-table
+    cache pre-injected. ``incremental`` is False in buffer mode (see
+    module docstring) — then only ``history`` accumulates.
+    """
+
+    def __init__(self, model, max_window: int = prepare.MAX_WINDOW):
+        self.model = model
+        self.max_window = max_window
+        self.intern = prepare._Interner()
+        self.kernel, self.init_state = self._stream_kernel(model)
+        self.incremental = self.kernel is not None
+        self.broken: str | None = None  # feed-time UnsupportedHistory
+        self.history: list[Op] = []     # every fed event, in feed order
+        self.ops: list[LinOp] = []      # resolved ops, invoke order
+        self.R = 0                      # settled return-event rows
+        self.events_processed = 0       # endpoint events walked
+        self.finalized = False
+
+        self._pos = 0                   # next event position
+        self._pending: dict = {}        # process -> (pos, invoke Op)
+        self._heap: list = []           # (pos, kind, seq, LinOp)
+        self._seq = 0
+        # prepare._pack_events_py walk state, carried across settles.
+        self._free = list(range(max_window))[::-1]
+        self._slot_of: dict[int, int] = {}     # op id -> slot
+        self._cur_active: dict[int, int] = {}  # slot -> op id
+        self.max_used = 0
+        # Per-op interned tables (grow in op order).
+        self._op_f: list[int] = []
+        self._op_v: list[list[int]] = []
+        self._vw = self.kernel.value_width if self.kernel is not None \
+            else int(prepare.VALUE_WIDTH)
+        # Row blocks at full alloc width (sliced to the live window in
+        # packed()); block lists amortize the per-settle concatenation.
+        self._blocks: dict[str, list[np.ndarray]] = {
+            k: [] for k in ("ret_slot", "ret_op", "active", "slot_f",
+                            "slot_v", "slot_op", "crashed")}
+        self._tables: dict[str, np.ndarray] | None = None
+        self._red_blocks: list[tuple[np.ndarray, np.ndarray]] = []
+        self._red_cache: tuple | None = None
+
+    # --- kernel selection ---------------------------------------------------
+
+    def _stream_kernel(self, model):
+        """Fixed-state-layout kernels only: a set/queue kernel is SIZED
+        from the history (element count, depth bound), so its packed
+        state — and any carried frontier — would change layout between
+        increments. Those models run in buffer mode instead."""
+        from jepsen_tpu.models.kernels import kernel_for
+
+        if isinstance(model, (model_ns.CASRegister, model_ns.Register)):
+            kernel = kernel_for(model)
+            return kernel, np.array([self.intern(model.value)], np.int32)
+        if isinstance(model, model_ns.Mutex):
+            kernel = kernel_for(model)
+            return kernel, kernel.init_state()
+        return None, None
+
+    # --- feeding ------------------------------------------------------------
+
+    def feed(self, op: Op) -> None:
+        """Record one history event. Endpoint bookkeeping mirrors
+        prepare.pair_ops exactly: failed ops are dropped, crashed reads
+        elided, an :info completion stays concurrent forever.
+
+        An unpackable event (double invoke without completion) DOWN-
+        GRADES the packer to buffer mode instead of raising: the full
+        history keeps accumulating, the session stops incrementing, and
+        the post-hoc check at finalize reports whatever the one-shot
+        pack would (same exception, honestly surfaced) — an exception
+        here would silently drop the rest of the caller's batch."""
+        self.history.append(op)
+        pos = self._pos
+        self._pos += 1
+        if not self.incremental:
+            return
+        try:
+            self._feed_endpoint(op, pos)
+        except UnsupportedHistory as e:
+            self.broken = str(e)
+            self.incremental = False
+
+    def _feed_endpoint(self, op: Op, pos: int) -> None:
+        if op.process == "nemesis" or op.f in ("start", "stop"):
+            return
+        if op.is_invoke:
+            if op.process in self._pending:
+                raise UnsupportedHistory(
+                    f"process {op.process} invoked twice without "
+                    f"completing (positions "
+                    f"{self._pending[op.process][0]} and {pos})")
+            self._pending[op.process] = (pos, op)
+        elif op.process in self._pending:
+            ipos, inv = self._pending.pop(op.process)
+            if op.is_fail:
+                return            # failed ops definitely did not happen
+            ok = op.is_ok
+            if not ok and inv.f == "read":
+                return            # crashed reads constrain nothing
+            self._resolve(inv, ipos, op, pos if ok else None)
+
+    def feed_many(self, events) -> int:
+        n = 0
+        for op in events:
+            self.feed(op)
+            n += 1
+        return n
+
+    def _resolve(self, inv: Op, ipos: int, completion: Op | None,
+                 return_pos: int | None) -> None:
+        o = LinOp(op_index=inv.index if inv.index is not None else ipos,
+                  process=inv.process, f=inv.f,
+                  value=prepare._semantic_value(inv.f, inv, completion),
+                  ok=return_pos is not None, invoke_pos=ipos,
+                  return_pos=return_pos)
+        heapq.heappush(self._heap, (ipos, 0, self._seq, o))
+        self._seq += 1
+        if return_pos is not None:
+            heapq.heappush(self._heap, (return_pos, 1, self._seq, o))
+            self._seq += 1
+
+    @property
+    def unresolved(self) -> int:
+        return len(self._pending)
+
+    # --- the settled-prefix walk --------------------------------------------
+
+    def settle(self, final: bool = False) -> int:
+        """Walk every endpoint event in the settled prefix (position
+        < q_min; everything once ``final``), extending the row tables.
+        Returns the number of NEW return-event rows."""
+        if not self.incremental:
+            return 0
+        if final and not self.finalized:
+            self.finalized = True
+            # Dangling invokes = crashed (:info semantics); crashed
+            # reads elide, like pair_ops.
+            for proc, (ipos, inv) in list(self._pending.items()):
+                if inv.f != "read":
+                    self._resolve(inv, ipos, None, None)
+            self._pending.clear()
+        q_min = _NEVER if not self._pending else \
+            min(pos for pos, _ in self._pending.values())
+        rows = {k: [] for k in self._blocks}
+        W = self.max_window
+        vw = self._vw
+        while self._heap and self._heap[0][0] < q_min:
+            pos, kind, _, o = heapq.heappop(self._heap)
+            self.events_processed += 1
+            if kind == 0:                                   # invoke
+                if not self._free:
+                    raise UnsupportedHistory(
+                        f"concurrency window exceeds {W} pending ops "
+                        f"at history position {pos}", kind="window")
+                i = len(self.ops)
+                o._id = i
+                self.ops.append(o)
+                f_id, v = prepare._op_f_and_values(o, self.intern)
+                self._op_f.append(f_id)
+                self._op_v.append(v[:vw] + [0] * (vw - len(v)))
+                s = self._free.pop()
+                self._slot_of[i] = s
+                self._cur_active[s] = i
+                self.max_used = max(self.max_used, s + 1)
+            else:                                           # ok return
+                i = o._id
+                s = self._slot_of[i]
+                active = np.zeros(W, bool)
+                slot_f = np.zeros(W, np.int32)
+                slot_v = np.full((W, vw), int(prepare.NIL), np.int32)
+                slot_op = np.full(W, -1, np.int32)
+                crashed = np.zeros(W, bool)
+                for slot, op_id in self._cur_active.items():
+                    active[slot] = True
+                    slot_op[slot] = op_id
+                    slot_f[slot] = self._op_f[op_id]
+                    slot_v[slot] = self._op_v[op_id]
+                    # Every op active at a settled row is RESOLVED, so
+                    # the crashed flag is final — the invariant the
+                    # exact reductions and the dominance prune need.
+                    crashed[slot] = self.ops[op_id].return_pos is None
+                rows["ret_slot"].append(np.int32(s))
+                rows["ret_op"].append(np.int32(i))
+                rows["active"].append(active)
+                rows["slot_f"].append(slot_f)
+                rows["slot_v"].append(slot_v)
+                rows["slot_op"].append(slot_op)
+                rows["crashed"].append(crashed)
+                self.R += 1
+                del self._cur_active[s]
+                del self._slot_of[i]
+                self._free.append(s)
+        n_new = len(rows["ret_slot"])
+        if n_new:
+            for k, items in rows.items():
+                self._blocks[k].append(np.stack(items) if items[0].ndim
+                                       else np.asarray(items))
+            self._tables = None
+            block = self._tables_concat()
+            lo = self.R - n_new
+            self._red_blocks.append(self._reduce_rows(block, lo, self.R))
+            self._red_cache = None
+        return n_new
+
+    def _tables_concat(self) -> dict[str, np.ndarray]:
+        if self._tables is None:
+            out = {}
+            for k, blocks in self._blocks.items():
+                if blocks:
+                    out[k] = np.concatenate(blocks, axis=0)
+                else:
+                    shape = {"ret_slot": (0,), "ret_op": (0,),
+                             "active": (0, self.max_window),
+                             "slot_f": (0, self.max_window),
+                             "slot_v": (0, self.max_window, self._vw),
+                             "slot_op": (0, self.max_window),
+                             "crashed": (0, self.max_window)}[k]
+                    dt = bool if k in ("active", "crashed") else np.int32
+                    out[k] = np.zeros(shape, dt)
+            self._tables = out
+        return self._tables
+
+    # --- reduction tables on return POSITIONS -------------------------------
+
+    def _reduce_rows(self, t: dict, lo: int, hi: int):
+        """(pure, pred) for rows [lo, hi): the exact twin of
+        prepare.reduction_tables with return-position ordkeys (see
+        module docstring — positions are order-isomorphic to return
+        rows, and order is all the chain lexsort consumes). Settled
+        rows' inputs are final, so the result is final."""
+        from jepsen_tpu.models import kernels as K
+
+        active = t["active"][lo:hi]
+        slot_f = t["slot_f"][lo:hi]
+        slot_op = t["slot_op"][lo:hi]
+        n_rows, W = active.shape
+        if n_rows == 0:
+            return (np.zeros((0, W), bool), np.full((0, W), -1, np.int32))
+        pure_fs = {int(K.F_IDS[f]) for f in ("read",) if f in K.F_IDS}
+        pure = active & np.isin(slot_f, list(pure_fs))
+
+        n_ops = len(self.ops)
+        ret_pos = np.fromiter(
+            (_NEVER if o.return_pos is None else o.return_pos
+             for o in self.ops), np.int64, n_ops)
+        inv_pos = np.fromiter((o.invoke_pos for o in self.ops),
+                              np.int64, n_ops)
+        slot_ret = np.where(slot_op >= 0,
+                            ret_pos[np.clip(slot_op, 0, None)], _NEVER)
+        slot_inv = np.where(slot_op >= 0,
+                            inv_pos[np.clip(slot_op, 0, None)], 0)
+        is_crashed = slot_ret >= _NEVER
+        ordkey = np.where(is_crashed, _NEVER + 2 + slot_inv, slot_ret)
+
+        slot_v = t["slot_v"][lo:hi]
+        chainable = active & ~pure & (slot_op >= 0)
+        sent = -1 - np.arange(W, dtype=np.int64)
+        f_key = np.where(chainable,
+                         (slot_f.astype(np.int64) << 1) | is_crashed,
+                         sent[None, :])
+        v_keys = [slot_v[:, :, k].astype(np.int64)
+                  for k in range(slot_v.shape[2])]
+        order = np.lexsort(tuple([ordkey] + v_keys[::-1] + [f_key]),
+                           axis=1)
+        f_s = np.take_along_axis(f_key, order, axis=1)
+        same = f_s[:, 1:] == f_s[:, :-1]
+        for vk in v_keys:
+            v_s = np.take_along_axis(vk, order, axis=1)
+            same &= v_s[:, 1:] == v_s[:, :-1]
+        pred = np.full((n_rows, W), -1, np.int32)
+        cols = order[:, 1:]
+        prev = order[:, :-1]
+        np.put_along_axis(pred, cols,
+                          np.where(same, prev, -1).astype(np.int32),
+                          axis=1)
+        return pure, pred
+
+    def reduction_tables(self):
+        if self._red_cache is None:
+            W = max(1, self.max_used)
+            if self._red_blocks:
+                pure = np.concatenate(
+                    [b[0][:, :W] for b in self._red_blocks], axis=0)
+                pred = np.concatenate(
+                    [b[1][:, :W] for b in self._red_blocks], axis=0)
+            else:
+                pure = np.zeros((0, W), bool)
+                pred = np.full((0, W), -1, np.int32)
+            self._red_cache = (pure, pred)
+        return self._red_cache
+
+    # --- views --------------------------------------------------------------
+
+    def packed(self) -> PackedHistory:
+        """A PackedHistory of the settled prefix (fresh object — per-
+        object caches like expansion tables rebuild against the grown
+        window/interner; the reduction-table cache is injected)."""
+        if not self.incremental:
+            raise UnsupportedHistory(
+                f"model {type(self.model).__name__} has no streaming "
+                f"kernel formulation (buffer mode)")
+        t = self._tables_concat()
+        W = max(1, self.max_used)
+        p = PackedHistory(
+            model=self.model, kernel=self.kernel, ops=self.ops,
+            window=W, R=self.R, ret_slot=t["ret_slot"],
+            ret_op=t["ret_op"], active=t["active"][:, :W],
+            slot_f=t["slot_f"][:, :W], slot_v=t["slot_v"][:, :W],
+            slot_op=t["slot_op"][:, :W], crashed=t["crashed"][:, :W],
+            init_state=self.init_state, intern=self.intern.ids,
+            unintern=self.intern.values,
+            crashed_ops=[o for o in self.ops if o.return_pos is None])
+        # Inject the position-keyed reduction cache: recomputing via
+        # prepare.reduction_tables here would misclassify a resolved-
+        # but-later-returning op as crashed (its return row is not yet
+        # assigned), silently corrupting the canonical chains.
+        p._reduction_tables = self.reduction_tables()
+        return p
+
+    def prefix_fingerprint(self, row: int) -> str:
+        """Identity of the settled row prefix [0, row) for stream
+        checkpoint resume: deterministic for any session fed the same
+        client events in the same order, REGARDLESS of where its
+        increment boundaries fell (rows are hashed at full alloc width,
+        which later window growth never rewrites)."""
+        t = self._tables_concat()
+        h = hashlib.sha256()
+        kname = self.kernel.name if self.kernel is not None else None
+        h.update(f"stream|{kname}|{row}".encode())
+        h.update(np.ascontiguousarray(self.init_state).tobytes())
+        for k in ("ret_slot", "ret_op", "active", "slot_f", "slot_v",
+                  "crashed"):
+            arr = np.ascontiguousarray(t[k][:row])
+            h.update(str(arr.shape).encode())
+            h.update(arr.tobytes())
+        return h.hexdigest()

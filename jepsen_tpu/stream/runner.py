@@ -1,0 +1,100 @@
+"""LiveChecker: the run loop's streaming-checker thread.
+
+:mod:`jepsen_tpu.core` feeds every history append (``conj_op``) to this
+wrapper; a dedicated daemon thread drains the queue into a
+:class:`jepsen_tpu.stream.session.StreamChecker` so increment checks
+never block a worker's op loop. The generator loop polls
+``should_abort()`` between ops — the moment an increment goes invalid,
+every worker stops drawing ops and the run ends with the witness in
+hand instead of generating hours more traffic against a system already
+proven wrong.
+
+Gated by ``JEPSEN_TPU_STREAM=1`` (doc/env.md § Streaming); the final
+verdict rides in ``test["results"]["stream"]`` next to whatever checker
+the test configured (the post-hoc checker still runs — the stream
+verdict is an additional, earlier view of the same history, equal by
+the parity argument in doc/streaming.md).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+
+
+def enabled() -> bool:
+    return os.environ.get("JEPSEN_TPU_STREAM", "0") == "1"
+
+
+def abort_enabled() -> bool:
+    """``JEPSEN_TPU_STREAM_ABORT=0`` keeps checking live but lets the
+    run complete (observe-only mode: the abort latency numbers without
+    the abort)."""
+    return os.environ.get("JEPSEN_TPU_STREAM_ABORT", "1") != "0"
+
+
+class LiveChecker:
+    """Queue-fed, thread-driven StreamChecker for a live run."""
+
+    def __init__(self, model, **session_kw):
+        from jepsen_tpu.stream.session import StreamChecker
+
+        self.session = StreamChecker(model, **session_kw)
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._aborted = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="jepsen-stream-checker")
+        self._thread.start()
+
+    def offer(self, op) -> None:
+        """Called from worker threads under the history append path —
+        must stay O(1): enqueue and wake the checker thread."""
+        with self._cv:
+            self._q.append(op)
+            self._cv.notify()
+
+    def should_abort(self) -> bool:
+        return abort_enabled() and self._aborted.is_set()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._stop:
+                    self._cv.wait(0.5)
+                batch = list(self._q)
+                self._q.clear()
+                stopping = self._stop
+            if batch:
+                try:
+                    self.session.append(batch)
+                except Exception:  # noqa: BLE001 - the checker thread
+                    pass           # must never take the run down
+                if self.session.aborted:
+                    self._aborted.set()
+            if stopping and not batch:
+                return
+
+    def finish(self) -> dict:
+        """Drain, finalize, and return the stream verdict (joins the
+        checker thread; called once after the workload completes)."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        self._thread.join(timeout=600)
+        return self.session.finalize()
+
+
+def live_checker_for(test: dict) -> LiveChecker | None:
+    """The core.run() gate: a LiveChecker when streaming is enabled and
+    the test carries a model, else None (zero overhead)."""
+    if not enabled():
+        return None
+    model = test.get("model")
+    if model is None:
+        return None
+    # min_rows defaults via session.default_min_rows() (the one
+    # JEPSEN_TPU_STREAM_ROWS definition).
+    return LiveChecker(model)

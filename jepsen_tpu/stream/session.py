@@ -1,0 +1,416 @@
+"""StreamChecker: the carried-frontier incremental checking session.
+
+One session = one history being checked WHILE it is produced. The
+session owns an :class:`jepsen_tpu.stream.incr.IncrementalPacker` and
+the sparse-engine frontier between increments — the multiword
+``bits``/``state``/``count`` arrays of the PR 5 chunk-kind checkpoint
+codec, held in memory (and, with a checkpoint path, on disk, so a
+killed session resumes mid-stream). Each increment is ONE call to
+``lin.device_check_packed(packed, frontier=, frontier_row=, partial=)``:
+the engine re-enters at the carried row exactly like the proven
+checkpoint-resume path, walks only the NEW settled rows, and hands the
+committed frontier back.
+
+Soundness is inherited, not re-argued: the carried frontier is an exact
+committed frontier at a row boundary (the same invariant PR 5's resume
+rests on), the settled-row tables are final when packed (incr.py), and
+at finalize the packed tables are bit-identical to the one-shot pack —
+so the streamed verdict, death row, and final-paths provably equal the
+post-hoc check (parity-fuzzed in tests/test_stream.py).
+
+Increment dispatches run SUPERVISED under the ``stream-incr`` site
+(:func:`jepsen_tpu.lin.supervise.run_guarded`: watchdog deadline,
+fault taxonomy, quarantine-ledger recording) and TRACED (one
+``stream-incr`` span per increment). A wedged/faulted/overflowed
+increment DEGRADES the session — incremental checking stops, and
+finalize runs one exact post-hoc check instead — it never corrupts the
+verdict and never hangs the producer.
+
+**Early abort.** The moment an increment returns ``valid? False`` the
+session latches the witness verdict; ``aborted`` flips, the
+``on_abort`` hook fires, and a ``stream-abort`` event lands in the obs
+feed — the producer (core.py's generator loop, a wire client) learns
+within one increment of the offending completion instead of at the end
+of the run.
+
+The ``stream`` metrics view (:mod:`jepsen_tpu.obs.metrics`) carries
+ops-ingested vs rows-checked lag, per-increment wall time, and abort
+state — rendered by ``web.py /run`` and snapshotted like every other
+view. Knobs in doc/env.md § Streaming; lifecycle in doc/streaming.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable
+
+import numpy as np
+
+from jepsen_tpu import util
+from jepsen_tpu.obs import metrics as obs_metrics
+from jepsen_tpu.obs import trace as obs_trace
+from jepsen_tpu.stream.incr import IncrementalPacker
+
+# kind tag of stream checkpoints (supervise.Checkpointer codec).
+CKPT_KIND = "stream"
+
+
+def default_min_rows() -> int:
+    """Settled rows buffered before an increment dispatches: smaller =
+    lower abort latency, larger = better row-loop amortization (each
+    increment pays fixed packing + dispatch entry costs)."""
+    return util.env_int("JEPSEN_TPU_STREAM_ROWS", 256)
+
+
+def stream_ckpt_path() -> str | None:
+    return os.environ.get("JEPSEN_TPU_STREAM_CKPT", "") or None
+
+
+class StreamChecker:
+    """Open → ``append``\\ ×N → ``finalize`` (or ``abort``).
+
+    ``append`` takes an iterable of history events (:class:`Op`) —
+    invocations AND completions, in history order; an op only enters an
+    increment once its completion is recorded (the packer's settled-row
+    rule enforces the ``:info`` contract structurally). ``finalize``
+    settles everything and returns the full-history verdict with the
+    session's ``stream`` stats attached.
+
+    Not thread-safe by itself — one producer at a time (the live run
+    wrapper :class:`jepsen_tpu.stream.runner.LiveChecker` and the
+    service daemon each serialize access).
+    """
+
+    def __init__(self, model, *, min_rows: int | None = None,
+                 checkpoint: str | None = None, explain: bool = True,
+                 check_kw: dict | None = None,
+                 on_abort: Callable[[dict], None] | None = None,
+                 view_name: str = "stream"):
+        self.model = model
+        self.packer = IncrementalPacker(model)
+        self.min_rows = min_rows if min_rows is not None \
+            else default_min_rows()
+        self.explain = explain
+        self.check_kw = dict(check_kw or {})
+        self.on_abort = on_abort
+        self.ckpt_path = checkpoint if checkpoint is not None \
+            else stream_ckpt_path()
+        self._ckpt = None
+        self._tried_resume = False
+
+        self._frontier = None          # (bits u32[n,nw], state i32[n,S])
+        self._count = 0
+        self._row = 0                  # rows checked (frontier row)
+        self._verdict: dict | None = None   # latched definite False
+        self._degraded: str | None = None
+        self._final: dict | None = None
+        self._t0 = time.monotonic()
+        self.stats: dict = {
+            "mode": "incremental" if self.packer.incremental
+            else "buffer", "ops_ingested": 0, "ops_pending": 0,
+            "rows_settled": 0, "rows_checked": 0, "lag_rows": 0,
+            "increments": 0, "increment_s": 0.0, "aborted": False}
+        # One registry view per session name: concurrent daemon
+        # sessions register under per-sid names (release_view() on
+        # close so the registry does not accumulate dead sessions);
+        # in-process/live-run sessions keep the canonical "stream"
+        # name web.py /run renders with its lag gauge.
+        self.view_name = view_name
+        obs_metrics.REGISTRY.view(view_name, self.stats)
+
+    def release_view(self) -> None:
+        """Swap this session's registry view for an empty dict (empty
+        views are skipped by snapshots) — called when a daemon session
+        closes so per-sid views do not leak."""
+        obs_metrics.REGISTRY.view(self.view_name, {})
+
+    # --- state --------------------------------------------------------------
+
+    @property
+    def aborted(self) -> bool:
+        """True once an increment returned a definite INVALID verdict
+        (the early-abort latch the generator loop polls)."""
+        return self._verdict is not None \
+            and self._verdict.get("valid?") is False
+
+    @property
+    def verdict(self) -> dict | None:
+        """The latched abort verdict (None while the stream is clean)."""
+        return self._verdict
+
+    def status(self) -> dict:
+        return {"row": self._row, "settled": self.packer.R,
+                "ops": self.stats["ops_ingested"],
+                "pending": self.packer.unresolved,
+                "aborted": self.aborted, "degraded": self._degraded,
+                "frontier": self._count}
+
+    # --- producing ----------------------------------------------------------
+
+    def append(self, events) -> dict:
+        """Feed events; advance the checker when enough rows settled.
+        Returns :meth:`status` (carrying the latched witness verdict
+        under ``"result"`` once aborted)."""
+        if self._final is not None:
+            raise RuntimeError("stream session already finalized")
+        n = self.packer.feed_many(events)
+        self.stats["ops_ingested"] += n
+        self._advance(final=False)
+        out = self.status()
+        if self._verdict is not None:
+            out["result"] = self._verdict
+        return out
+
+    def finalize(self) -> dict:
+        """Settle everything, run the last increment (or the post-hoc
+        fallback), and return the full-history verdict. Idempotent."""
+        if self._final is not None:
+            return self._final
+        if self._verdict is not None:
+            out = dict(self._verdict)
+        elif not self.packer.incremental:
+            out = self._posthoc_check(
+                f"unpackable event: {self.packer.broken}"
+                if self.packer.broken else "buffer mode")
+        else:
+            self._advance(final=True)
+            if self._verdict is not None:
+                out = dict(self._verdict)
+            elif self._degraded is not None:
+                out = self._posthoc_check(self._degraded)
+            else:
+                # Every settled row checked clean.
+                out = {"valid?": True, "analyzer": "tpu-bfs-stream",
+                       "configs": [],
+                       "final-frontier-size": int(self._count)}
+        out["stream"] = self._stream_summary()
+        self._final = out
+        if self._ckpt is not None and out.get("valid?") in (True, False):
+            self._ckpt.clear()
+        return out
+
+    def abort(self) -> None:
+        """Producer-side cancel: drop the session state (no verdict)."""
+        if self._final is None:
+            self._final = {"valid?": "unknown",
+                           "analyzer": "tpu-bfs-stream",
+                           "error": "stream aborted by producer",
+                           "stream": self._stream_summary()}
+
+    # --- the increment loop -------------------------------------------------
+
+    def _advance(self, final: bool) -> None:
+        from jepsen_tpu.lin.prepare import UnsupportedHistory
+
+        try:
+            self.packer.settle(final=final)
+        except UnsupportedHistory as e:
+            self._degrade(f"settle: {e}")
+            return
+        self.stats["rows_settled"] = self.packer.R
+        self.stats["ops_pending"] = self.packer.unresolved
+        self.stats["lag_rows"] = self.packer.R - self._row
+        if self.packer.broken and self.stats.get("mode") != "buffer":
+            # Feed-time downgrade (incr.feed docstring): keep buffering,
+            # stop incrementing, surface the reason.
+            self.stats["mode"] = "buffer"
+            self.stats["degraded"] = \
+                f"unpackable event: {self.packer.broken}"[:200]
+        if not self.packer.incremental or self._degraded is not None \
+                or self._verdict is not None:
+            return
+        if not self._maybe_resume(final):
+            return   # resume decision pending: settle only, check later
+        while self._verdict is None and self._degraded is None:
+            todo = self.packer.R - self._row
+            if todo <= 0 or (not final and todo < self.min_rows):
+                break
+            self._increment()
+        obs_metrics.REGISTRY.write_snapshot()
+
+    def _increment(self) -> None:
+        from jepsen_tpu import lin
+        from jepsen_tpu.lin import supervise
+
+        p = self.packer.packed()
+        if p.kernel is None:
+            self._degrade("no device kernel")
+            return
+        row0, rows = self._row, p.R - self._row
+        kname = p.kernel.name
+        key = supervise.shape_key("stream-incr", rows=rows,
+                                  cap=self._count or 1,
+                                  window=int(p.window), kernel=kname)
+        cancel = threading.Event()
+
+        def thunk():
+            kw = dict(self.check_kw)
+            kw.setdefault("explain", self.explain)
+            return lin.device_check_packed(
+                p, cancel=cancel, frontier=self._frontier_arg(),
+                frontier_row=row0, partial=True, **kw)
+
+        t0 = time.monotonic()
+        with obs_trace.span("stream-incr", row0=row0, rows=rows,
+                            window=int(p.window)) as sp:
+            # The watchdog deadline scales with the increment (rows /
+            # CHUNK dispatches, each owed a base deadline) — a healthy
+            # long increment must not false-trip, a wedged one must
+            # cost its detection window, not the producer.
+            outcome, r = supervise.run_guarded(
+                "stream-incr", key, thunk,
+                scale=max(3.0, rows / 512), stats=self.stats)
+            if outcome != "ok":
+                cancel.set()   # stop the abandoned increment's chunks
+                sp.note(outcome=outcome)
+                self._degrade(f"increment {outcome} at row {row0}: {r}")
+                return
+            sp.note(verdict=str(r.get("valid?")))
+        dt = time.monotonic() - t0
+        self.stats["increments"] += 1
+        self.stats["increment_s"] = round(
+            self.stats["increment_s"] + dt, 4)
+        v = r.get("valid?")
+        if v is False:
+            self._abort_with(r)
+            return
+        if v is not True or "stream-frontier" not in r:
+            self._degrade(f"increment undecided at row {row0}: "
+                          f"{r.get('error', r.get('overflow', v))!r}")
+            return
+        sf = r["stream-frontier"]
+        self._frontier = (np.asarray(sf["bits"], np.uint32),
+                          np.asarray(sf["state"], np.int32))
+        self._count = int(sf["count"])
+        self._row = int(sf["row"])
+        self.stats["rows_checked"] = self._row
+        self.stats["lag_rows"] = self.packer.R - self._row
+        self.stats["frontier"] = self._count
+        obs_metrics.REGISTRY.progress(row=self._row,
+                                      frontier=self._count)
+        self._save_ckpt()
+
+    def _frontier_arg(self):
+        if self._frontier is None:
+            return None
+        return (self._frontier[0], self._frontier[1], self._count)
+
+    def _abort_with(self, r: dict) -> None:
+        self._verdict = dict(r)
+        self.stats["aborted"] = True
+        self.stats["aborted_row"] = r.get("dead-row")
+        self.stats["rows_checked"] = self._row
+        obs_metrics.REGISTRY.event("stream-abort",
+                                   row=r.get("dead-row"),
+                                   op=str((r.get("op") or {}).get("f")))
+        obs_metrics.REGISTRY.write_snapshot(force=True)
+        if self.on_abort is not None:
+            try:
+                self.on_abort(self._verdict)
+            except Exception:  # noqa: BLE001 - observer must not
+                pass           # poison the verdict
+
+    def _degrade(self, reason: str) -> None:
+        """Incremental checking is an OPTIMIZATION of the post-hoc
+        check; anything it cannot decide exactly (wedge, fault,
+        capacity, unpackable tail) hands the whole verdict back to the
+        one-shot path at finalize. Never guess, never hang."""
+        self._degraded = reason
+        self._frontier = None
+        self.stats["degraded"] = reason[:200]
+        obs_metrics.REGISTRY.event("stream-degrade", reason=reason[:120])
+
+    def _posthoc_check(self, why: str) -> dict:
+        from jepsen_tpu import lin
+
+        out = dict(lin.analysis(self.model, list(self.packer.history),
+                                explain=self.explain))
+        out["stream-fallback"] = why
+        return out
+
+    # --- checkpoint / resume ------------------------------------------------
+
+    def _checkpointer(self):
+        from jepsen_tpu.lin import supervise
+
+        if self._ckpt is None and self.ckpt_path:
+            self._ckpt = supervise.Checkpointer(self.ckpt_path, "",
+                                                every_s=0.0)
+        return self._ckpt
+
+    def _save_ckpt(self) -> None:
+        ck = self._checkpointer()
+        if ck is None or self._frontier is None:
+            return
+        # The fingerprint is the settled-prefix identity at THIS row —
+        # recomputable by any session fed the same events, wherever its
+        # increment boundaries fall.
+        ck.fingerprint = self.packer.prefix_fingerprint(self._row)
+        n = max(self._count, 1)
+        ck.save(CKPT_KIND, self._row, self._count,
+                {"bits": self._frontier[0][:n],
+                 "state": self._frontier[1][:n]},
+                {"kernel": self.packer.kernel.name})
+
+    def _maybe_resume(self, final: bool = False) -> bool:
+        """First advances of a session with a checkpoint path: adopt a
+        prior session's frontier when its settled-prefix fingerprint
+        matches ours at the checkpointed row (same client events in the
+        same order — anything else is rejected and checking starts at
+        row 0, degraded to a fresh-but-correct run). Returns False
+        while the decision is PENDING (the checkpoint row lies past the
+        settled prefix — checking must hold off, or the session would
+        re-check from row 0 and orphan the resume); at ``final`` a
+        still-unreachable checkpoint row is rejected for good."""
+        if self._tried_resume or not self.ckpt_path or self._row:
+            return True
+        status, rd = self._load_ckpt()
+        if status == "wait" and not final:
+            return False   # not settled as far as the checkpoint row
+        self._tried_resume = True
+        if rd is None:
+            return True
+        self._frontier = (np.asarray(rd["bits"], np.uint32),
+                          np.asarray(rd["state"], np.int32))
+        self._count = int(rd["count"])
+        self._row = int(rd["row"])
+        self.stats["rows_checked"] = self._row
+        self.stats["resumed_from_row"] = self._row
+        return True
+
+    def _load_ckpt(self) -> tuple[str, dict | None]:
+        """("ok", payload) | ("none", None) — reject, stop looking |
+        ("wait", None) — the checkpoint row lies past our settled
+        prefix, so the fingerprint cannot be judged yet (the next
+        settle retries)."""
+        from jepsen_tpu.lin import supervise
+
+        path = self.ckpt_path
+        if not path or not os.path.exists(path):
+            return "none", None
+        try:
+            with np.load(path) as z:
+                meta = json.loads(bytes(z["__meta__"]).decode())
+                if meta.get("version") != supervise.CKPT_VERSION \
+                        or meta.get("kind") != CKPT_KIND:
+                    return "none", None
+                row = int(meta["row"])
+                if row > self.packer.R:
+                    return "wait", None
+                if meta.get("fingerprint") != \
+                        self.packer.prefix_fingerprint(row):
+                    return "none", None
+                return "ok", {"bits": z["bits"], "state": z["state"],
+                              "row": row, "count": int(meta["count"])}
+        except Exception:  # noqa: BLE001 - damage means no checkpoint
+            return "none", None
+
+    # --- reporting ----------------------------------------------------------
+
+    def _stream_summary(self) -> dict:
+        out = dict(self.stats)
+        out["wall_s"] = round(time.monotonic() - self._t0, 3)
+        return util.round_stats(out)

@@ -1,0 +1,120 @@
+"""`make stream-smoke`: open -> append xN -> finalize, twice over.
+
+A FRESH-process, chip-free proof (forced CPU mesh, like serve-smoke)
+that streaming incremental checking round-trips real verdicts both
+IN-PROCESS and OVER THE WIRE:
+
+1. In-process: a register history streamed through
+   :class:`jepsen_tpu.stream.StreamChecker` in increments decides with
+   verdict parity vs the CPU oracle; its corrupted twin ABORTS the
+   stream mid-feed with the witness latched.
+2. Wire: the same open -> append xN -> finalize lifecycle through an
+   ephemeral-port daemon session (``stream-open``/``stream-append``/
+   ``stream-finalize`` frames), verdict parity again, clean shutdown.
+
+Prints one JSON result line and exits 0/1 — timeout-guarded by the
+Makefile so a wedge cannot hold the shell.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    # CPU mesh BEFORE any jax backend init (CLAUDE.md: the TPU plugin
+    # force-selects its platform; the smoke must never take the chip).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu import util
+    from jepsen_tpu.lin import cpu, prepare, synth
+    from jepsen_tpu.service.daemon import CheckerService
+    from jepsen_tpu.service.protocol import CheckerClient
+    from jepsen_tpu.stream import StreamChecker
+
+    util.enable_compile_cache()
+    out: dict = {"checks": []}
+    ok = True
+
+    h = list(synth.generate_register_history(
+        300, concurrency=5, seed=11, value_range=5, crash_prob=0.01,
+        max_crashes=3))
+    bad = list(synth.corrupt_history(
+        synth.generate_register_history(300, concurrency=5, seed=11,
+                                        value_range=5), seed=3))
+    want_ok = cpu.check_packed(
+        prepare.prepare(m.cas_register(), list(h)))["valid?"]
+    want_bad = cpu.check_packed(
+        prepare.prepare(m.cas_register(), list(bad)))["valid?"]
+    step = max(1, len(h) // 5)
+
+    # --- in-process ---------------------------------------------------------
+    sc = StreamChecker(m.cas_register(), min_rows=16)
+    for i in range(0, len(h), step):
+        sc.append(h[i:i + step])
+    r = sc.finalize()
+    rec = {"leg": "in-process", "want": want_ok,
+           "got": r.get("valid?"),
+           "increments": (r.get("stream") or {}).get("increments")}
+    out["checks"].append(rec)
+    ok = ok and r.get("valid?") == want_ok
+
+    sc2 = StreamChecker(m.cas_register(), min_rows=16)
+    fed = len(bad)
+    for i in range(0, len(bad), step):
+        sc2.append(bad[i:i + step])
+        if sc2.aborted:
+            fed = i + step
+            break
+    r2 = sc2.finalize()
+    rec = {"leg": "in-process-abort", "want": want_bad,
+           "got": r2.get("valid?"), "aborted_early": fed < len(bad),
+           "ops_unfed": len(bad) - fed}
+    out["checks"].append(rec)
+    ok = ok and r2.get("valid?") == want_bad and fed < len(bad)
+
+    # --- over the wire ------------------------------------------------------
+    svc = CheckerService("127.0.0.1", 0, flush_ms_=20).start()
+    out["port"] = svc.port
+    try:
+        client = CheckerClient("127.0.0.1", svc.port)
+        sid = client.stream_open("cas-register")
+        appends = 0
+        for i in range(0, len(h), step):
+            st = client.stream_append(sid, h[i:i + step])
+            appends += 1
+            if st.get("type") != "stream-state":
+                ok = False
+                out["checks"].append({"leg": "wire", "error": st})
+                break
+        rw = client.stream_finalize(sid)
+        rec = {"leg": "wire", "want": want_ok, "got": rw.get("valid?"),
+               "appends": appends,
+               "increments": (rw.get("stream") or {}).get("increments")}
+        out["checks"].append(rec)
+        ok = ok and rw.get("valid?") == want_ok
+        out["stats"] = {k: v for k, v in client.stats().items()
+                        if k in ("stream_opens", "stream_appends",
+                                 "stream_finalizes",
+                                 "stream_sessions_open",
+                                 "xla_compiles")}
+        client.shutdown()
+        client.close()
+    finally:
+        svc.stop()
+    out["ok"] = ok
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

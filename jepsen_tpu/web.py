@@ -282,6 +282,32 @@ def run_html(snapshot_file: str | None = None) -> str:
                                       "xla_cache_hits")
                if snap.get(k) is not None]
     parts.append(table("progress", gauges))
+    stream = (snap.get("views") or {}).get("stream") or {}
+    if stream:
+        # Streaming checker telemetry (doc/streaming.md): the ingest-
+        # vs-checked lag gauge — how far the live checker trails the
+        # producing run — plus the abort latch, loudly.
+        settled = stream.get("rows_settled") or 0
+        checked = stream.get("rows_checked") or 0
+        lag_bar = ""
+        if settled:
+            pct = min(100.0, 100.0 * checked / settled)
+            lag_bar = (
+                f'<div style="width:600px;border:1px solid #ccc">'
+                f'<div style="width:{pct:.1f}%;background:#B0D8F6">'
+                f"&nbsp;checked {checked} / settled {settled} "
+                f"(lag {stream.get('lag_rows', settled - checked)} "
+                f"rows)</div></div>")
+        banner = ""
+        if stream.get("aborted"):
+            banner = (
+                '<p style="background:#F6B0B0;padding:4px">'
+                "<b>stream ABORTED</b>: invalid increment at row "
+                f"{_html.escape(str(stream.get('aborted_row')))}</p>")
+        parts.append("<h2>stream checker</h2>" + banner + lag_bar)
+        parts.append(table("stream", sorted(
+            (k, v) for k, v in stream.items()
+            if not isinstance(v, (dict, list)))))
     parts.append("<h2>frontier</h2>"
                  + _sparkline_svg(snap.get("samples") or []))
     events = snap.get("events") or []
@@ -295,6 +321,8 @@ def run_html(snapshot_file: str | None = None) -> str:
                      "<table><tr><th>time</th><th>kind</th>"
                      "<th>detail</th></tr>" + rows + "</table>")
     for name in sorted(snap.get("views") or {}):
+        if name == "stream":
+            continue   # rendered above with its lag gauge
         view = snap["views"][name] or {}
         parts.append(table(
             name, sorted((k, v) for k, v in view.items()

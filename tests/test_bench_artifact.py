@@ -201,6 +201,17 @@ def test_txn_probe_in_order_and_registry(bench):
     assert "txn_c30" in bench.PROBES
 
 
+def test_stream_probe_in_order_and_registry(bench):
+    # The stream probe contract (ISSUE 11): registered, fault-isolated
+    # like every probe, and ordered BEFORE the long/dangerous
+    # partitioned probe so a stream fault can never shadow the
+    # headline.
+    keys = [k for k, _t in bench.PROBE_ORDER]
+    assert "stream_c30" in keys
+    assert keys.index("stream_c30") < keys.index("partitioned_c30")
+    assert "stream_c30" in bench.PROBES
+
+
 def test_txn_probe_stats_pass_through(bench, monkeypatch, capsys):
     # edges/s, verdict, anomaly counts, and the device tier stats must
     # reach detail verbatim and be re-emitted the moment the probe

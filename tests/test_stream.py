@@ -1,0 +1,552 @@
+"""Streaming incremental checker (jepsen_tpu.stream, doc/streaming.md).
+
+Four layers, mirroring the subsystem's vertical slice:
+
+- Packer: the settled-row incremental pack is BIT-IDENTICAL to the
+  one-shot prepare() of the same events (the foundation of the parity
+  argument), including the position-keyed reduction tables.
+- Session: a history checked in K >= 3 increments returns verdict,
+  death row, and final-paths identical to the one-shot engine AND the
+  lin/cpu.py oracle on the witness shapes; an injected violation
+  aborts the stream within one increment of the offending completion;
+  a killed mid-stream session resumes from its carried-frontier
+  checkpoint with an identical verdict; a wedged increment degrades to
+  the exact post-hoc check instead of guessing.
+- Wire: daemon stream sessions round-trip with parity; a client drop
+  mid-session is reaped (slot freed); :info-only completions decide
+  vacuously valid (the indeterminate contract); a v1 frame gets a
+  readable version-mismatch error, not an opaque codec failure.
+- Runner: the abort latch stops the generator loop; with
+  JEPSEN_TPU_STREAM=1 the stream verdict rides in results["stream"].
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu.history import Op
+from jepsen_tpu.lin import bfs, cpu, prepare, synth
+from jepsen_tpu.stream import IncrementalPacker, StreamChecker
+
+# Same compiled shapes as tests/test_lin_ckpt_resume.py (shared
+# .jax_cache programs); `compiles` exempts the cold-cache compile from
+# the quick tier's no-compile enforcement.
+pytestmark = [pytest.mark.quick, pytest.mark.compiles]
+
+KW = dict(cap_schedule=(8,), host_caps=(64, 4096), explain=True)
+
+
+@pytest.fixture(scope="module")
+def witness_events():
+    h = synth.generate_partitioned_register_history(
+        140, concurrency=40, seed=0, partition_every=60,
+        partition_len=20, max_crashes=10)
+    return list(synth.corrupt_history(h, seed=3))
+
+
+@pytest.fixture(scope="module")
+def witness_full(witness_events):
+    p = prepare.prepare(m.cas_register(), list(witness_events))
+    r = bfs.check_packed(p, **KW)
+    assert r["valid?"] is False
+    return p, r
+
+
+def _paths_key(result):
+    return sorted(repr(sorted(od["index"] for od in fp["path"]))
+                  for fp in result["final-paths"])
+
+
+def _stream(events, k=4, min_rows=4, **kw):
+    sc = StreamChecker(m.cas_register(), min_rows=min_rows,
+                       check_kw=KW, **kw)
+    n = max(1, len(events) // k)
+    for i in range(0, len(events), n):
+        sc.append(events[i:i + n])
+    return sc, sc.finalize()
+
+
+class TestPacker:
+    SHAPES = [
+        lambda: synth.generate_register_history(
+            300, concurrency=8, seed=2, crash_prob=0.05,
+            max_crashes=6),
+        lambda: synth.generate_register_history(
+            200, concurrency=4, seed=5, fs=("read", "write")),
+        lambda: synth.generate_mutex_history(
+            200, concurrency=6, seed=3, crash_prob=0.03),
+    ]
+
+    @pytest.mark.parametrize("shape", range(len(SHAPES)))
+    def test_final_tables_bit_identical(self, shape):
+        events = list(self.SHAPES[shape]())
+        mk = m.mutex if shape == 2 else m.cas_register
+        one = prepare.prepare(mk(), list(events))
+        pk = IncrementalPacker(mk())
+        step = max(1, len(events) // 7)
+        for i in range(0, len(events), step):
+            pk.feed_many(events[i:i + step])
+            pk.settle()
+        pk.settle(final=True)
+        p2 = pk.packed()
+        assert p2.window == one.window and p2.R == one.R
+        for k in ("ret_slot", "ret_op", "active", "slot_f", "slot_v",
+                  "slot_op", "crashed"):
+            a1 = np.asarray(getattr(one, k))
+            a2 = np.asarray(getattr(p2, k))
+            assert a1.shape == a2.shape and (a1 == a2).all(), k
+        assert one.unintern == p2.unintern
+        assert one.init_state.tolist() == p2.init_state.tolist()
+        r1 = prepare.reduction_tables(one)
+        r2 = p2._reduction_tables
+        assert (r1[0] == r2[0]).all() and (r1[1] == r2[1]).all()
+
+    def test_witness_shape_tables_bit_identical(self, witness_events,
+                                                witness_full):
+        one, _ = witness_full
+        pk = IncrementalPacker(m.cas_register())
+        for i in range(0, len(witness_events), 50):
+            pk.feed_many(witness_events[i:i + 50])
+            pk.settle()
+        pk.settle(final=True)
+        p2 = pk.packed()
+        for k in ("ret_slot", "active", "slot_v", "crashed"):
+            assert (np.asarray(getattr(one, k))
+                    == np.asarray(getattr(p2, k))).all(), k
+        r1 = prepare.reduction_tables(one)
+        assert (r1[1] == p2._reduction_tables[1]).all()
+
+    def test_settled_rows_are_final(self):
+        """Mid-stream reduction rows are a PREFIX of the final tables:
+        a settled row is never revised by later events (the invariant
+        that makes carried-frontier increments sound)."""
+        h = list(synth.generate_register_history(
+            300, concurrency=8, seed=2, crash_prob=0.05,
+            max_crashes=6))
+        one = prepare.prepare(m.cas_register(), list(h))
+        r1 = prepare.reduction_tables(one)
+        pk = IncrementalPacker(m.cas_register())
+        for i in range(0, len(h), 37):
+            pk.feed_many(h[i:i + 37])
+            pk.settle()
+            if pk.R:
+                r2 = pk.reduction_tables()
+                w2 = r2[0].shape[1]
+                assert (r1[0][:pk.R, :w2] == r2[0][:pk.R]).all()
+                assert (r1[1][:pk.R, :w2] == r2[1][:pk.R]).all()
+                # cols past the current window are inactive so far
+                assert not r1[0][:pk.R, w2:].any()
+                assert (r1[1][:pk.R, w2:] == -1).all()
+
+    def test_history_sized_kernels_run_in_buffer_mode(self):
+        # Set/queue kernels are sized from the data: no stable frontier
+        # layout to carry, so the session buffers and checks post-hoc.
+        h = list(synth.generate_set_history(40, concurrency=3, seed=4))
+        sc = StreamChecker(m.set_model(), min_rows=4)
+        assert not sc.packer.incremental
+        for i in range(0, len(h), 20):
+            sc.append(h[i:i + 20])
+        r = sc.finalize()
+        want = cpu.check_packed(
+            prepare.prepare(m.set_model(), list(h)))["valid?"]
+        assert r["valid?"] == want
+        assert r["stream"]["mode"] == "buffer"
+
+
+class TestSessionParity:
+    def test_witness_shape_matches_oneshot_and_oracle(
+            self, witness_events, witness_full):
+        p, full = witness_full
+        sc, r = _stream(list(witness_events), k=5)
+        assert r["valid?"] is False
+        assert r["dead-row"] == full["dead-row"]
+        assert r["op"] == full["op"]
+        assert _paths_key(r) == _paths_key(full)
+        assert r["stream"]["increments"] >= 3
+        assert not r["stream"].get("degraded")
+        want = cpu.check_packed(p)
+        assert want["valid?"] is False and r["op"] == want["op"]
+
+    def test_valid_history_matches_oneshot(self):
+        from jepsen_tpu.lin import device_check_packed
+
+        h = list(synth.generate_register_history(
+            400, concurrency=5, seed=11, value_range=5))
+        sc = StreamChecker(m.cas_register(), min_rows=8)
+        for i in range(0, len(h), 100):
+            sc.append(h[i:i + 100])
+        r = sc.finalize()
+        full = device_check_packed(
+            prepare.prepare(m.cas_register(), list(h)))
+        assert r["valid?"] is True is full["valid?"]
+        assert r["stream"]["increments"] >= 3
+        assert not r["stream"].get("degraded")
+
+    def test_info_only_completions_decide_vacuously_valid(self):
+        # Every completion indeterminate: nothing may be checked as
+        # absent, so there are zero return-event rows and the stream
+        # (like the oracle) decides True.
+        h = [Op("invoke", "write", 1, 0), Op("invoke", "write", 2, 1),
+             Op("info", "write", 1, 0), Op("info", "write", 2, 1)]
+        want = cpu.check_packed(
+            prepare.prepare(m.cas_register(), list(h)))["valid?"]
+        sc = StreamChecker(m.cas_register(), min_rows=1)
+        sc.append(h)
+        r = sc.finalize()
+        assert r["valid?"] is True is want
+        assert r["stream"]["rows_settled"] == 0
+
+
+    def test_unpackable_event_downgrades_without_dropping_events(self):
+        # A double invoke (unpackable) must not raise out of append or
+        # silently drop the rest of the batch: the session downgrades
+        # to buffer mode and finalize surfaces the one-shot verdict
+        # (honest unknown) over the COMPLETE fed history.
+        h = [Op("invoke", "write", 1, 0),
+             Op("invoke", "write", 2, 0),      # same process, no completion
+             Op("ok", "write", 2, 0)]
+        sc = StreamChecker(m.cas_register(), min_rows=1)
+        sc.append(h)                           # must not raise
+        assert len(sc.packer.history) == 3, "no event may be dropped"
+        r = sc.finalize()
+        assert r["valid?"] == "unknown"
+        assert "invoked twice" in str(r.get("stream-fallback", "")) \
+            or "invoked twice" in str(r.get("error", ""))
+
+
+class TestAbort:
+    def test_abort_within_one_increment_of_offending_completion(self):
+        h = list(synth.generate_register_history(
+            400, concurrency=5, seed=11, value_range=5))
+        bad = list(synth.corrupt_history(
+            synth.generate_register_history(
+                400, concurrency=5, seed=11, value_range=5), seed=3))
+        bad_at = next(i for i, (a, b) in enumerate(zip(h, bad))
+                      if a.value != b.value or a.type != b.type)
+        n = 50
+        sc = StreamChecker(m.cas_register(), min_rows=8)
+        fed = None
+        for i in range(0, len(bad), n):
+            sc.append(bad[i:i + n])
+            if sc.aborted:
+                fed = i + n
+                break
+        assert fed is not None, "stream never aborted"
+        # Within one increment of the offending completion (plus the
+        # settling slack of the <= concurrency ops pending across it).
+        assert fed - bad_at <= 2 * n
+        assert fed < len(bad), "abort must save remaining traffic"
+        # The latched witness IS the final verdict.
+        r = sc.finalize()
+        assert r["valid?"] is False and sc.verdict["valid?"] is False
+        assert r["stream"]["aborted"] is True
+
+    def test_wedged_increment_degrades_to_exact_posthoc(
+            self, witness_events, witness_full, monkeypatch,
+            tmp_path):
+        from jepsen_tpu.lin import supervise
+
+        monkeypatch.setenv("JEPSEN_TPU_QUARANTINE",
+                           str(tmp_path / "q.json"))
+        _, full = witness_full
+        # Wedge every attempt of the first increment (budget = 1 retry
+        # by default -> 2 attempts); injected attempts never touch the
+        # device (supervise._consume_injection).
+        supervise.inject_wedge("stream-incr", 2, 0.1)
+        sc, r = _stream(list(witness_events), k=4)
+        assert r["stream"].get("degraded"), "wedge must degrade"
+        assert r["valid?"] is False
+        assert r.get("stream-fallback") or r["stream"]["degraded"]
+        assert r["op"] == full["op"]
+
+
+class TestCheckpointResume:
+    def _feed(self, events, sc, k=6, stop_after=None):
+        n = max(1, len(events) // k)
+        fed = 0
+        for i in range(0, len(events), n):
+            sc.append(events[i:i + n])
+            fed += 1
+            if stop_after is not None and fed >= stop_after:
+                return False
+        return True
+
+    def test_killed_session_resumes_identical_verdict(
+            self, witness_events, witness_full, tmp_path):
+        _, full = witness_full
+        ck = str(tmp_path / "stream.ckpt.npz")
+        sc1 = StreamChecker(m.cas_register(), min_rows=4,
+                            checkpoint=ck, check_kw=KW)
+        self._feed(list(witness_events), sc1, stop_after=3)
+        assert sc1._row > 0 and os.path.exists(ck), \
+            "mid-stream session must have checkpointed progress"
+        # The killed session is simply dropped (a real kill -9 leaves
+        # exactly this file state — writes are atomic); the producer
+        # replays the same events into a fresh session.
+        sc2 = StreamChecker(m.cas_register(), min_rows=4,
+                            checkpoint=ck, check_kw=KW)
+        self._feed(list(witness_events), sc2)
+        r = sc2.finalize()
+        assert r["valid?"] is False
+        assert r["dead-row"] == full["dead-row"]
+        assert r["op"] == full["op"]
+        assert _paths_key(r) == _paths_key(full)
+        assert r["stream"]["resumed_from_row"] == sc1._row
+        # Definite verdict clears the checkpoint (PR 5 contract).
+        assert not os.path.exists(ck)
+
+    def test_foreign_events_reject_checkpoint(self, witness_events,
+                                              tmp_path):
+        ck = str(tmp_path / "foreign.ckpt.npz")
+        sc1 = StreamChecker(m.cas_register(), min_rows=4,
+                            checkpoint=ck, check_kw=KW)
+        self._feed(list(witness_events), sc1, stop_after=3)
+        assert os.path.exists(ck)
+        other = list(synth.generate_register_history(
+            200, concurrency=5, seed=1, value_range=5))
+        sc2 = StreamChecker(m.cas_register(), min_rows=8,
+                            checkpoint=ck)
+        n = max(1, len(other) // 4)
+        for i in range(0, len(other), n):
+            sc2.append(other[i:i + n])
+        r = sc2.finalize()
+        # Fingerprint mismatch: fresh correct run, no resume stamp.
+        assert r["valid?"] is True
+        assert "resumed_from_row" not in r["stream"]
+
+
+class TestWire:
+    def _svc(self, tmp_path, monkeypatch):
+        from jepsen_tpu.service.daemon import CheckerService
+
+        monkeypatch.setenv("JEPSEN_TPU_QUARANTINE",
+                           str(tmp_path / "quarantine.json"))
+        return CheckerService(
+            "127.0.0.1", 0, flush_ms_=10,
+            stats_file=str(tmp_path / "svc.json")).start()
+
+    def test_round_trip_parity_and_abort_surfaces_witness(
+            self, tmp_path, monkeypatch):
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        svc = self._svc(tmp_path, monkeypatch)
+        try:
+            c = CheckerClient("127.0.0.1", svc.port)
+            h = list(synth.generate_register_history(
+                200, concurrency=5, seed=11, value_range=5))
+            want = cpu.check_packed(
+                prepare.prepare(m.cas_register(), list(h)))["valid?"]
+            sid = c.stream_open("cas-register")
+            n = len(h) // 4
+            for i in range(0, len(h), n):
+                st = c.stream_append(sid, h[i:i + n])
+                assert st.get("type") == "stream-state", st
+            r = c.stream_finalize(sid)
+            assert r["valid?"] == want
+            assert (r.get("stream") or {}).get("increments", 0) >= 3
+
+            bad = list(synth.corrupt_history(
+                synth.generate_register_history(
+                    200, concurrency=5, seed=11, value_range=5),
+                seed=3))
+            sid2 = c.stream_open("cas-register")
+            aborted = None
+            for i in range(0, len(bad), n):
+                st = c.stream_append(sid2, bad[i:i + n])
+                if st.get("aborted"):
+                    aborted = st
+                    break
+            assert aborted is not None, "append must surface the abort"
+            assert aborted["result"]["valid?"] is False
+            assert c.stream_finalize(sid2)["valid?"] is False
+            c.shutdown()
+            c.close()
+        finally:
+            svc.stop()
+
+    def test_client_drop_mid_session_reaps_and_frees_slot(
+            self, tmp_path, monkeypatch):
+        from jepsen_tpu.service import protocol
+        from jepsen_tpu.service.protocol import CheckerClient
+        from jepsen_tpu.suites.common import SocketIO
+
+        monkeypatch.setenv("JEPSEN_TPU_STREAM_SESSIONS", "1")
+        svc = self._svc(tmp_path, monkeypatch)
+        try:
+            io = SocketIO(socket.create_connection(
+                ("127.0.0.1", svc.port), timeout=5))
+            protocol.send_msg(io, {"type": "stream-open", "id": 1,
+                                   "model": "cas-register"})
+            assert protocol.read_msg(io)["type"] == "stream-opened"
+            c = CheckerClient("127.0.0.1", svc.port)
+            assert c.stats()["stream_sessions_open"] == 1
+            # At the bound: a second open must backpressure.
+            with pytest.raises(RuntimeError, match="overload"):
+                c.stream_open("cas-register")
+            # DROP mid-session: the daemon reaps it and frees the slot.
+            io.close()
+            deadline = time.time() + 10
+            while time.time() < deadline and \
+                    c.stats().get("stream_sessions_open"):
+                time.sleep(0.05)
+            st = c.stats()
+            assert st["stream_sessions_open"] == 0
+            assert st.get("stream_reaped", 0) >= 1
+            # Slot actually reusable.
+            sid = c.stream_open("cas-register")
+            c.stream_abort(sid)
+            c.close()
+        finally:
+            svc.stop()
+
+    def test_info_only_completions_over_the_wire(self, tmp_path,
+                                                 monkeypatch):
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        svc = self._svc(tmp_path, monkeypatch)
+        try:
+            c = CheckerClient("127.0.0.1", svc.port)
+            sid = c.stream_open("cas-register")
+            h = [Op("invoke", "write", 1, 0),
+                 Op("invoke", "write", 2, 1),
+                 Op("info", "write", 1, 0),
+                 Op("info", "write", 2, 1)]
+            st = c.stream_append(sid, h)
+            assert st["type"] == "stream-state"
+            # Indeterminate ops never become checkable rows.
+            assert st["settled"] == 0 and st["pending"] == 0
+            r = c.stream_finalize(sid)
+            assert r["valid?"] is True
+            c.close()
+        finally:
+            svc.stop()
+
+    def test_v1_frame_gets_readable_version_error(self, tmp_path,
+                                                  monkeypatch):
+        from jepsen_tpu.service import protocol
+        from jepsen_tpu.suites.common import SocketIO
+
+        svc = self._svc(tmp_path, monkeypatch)
+        try:
+            io = SocketIO(socket.create_connection(
+                ("127.0.0.1", svc.port), timeout=5))
+            # A v1 client's frame (no version field -> v1).
+            protocol.send_msg(io, {"type": "check", "id": 7,
+                                   "model": "cas-register",
+                                   "history": [], "v": 1})
+            resp = protocol.read_msg(io)
+            assert resp["type"] == "error"
+            assert "version mismatch" in resp["error"]
+            assert resp["daemon_version"] == protocol.PROTOCOL_VERSION
+            io.close()
+            from jepsen_tpu.service.protocol import CheckerClient
+
+            c = CheckerClient("127.0.0.1", svc.port)
+            assert c.stats().get("version_mismatches", 0) >= 1
+            c.close()
+        finally:
+            svc.stop()
+
+
+class TestRunner:
+    def test_abort_latch_stops_generation(self):
+        from jepsen_tpu import checker as c
+        from jepsen_tpu import core
+        from jepsen_tpu import generator as g
+        from jepsen_tpu import tests_support as ts
+
+        class AbortedStub:
+            def offer(self, op):
+                pass
+
+            def should_abort(self):
+                return True
+
+        reg = ts.AtomRegister()
+        test = ts.noop_test(
+            client=ts.AtomClient(reg),
+            generator=g.clients(g.limit(40, g.cas(5))),
+            model=m.cas_register(),
+            checker=c.unbridled_optimism(),
+        )
+        test["stream-live"] = AbortedStub()
+        result = core.run(test)
+        # Every worker saw the latch before drawing its first op.
+        assert not [o for o in result["history"] if o.is_invoke]
+
+    def test_live_run_attaches_stream_verdict(self, monkeypatch):
+        from jepsen_tpu import checker as c
+        from jepsen_tpu import core
+        from jepsen_tpu import generator as g
+        from jepsen_tpu import tests_support as ts
+
+        monkeypatch.setenv("JEPSEN_TPU_STREAM", "1")
+        monkeypatch.setenv("JEPSEN_TPU_STREAM_ROWS", "8")
+        reg = ts.AtomRegister()
+        test = ts.noop_test(
+            client=ts.AtomClient(reg),
+            generator=g.clients(g.limit(40, g.cas(5))),
+            model=m.cas_register(),
+            checker=c.linearizable("cpu"),
+        )
+        result = core.run(test)
+        assert result["results"][c.VALID] is True
+        assert result["results"]["stream"]["valid?"] is True
+
+    def test_live_run_flags_lying_client(self, monkeypatch):
+        from jepsen_tpu import checker as c
+        from jepsen_tpu import core
+        from jepsen_tpu import generator as g
+        from jepsen_tpu import tests_support as ts
+
+        class LyingClient(ts.AtomClient):
+            def invoke(self, test, op):
+                if op.f == "write":
+                    return op.replace(type="ok")   # ack, don't apply
+                return super().invoke(test, op)
+
+            def open(self, test, node):
+                return LyingClient(self.register)
+
+        monkeypatch.setenv("JEPSEN_TPU_STREAM", "1")
+        monkeypatch.setenv("JEPSEN_TPU_STREAM_ROWS", "8")
+        reg = ts.AtomRegister()
+        reg.write(99)   # writes never land: reads must keep seeing 99
+        test = ts.noop_test(
+            client=LyingClient(reg),
+            generator=g.clients(g.limit(60, g.mix(
+                [Op("invoke", "read", None),
+                 lambda: Op("invoke", "write", 1)]))),
+            model=m.cas_register(99),
+            checker=c.linearizable("cpu"),
+        )
+        result = core.run(test)
+        assert result["results"][c.VALID] is False
+        assert result["results"]["stream"]["valid?"] is False
+
+
+def test_run_page_renders_stream_lag_and_abort(tmp_path):
+    from jepsen_tpu import web
+
+    snap = {"updated": "t", "pid": 1,
+            "run": {"run": "lin-sparse", "row": 60, "total_rows": 100},
+            "samples": [], "events": [],
+            "views": {"stream": {
+                "rows_settled": 100, "rows_checked": 60,
+                "lag_rows": 40, "ops_ingested": 300,
+                "aborted": True, "aborted_row": 61}}}
+    path = tmp_path / "telemetry.json"
+    import json
+
+    path.write_text(json.dumps(snap))
+    html = web.run_html(snapshot_file=str(path))
+    assert "stream checker" in html
+    assert "checked 60 / settled 100" in html
+    assert "lag 40" in html
+    assert "ABORTED" in html and "61" in html
