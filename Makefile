@@ -1,7 +1,7 @@
 # jepsen_tpu development targets.
 
 .PHONY: test test-quick integration integration-local bench \
-	probe-config5 serve-smoke txn-smoke trace-smoke stream-smoke
+	probe-config5 serve-smoke txn-smoke trace-smoke stream-smoke lint
 
 # Unit + parity suite on the virtual 8-device CPU mesh (no cluster).
 # Hardware note: ~8 min on a 4-core box; the compile-heavy lin parity
@@ -22,6 +22,17 @@ TEST_QUICK_TIMEOUT ?= 900
 test-quick:
 	timeout -k 15 $(TEST_QUICK_TIMEOUT) \
 		python -m pytest tests/ -q -m "quick and not slow"
+
+# Repo contract linter (doc/analysis.md): the CLAUDE.md invariants as
+# a zero-findings gate — lax.while_loop iteration ceilings in
+# lin/+txn/, JEPSEN_TPU_* <-> doc/env.md drift both ways, the wire
+# suites' :info-never-:fail exception rule, Pallas module-constant
+# hygiene, quick-tier compiles markers. Pure AST: chip-free,
+# sub-second; run it before committing engine changes (CLAUDE.md).
+# Exit 1 on findings; every waiver is greppable (`grep -rn 'lint:'`).
+LINT_TIMEOUT ?= 120
+lint:
+	timeout -k 10 $(LINT_TIMEOUT) python -m jepsen_tpu.cli lint
 
 # Cluster integration matrix against the dockerized 1-control + 5-node
 # environment: brings the compose cluster up, then runs the per-suite

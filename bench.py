@@ -800,6 +800,12 @@ def _wide_probes(detail: dict, out: dict, t_start: float) -> None:
                          "JEPSEN_TPU_FUSED_CLOSURE": str(fused),
                          "JEPSEN_TPU_HOST_STICKY": str(sticky),
                          "JEPSEN_TPU_HOST_ROWS_K": str(k),
+                         # The static gate must never ROUTE a bench
+                         # rung (an exported route mode would run a
+                         # rung at a config other than the one its
+                         # artifact records): force the observe-only
+                         # default on every rung.
+                         "JEPSEN_TPU_STATIC_GATE": "warn",
                          "JEPSEN_TPU_CKPT": ck},
                         {"sync_chunks": sync, "fused_closure": fused,
                          "host_sticky": sticky, "host_rows_k": k,
@@ -832,7 +838,8 @@ def _wide_probes(detail: dict, out: dict, t_start: float) -> None:
                     env_extra={"JEPSEN_TPU_SYNC_CHUNKS": "2",
                                "JEPSEN_TPU_FUSED_CLOSURE": "1",
                                "JEPSEN_TPU_HOST_STICKY": "1",
-                               "JEPSEN_TPU_HOST_ROWS_K": "4"},
+                               "JEPSEN_TPU_HOST_ROWS_K": "4",
+                               "JEPSEN_TPU_STATIC_GATE": "warn"},
                     stall_s=WAVE_SMOKE_BUDGET_S / 2)
                 detail["wave_smoke"] = smoke
                 _emit(out)

@@ -1,0 +1,32 @@
+"""Static analysis: the fault lore as machine-checked rules.
+
+Five rounds of fault lore (CLAUDE.md) and PR 5's quarantine ledger
+encode the TPU runtime's failure envelope *reactively* — a shape must
+fault or wedge once (killing the worker for ~a minute, or stalling a
+3217 s config-5 run) before the runtime routes around it. This package
+makes the knowledge *predictive*, in two layers:
+
+- :mod:`jepsen_tpu.analysis.jaxpr_lint` — pure rules over a traced
+  program's closed jaxpr: the catalogued fault classes (round-1
+  gather+reduce_or in nested loops, round-3 wide sorts, round-2
+  cumsum/searchsorted/gather compaction, the round-5 unbounded-loop
+  orbit class, the rows×cap program-complexity envelope) as shape
+  predicates. No jax import cost until a jaxpr is actually analyzed.
+- :mod:`jepsen_tpu.analysis.gate` — the pre-dispatch gate
+  :func:`jepsen_tpu.lin.supervise.run_guarded` consults: trace the
+  program about to launch (cached per traced shape key), flag it
+  against the rules, and — under ``JEPSEN_TPU_STATIC_GATE=route`` —
+  send a predicted-faulty program down its existing fallback ladder
+  *before* it ever touches the chip, recording a ``static`` entry in
+  the quarantine ledger (distinct from ``fault``/``wedge``).
+- :mod:`jepsen_tpu.analysis.lint` — the repo contract linter
+  (``cli.py lint``, ``make lint``): AST-level checks that the
+  CLAUDE.md architecture invariants hold in source — iteration
+  ceilings on ``lax.while_loop``s in ``lin/``+``txn/``, two-way
+  ``JEPSEN_TPU_*``/doc/env.md drift, the wire suites'
+  ``:info``-never-``:fail`` exception contract, no module-level
+  ``jnp`` constants in Pallas kernel modules, and the quick tier's
+  ``compiles``-marker discipline. Pure ``ast``; jax-free at import.
+
+Rule catalog, thresholds, and waiver syntax: doc/analysis.md.
+"""

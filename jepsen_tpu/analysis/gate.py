@@ -1,0 +1,208 @@
+"""The pre-dispatch static gate (``JEPSEN_TPU_STATIC_GATE``).
+
+:func:`jepsen_tpu.lin.supervise.run_guarded` calls :func:`consider`
+with the engine's *traceable* — the pure-jax half of the dispatch
+thunk (no host fetches) — right before dispatching it. The gate traces
+the program (``jax.make_jaxpr``: host-side, no compile, no chip) and
+checks it against the :mod:`jepsen_tpu.analysis.jaxpr_lint` fault
+rules, cached per traced shape key so each program shape is analyzed
+once per process.
+
+Modes (read per call, the env-knob convention):
+
+- ``warn`` (default): a flagged program emits a ``static`` event on
+  the obs feed and a ``static-flag`` trace instant, then dispatches
+  normally. Attribution and triage see the prediction; behaviour is
+  unchanged.
+- ``route``: at the sites that HAVE a fallback rung
+  (:data:`ROUTED_SITES` — the same set that consults the quarantine
+  ledger), a flagged program is sent down the ladder *before touching
+  the chip*: ``run_guarded`` returns ``("static", StaticallyFlagged)``
+  without dispatching, the shape is recorded in the quarantine ledger
+  with reason ``static`` (distinct from ``fault``/``wedge`` in
+  ``cli.py quarantine list``; it does NOT quarantine the shape — turn
+  the gate off and the entry is routing-inert), and a ``static-skip``
+  trace instant carries the estimated seconds saved (a fault costs
+  ~a minute of dead worker, CLAUDE.md). Base-rung sites (chunk,
+  chunk-batch, spike, mesh-chunk) have no alternative rung and only
+  ever warn — exactly the ledger's routing split.
+- ``off``: no tracing, no analysis, zero overhead.
+
+A program the gate cannot trace (host fetches in the traceable, exotic
+control flow) is treated as unanalyzable and passes — the gate must
+never take a healthy run down, and the watchdog/ledger reactive layer
+still stands behind it.
+
+Test hook: ``JEPSEN_TPU_STATIC_FORCE="substr[:rule]"`` force-flags any
+key containing ``substr`` (comma-separable), so route-mode plumbing is
+testable without constructing a genuinely faulty program — the
+``JEPSEN_TPU_WEDGE`` precedent.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from jepsen_tpu import util
+from jepsen_tpu.analysis import jaxpr_lint
+from jepsen_tpu.obs import metrics as _obs_metrics
+from jepsen_tpu.obs import trace as _obs_trace
+
+MODES = ("off", "warn", "route")
+
+# The sites with a proven fallback rung below them — the same set that
+# consults the quarantine ledger for routing (supervise docstring).
+ROUTED_SITES = frozenset(
+    {"host-wave", "host-fixpoint", "host-pass", "txn-scc"})
+
+# Per-site rule waivers: the jaxpr twin of the source-level
+# `# lint: unbounded-ok` comments. The mesh closure fixpoints
+# (sharded.py) are provably monotone (no content-sensitive dominance
+# prune) and carry the justification at their while_loops; until the
+# crash-dom mesh work adds in-carry ceilings (ROADMAP), the gate must
+# not flag every healthy mesh chunk.
+SITE_WAIVERS = {"mesh-chunk": ("unbounded-while",)}
+
+# What one avoided fault is worth: a kernel fault kills the TPU worker
+# for ~a minute (CLAUDE.md round-1 lore) before the retry even starts.
+FAULT_RECOVERY_EST_S = 60.0
+
+
+class StaticallyFlagged(Exception):
+    """run_guarded's ``("static", exc)`` payload: the program was
+    routed to its fallback rung by prediction, not by a crash."""
+
+    def __init__(self, site: str, key: str, findings):
+        self.site, self.key, self.findings = site, key, list(findings)
+        super().__init__(
+            f"static gate flagged {key!r}: "
+            + "; ".join(str(f) for f in self.findings))
+
+
+def mode() -> str:
+    v = os.environ.get("JEPSEN_TPU_STATIC_GATE", "warn").strip().lower()
+    return v if v in MODES else "warn"
+
+
+_lock = threading.Lock()
+# key -> list[Finding] ([] = analyzed clean, or unanalyzable).
+_cache: dict[str, list] = {}
+_unanalyzable: set[str] = set()
+# Keys already ledger-recorded this process: a flagged per-row shape
+# is considered once per ROW, but the ledger write happens once.
+_recorded: set[str] = set()
+# Keys whose flagging was already announced on the bounded obs event
+# feed / warn-mode trace: a per-pass site dispatches hundreds of times
+# per row, and per-dispatch `static` events would evict the real
+# fault/wedge events triage depends on. (Route-mode `static-skip`
+# instants stay per-dispatch — they ARE the avoided-dispatch count the
+# attribution report prices.)
+_noted: set[str] = set()
+
+
+def reset() -> None:
+    """Tests: drop the per-process analysis cache (e.g. after flipping
+    engine env knobs that change the program behind a key)."""
+    with _lock:
+        _cache.clear()
+        _unanalyzable.clear()
+        _recorded.clear()
+        _noted.clear()
+
+
+def analyzed() -> dict:
+    """Snapshot of key -> findings analyzed so far (tests; the
+    shipped-programs-pass regression reads this)."""
+    with _lock:
+        return {k: list(v) for k, v in _cache.items()}
+
+
+def unanalyzable() -> set:
+    with _lock:
+        return set(_unanalyzable)
+
+
+def _forced(key: str):
+    env = os.environ.get("JEPSEN_TPU_STATIC_FORCE", "")
+    if not env:
+        return []
+    out = []
+    for part in env.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":", 1)
+        if bits[0] and bits[0] in key:
+            out.append(jaxpr_lint.Finding(
+                bits[1] if len(bits) > 1 and bits[1] else "forced",
+                f"JEPSEN_TPU_STATIC_FORCE={part!r} (test hook)"))
+    return out
+
+
+def check(key: str, traceable, waive=()) -> list:
+    """Findings for ``traceable`` (a no-arg pure-jax callable), cached
+    per shape key. Unanalyzable programs return [] and are remembered
+    so the (possibly expensive) failed trace is never repeated."""
+    with _lock:
+        if key in _cache:
+            return list(_cache[key])
+    try:
+        import jax
+
+        findings = jaxpr_lint.analyze_jaxpr(
+            jax.make_jaxpr(traceable)(), waive=waive)
+        bad = False
+    except Exception:  # noqa: BLE001 - unanalyzable must pass, never raise
+        findings = []
+        bad = True
+    with _lock:
+        # Cache findings only — a ClosedJaxpr pins its closed-over
+        # device arrays; dropping it here keeps the cache O(keys).
+        _cache[key] = list(findings)
+        if bad:
+            _unanalyzable.add(key)
+    return findings
+
+
+def consider(site: str, key: str, traceable,
+             stats: dict | None = None):
+    """The run_guarded hook. Returns None to proceed with the
+    dispatch, or a :class:`StaticallyFlagged` when the program is
+    flagged AND the mode/site combination routes."""
+    m = mode()
+    if m == "off":
+        return None
+    findings = check(key, traceable,
+                     waive=SITE_WAIVERS.get(site, ())) + _forced(key)
+    if not findings:
+        return None
+    rules = [f.rule for f in findings]
+    route = m == "route" and site in ROUTED_SITES
+    with _lock:
+        first = key not in _noted
+        _noted.add(key)
+    if first:
+        _obs_metrics.REGISTRY.event("static", site=site, key=key,
+                                    rules=rules, routed=route)
+    if not route:
+        if first:
+            _obs_trace.instant("static-flag", site=site, key=key,
+                               rules=rules)
+        return None
+    # Routed: ledger entry (reason "static" — observability, not
+    # quarantine), stats counter, and the attribution instant pricing
+    # the dispatch-and-fault this prediction avoided.
+    from jepsen_tpu.lin import supervise
+
+    if stats is not None:
+        util.stat_bump(stats, "static_skips")
+    with _lock:
+        record = key not in _recorded
+        _recorded.add(key)
+    if record:
+        supervise.record_fault(key, "static",
+                               "; ".join(str(f) for f in findings))
+    _obs_trace.instant("static-skip", site=site, key=key, rules=rules,
+                       est_saved_s=FAULT_RECOVERY_EST_S)
+    return StaticallyFlagged(site, key, findings)

@@ -1,0 +1,369 @@
+"""Jaxpr rules: the round 1-5 fault classes as shape predicates.
+
+Each rule walks the closed jaxpr of a program about to be dispatched
+(``jax.make_jaxpr`` on the engine's traceable thunk — tracing is
+host-side and never compiles or touches the chip) and flags the
+lowering patterns the axon TPU runtime is known to kill the worker
+over. The thresholds are the probed lore constants, not guesses; each
+rule's provenance is tabled in doc/analysis.md.
+
+Rules (finding ``rule`` ids):
+
+- ``gather-reduce-while`` — round 1: slot-batched
+  ``take_along_axis``-class gathers combined with a
+  ``lax.reduce(bitwise_or)`` inside nested loops kernel-fault the
+  runtime (dense.py's reshape/concat bit algebra exists to avoid it).
+  Fires when a loop body at nesting depth >= 2 contains both a
+  ``gather`` over >= :data:`GATHER_ELEMS_MIN` elements and a
+  ``reduce_or``.
+- ``wide-sort`` — round 3: the 6-operand pair-dom ``lax.sort`` at the
+  1M spike cap CRASHED the worker while the 4-operand dominance-word
+  packing runs clean there (probed). Fires on a ``sort`` with more
+  than :data:`SORT_MAX_OPERANDS` operands of >=
+  :data:`SORT_ELEMS_MIN` elements.
+- ``compact-chain`` — round 2: dedup compaction by
+  cumsum+searchsorted+gather faults at spike sizes (bfs compacts with
+  a second sort instead). Fires when a loop body contains both a
+  ``cumsum`` and a ``gather`` over >= :data:`COMPACT_ELEMS_MIN`
+  elements.
+- ``unbounded-while`` — round 5: the group-cycled closure fixpoint
+  ORBITED forever (observed 4124<->4110), and inside a nested
+  ``lax.while_loop`` an infinite loop presents exactly like a kernel
+  fault. Post-round-5 convention: every closure loop carries an
+  iteration ceiling. Fires on any ``while`` whose cond contains no
+  integer bound comparison (``lt``/``le``/``gt``/``ge``).
+- ``rows-cap-envelope`` — rounds 2/4: the runtime objects to rows×cap
+  PROGRAM complexity, not capacity (512-row chunks fault past cap
+  131072 while 8-row chunks of the same program run clean at 2^20).
+  Fires when a sort-bearing loop has a resolvable trip bound >
+  :data:`ENVELOPE_ROWS_MAX` and carries arrays of leading dimension >
+  :data:`ENVELOPE_CAP_MAX`.
+
+The walker is conservative where it cannot resolve (an unknown trip
+bound never fires the envelope rule), and every finding carries the
+rule id + a human-readable detail for the ledger/event feed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# --- lore thresholds --------------------------------------------------------
+# Round 3: 4-operand dominance-word sorts probed clean at cap 1048576
+# x 32 rows; the 6-operand pair-dom sort crashed there.
+SORT_MAX_OPERANDS = 4
+# Spike-scale operand size: the crash was at the 1M spike cap; psort
+# tops out at 2^19 pads and the host dedups at ~2^19-2^21 are clean
+# with <=4 operands, so the operand-count rule engages at 2^19.
+SORT_ELEMS_MIN = 1 << 19
+# Round 2: compaction faults "at those sizes" = past the 131072 chunk
+# cap; engage at 2^17 for margin.
+COMPACT_ELEMS_MIN = 1 << 17
+# Round 1's faulting gathers were slot-batched frontier-sized
+# operands; tiny per-row index gathers are everywhere and harmless.
+GATHER_ELEMS_MIN = 2048
+# Rounds 2/4 envelope: 512-row chunks at cap 131072 are the probed
+# fault frontier — flag a sort-bearing loop strictly past BOTH axes.
+ENVELOPE_ROWS_MAX = 256
+ENVELOPE_CAP_MAX = 131072
+
+RULES = ("gather-reduce-while", "wide-sort", "compact-chain",
+         "unbounded-while", "rows-cap-envelope")
+
+_CMP_PRIMS = ("lt", "le", "gt", "ge")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation on one program."""
+
+    rule: str
+    detail: str
+
+    def __str__(self):
+        return f"{self.rule}: {self.detail}"
+
+
+def _elems(v) -> int:
+    aval = getattr(v, "aval", None)
+    shape = getattr(aval, "shape", None)
+    if not shape:
+        return 1 if aval is not None else 0
+    n = 1
+    for d in shape:
+        try:
+            n *= int(d)
+        except (TypeError, ValueError):
+            return 0      # symbolic dim: unresolvable, stay quiet
+    return n
+
+
+def _dim0(v) -> int:
+    aval = getattr(v, "aval", None)
+    shape = getattr(aval, "shape", None)
+    if not shape:
+        return 0
+    try:
+        return int(shape[0])
+    except (TypeError, ValueError):
+        return 0
+
+
+def _scalar_int(val):
+    """A known Python int from a numpy/jax scalar, else None."""
+    try:
+        import numpy as np
+
+        if hasattr(val, "shape") and np.size(val) != 1:
+            return None
+        v = np.asarray(val).reshape(())
+        if v.dtype.kind not in "iu":
+            return None
+        return int(v)
+    except Exception:  # noqa: BLE001 - resolution is best-effort
+        return None
+
+
+def _known(v, env: dict):
+    """Resolve a jaxpr atom to a known scalar int (Literal or
+    env-tracked const), else None."""
+    if hasattr(v, "val"):                  # Literal
+        return _scalar_int(v.val)
+    return env.get(id(v))
+
+
+def _is_jaxpr_like(v) -> bool:
+    # ClosedJaxpr (has .jaxpr) or a raw Jaxpr (shard_map and some
+    # pallas params carry the body UNclosed — has .eqns but no
+    # .jaxpr); skipping raw bodies would make the mesh-chunk gate a
+    # silent no-op.
+    return hasattr(v, "jaxpr") or hasattr(v, "eqns")
+
+
+def _raw(v):
+    """The underlying Jaxpr of a ClosedJaxpr-or-Jaxpr param."""
+    return v.jaxpr if hasattr(v, "jaxpr") else v
+
+
+def _sub_jaxprs(eqn):
+    """Every sub-program (ClosedJaxpr or raw Jaxpr) of a non-while
+    eqn's params."""
+    out = []
+    for v in eqn.params.values():
+        if _is_jaxpr_like(v):
+            out.append(v)
+        elif isinstance(v, (list, tuple)):
+            out.extend(w for w in v if _is_jaxpr_like(w))
+    return out
+
+
+def _closed_env(closed) -> dict:
+    env = {}
+    if not hasattr(closed, "consts"):   # raw Jaxpr: no const values
+        return env
+    for var, val in zip(closed.jaxpr.constvars, closed.consts):
+        k = _scalar_int(val)
+        if k is not None:
+            env[id(var)] = k
+    return env
+
+
+class _Scope:
+    """Aggregate facts about one jaxpr scope INCLUDING its sub-scopes
+    (what the loop-body rules test against)."""
+
+    __slots__ = ("max_dim0", "gather_elems", "cumsum_elems",
+                 "has_sort", "has_reduce_or")
+
+    def __init__(self):
+        self.max_dim0 = 0
+        self.gather_elems = 0
+        self.cumsum_elems = 0
+        self.has_sort = False
+        self.has_reduce_or = False
+
+    def absorb(self, other: "_Scope") -> None:
+        self.max_dim0 = max(self.max_dim0, other.max_dim0)
+        self.gather_elems = max(self.gather_elems, other.gather_elems)
+        self.cumsum_elems = max(self.cumsum_elems, other.cumsum_elems)
+        self.has_sort = self.has_sort or other.has_sort
+        self.has_reduce_or = self.has_reduce_or or other.has_reduce_or
+
+
+def _cond_bound(eqn, env: dict):
+    """(bounded, trip) for a while eqn: bounded = the cond contains an
+    integer comparison (the iteration-ceiling convention); trip = the
+    compared-against constant when it resolves (via a Literal, a cond
+    const, or the carry's init value), else None."""
+    cond = eqn.params["cond_jaxpr"]
+    n_cc = eqn.params["cond_nconsts"]
+    n_bc = eqn.params["body_nconsts"]
+    cenv = _closed_env(cond)
+    cond_invars = cond.jaxpr.invars
+
+    def resolve(v):
+        k = _known(v, cenv)
+        if k is not None:
+            return k
+        # A cond invar: position < n_cc is a cond const, else carry —
+        # both resolvable from the while eqn's operands when those are
+        # Literals/known consts of the ENCLOSING scope.
+        for i, iv in enumerate(cond_invars):
+            if iv is v:
+                j = i if i < n_cc else n_bc + i
+                if j < len(eqn.invars):
+                    return _known(eqn.invars[j], env)
+                return None
+        return None
+
+    bounded = False
+    trip = None
+    for ce in cond.jaxpr.eqns:
+        if ce.primitive.name not in _CMP_PRIMS:
+            continue
+        ints = [v for v in ce.invars
+                if getattr(getattr(v, "aval", None), "dtype", None)
+                is not None
+                and getattr(v.aval.dtype, "kind", "") in "iu"]
+        if len(ints) < 2:
+            continue
+        bounded = True
+        for v in ce.invars:
+            k = resolve(v)
+            if k is not None and k > 1:
+                trip = max(trip or 0, k)
+    return bounded, trip
+
+
+def _scan(jaxpr, env: dict, loop_depth: int,
+          findings: list) -> _Scope:
+    scope = _Scope()
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        for v in eqn.invars:
+            scope.max_dim0 = max(scope.max_dim0, _dim0(v))
+        if name == "sort":
+            scope.has_sort = True
+            nops = len(eqn.invars)
+            elems = max((_elems(v) for v in eqn.invars), default=0)
+            if nops > SORT_MAX_OPERANDS and elems >= SORT_ELEMS_MIN:
+                findings.append(Finding(
+                    "wide-sort",
+                    f"{nops}-operand sort over {elems} elements "
+                    f"(>{SORT_MAX_OPERANDS} operands at >="
+                    f"{SORT_ELEMS_MIN}: the round-3 worker-killer; "
+                    f"pack into <=4 operands)"))
+        elif name == "gather":
+            scope.gather_elems = max(
+                scope.gather_elems,
+                max((_elems(v) for v in eqn.invars), default=0))
+        elif name == "cumsum":
+            scope.cumsum_elems = max(
+                scope.cumsum_elems,
+                max((_elems(v) for v in eqn.invars), default=0))
+        elif name == "reduce_or":
+            scope.has_reduce_or = True
+
+        if name == "while":
+            body = eqn.params["body_jaxpr"]
+            n_cc = eqn.params["cond_nconsts"]
+            benv = _closed_env(body)
+            # Body consts map 1:1 onto eqn operands after the cond
+            # consts; carry values mutate per iteration — never
+            # propagated.
+            n_bc = eqn.params["body_nconsts"]
+            for i in range(n_bc):
+                k = _known(eqn.invars[n_cc + i], env)
+                if k is not None and i < len(body.jaxpr.invars):
+                    benv[id(body.jaxpr.invars[i])] = k
+            sub = _scan(body.jaxpr, benv, loop_depth + 1, findings)
+            cond_scope = _scan(eqn.params["cond_jaxpr"].jaxpr, {},
+                               loop_depth + 1, findings)
+            sub.absorb(cond_scope)
+            bounded, trip = _cond_bound(eqn, env)
+            if not bounded:
+                findings.append(Finding(
+                    "unbounded-while",
+                    f"while at loop depth {loop_depth + 1} carries no "
+                    f"iteration ceiling (no integer bound comparison "
+                    f"in its cond — the round-5 orbit class: a "
+                    f"nonterminating fixpoint presents as a kernel "
+                    f"fault)"))
+            _loop_rules(sub, loop_depth, trip, findings)
+            scope.absorb(sub)
+        elif name == "scan":
+            sub_closed = eqn.params.get("jaxpr")
+            if sub_closed is not None:
+                sub = _scan(_raw(sub_closed), {}, loop_depth + 1,
+                            findings)
+                trip = eqn.params.get("length")
+                _loop_rules(sub, loop_depth,
+                            int(trip) if trip else None, findings)
+                scope.absorb(sub)
+        else:
+            for sub_closed in _sub_jaxprs(eqn):
+                senv = {}
+                sub_invars = _raw(sub_closed).invars
+                if name == "pjit" and len(sub_invars) == len(eqn.invars):
+                    for iv, ov in zip(sub_invars, eqn.invars):
+                        k = _known(ov, env)
+                        if k is not None:
+                            senv[id(iv)] = k
+                scope.absorb(_scan(_raw(sub_closed), senv, loop_depth,
+                                   findings))
+    return scope
+
+
+def _loop_rules(sub: _Scope, outer_depth: int, trip,
+                findings: list) -> None:
+    """Rules tested against one loop BODY scope (while or scan).
+    ``outer_depth`` is the loop nesting around this loop."""
+    if outer_depth >= 1 and sub.has_reduce_or \
+            and sub.gather_elems >= GATHER_ELEMS_MIN:
+        findings.append(Finding(
+            "gather-reduce-while",
+            f"gather over {sub.gather_elems} elements + reduce_or "
+            f"inside a depth-{outer_depth + 1} nested loop (round-1 "
+            f"kernel-faulter; prefer reshape/concat bit algebra)"))
+    if sub.cumsum_elems >= COMPACT_ELEMS_MIN \
+            and sub.gather_elems >= COMPACT_ELEMS_MIN:
+        findings.append(Finding(
+            "compact-chain",
+            f"cumsum ({sub.cumsum_elems}) + gather "
+            f"({sub.gather_elems}) compaction inside a loop (round-2 "
+            f"faulter at dedup sizes; compact with a second sort)"))
+    if trip is not None and trip > ENVELOPE_ROWS_MAX and sub.has_sort \
+            and sub.max_dim0 > ENVELOPE_CAP_MAX:
+        findings.append(Finding(
+            "rows-cap-envelope",
+            f"sort-bearing loop with trip bound {trip} over arrays of "
+            f"leading dim {sub.max_dim0} — past the rows×cap fault "
+            f"frontier ({ENVELOPE_ROWS_MAX} rows × {ENVELOPE_CAP_MAX} "
+            f"cap, rounds 2/4); shrink the chunk (spike mode) or "
+            f"route to host rows"))
+
+
+def analyze_jaxpr(closed, waive=()) -> list[Finding]:
+    """All findings for one ``ClosedJaxpr``, deduplicated by rule
+    (one program either has a fault class or it does not — per-eqn
+    multiplicity is noise). ``waive`` drops the named rules."""
+    findings: list[Finding] = []
+    _scan(closed.jaxpr, _closed_env(closed), 0, findings)
+    out, seen = [], set()
+    for f in findings:
+        if f.rule in waive or f.rule in seen:
+            continue
+        seen.add(f.rule)
+        out.append(f)
+    return out
+
+
+def analyze_fn(fn, *args, waive=(), **kwargs) -> list[Finding]:
+    """``analyze_jaxpr(jax.make_jaxpr(fn)(*args, **kwargs))`` —
+    tracing only: no compile, no device dispatch. Accepts
+    ``jax.ShapeDtypeStruct`` args so callers never materialize
+    spike-scale operands."""
+    import jax
+
+    return analyze_jaxpr(jax.make_jaxpr(fn)(*args, **kwargs),
+                         waive=waive)

@@ -1,0 +1,420 @@
+"""Repo contract linter: the CLAUDE.md invariants as CI.
+
+``cli.py lint`` / ``make lint`` run :func:`lint_repo` over the
+checkout; a quick-tier test asserts zero findings so every contract
+below gates every future PR through tier-1. Pure ``ast`` + regex —
+jax-free, sub-second, chip-free.
+
+Rules (finding ``rule`` ids):
+
+- ``while-ceiling`` — every ``lax.while_loop`` in ``lin/`` + ``txn/``
+  must carry an iteration ceiling: its cond function contains an
+  ordered comparison (``<``/``<=``/``>``/``>=``). The round-5 orbit
+  lesson (a nonterminating fixpoint inside a nested while presents as
+  a kernel fault) as a source-level invariant; ``fori_loop`` is
+  bounded by construction. Waiver: ``# lint: unbounded-ok`` (for the
+  provably-monotone closure fixpoints that predate the convention).
+- ``env-doc`` — every ``JEPSEN_TPU_*`` knob referenced in code is
+  tabled in doc/env.md and vice versa (drift both ways). Tokens
+  ending in ``_`` (f-string prefixes) are exempt.
+- ``wire-fail`` — wire suites (``suites/*wire*.py``) never complete
+  an op as ``"fail"`` from inside an ``except`` handler unless the
+  completion is read-guarded (``"fail" if op.f == "read" else
+  "info"`` — reads never apply). A ``:fail`` for a mutator that may
+  have applied makes the checker unsound. Waiver: ``# lint: fail-ok``
+  with the soundness argument (e.g. a parsed server error response is
+  a definite rejection).
+- ``pallas-const`` — modules importing Pallas hold no module-level
+  ``jnp`` constants (Mosaic illegal-captured-const lore: module-level
+  jnp values become illegal captured consts in kernels; use Python
+  ints). Waiver: ``# lint: jnp-const-ok``.
+- ``quick-compiles`` — a quick-marked test file importing a
+  compile-triggering engine module carries at least one ``compiles``
+  marker (the conftest no-compile enforcement's exemption), so the
+  quick tier's no-compile promise stays auditable. Waiver:
+  ``# lint: compiles-ok``.
+
+Waiver syntax: the comment goes on the offending line or the line
+directly above it. Waivers are greppable (``grep -rn 'lint:'``) so
+every exemption stays reviewable.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+
+ENV_RE = re.compile(r"JEPSEN_TPU_[A-Z0-9_]+")
+
+# Modules whose import (module-level jnp constants) or first use
+# triggers XLA compiles — the conftest enforcement's usual suspects.
+COMPILE_TRIGGER_MODULES = (
+    "jepsen_tpu.lin.bfs", "jepsen_tpu.lin.dense",
+    "jepsen_tpu.lin.dense_pallas", "jepsen_tpu.lin.batched",
+    "jepsen_tpu.lin.psort", "jepsen_tpu.lin.sharded",
+    "jepsen_tpu.lin.sharded_dense", "jepsen_tpu.txn.device",
+)
+
+
+@dataclass(frozen=True)
+class LintFinding:
+    rule: str
+    path: str
+    line: int
+    msg: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.msg}"
+
+
+def repo_root() -> str:
+    """The checkout root: the parent of the ``jepsen_tpu`` package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _waived(lines: list[str], lineno: int, tag: str) -> bool:
+    """``# lint: <tag>-ok`` on the finding's line or anywhere in the
+    contiguous comment block directly above it — justifications are
+    encouraged, so a waiver may open a multi-line comment."""
+    pat = f"lint: {tag}-ok"
+    if 1 <= lineno <= len(lines) and pat in lines[lineno - 1]:
+        return True
+    ln = lineno - 1
+    while 1 <= ln <= len(lines) \
+            and lines[ln - 1].strip().startswith("#"):
+        if pat in lines[ln - 1]:
+            return True
+        ln -= 1
+    return False
+
+
+def _py_files(root: str, *subdirs: str) -> list[str]:
+    out = []
+    for sub in subdirs:
+        d = os.path.join(root, sub)
+        if not os.path.isdir(d):
+            continue
+        for name in sorted(os.listdir(d)):
+            if name.endswith(".py"):
+                out.append(os.path.join(d, name))
+    return out
+
+
+def _rel(root: str, path: str) -> str:
+    return os.path.relpath(path, root)
+
+
+# --- while-ceiling ----------------------------------------------------------
+
+
+def _has_ordered_compare(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Compare) and any(
+                isinstance(op, (ast.Lt, ast.LtE, ast.Gt, ast.GtE))
+                for op in n.ops):
+            return True
+    return False
+
+
+def lint_while_source(src: str, path: str) -> list[LintFinding]:
+    findings: list[LintFinding] = []
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [LintFinding("while-ceiling", path, e.lineno or 0,
+                            f"unparseable: {e.msg}")]
+    defs: dict[str, list[ast.FunctionDef]] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(n.name, []).append(n)
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        fname = None
+        if isinstance(n.func, ast.Attribute):
+            fname = n.func.attr
+        elif isinstance(n.func, ast.Name):
+            fname = n.func.id
+        if fname != "while_loop":
+            continue
+        cond = None
+        if n.args:
+            cond = n.args[0]
+        else:
+            for kw in n.keywords:
+                if kw.arg == "cond_fun":
+                    cond = kw.value
+        ok = False
+        if isinstance(cond, ast.Lambda):
+            ok = _has_ordered_compare(cond.body)
+        elif isinstance(cond, ast.Name) and cond.id in defs:
+            # All same-named defs must carry a compare (shadowed
+            # helpers must not vouch for each other).
+            ok = all(_has_ordered_compare(d) for d in defs[cond.id])
+        if ok or _waived(lines, n.lineno, "unbounded"):
+            continue
+        findings.append(LintFinding(
+            "while-ceiling", path, n.lineno,
+            "lax.while_loop without an iteration ceiling (no ordered "
+            "comparison in its cond — the round-5 orbit class); add "
+            "an in-carry counter bound or '# lint: unbounded-ok' "
+            "with the termination argument"))
+    return findings
+
+
+# --- env-doc drift ----------------------------------------------------------
+
+
+def _env_tokens(text: str):
+    return {t for t in ENV_RE.findall(text) if not t.endswith("_")}
+
+
+def lint_env_doc(root: str) -> list[LintFinding]:
+    doc_path = os.path.join(root, "doc", "env.md")
+    try:
+        with open(doc_path) as fh:
+            doc_tokens = _env_tokens(fh.read())
+    except OSError:
+        return [LintFinding("env-doc", "doc/env.md", 0,
+                            "doc/env.md missing (the every-knob table, "
+                            "CLAUDE.md)")]
+    code_where: dict[str, tuple[str, int]] = {}
+    files = _py_files(root, "jepsen_tpu", "jepsen_tpu/lin",
+                      "jepsen_tpu/txn", "jepsen_tpu/obs",
+                      "jepsen_tpu/service", "jepsen_tpu/stream",
+                      "jepsen_tpu/suites", "jepsen_tpu/analysis",
+                      "jepsen_tpu/models", "jepsen_tpu/checker",
+                      "jepsen_tpu/control", "tests")
+    for extra in ("bench.py", "__graft_entry__.py", "Makefile"):
+        p = os.path.join(root, extra)
+        if os.path.exists(p):
+            files.append(p)
+    for path in files:
+        try:
+            with open(path) as fh:
+                text = fh.read()
+        except OSError:
+            continue
+        for i, ln in enumerate(text.splitlines(), 1):
+            for t in _env_tokens(ln):
+                code_where.setdefault(t, (_rel(root, path), i))
+    findings = []
+    for t in sorted(set(code_where) - doc_tokens):
+        p, ln = code_where[t]
+        findings.append(LintFinding(
+            "env-doc", p, ln,
+            f"{t} referenced in code but not tabled in doc/env.md "
+            f"(the every-knob rule, CLAUDE.md)"))
+    for t in sorted(doc_tokens - set(code_where)):
+        findings.append(LintFinding(
+            "env-doc", "doc/env.md", 0,
+            f"{t} tabled in doc/env.md but referenced nowhere in "
+            f"code (stale row)"))
+    return findings
+
+
+# --- wire-fail --------------------------------------------------------------
+
+
+def _is_read_guard(test: ast.AST) -> bool:
+    """``op.f == "read"``-shaped test (possibly inside or/and)."""
+    for n in ast.walk(test):
+        if isinstance(n, ast.Compare) and isinstance(n.ops[0], ast.Eq):
+            vals = [n.left] + list(n.comparators)
+            has_f = any(isinstance(v, ast.Attribute) and v.attr == "f"
+                        for v in vals)
+            has_read = any(isinstance(v, ast.Constant)
+                           and v.value == "read" for v in vals)
+            if has_f and has_read:
+                return True
+    return False
+
+
+def lint_wire_source(src: str, path: str) -> list[LintFinding]:
+    findings: list[LintFinding] = []
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [LintFinding("wire-fail", path, e.lineno or 0,
+                            f"unparseable: {e.msg}")]
+    for handler in (n for n in ast.walk(tree)
+                    if isinstance(n, ast.ExceptHandler)):
+        for call in (n for n in ast.walk(handler)
+                     if isinstance(n, ast.Call)):
+            for kw in call.keywords:
+                if kw.arg != "type":
+                    continue
+                v = kw.value
+                bad = None
+                if isinstance(v, ast.Constant) and v.value == "fail":
+                    bad = 'completes type="fail" inside an except ' \
+                          "handler"
+                elif isinstance(v, ast.IfExp):
+                    body_fail = isinstance(v.body, ast.Constant) \
+                        and v.body.value == "fail"
+                    orelse_fail = isinstance(v.orelse, ast.Constant) \
+                        and v.orelse.value == "fail"
+                    if orelse_fail:
+                        bad = 'conditional completion falls back to ' \
+                              '"fail" inside an except handler'
+                    elif body_fail and not _is_read_guard(v.test):
+                        bad = '"fail" branch of an except-handler ' \
+                              "completion is not read-guarded"
+                if bad is None:
+                    continue
+                if _waived(lines, call.lineno, "fail") \
+                        or _waived(lines, kw.value.lineno, "fail"):
+                    continue
+                findings.append(LintFinding(
+                    "wire-fail", path, call.lineno,
+                    f"{bad}: an op that may have APPLIED must "
+                    f"complete :info, never :fail (checker "
+                    f"soundness). Guard with op.f == \"read\", "
+                    f"complete :info, or waiver '# lint: fail-ok' "
+                    f"with the definite-rejection argument"))
+    return findings
+
+
+# --- pallas-const -----------------------------------------------------------
+
+
+def lint_pallas_source(src: str, path: str) -> list[LintFinding]:
+    findings: list[LintFinding] = []
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return findings
+    imports_pallas = any(
+        (isinstance(n, ast.ImportFrom)
+         and ("pallas" in (n.module or "")
+              or any("pallas" in a.name for a in n.names)))
+        or (isinstance(n, ast.Import)
+            and any("pallas" in a.name for a in n.names))
+        for n in ast.walk(tree))
+    if not imports_pallas:
+        return findings
+    for stmt in tree.body:
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = stmt.value
+        if value is None:
+            continue
+        jnp_use = next(
+            (n for n in ast.walk(value)
+             if isinstance(n, ast.Attribute)
+             and isinstance(n.value, ast.Name)
+             and n.value.id == "jnp"), None)
+        if jnp_use is None or _waived(lines, stmt.lineno, "jnp-const"):
+            continue
+        findings.append(LintFinding(
+            "pallas-const", path, stmt.lineno,
+            "module-level jnp constant in a Pallas kernel module: "
+            "Mosaic rejects captured jnp consts (round-3 lore) — use "
+            "Python ints/tuples and build arrays inside the kernel"))
+    return findings
+
+
+# --- quick-compiles ---------------------------------------------------------
+
+
+def _marker_attrs(tree: ast.AST) -> set[str]:
+    """Names used as pytest marker attributes anywhere in the file
+    (``pytest.mark.quick``, ``pytest.mark.compiles(...)``, ...)."""
+    out = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Attribute) \
+                and isinstance(n.value, ast.Attribute) \
+                and n.value.attr == "mark":
+            out.add(n.attr)
+    return out
+
+
+def _imported_modules(tree: ast.AST) -> set[str]:
+    mods = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Import):
+            mods.update(a.name for a in n.names)
+        elif isinstance(n, ast.ImportFrom) and n.module:
+            mods.add(n.module)
+            mods.update(f"{n.module}.{a.name}" for a in n.names)
+    return mods
+
+
+def lint_quick_source(src: str, path: str) -> list[LintFinding]:
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []
+    markers = _marker_attrs(tree)
+    if "quick" not in markers or "compiles" in markers:
+        return []
+    lines = src.splitlines()
+    mods = _imported_modules(tree)
+    hits = sorted(m for m in mods if m in COMPILE_TRIGGER_MODULES)
+    if not hits:
+        return []
+    if any("lint: compiles-ok" in ln for ln in lines):
+        return []
+    return [LintFinding(
+        "quick-compiles", path, 1,
+        f"quick-marked test file imports compile-triggering "
+        f"module(s) {', '.join(hits)} but carries no 'compiles' "
+        f"marker: mark the compiling tests @pytest.mark.compiles (the "
+        f"conftest no-compile enforcement's exemption) or waiver "
+        f"'# lint: compiles-ok' if nothing in the file ever "
+        f"dispatches them")]
+
+
+# --- driver -----------------------------------------------------------------
+
+
+def lint_repo(root: str | None = None) -> list[LintFinding]:
+    """Run every rule over the checkout; findings sorted by path."""
+    root = root or repo_root()
+    findings: list[LintFinding] = []
+
+    for path in _py_files(root, "jepsen_tpu/lin", "jepsen_tpu/txn"):
+        with open(path) as fh:
+            src = fh.read()
+        findings.extend(lint_while_source(src, _rel(root, path)))
+
+    findings.extend(lint_env_doc(root))
+
+    for path in _py_files(root, "jepsen_tpu/suites"):
+        if "wire" not in os.path.basename(path):
+            continue
+        with open(path) as fh:
+            src = fh.read()
+        findings.extend(lint_wire_source(src, _rel(root, path)))
+
+    for path in _py_files(root, "jepsen_tpu", "jepsen_tpu/lin",
+                          "jepsen_tpu/txn", "jepsen_tpu/models"):
+        with open(path) as fh:
+            src = fh.read()
+        findings.extend(lint_pallas_source(src, _rel(root, path)))
+
+    for path in _py_files(root, "tests"):
+        if not os.path.basename(path).startswith("test_"):
+            continue
+        with open(path) as fh:
+            src = fh.read()
+        findings.extend(lint_quick_source(src, _rel(root, path)))
+
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def render(findings: list[LintFinding]) -> str:
+    if not findings:
+        return "lint: clean (0 findings)"
+    by_rule: dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    head = ", ".join(f"{r}={n}" for r, n in sorted(by_rule.items()))
+    return "\n".join([f"lint: {len(findings)} finding(s) ({head})"]
+                     + [str(f) for f in findings])

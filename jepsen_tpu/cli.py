@@ -396,10 +396,25 @@ def quarantine_cmd() -> dict:
             if not shapes:
                 print(f"quarantine ledger empty ({path})")
                 return EXIT_OK
-            for k in sorted(shapes):
-                e = shapes[k]
+            # Crash evidence (fault/wedge — routes future runs) prints
+            # apart from the static gate's PREDICTIONS (reason=static:
+            # observability; routing-inert once the gate is off).
+            crash = {k: e for k, e in shapes.items()
+                     if e.get("reason") != "static" or e.get("faulted")}
+            static = {k: e for k, e in shapes.items()
+                      if k not in crash}
+            for k in sorted(crash):
+                e = crash[k]
                 print(f"{k}  reason={e.get('reason')} "
                       f"count={e.get('count')} last={e.get('last')}")
+            if static:
+                print(f"static (gate-predicted, JEPSEN_TPU_STATIC_GATE"
+                      f" — not crash evidence): {len(static)} shape(s)")
+                for k in sorted(static):
+                    e = static[k]
+                    print(f"  {k}  count={e.get('count')} "
+                          f"last={e.get('last')} "
+                          f"detail={e.get('detail', '')[:80]}")
             return EXIT_OK
         if opts.action == "clear":
             n = supervise.clear_ledger(keys=opts.shape, path=path)
@@ -439,6 +454,45 @@ def quarantine_cmd() -> dict:
                 "shapes that faulted/wedged the TPU runtime "
                 "(.jax_cache/quarantine.json; doc/env.md "
                 "JEPSEN_TPU_QUARANTINE)."}
+
+
+@command
+def lint_cmd() -> dict:
+    """Run the repo contract linter (jepsen_tpu.analysis.lint): the
+    CLAUDE.md architecture invariants — iteration ceilings, env-knob
+    doc drift, the wire suites' :info-never-:fail rule, Pallas
+    module-constant hygiene, quick-tier compile markers — as a
+    zero-findings gate (``make lint``; doc/analysis.md)."""
+
+    def build_parser(p: argparse.ArgumentParser):
+        p.add_argument("--root", help="checkout root to lint "
+                                      "(default: this package's "
+                                      "checkout)")
+        p.add_argument("--json", action="store_true",
+                       help="findings as JSON records")
+
+    def run_cmd(opts: argparse.Namespace) -> int:
+        import json
+
+        from jepsen_tpu.analysis import lint as lint_mod
+
+        findings = lint_mod.lint_repo(opts.root)
+        if opts.json:
+            print(json.dumps([vars(f) for f in findings], indent=1))
+        else:
+            print(lint_mod.render(findings))
+        return EXIT_OK if not findings else EXIT_INVALID
+
+    return {"name": "lint", "parser": build_parser, "run": run_cmd,
+            "help": "run the repo contract linter (zero findings = "
+                    "clean)",
+            "description":
+                "Static repo contracts (doc/analysis.md): "
+                "lax.while_loop iteration ceilings in lin/+txn/, "
+                "JEPSEN_TPU_* <-> doc/env.md drift both ways, wire "
+                "suites' :info-never-:fail exception rule, no "
+                "module-level jnp constants in Pallas modules, "
+                "quick-tier compiles markers. Exit 1 on findings."}
 
 
 @command

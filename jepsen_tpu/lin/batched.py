@@ -147,15 +147,27 @@ def _try_dense_batch(packed: dict) -> dict | Decline:
         slot_v[i, :R, :W] = p.slot_v
 
     step_fn = packed[ks[0]].kernel.step
-    F, r_done, dead = jax.vmap(
+    F, r_done, dead, trunc = jax.vmap(
         lambda f, n, nid, rs, ac, sf, sv: dense._dense_chunk(
             f, n, nid, rs, ac, sf, sv, w=w, ns=ns, step_fn=step_fn))(
         jnp.asarray(F0), jnp.asarray(n_rows), jnp.asarray(nil_ids),
         jnp.asarray(ret_slot), jnp.asarray(active), jnp.asarray(slot_f),
         jnp.asarray(slot_v))
 
-    return _result_rows(packed, ks, np.asarray(dead), np.asarray(r_done),
-                        "tpu-dense-batch")
+    results = _result_rows(packed, ks, np.asarray(dead),
+                           np.asarray(r_done), "tpu-dense-batch")
+    # A key whose closure hit the pass ceiling with changes pending
+    # (provably unreachable for the monotone dense closure) must
+    # answer an honest unknown, never a verdict off an incomplete
+    # frontier (the round-5 invariant; dense.check_packed's twin).
+    for i, k in enumerate(ks):
+        if bool(np.asarray(trunc)[i]):
+            results[k] = {"valid?": "unknown",
+                          "analyzer": "tpu-dense-batch",
+                          "overflow": "budget",
+                          "error": "dense closure pass ceiling hit "
+                                   "with changes pending"}
+    return results
 
 
 def _pad_to(p: PackedHistory, r_pad: int, w_pad: int, nw: int):

@@ -750,10 +750,19 @@ def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v, pure, pred_bit,
             max_tier=max_tier, cand_max=cand_max)
     C, W = active.shape
     nw = bits.shape[1]
+    # Closure-iteration ceiling (post-round-5 invariant: every closure
+    # loop converts a would-be nontermination into an honest overflow).
+    # This band's closure is monotone — no content-sensitive dominance
+    # prune, candidates include the current frontier — so convergence
+    # takes O(W) passes and the ceiling can never bind on a healthy
+    # program; exhaustion with changes pending flags OVERFLOW, which
+    # escalates/routes exactly like a capacity overflow (sound: the
+    # frontier restarts from the row entry on the next rung).
+    it_max = 4 * W + 16
 
     def closure_cond(c):
-        _, _, _, changed, ovf = c
-        return changed & ~ovf
+        _, _, _, changed, ovf, it = c
+        return changed & ~ovf & (it < it_max)
 
     def row_body(carry):
         r, bits, state, count, dead, ovf = carry
@@ -764,14 +773,16 @@ def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v, pure, pred_bit,
         pred_row = pred_bit[r]                         # [W, NW]
 
         def closure_body(c):
-            bits_in, state, count, _, ovf = c
+            bits_in, state, count, _, ovf, it = c
             b2, s2, n2, changed, o2 = _closure_pass_mw(
                 bits_in, state, count, act, f_row, v_row, pure_row,
                 pred_row, cap=cap, W=W, nw=nw, step_fn=step_fn)
-            return (b2, s2, n2, changed, ovf | o2)
+            o3 = ovf | o2 | ((it + 1 >= it_max) & changed)
+            return (b2, s2, n2, changed, o3, it + 1)
 
-        init = (bits, state, count, jnp.bool_(True), ovf)
-        bits, state, count, _, ovf = lax.while_loop(
+        init = (bits, state, count, jnp.bool_(True), ovf,
+                jnp.int32(0))
+        bits, state, count, _, ovf, _ = lax.while_loop(
             closure_cond, closure_body, init)
 
         bits, state, count, dead = _filter_pass_mw(
@@ -1434,14 +1445,18 @@ def _spike_rows(p, r0, bits, state, count, *, tables_h, caps, dropback,
         while True:
             util.progress_tick()
 
-            def _mini(bits=bits, state=state, count=count, lvl=lvl):
-                out = _search_chunk(
+            def _mini_prog(bits=bits, state=state, count=count,
+                           lvl=lvl):
+                return _search_chunk(
                     jnp.int32(m_n), *sp_tables, bits, state, count,
                     sp_exp, cap=caps[lvl], step_fn=step_fn,
                     state_bits=state_bits, nil_id=nil_id,
                     read_value_match=read_value_match,
                     use_psort=use_psort, row_tiers=False, key_hi=key_hi,
                     crash_dom=crash_dom, cand_max=cand_max)
+
+            def _mini():
+                out = _mini_prog()
                 return out, bool(out[5])
 
             spike_key = supervise.shape_key(
@@ -1449,7 +1464,8 @@ def _spike_rows(p, r0, bits, state, count, *, tables_h, caps, dropback,
                 window=p.window,
                 kernel=p.kernel.name if p.kernel else "generic")
             outcome, val = supervise.run_guarded("spike", spike_key,
-                                                 _mini, stats=stats)
+                                                 _mini, stats=stats,
+                                                 traceable=_mini_prog)
             if outcome != "ok":
                 return (bits, state, int(count), r, False,
                         "wedged" if outcome == "wedge" else "fault",
@@ -1925,7 +1941,8 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
     for k in ("rows", "dispatches", "passes", "wasted_passes",
               "sticky_hits", "sticky_misses", "multi_rows",
               "multi_dispatches", "multi_trips", "watchdog_trips",
-              "faults", "quarantine_skips", "cpu_rows"):
+              "faults", "quarantine_skips", "static_skips",
+              "cpu_rows"):
         stats.setdefault(k, 0)
     stats.setdefault("cap_seconds", {})
 
@@ -2016,20 +2033,23 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
             util.progress_tick()
             t0 = _time.monotonic()
 
-            def _wave(lo=lo, hi=hi, count=count):
-                lo2, hi2, flags = _host_closure_fixpoint_rows(
+            def _wave_prog(lo=lo, hi=hi, count=count):
+                return _host_closure_fixpoint_rows(
                     lo, hi, count, acts, v_rows, pure_rows, exp_rs,
                     rets, jnp.int32(kn), cap=cap, W=W, b=b,
                     nil_id=nil_id, step_fn=step_fn, use_psort=use_psort,
                     crash_dom=crash_dom, key_hi=key_hi, it_max=it_max,
                     K=K)
+
+            def _wave():
+                lo2, hi2, flags = _wave_prog()
                 return lo2, hi2, np.asarray(flags)
 
             # The K-row fixpoint legitimately runs minutes in one
             # dispatch: 3x the base watchdog deadline.
             outcome, val = supervise.run_guarded(
                 "host-wave", skey("host-wave", cap, kn), _wave,
-                scale=3.0, stats=stats)
+                scale=3.0, stats=stats, traceable=_wave_prog)
             tripped = None if outcome == "ok" else outcome
             if tripped is None:
                 lo2, hi2, flags = val
@@ -2126,20 +2146,24 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
             if run_fused:
                 t0 = _time.monotonic()
 
-                def _fixpoint(lo=lo, hi=hi, count=count):
-                    l2, h2, flags = _host_closure_fixpoint(
+                def _fixpoint_prog(lo=lo, hi=hi, count=count):
+                    return _host_closure_fixpoint(
                         lo, hi, count, act, v_row, pure_row, exp_r,
                         ret, cap=cap, W=W, b=b, nil_id=nil_id,
                         step_fn=step_fn, use_psort=use_psort,
                         crash_dom=crash_dom, key_hi=key_hi,
                         it_max=it_max)
+
+                def _fixpoint():
+                    l2, h2, flags = _fixpoint_prog()
                     return l2, h2, np.asarray(flags)
 
                 # One fused fixpoint legitimately runs minutes:
                 # 3x the base watchdog deadline.
                 outcome, val = supervise.run_guarded(
                     "host-fixpoint", skey("host-fixpoint", cap),
-                    _fixpoint, scale=3.0, stats=stats)
+                    _fixpoint, scale=3.0, stats=stats,
+                    traceable=_fixpoint_prog)
                 if outcome != "ok":
                     # Wedged/faulted fused program: this row falls to
                     # the unfused per-pass rung at the same capacity,
@@ -2179,18 +2203,21 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
                 while True:
                     t0 = _time.monotonic()
 
-                    def _pass(lo=lo, hi=hi, count=count):
-                        l2, h2, c2, flags = _host_closure_pass(
+                    def _pass_prog(lo=lo, hi=hi, count=count):
+                        return _host_closure_pass(
                             lo, hi, count, act, v_row, pure_row,
                             exp_r, cap=cap, W=W, b=b,
                             nil_id=nil_id, step_fn=step_fn,
                             use_psort=use_psort,
                             crash_dom=crash_dom)
+
+                    def _pass():
+                        l2, h2, c2, flags = _pass_prog()
                         return l2, h2, c2, np.asarray(flags)
 
                     outcome, val = supervise.run_guarded(
                         "host-pass", skey("host-pass", cap), _pass,
-                        stats=stats)
+                        stats=stats, traceable=_pass_prog)
                     if outcome != "ok":
                         # Wedged/faulted per-pass program: last rung —
                         # the CPU oracle owns this row.
@@ -2612,8 +2639,8 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                         "multi_rows": 0, "multi_dispatches": 0,
                         "multi_trips": 0, "watchdog_trips": 0,
                         "faults": 0, "quarantine_skips": 0,
-                        "cpu_rows": 0, "cap_seconds": {},
-                        "wasted_seconds": {}}
+                        "static_skips": 0, "cpu_rows": 0,
+                        "cap_seconds": {}, "wasted_seconds": {}}
     # Flight recorder: host-stats becomes a live named view of the obs
     # registry (one snapshot codec for every stats dict), and the run
     # gauges/sparkline behind web.py /run start here.
@@ -2748,7 +2775,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     def _with_stats(out: dict) -> dict:
         if host_stats["episodes"] or host_stats["watchdog_trips"] \
                 or host_stats["faults"] or host_stats["quarantine_skips"] \
-                or host_stats["cpu_rows"]:
+                or host_stats["static_skips"] or host_stats["cpu_rows"]:
             out["host-stats"] = util.round_stats(host_stats)
         if resumed_from is not None:
             out["resumed-from-row"] = resumed_from
@@ -2926,7 +2953,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                      "error": "cancelled"})
             entry = (bits, state, count, level, base)
 
-            def _fast_batch(entry=entry):
+            def _fast_batch_prog(entry=entry):
                 bits, state, count, level, base = entry
                 flags = []
                 while base < p.R and len(flags) < sync_chunks:
@@ -2945,9 +2972,12 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                                             c2)))
                     bits, state, count = b2, s2, c2
                     base += n
+                return bits, state, count, base, jnp.stack(flags)
+
+            def _fast_batch():
+                bits, state, count, base, flags = _fast_batch_prog()
                 # ONE transfer per batch
-                return bits, state, count, base, np.asarray(
-                    jnp.stack(flags))
+                return bits, state, count, base, np.asarray(flags)
 
             batch_key = supervise.shape_key(
                 "chunk-batch", rows=chunk, cap=cap_schedule[level],
@@ -2962,7 +2992,8 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             # never escapes as a raw exception.
             outcome, val = supervise.run_guarded(
                 "chunk-batch", batch_key, _fast_batch,
-                scale=sync_chunks, stats=host_stats)
+                scale=sync_chunks, stats=host_stats,
+                traceable=_fast_batch_prog)
             if outcome == "wedge":
                 return _with_stats(
                     {"valid?": "unknown", "analyzer": "tpu-bfs",
@@ -3010,9 +3041,9 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
         while True:
             util.progress_tick()
 
-            def _chunk(bits=bits, state=state, count=count,
-                       level=level):
-                out = _search_chunk(
+            def _chunk_prog(bits=bits, state=state, count=count,
+                            level=level):
+                return _search_chunk(
                     jnp.int32(n), *tables, bits, state, count, exp_c,
                     cap=cap_schedule[level], step_fn=step_fn,
                     state_bits=state_bits, nil_id=nil_id,
@@ -3020,13 +3051,17 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                     use_psort=use_psort, key_hi=key_hi,
                     crash_dom=crash_dom, max_tier=max_tier,
                     cand_max=cand_max)
+
+            def _chunk():
+                out = _chunk_prog()
                 return out, bool(out[5])
 
             chunk_key = supervise.shape_key(
                 "chunk", rows=chunk, cap=cap_schedule[level],
                 window=p.window, kernel=kname)
             outcome, val = supervise.run_guarded(
-                "chunk", chunk_key, _chunk, stats=host_stats)
+                "chunk", chunk_key, _chunk, stats=host_stats,
+                traceable=_chunk_prog)
             if outcome == "wedge":
                 return _with_stats(
                     {"valid?": "unknown", "analyzer": "tpu-bfs",

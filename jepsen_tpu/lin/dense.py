@@ -119,8 +119,13 @@ def _dense_chunk(F, n_rows, nil_id, ret_slot, active, slot_f, slot_v,
 
     F: u32[2**w]; ret_slot: i32[CH]; active: bool[CH,w];
     slot_f: i32[CH,w]; slot_v: i32[CH,w,VW]. Rows past n_rows ignored.
-    Returns (F, rows_done, dead) — dead means the frontier emptied while
-    filtering row rows_done-1, i.e. the history is not linearizable.
+    Returns (F, rows_done, dead, trunc) — dead means the frontier
+    emptied while filtering row rows_done-1, i.e. the history is not
+    linearizable; trunc means a closure hit the w+2 pass ceiling with
+    changes still pending (provably impossible for this monotone
+    closure — the honest-overflow channel the round-5 invariant
+    demands, so a hypothetical non-monotone edit can never ship a
+    silently truncated frontier as a verdict).
     """
     n_words = 1 << w
     iota = lax.iota(jnp.uint32, n_words)
@@ -129,7 +134,7 @@ def _dense_chunk(F, n_rows, nil_id, ret_slot, active, slot_f, slot_v,
                                ns=ns, step_fn=step_fn)
 
     def row_body(carry):
-        r, F, dead = carry
+        r, F, dead, trunc = carry
         ok_r = ok[r]                                          # [w, ns]
         to_r = to[r]                                          # [w, ns]
 
@@ -155,14 +160,22 @@ def _dense_chunk(F, n_rows, nil_id, ret_slot, active, slot_f, slot_v,
             return F
 
         def closure_body(c):
-            F, _ = c
-            return closure_pass(F), F
+            F, _, it = c
+            return closure_pass(F), F, it + 1
 
         # Do-while to fixpoint: the candidate pool includes the current
         # frontier (OR-accumulation), so the set is monotone and the loop
-        # terminates in at most W+1 passes.
-        F, _ = lax.while_loop(lambda c: jnp.any(c[0] != c[1]),
-                              closure_body, closure_body((F, F)))
+        # terminates in at most W+1 passes. The w+2 pass ceiling can
+        # therefore never bind — it exists for the post-round-5
+        # every-loop-carries-a-ceiling invariant (analysis/jaxpr_lint's
+        # unbounded-while rule); exiting at the ceiling with changes
+        # still pending flags ``trunc``, an HONEST overflow a caller
+        # must turn into an unknown verdict, never a silently
+        # incomplete frontier.
+        F, F_prev, _ = lax.while_loop(
+            lambda c: jnp.any(c[0] != c[1]) & (c[2] < w + 2),
+            closure_body, closure_body((F, F, jnp.int32(0))))
+        trunc = trunc | jnp.any(F != F_prev)
 
         # Return filter: the returner's linearization point must precede
         # its return; then recycle its slot bit. Rows without bit s wrap to
@@ -171,15 +184,16 @@ def _dense_chunk(F, n_rows, nil_id, ret_slot, active, slot_f, slot_v,
         keep = jnp.where((iota >> s.astype(jnp.uint32)) & 1 == 1,
                          F, jnp.uint32(0))
         F = jnp.roll(keep, -(jnp.int32(1) << s))
-        return r + 1, F, ~jnp.any(F != 0)
+        return r + 1, F, ~jnp.any(F != 0), trunc
 
     def row_cond(carry):
-        r, _, dead = carry
-        return (r < n_rows) & ~dead
+        r, _, dead, trunc = carry
+        return (r < n_rows) & ~dead & ~trunc
 
-    r, F, dead = lax.while_loop(
-        row_cond, row_body, (jnp.int32(0), F, jnp.bool_(False)))
-    return F, r, dead
+    r, F, dead, trunc = lax.while_loop(
+        row_cond, row_body,
+        (jnp.int32(0), F, jnp.bool_(False), jnp.bool_(False)))
+    return F, r, dead, trunc
 
 
 def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
@@ -306,8 +320,11 @@ def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
             F, r_done, dead = dp.pallas_chunk(
                 F, jnp.int32(n), masks, jnp.asarray(sl(ret_slot_h)),
                 w=w_cur, ns=ns, chunk=n_pad, interpret=interpret)
+            # The pallas closure runs to true fixpoint (its waived
+            # unbounded loop) — no truncation channel to consult.
+            trunc = jnp.bool_(False)
         else:
-            F, r_done, dead = _dense_chunk(
+            F, r_done, dead, trunc = _dense_chunk(
                 F, jnp.int32(n), jnp.int32(nil_id),
                 jnp.asarray(_chunk_slice(ret_slot_h, base, chunk)),
                 jnp.asarray(pad_w(_chunk_slice(active_h, base, chunk),
@@ -318,11 +335,26 @@ def check_packed(p: PackedHistory, chunk: int = CHUNK, cancel=None,
                                   w_cur)),
                 w=w_cur, ns=ns, step_fn=step_fn)
         util.progress_tick()   # liveness: one tick per decided chunk
-        dead_b = bool(dead)    # forces the dispatch; time it honestly
+        # ONE blocking transfer carries both flags (the per-chunk
+        # fetch budget this engine's cost model is built on).
+        flags = np.asarray(jnp.stack([dead, trunc]))
+        dead_b, trunc_b = bool(flags[0]), bool(flags[1])
         obs_trace.complete("dispatch", _d0, _monotonic() - _d0,
                            site="dense-pallas" if use_pallas
                            else "dense-chunk", rows=int(n),
                            outcome="ok")
+        if trunc_b:
+            # The closure ceiling fired with changes pending: the
+            # frontier is incomplete, so neither a dead nor a live
+            # result is trustworthy — honest unknown (round-5
+            # invariant; provably unreachable for the monotone
+            # closure).
+            return {"valid?": "unknown", "analyzer": "tpu-dense",
+                    "backend": "pallas" if use_pallas else "xla",
+                    "overflow": "budget",
+                    "error": f"dense closure pass ceiling hit with "
+                             f"changes pending near row {base} "
+                             f"(non-monotone closure edit?)"}
         if dead_b:
             r = base + int(r_done) - 1
             ret = p.ops[int(p.ret_op[r])]

@@ -198,6 +198,11 @@ def _search_sharded(ret_slot, active, slot_f, slot_v, pure, pred_mask,
                 return (b2, s2, n2, tot2, changed, ovf | o2)
 
             init = (bits, state, count, total, jnp.bool_(True), ovf)
+            # lint: unbounded-ok — monotone closure fixpoint (no
+            # content-sensitive dominance prune on the mesh path;
+            # candidates include the current frontier) so it
+            # terminates in O(W) passes; an in-carry ceiling rides
+            # with the crash-dom mesh work (ROADMAP mesh item).
             bits, state, count, total, _, ovf = lax.while_loop(
                 closure_cond, closure_body, init)
 
@@ -283,6 +288,8 @@ def _search_sharded_keys(ret_slot, active, slot_f, slot_v, pure, pred_mask,
                 return (k2, n2, tot2, changed, ovf | o2)
 
             init = (keys, count, total, jnp.bool_(True), ovf)
+            # lint: unbounded-ok — monotone closure fixpoint (same
+            # termination argument as the multiword body above).
             keys, count, total, _, ovf = lax.while_loop(
                 closure_cond, closure_body, init)
 
@@ -530,12 +537,16 @@ def _run_packed_chunks(p, mesh, axis, tables_h, cap_schedule, *, b,
         while True:
             util.progress_tick()   # liveness: one tick per chunk dispatch
 
-            def _mesh_chunk(keys=keys, counts=counts, level=level):
-                out = _search_sharded_keys(
+            def _mesh_chunk_prog(keys=keys, counts=counts,
+                                 level=level):
+                return _search_sharded_keys(
                     *tbl, keys, counts, jnp.int32(n),
                     cap_local=cap_schedule[level], step_fn=step_fn,
                     mesh=mesh, b=b, nil_id=nil_id,
                     read_value_match=read_value_match, axis=axis)
+
+            def _mesh_chunk():
+                out = _mesh_chunk_prog()
                 return out, bool(out[4])
 
             mesh_key = supervise.shape_key(
@@ -543,7 +554,8 @@ def _run_packed_chunks(p, mesh, axis, tables_h, cap_schedule, *, b,
                 cap=cap_schedule[level], window=p.window,
                 kernel=p.kernel.name)
             outcome, val = supervise.run_guarded(
-                "mesh-chunk", mesh_key, _mesh_chunk, stats=sup_stats)
+                "mesh-chunk", mesh_key, _mesh_chunk, stats=sup_stats,
+                traceable=_mesh_chunk_prog)
             if outcome == "wedge":
                 return {"valid?": "unknown",
                         "analyzer": "tpu-bfs-sharded",

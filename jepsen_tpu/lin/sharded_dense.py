@@ -135,6 +135,9 @@ def _chunk_sharded(F_local, n_rows, nil_id, ret_slot, active, slot_f,
                     jnp.any(F2 != F).astype(jnp.int32), axis) > 0
                 return F2, changed
 
+            # lint: unbounded-ok — monotone OR-accumulated bitmap
+            # closure (dense.py's termination argument: <= w+1 passes
+            # globally, psum'd convergence).
             F, _ = lax.while_loop(lambda c: c[1], closure_body,
                                   closure_body((F, jnp.bool_(True))))
 

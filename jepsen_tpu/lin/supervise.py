@@ -42,7 +42,10 @@ down into the library, per DISPATCH:
 Env knobs (all tabled in doc/env.md): JEPSEN_TPU_SUPERVISE,
 JEPSEN_TPU_DISPATCH_DEADLINE_S, JEPSEN_TPU_DISPATCH_RETRIES,
 JEPSEN_TPU_QUARANTINE, JEPSEN_TPU_CKPT, JEPSEN_TPU_CKPT_EVERY_S,
-JEPSEN_TPU_WEDGE (test hook), JEPSEN_TPU_CPU_ROW_MAX.
+JEPSEN_TPU_WEDGE (test hook), JEPSEN_TPU_CPU_ROW_MAX. The predictive
+twin of the ledger — the pre-dispatch STATIC GATE over traced jaxprs
+(JEPSEN_TPU_STATIC_GATE, doc/analysis.md) — hooks in via
+:func:`run_guarded`'s ``traceable`` parameter.
 """
 
 from __future__ import annotations
@@ -280,14 +283,34 @@ def call(site: str, thunk: Callable, *, scale: float = 1.0,
 
 def run_guarded(site: str, key: str, thunk: Callable, *,
                 scale: float = 1.0, stats: dict | None = None,
-                retries: int | None = None):
+                retries: int | None = None,
+                traceable: Callable | None = None):
     """:func:`call` + the fault taxonomy + ledger recording, in one
     place (the seven engine call sites differ only in their fallback
     ACTION). Returns ``(outcome, value)``: ``("ok", result)``,
     ``("wedge", WedgedDispatch)`` — budget exhausted, shape recorded —
     or ``("fault", exc)`` — the dispatch raised RuntimeError/OSError
     (dead worker, XLA runtime error), event noted in ``stats`` and
-    shape recorded. Other exceptions (programming errors) propagate."""
+    shape recorded. Other exceptions (programming errors) propagate.
+
+    ``traceable`` is the pure-jax half of the thunk (same program, no
+    host fetches): when given, the STATIC GATE
+    (:mod:`jepsen_tpu.analysis.gate`, ``JEPSEN_TPU_STATIC_GATE``)
+    traces it against the fault-lore jaxpr rules before dispatch;
+    under ``route`` a flagged program at a fallback-owning site
+    returns ``("static", StaticallyFlagged)`` with ZERO device
+    dispatches — the predictive twin of the quarantine check the host
+    sites already do. The gate must never take a run down: any
+    analysis error means "proceed"."""
+    if traceable is not None:
+        try:
+            from jepsen_tpu.analysis import gate as _gate
+
+            flagged = _gate.consider(site, key, traceable, stats=stats)
+        except Exception:  # noqa: BLE001 - the gate observes; it must
+            flagged = None  # never fail a healthy dispatch
+        if flagged is not None:
+            return "static", flagged
     try:
         return "ok", call(site, thunk, scale=scale, stats=stats,
                           retries=retries, shape=key)
@@ -376,6 +399,12 @@ def quarantined(key: str, path: str | None = None) -> dict | None:
     e = load_ledger(path).get(key)
     if e is None:
         return None
+    # STATIC entries (the analysis gate's predictions) are
+    # observability, not quarantine: the gate re-derives its routing
+    # per process, so turning it off must make the entry routing-inert
+    # — only a real crash (faulted) hardens it.
+    if e.get("reason") == "static" and not e.get("faulted"):
+        return None
     # Wedge tolerance applies only to shapes that have NEVER faulted:
     # a fault is hard evidence regardless of later wedges.
     if e.get("reason") == "wedge" and not e.get("faulted") \
@@ -395,7 +424,9 @@ def _write_ledger(path: str, shapes: dict) -> None:
 def record_fault(key: str, reason: str, detail: str = "",
                  path: str | None = None) -> dict | None:
     """Record (or re-record) a faulting shape. ``reason`` is "fault"
-    (the dispatch raised) or "wedge" (watchdog deadline). Last-writer-
+    (the dispatch raised), "wedge" (watchdog deadline), or "static"
+    (the analysis gate predicted a fault and routed — never a crash
+    record, so it does not harden the entry). Last-writer-
     wins read-modify-write with an atomic replace — monitoring-grade
     concurrency, matching the bench's subprocess fan-out."""
     path = path or ledger_path()
@@ -405,12 +436,24 @@ def record_fault(key: str, reason: str, detail: str = "",
     now_s = time.time()
     now = time.strftime(_TS_FMT, time.gmtime(now_s))
     e = dict(shapes.get(key) or {"first": now, "count": 0})
+    if reason == "static" and e.get("reason") in ("wedge", "fault"):
+        # A prediction must never overwrite CRASH evidence: a
+        # wedge-streak (or faulted) entry keeps its reason and
+        # streak so quarantined() still honors it with the gate off;
+        # the prediction rides alongside as its own counter.
+        e["static_count"] = e.get("static_count", 0) + 1
+        e["last_static"] = now
+        shapes[key] = e
+        _write_ledger(path, shapes)
+        _obs_metrics.REGISTRY.event("quarantine", key=key,
+                                    reason=reason)
+        return e
     if reason == "wedge":
         prev = _parse_ts(e.get("last"))
         within = prev is not None and now_s - prev <= \
             WEDGE_STREAK_WINDOW_S
         e["streak"] = (e.get("streak", 0) + 1) if within else 1
-    else:
+    elif reason == "fault":
         e["faulted"] = True
     e.update(reason=reason, count=e.get("count", 0) + 1, last=now)
     if detail:

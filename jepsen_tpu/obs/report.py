@@ -83,6 +83,8 @@ def attribution(events: list[dict]) -> dict:
     compile_n = 0
     wasted_s = 0.0
     wasted_n = 0
+    static_n = 0
+    static_saved_s = 0.0
     for ev in events:
         name = ev.get("name")
         dur = float(ev.get("dur") or 0.0)
@@ -120,6 +122,12 @@ def attribution(events: list[dict]) -> dict:
                                               "wave-trip"):
             wasted_s += float(args.get("seconds") or 0.0)
             wasted_n += 1
+        elif ev.get("ph") == "i" and name == "static-skip":
+            # A dispatch the static gate routed away before it touched
+            # the chip (analysis/gate): counted next to the wasted
+            # rungs it is the predictive inverse of.
+            static_n += 1
+            static_saved_s += float(args.get("est_saved_s") or 0.0)
         elif ev.get("ph") == "X" and name:
             o = other.setdefault(str(name), {"n": 0, "wall_s": 0.0})
             o["n"] += 1
@@ -131,6 +139,8 @@ def attribution(events: list[dict]) -> dict:
         "dispatch_s": round(dispatch_s, 3), "dispatches": dispatch_n,
         "compile_s": round(compile_s, 3), "compiles": compile_n,
         "wasted_s": round(wasted_s, 3), "wasted_events": wasted_n,
+        "static_skips": static_n,
+        "static_saved_est_s": round(static_saved_s, 3),
         "tunnel_overhead_est_s": round(tunnel_est, 3),
         "device_busy_est_s": round(max(0.0, dispatch_s - tunnel_est),
                                    3),
@@ -196,6 +206,11 @@ def render(agg: dict) -> str:
     lines.append(f"wasted (failed rungs)   "
                  f"{agg.get('wasted_s', 0.0):10.2f} s "
                  f"({agg.get('wasted_events', 0)} events)")
+    if agg.get("static_skips"):
+        lines.append(f"avoided (static gate)   "
+                     f"{agg.get('static_saved_est_s', 0.0):10.2f} s "
+                     f"est ({agg['static_skips']} dispatch(es) routed "
+                     f"pre-chip)")
     if agg.get("other"):
         lines.append("")
         lines.append("other spans: " + ", ".join(
@@ -209,9 +224,9 @@ def summary(events: list[dict]) -> dict:
     numbers without the per-site table bulk."""
     agg = attribution(events)
     keys = ("events", "total_s", "dispatch_s", "dispatches",
-            "compile_s", "compiles", "wasted_s",
-            "tunnel_overhead_est_s", "device_busy_est_s",
-            "host_other_s")
+            "compile_s", "compiles", "wasted_s", "static_skips",
+            "static_saved_est_s", "tunnel_overhead_est_s",
+            "device_busy_est_s", "host_other_s")
     out = {k: agg[k] for k in keys if k in agg}
     out["site_s"] = {k: v["wall_s"]
                      for k, v in (agg.get("sites") or {}).items()}

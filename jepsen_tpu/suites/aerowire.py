@@ -283,6 +283,10 @@ class RegisterClient(client_ns.Client):
                     return op.replace(type="ok")
                 except AerospikeError as e:
                     if e.generation_mismatch:
+                        # lint: fail-ok — a generation-mismatch result
+                        # code is a parsed server response: the
+                        # conditional put definitely did not apply
+                        # (socket losses raise OSError, handled below).
                         return op.replace(type="fail")
                     raise
         except AerospikeError as e:

@@ -249,9 +249,17 @@ class LockClient(client_ns.Client):
                     self.conn.unlock(self.NAME)
                     return op.replace(type="ok")
                 except HazelcastError:
+                    # lint: fail-ok — HazelcastError is raised only on
+                    # a parsed ERROR_RESPONSE frame (the server
+                    # processed the unlock and rejected it: not held);
+                    # transport losses raise OSError, handled below.
                     return op.replace(type="fail", error="not held")
         except HazelcastError as e:
-            # A server-side rejection is definite: the op did not happen.
+            # A server-side rejection is definite: the op did not
+            # happen — HazelcastError only ever comes from a parsed
+            # ERROR_RESPONSE frame (_call), never from socket loss
+            # (OSError/ConnectionError, handled below as :info).
+            # lint: fail-ok
             return op.replace(type="fail", error=str(e))
         except (OSError, ConnectionError) as e:
             return op.replace(type="info", error=repr(e))

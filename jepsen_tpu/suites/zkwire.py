@@ -210,6 +210,10 @@ class ZkRegisterClient(client_ns.Client):
                     return op.replace(type="ok")
                 except ZkError as e:
                     if e.bad_version:
+                        # lint: fail-ok — a BADVERSION reply is a
+                        # parsed server response: the CAS was
+                        # definitely rejected (transport losses raise
+                        # OSError/WireIndeterminate, handled below).
                         return op.replace(type="fail")
                     raise
         except ZkError as e:

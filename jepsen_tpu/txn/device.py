@@ -228,15 +228,18 @@ def _tier_device_sccs(pt: PackedTxnHistory, tier: str, stats: dict,
     live_d = jnp.asarray(np.arange(e_pad) < e)
     it_max = it_max_for(n)
 
+    def prog():
+        return _scc_program(src_d, dst_d, live_d, jnp.int32(n),
+                            jnp.int32(it_max), n_pad=n_pad)
+
     def thunk():
-        out = _scc_program(src_d, dst_d, live_d, jnp.int32(n),
-                           jnp.int32(it_max), n_pad=n_pad)
         # Materialize on host inside the supervised worker: a wedged
         # fetch is a wedged dispatch, not a wedged caller.
-        return tuple(np.asarray(x) for x in out)
+        return tuple(np.asarray(x) for x in prog())
 
     outcome, value = supervise.run_guarded("txn-scc", key, thunk,
-                                           stats=stats)
+                                           stats=stats,
+                                           traceable=prog)
     util.progress_tick()
     if outcome != "ok":
         raise _TierFallback(tier, outcome, key)
