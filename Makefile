@@ -1,7 +1,8 @@
 # jepsen_tpu development targets.
 
 .PHONY: test test-quick integration integration-local bench \
-	probe-config5 serve-smoke txn-smoke trace-smoke stream-smoke lint
+	probe-config5 serve-smoke txn-smoke trace-smoke stream-smoke \
+	fleet-smoke lint
 
 # Unit + parity suite on the virtual 8-device CPU mesh (no cluster).
 # Hardware note: ~8 min on a 4-core box; the compile-heavy lin parity
@@ -98,6 +99,22 @@ STREAM_SMOKE_TIMEOUT ?= 600
 stream-smoke:
 	timeout -k 15 $(STREAM_SMOKE_TIMEOUT) \
 		python -m jepsen_tpu.stream.smoke
+
+# Fleet smoke (doc/service.md § Fleet): the checker daemon tested like
+# a database — (1) an in-process chaos run (seeded wedge + fault +
+# worker-kill schedule under concurrent clients, soundness audited
+# against the CPU oracle: verdicts match or degrade to honest unknown,
+# never flip/duplicate/vanish), then (2) a REAL SIGKILL of a daemon
+# subprocess with journaled requests and an open stream session in
+# flight, restart on the same journal, replay-and-re-decide parity,
+# and stream-session re-adoption off its per-sid checkpoint. Chip-free
+# (forced CPU mesh in both legs); artifacts under
+# .jax_cache/fleet_smoke/. Run it after touching jepsen_tpu/service/,
+# the journal, or the worker pool.
+FLEET_SMOKE_TIMEOUT ?= 900
+fleet-smoke:
+	timeout -k 15 $(FLEET_SMOKE_TIMEOUT) \
+		python -m jepsen_tpu.service.chaos
 
 # Flight-recorder smoke (doc/observability.md): chip-free CPU-mesh
 # check of a small sparse-engine history with JEPSEN_TPU_TRACE=1 —

@@ -339,13 +339,24 @@ def _probe_service_c30():
     (submit -> verdict on the wire) with p50/p99 latency plus the
     daemon's own stats (batch occupancy proves the bins actually
     batched; the XLA compile meter shows the warm-worker
-    amortization)."""
+    amortization). Fleet-shaped since ISSUE 13: a 2-worker pool with
+    the request journal on and ONE injected worker-kill mid-run, so
+    the artifact also prices the recovery path (worker_deaths /
+    requeues / journal depth ride in ``service_stats``) — a kill must
+    cost one requeue, never a verdict."""
+    import os as _os
     import threading as _th
 
     from jepsen_tpu.lin import synth
     from jepsen_tpu.service.daemon import CheckerService
     from jepsen_tpu.service.protocol import CheckerClient
 
+    journal = _os.path.join(".jax_cache", "bench_service.journal.jsonl")
+    for f in (journal, journal + ".index.json"):
+        try:
+            _os.remove(f)
+        except OSError:
+            pass
     n_clients = 8
     jobs: list[tuple[str, object]] = []
     # Majority bin: one traced shape (same concurrency/length bucket).
@@ -364,7 +375,8 @@ def _probe_service_c30():
             120, concurrency=24, seed=100 + i, value_range=5)))
     n_jobs = len(jobs)
 
-    svc = CheckerService("127.0.0.1", 0, flush_ms_=40).start()
+    svc = CheckerService("127.0.0.1", 0, flush_ms_=40, workers=2,
+                         journal=journal).start()
     lock = _th.Lock()
     latencies: list[float] = []
     verdicts = {"true": 0, "false": 0, "unknown": 0}
@@ -396,6 +408,9 @@ def _probe_service_c30():
         warm.submit(model_name, h)
     warm.close()
 
+    # One worker dies mid-run (the chaos hook): its in-hand bin must
+    # requeue once and decide — visible in the stats, not the verdicts.
+    svc.inject_worker_kill(1)
     t0 = time.time()
     threads = [_th.Thread(target=client_loop) for _ in range(n_clients)]
     for t in threads:
@@ -430,6 +445,13 @@ def _probe_service_c30():
     if not occ or occ <= 1:
         out["note"] = ("batch occupancy <= 1: bins did not share "
                        "device programs (vacuous batching)")
+    st = stats or {}
+    out["fleet"] = {k: st.get(k) for k in
+                    ("workers", "worker_deaths", "worker_respawns",
+                     "requeues", "journal_depth", "journal_settles")}
+    if st.get("journal_depth"):
+        out["note_fleet"] = (f"journal depth {st['journal_depth']} "
+                             f"after drain: requests LOST (bug)")
     return out
 
 

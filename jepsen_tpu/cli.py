@@ -228,6 +228,13 @@ def serve_checker_cmd() -> dict:
         p.add_argument("--stats-file", default=None,
                        help="stats snapshot path (web.py /service "
                             "page); default JEPSEN_TPU_SERVICE_STATS")
+        p.add_argument("--workers", type=int, default=None,
+                       help="decide worker pool size; default "
+                            "JEPSEN_TPU_SERVICE_WORKERS (1)")
+        p.add_argument("--journal", default=None,
+                       help="durable request journal path (restart "
+                            "replays unsettled entries); default "
+                            "JEPSEN_TPU_SERVICE_JOURNAL (off)")
 
     def run_cmd(opts: argparse.Namespace) -> int:
         from jepsen_tpu.service.daemon import serve_checker
@@ -237,7 +244,9 @@ def serve_checker_cmd() -> dict:
                       flush_ms_=opts.flush_ms,
                       max_batch_=opts.max_batch,
                       deadline_s=opts.deadline,
-                      stats_file=opts.stats_file)
+                      stats_file=opts.stats_file,
+                      workers=opts.workers,
+                      journal=opts.journal)
         return EXIT_OK
 
     return {"name": "serve-checker", "parser": build_parser,
@@ -312,6 +321,99 @@ def service_stats_cmd() -> dict:
                 "depths, batch occupancy, verdict counters, latency "
                 "p50/p99, XLA compile meter. Tries the live daemon "
                 "first, then the stats snapshot file."}
+
+
+@command
+def journal_cmd() -> dict:
+    """Manage the checker daemon's durable request journal
+    (jepsen_tpu.service.journal, doc/service.md § Fleet): ``list``
+    prints its state (unsettled admits are requests a crash left
+    undecided), ``replay`` re-decides them offline through the
+    daemon's own replay machinery, ``gc`` compacts settled pairs."""
+
+    def build_parser(p: argparse.ArgumentParser):
+        p.add_argument("action", choices=["list", "replay", "gc"])
+        p.add_argument("--journal", help="journal path (default: "
+                                         "JEPSEN_TPU_SERVICE_JOURNAL)")
+        p.add_argument("--json", action="store_true",
+                       help="machine-readable output")
+        p.add_argument("--timeout", type=float, default=600.0,
+                       help="replay: max seconds to wait for every "
+                            "unsettled entry to re-decide")
+
+    def run_cmd(opts: argparse.Namespace) -> int:
+        import json
+        import time
+
+        from jepsen_tpu.service import journal as journal_mod
+
+        path = opts.journal or journal_mod.journal_path()
+        if not path:
+            print("no journal: set JEPSEN_TPU_SERVICE_JOURNAL or "
+                  "pass --journal", file=sys.stderr)
+            return EXIT_ERROR
+        j = journal_mod.Journal(path)
+        if opts.action == "list":
+            stats = j.stats()
+            recs = journal_mod.describe(j.load())
+            if opts.json:
+                print(json.dumps({"stats": stats, "records": recs},
+                                 indent=1, default=str))
+                return EXIT_OK
+            print(f"journal {path}: depth {stats['journal_depth']} "
+                  f"unsettled, {stats['journal_settles']} settled, "
+                  f"{stats['journal_streams_open']} stream session(s) "
+                  f"open, {stats['journal_torn_lines']} torn line(s)")
+            for r in recs:
+                if r["kind"] in ("check", "txn-check"):
+                    mark = "settled" if r["settled"] else "UNSETTLED"
+                    print(f"  seq {r['seq']}  {r['kind']}  "
+                          f"{r['model']}  {r['ops']} ops  fp "
+                          f"{r['fp']}  {mark}")
+                else:
+                    print(f"  seq {r['seq']}  {r['kind']}  "
+                          f"{r.get('sid')}  "
+                          f"{r.get('model', r.get('how', ''))}")
+            return EXIT_OK
+        if opts.action == "gc":
+            r = j.gc()
+            print(f"journal gc: kept {r['kept']} record(s), dropped "
+                  f"{r['dropped']}")
+            return EXIT_OK
+        # replay: the daemon's OWN replay machinery (an ephemeral-port
+        # CheckerService that never advertises), so offline re-decides
+        # cannot drift from restart re-decides.
+        depth = j.depth()
+        j.close()
+        if depth == 0:
+            print("journal replay: nothing unsettled")
+            return EXIT_OK
+        from jepsen_tpu.service.daemon import CheckerService
+
+        svc = CheckerService("127.0.0.1", 0, journal=path).start()
+        deadline = time.time() + opts.timeout
+        try:
+            while time.time() < deadline \
+                    and svc._journal.depth() > 0:
+                time.sleep(0.2)
+            left = svc._journal.depth()
+        finally:
+            svc.stop()
+        print(f"journal replay: re-decided {depth - left} of {depth} "
+              f"unsettled entr{'y' if depth == 1 else 'ies'}"
+              + (f" ({left} still unsettled)" if left else ""))
+        return EXIT_OK if left == 0 else EXIT_UNKNOWN
+
+    return {"name": "journal", "parser": build_parser, "run": run_cmd,
+            "help": "list/replay/gc the checker daemon's request "
+                    "journal",
+            "description":
+                "Durable request journal (doc/service.md § Fleet): "
+                "every admitted check is journaled before it is "
+                "decided; a restarted daemon (or `journal replay`) "
+                "re-decides the unsettled tail. `gc` compacts "
+                "settled pairs; JEPSEN_TPU_SERVICE_JOURNAL names the "
+                "file."}
 
 
 @command

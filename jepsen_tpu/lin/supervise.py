@@ -42,7 +42,8 @@ down into the library, per DISPATCH:
 Env knobs (all tabled in doc/env.md): JEPSEN_TPU_SUPERVISE,
 JEPSEN_TPU_DISPATCH_DEADLINE_S, JEPSEN_TPU_DISPATCH_RETRIES,
 JEPSEN_TPU_QUARANTINE, JEPSEN_TPU_CKPT, JEPSEN_TPU_CKPT_EVERY_S,
-JEPSEN_TPU_WEDGE (test hook), JEPSEN_TPU_CPU_ROW_MAX. The predictive
+JEPSEN_TPU_WEDGE / JEPSEN_TPU_FAULT (test hooks),
+JEPSEN_TPU_CPU_ROW_MAX. The predictive
 twin of the ledger — the pre-dispatch STATIC GATE over traced jaxprs
 (JEPSEN_TPU_STATIC_GATE, doc/analysis.md) — hooks in via
 :func:`run_guarded`'s ``traceable`` parameter.
@@ -124,6 +125,15 @@ _injected: dict[str, list] = {}   # site -> [count, deadline_s | None]
 _env_wedge_loaded: str | None = None
 _lock = threading.Lock()
 
+# JEPSEN_TPU_FAULT="site:count" (or inject_fault()) makes the next
+# ``count`` supervised calls at ``site`` RAISE a RuntimeError before
+# the real thunk runs — the fault twin of the wedge hook, so the chaos
+# nemesis (service/chaos.py) and tests exercise the fault taxonomy
+# (requeue, ledger recording, honest `overflow: fault`) without a real
+# dead worker. The real thunk runs on the next attempt/retry.
+_injected_faults: dict[str, int] = {}
+_env_fault_loaded: str | None = None
+
 
 def inject_wedge(site: str, n: int = 1,
                  deadline_s: float | None = None) -> None:
@@ -132,6 +142,43 @@ def inject_wedge(site: str, n: int = 1,
         e[0] += n
         if deadline_s is not None:
             e[1] = deadline_s
+
+
+def inject_fault(site: str, n: int = 1) -> None:
+    with _lock:
+        _injected_faults[site] = _injected_faults.get(site, 0) + n
+
+
+def reset_injections() -> None:
+    """Tests/chaos only: disarm every pending wedge/fault injection —
+    a chaos schedule's leftover armed events must not leak into the
+    next run (or the next test) in the same process."""
+    global _env_wedge_loaded, _env_fault_loaded
+    with _lock:
+        _injected.clear()
+        _injected_faults.clear()
+        _env_wedge_loaded = os.environ.get("JEPSEN_TPU_WEDGE") or None
+        _env_fault_loaded = os.environ.get("JEPSEN_TPU_FAULT") or None
+
+
+def _consume_fault_injection(site: str) -> bool:
+    global _env_fault_loaded
+    with _lock:
+        env = os.environ.get("JEPSEN_TPU_FAULT", "")
+        if env and env != _env_fault_loaded:
+            _env_fault_loaded = env
+            for part in env.split(","):
+                bits = part.split(":")
+                if bits and bits[0]:
+                    s = bits[0].strip()
+                    _injected_faults[s] = _injected_faults.get(s, 0) + (
+                        int(bits[1]) if len(bits) > 1 and bits[1]
+                        else 1)
+        n = _injected_faults.get(site, 0)
+        if n > 0:
+            _injected_faults[site] = n - 1
+            return True
+        return False
 
 
 def _consume_injection(site: str):
@@ -227,6 +274,15 @@ def call(site: str, thunk: Callable, *, scale: float = 1.0,
                            else retry_budget()) + 1)
         wedges = 0
         for _attempt in range(attempts):
+            if _consume_fault_injection(site):
+                # Injected FAULT (chaos/test hook): raise like a dead
+                # worker would, without touching the device — the call
+                # site's fault taxonomy (ledger, requeue, honest
+                # unknown) takes it from here.
+                sp.note(outcome="fault", error="InjectedFault")
+                raise RuntimeError(
+                    f"injected fault at site {site!r} "
+                    f"(JEPSEN_TPU_FAULT/inject_fault test hook)")
             fn = thunk
             join_deadline = deadline
             inj = _consume_injection(site)

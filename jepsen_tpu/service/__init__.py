@@ -22,13 +22,25 @@ concurrent test runs. This package amortizes the warm chip across them:
   ``lin.device_check_packed`` under the supervision ladder with a
   per-request deadline. A worker fault costs the in-flight bin (one
   requeue, then an honest failure), never the daemon.
+- :mod:`jepsen_tpu.service.journal` — the durable request journal
+  (``JEPSEN_TPU_SERVICE_JOURNAL``): every admitted check / txn-check /
+  stream event is JSONL-journaled before it is decided, answers append
+  settle records, and a restarted daemon replays the unsettled tail —
+  a daemon crash costs in-flight LATENCY, never in-flight work.
+- :mod:`jepsen_tpu.service.chaos` — the checker-side chaos nemesis:
+  drives a daemon through seeded wedge/fault/worker-kill/restart
+  schedules under concurrent clients and asserts the soundness
+  invariant (verdicts match the CPU oracle or degrade to honest
+  ``unknown`` — never flip, never duplicate, never vanish).
+  ``make fleet-smoke`` is its SIGKILL-restart proof.
 - :mod:`jepsen_tpu.service.smoke` — the ``make serve-smoke`` start →
   submit → assert → shutdown proof on the forced-CPU mesh.
 
 Entry points: ``python -m jepsen_tpu.cli serve-checker`` and
-``cli.py service-stats``; all ``JEPSEN_TPU_SERVICE_*`` knobs are
-tabled in ``doc/env.md``; protocol and capacity planning in
-``doc/service.md``.
+``cli.py service-stats`` / ``cli.py journal``; all
+``JEPSEN_TPU_SERVICE_*`` knobs are tabled in ``doc/env.md``; protocol,
+capacity planning, and the fleet semantics in ``doc/service.md``.
 """
 
+from jepsen_tpu.service.journal import Journal  # noqa: F401
 from jepsen_tpu.service.protocol import CheckerClient  # noqa: F401

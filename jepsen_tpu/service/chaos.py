@@ -1,0 +1,441 @@
+"""Checker-side chaos nemesis: the daemon tested like a database.
+
+Everything in this repo rests on one invariant: the checker never
+reports a verdict it did not compute. The daemon is now itself a
+long-lived networked system (queues, worker threads, a journal), so it
+gets the Jepsen treatment the databases under test get — a nemesis
+injecting the daemon's own failure modes while concurrent clients
+submit seeded histories, followed by a soundness audit:
+
+    every answer (wire reply AND journal settle record) either equals
+    the CPU oracle's verdict or is an honest ``valid? "unknown"`` —
+    verdicts never FLIP, requests never VANISH (every journaled admit
+    settles), and no request is answered twice on the wire (the
+    ``done`` guard; one reply per request by protocol).
+
+Injected event kinds (all deterministic test hooks, doc/env.md):
+
+- ``wedge-check`` / ``wedge-batch`` — ``supervise.inject_wedge`` at
+  the service sites (the ``JEPSEN_TPU_WEDGE`` machinery): the next
+  dispatch blocks past its (injection-scoped) deadline.
+- ``fault-check`` / ``fault-batch`` — ``supervise.inject_fault``
+  (``JEPSEN_TPU_FAULT``): the next dispatch raises like a dead worker.
+- ``worker-kill`` — ``CheckerService.inject_worker_kill``
+  (``JEPSEN_TPU_SERVICE_KILL``): a worker THREAD dies with its batch
+  in hand; the supervisor must requeue-once and respawn.
+
+:func:`run_chaos` drives an in-process daemon (real engines, real
+sockets) through a seeded schedule — the chaos-gate tests run it at
+1-worker and 4-worker pools. :func:`main` (``make fleet-smoke``) adds
+the one failure mode an in-process harness cannot fake honestly: a
+real ``SIGKILL`` of a daemon subprocess mid-flight (including an open
+stream session), a restart on the same journal, and the
+replay-and-re-decide audit. Chip-free: both legs force the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+EVENT_KINDS = ("wedge-check", "wedge-batch", "fault-check",
+               "fault-batch", "worker-kill")
+
+
+def seeded_jobs(n: int, seed: int) -> list[tuple[str, list]]:
+    """``n`` mixed histories: mostly one cas-register shape bin (so
+    bins actually batch), some corrupted (definite invalid — verdict
+    flips would be visible), a mutex minority (second kernel bin)."""
+    from jepsen_tpu.lin import synth
+
+    rng = random.Random(seed)
+    jobs: list[tuple[str, list]] = []
+    for i in range(n):
+        r = rng.random()
+        if r < 0.15:
+            jobs.append(("mutex", list(synth.generate_mutex_history(
+                20, concurrency=3, seed=seed * 1000 + i))))
+        elif r < 0.45:
+            jobs.append(("cas-register", list(synth.corrupt_history(
+                synth.generate_register_history(
+                    24, concurrency=3, seed=seed * 1000 + i,
+                    value_range=3), seed=i))))
+        else:
+            jobs.append(("cas-register",
+                         list(synth.generate_register_history(
+                             24, concurrency=3, seed=seed * 1000 + i,
+                             value_range=3, crash_prob=0.02,
+                             max_crashes=2))))
+    return jobs
+
+
+def oracle_verdicts(jobs) -> list:
+    from jepsen_tpu import models as m
+    from jepsen_tpu.lin import cpu, prepare
+
+    factories = {"cas-register": m.cas_register, "mutex": m.mutex,
+                 "register": m.register}
+    return [cpu.check_packed(prepare.prepare(factories[name](),
+                                             list(h)))["valid?"]
+            for name, h in jobs]
+
+
+def audit_journal(journal_path: str, oracle_by_fp: dict | None = None):
+    """Audit a (finished) journal for the soundness invariant:
+    returns ``(flips, unsettled, settles)`` — flips are settle records
+    whose definite verdict contradicts the oracle; unsettled are admit
+    records that VANISHED (no settle). ``oracle_by_fp`` maps history
+    fingerprints to expected verdicts (omit to audit settlement
+    only)."""
+    from jepsen_tpu.service import journal as journal_mod
+
+    j = journal_mod.Journal(journal_path)
+    flips: list[dict] = []
+    settles = [r for r in j.load() if r.get("kind") == "settle"]
+    if oracle_by_fp:
+        for rec in settles:
+            want = oracle_by_fp.get(rec.get("fp"))
+            got = rec.get("verdict")
+            if want is not None and got in (True, False) \
+                    and got != want:
+                flips.append({"fp": rec.get("fp"), "want": want,
+                              "got": got})
+    return flips, j.unsettled(), settles
+
+
+def run_chaos(*, histories: int = 60, events: int = 20,
+              workers: int = 1, seed: int = 0,
+              journal: str | None = None, clients: int = 4,
+              svc_kw: dict | None = None,
+              event_kinds=EVENT_KINDS) -> dict:
+    """One seeded chaos run against an in-process daemon; returns the
+    audit report (``report["sound"]`` is the gate).
+
+    The quarantine ledger is redirected to a throwaway path for the
+    run: injected faults are FAKE evidence and must never pollute the
+    repo's real fault lore (``.jax_cache/quarantine.json``)."""
+    from jepsen_tpu.lin import prepare, supervise
+    from jepsen_tpu import models as m
+    from jepsen_tpu.service.daemon import CheckerService
+    from jepsen_tpu.service.protocol import CheckerClient
+
+    jobs = seeded_jobs(histories, seed)
+    want = oracle_verdicts(jobs)
+    factories = {"cas-register": m.cas_register, "mutex": m.mutex}
+    oracle_by_fp = {}
+    for (name, h), w in zip(jobs, want):
+        fp = supervise.history_fingerprint(
+            prepare.prepare(factories[name](), list(h)))
+        oracle_by_fp[fp] = w
+
+    q_prev = os.environ.get("JEPSEN_TPU_QUARANTINE")
+    q_tmp = (journal or os.path.join(".jax_cache", "chaos")) \
+        + ".quarantine.json"
+    os.environ["JEPSEN_TPU_QUARANTINE"] = q_tmp
+    rng = random.Random(seed + 1)
+    schedule = [rng.choice(list(event_kinds)) for _ in range(events)]
+    injected: dict[str, int] = {}
+    svc = CheckerService("127.0.0.1", 0, workers=workers,
+                         journal=journal, flush_ms_=10,
+                         **(svc_kw or {})).start()
+    results: dict[int, dict] = {}
+    lock = threading.Lock()
+    it = iter(list(enumerate(jobs)))
+    done = threading.Event()
+
+    def inject(kind: str) -> None:
+        if kind == "wedge-check":
+            supervise.inject_wedge("service-check", 1, deadline_s=0.2)
+        elif kind == "wedge-batch":
+            supervise.inject_wedge("service-batch", 1, deadline_s=0.2)
+        elif kind == "fault-check":
+            supervise.inject_fault("service-check", 1)
+        elif kind == "fault-batch":
+            supervise.inject_fault("service-batch", 1)
+        elif kind == "worker-kill":
+            svc.inject_worker_kill(1)
+        injected[kind] = injected.get(kind, 0) + 1
+
+    def nemesis() -> None:
+        for kind in schedule:
+            if done.wait(rng.uniform(0.02, 0.15)):
+                # Clients finished early: fire the rest back-to-back
+                # so the schedule's event COUNT is honored (they land
+                # on the drain or are consumed by the next run).
+                inject(kind)
+                continue
+            inject(kind)
+
+    def client_loop() -> None:
+        c = CheckerClient("127.0.0.1", svc.port)
+        while True:
+            with lock:
+                nxt = next(it, None)
+            if nxt is None:
+                break
+            i, (name, h) = nxt
+            r = c.submit(name, h, req_id=i)
+            with lock:
+                results[i] = r
+        c.close()
+
+    try:
+        nem = threading.Thread(target=nemesis, daemon=True)
+        nem.start()
+        threads = [threading.Thread(target=client_loop)
+                   for _ in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        done.set()
+        nem.join(10)
+        stats_client = CheckerClient("127.0.0.1", svc.port)
+        stats = stats_client.stats()
+        stats_client.close()
+    finally:
+        done.set()
+        svc.stop()
+        # Leftover armed injections must not leak into the next run.
+        supervise.reset_injections()
+        if q_prev is None:
+            os.environ.pop("JEPSEN_TPU_QUARANTINE", None)
+        else:
+            os.environ["JEPSEN_TPU_QUARANTINE"] = q_prev
+
+    flips = []
+    verdicts = {"match": 0, "unknown": 0, "missing": 0}
+    for i, w in enumerate(want):
+        got = results.get(i, {}).get("valid?")
+        if got == w:
+            verdicts["match"] += 1
+        elif got == "unknown":
+            verdicts["unknown"] += 1
+        elif got is None:
+            verdicts["missing"] += 1
+        else:
+            flips.append({"i": i, "want": w, "got": got})
+    j_flips, j_unsettled, j_settles = ([], [], [])
+    if journal:
+        j_flips, j_unsettled, j_settles = audit_journal(journal,
+                                                        oracle_by_fp)
+    report = {
+        "n": len(jobs), "workers": workers, "seed": seed,
+        "verdicts": verdicts, "flips": flips,
+        "journal_flips": j_flips,
+        "journal_unsettled": len(j_unsettled),
+        "journal_settles": len(j_settles),
+        "injected": injected,
+        "stats": {k: stats.get(k) for k in
+                  ("decided", "requeues", "honest_fails",
+                   "wedged_requests", "worker_deaths", "worker_kills",
+                   "worker_wedges", "worker_respawns",
+                   "watchdog_trips", "faults", "journal_replays",
+                   "journal_depth", "dropped_responses")},
+        # Soundness: no flipped verdict anywhere, every request
+        # answered, every journaled admit settled.
+        "sound": (not flips and not j_flips
+                  and verdicts["missing"] == 0
+                  and (not journal or not j_unsettled)),
+    }
+    return report
+
+
+# --- the fleet smoke (`make fleet-smoke`) ----------------------------------
+
+
+def _force_cpu_env(extra: dict | None = None) -> dict:
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra or {})
+    return env
+
+
+def _spawn_daemon(env: dict) -> tuple[subprocess.Popen, int]:
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu.cli", "serve-checker",
+         "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=env)
+    line = proc.stdout.readline()
+    # "checker daemon on 127.0.0.1:PORT (queue bound ...)"
+    try:
+        port = int(line.split(":")[1].split()[0].strip("()"))
+    except (IndexError, ValueError):
+        proc.kill()
+        raise RuntimeError(f"daemon did not announce a port: {line!r}")
+    return proc, port
+
+
+def main() -> int:
+    # CPU mesh BEFORE any jax backend init (CLAUDE.md: the TPU plugin
+    # force-selects its platform; the smoke must never take the chip).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu import util
+    from jepsen_tpu.lin import cpu, prepare, synth
+    from jepsen_tpu.service.protocol import CheckerClient
+
+    util.enable_compile_cache()
+    base = os.path.join(".jax_cache", "fleet_smoke")
+    os.makedirs(base, exist_ok=True)
+    for f in os.listdir(base):
+        try:
+            os.remove(os.path.join(base, f))
+        except OSError:
+            pass
+    out: dict = {"checks": []}
+    ok = True
+
+    # --- leg 1: in-process chaos (wedge+fault+worker-kill) ------------------
+    report = run_chaos(histories=24, events=10, workers=2, seed=7,
+                       journal=os.path.join(base, "chaos.jsonl"))
+    out["checks"].append({"leg": "chaos", "sound": report["sound"],
+                          "verdicts": report["verdicts"],
+                          "injected": report["injected"],
+                          "stats": report["stats"]})
+    ok = ok and report["sound"]
+
+    # --- leg 2: SIGKILL mid-flight -> restart -> replay -> parity -----------
+    journal = os.path.join(base, "restart.jsonl")
+    stream_ckpt = os.path.join(base, "stream.ckpt")
+    child_env = _force_cpu_env({
+        "JEPSEN_TPU_SERVICE_JOURNAL": journal,
+        "JEPSEN_TPU_SERVICE_WORKERS": "2",
+        "JEPSEN_TPU_STREAM_CKPT": stream_ckpt,
+        "JEPSEN_TPU_SERVICE_STATS": os.path.join(base, "stats.json"),
+        "JEPSEN_TPU_QUARANTINE": os.path.join(base, "quarantine.json"),
+        # Two bin batches wedge IN FLIGHT (long injected deadline,
+        # blocking both workers): the SIGKILL lands while their
+        # requests — and everything queued behind them — are
+        # admitted-but-undecided, so the journal is guaranteed an
+        # unsettled tail to replay.
+        "JEPSEN_TPU_WEDGE": "service-batch:2:120,service-check:2:120",
+    })
+    proc, port = _spawn_daemon(child_env)
+    h = list(synth.generate_register_history(
+        120, concurrency=4, seed=21, value_range=5, crash_prob=0.02,
+        max_crashes=2))
+    want_stream = cpu.check_packed(
+        prepare.prepare(m.cas_register(), list(h)))["valid?"]
+    jobs = seeded_jobs(8, seed=31)
+    want = oracle_verdicts(jobs)
+    from jepsen_tpu.lin import supervise
+    fps = [supervise.history_fingerprint(
+        prepare.prepare({"cas-register": m.cas_register,
+                         "mutex": m.mutex}[name](), list(hh)))
+        for name, hh in jobs]
+    oracle_by_fp = dict(zip(fps, want))
+
+    # One stream session, half-fed FIRST (while the workers are still
+    # free): its frontier must survive the kill via the per-sid
+    # checkpoint + journaled appends.
+    sc = CheckerClient("127.0.0.1", port, timeout=60)
+    sid = sc.stream_open("cas-register")
+    half = len(h) // 2
+    step = max(1, half // 3)
+    appends_before = 0
+    for i in range(0, half, step):
+        st = sc.stream_append(sid, h[i:i + step])
+        if st.get("type") == "stream-state":
+            appends_before += 1
+
+    # Then the check burst: the armed service-batch wedges block both
+    # workers, so these sit admitted-but-undecided for the SIGKILL.
+    def submit(i):
+        c = CheckerClient("127.0.0.1", port, timeout=300)
+        c.submit(jobs[i][0], jobs[i][1], req_id=i)
+        c.close()
+
+    threads = [threading.Thread(target=submit, args=(i,), daemon=True)
+               for i in range(len(jobs))]
+    for t in threads:
+        t.start()
+    # Wait until the journal shows unsettled admits (the two wedged
+    # submits), then SIGKILL mid-flight.
+    from jepsen_tpu.service import journal as journal_mod
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if os.path.exists(journal) \
+                and journal_mod.Journal(journal).depth() >= 2:
+            break
+        time.sleep(0.2)
+    depth_at_kill = journal_mod.Journal(journal).depth()
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait(30)
+    out["checks"].append({"leg": "sigkill",
+                          "unsettled_at_kill": depth_at_kill,
+                          "ok": depth_at_kill >= 2})
+    ok = ok and depth_at_kill >= 2
+
+    # Restart on the same journal, no injections: replay re-decides.
+    child_env2 = {k: v for k, v in child_env.items()
+                  if k != "JEPSEN_TPU_WEDGE"}
+    proc2, port2 = _spawn_daemon(child_env2)
+    try:
+        c2 = CheckerClient("127.0.0.1", port2, timeout=300)
+        deadline = time.time() + 300
+        depth = None
+        while time.time() < deadline:
+            st = c2.stats()
+            depth = st.get("journal_depth")
+            if depth == 0:
+                break
+            time.sleep(0.3)
+        flips, unsettled, settles = audit_journal(journal,
+                                                  oracle_by_fp)
+        rec = {"leg": "replay", "journal_depth": depth,
+               "journal_replays": st.get("journal_replays"),
+               "settles": len(settles), "flips": flips,
+               "unsettled": len(unsettled),
+               "ok": (depth == 0 and not flips and not unsettled
+                      and st.get("journal_replays", 0) >= 2)}
+        out["checks"].append(rec)
+        ok = ok and rec["ok"]
+
+        # Re-adopt the stream session; feed the rest; parity.
+        opened = c2.stream_open("cas-register", session=sid)
+        for i in range(half, len(h), step):
+            c2.stream_append(sid, h[i:i + step])
+        rw = c2.stream_finalize(sid)
+        rec = {"leg": "stream-resume", "want": want_stream,
+               "got": rw.get("valid?"),
+               "replayed_appends": opened.get("replayed_appends"),
+               "resumed_from_row":
+                   (rw.get("stream") or {}).get("resumed_from_row"),
+               "ok": (rw.get("valid?") == want_stream
+                      and opened.get("replayed_appends", 0)
+                      >= appends_before)}
+        out["checks"].append(rec)
+        ok = ok and rec["ok"]
+        c2.shutdown()
+        c2.close()
+    finally:
+        try:
+            proc2.wait(30)
+        except subprocess.TimeoutExpired:
+            proc2.kill()
+    out["ok"] = ok
+    print(json.dumps(out, default=str))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

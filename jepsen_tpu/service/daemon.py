@@ -35,6 +35,31 @@ The quarantine ledger records faulting service shapes under the
 base engine rungs — the in-daemon routing is the requeue policy, and
 the engine-internal sites keep their own ledger routing).
 
+**Fleet grade (doc/service.md § Fleet).** Three hardening axes over
+the same pipeline:
+
+- **Durable request journal** (journal.py,
+  ``JEPSEN_TPU_SERVICE_JOURNAL``): every admitted check / txn-check /
+  stream event appends to a JSONL journal before it is queued; the
+  answer appends a settle record. A restarted daemon replays the
+  unsettled entries and re-decides them automatically
+  (``journal_replays``); a crashed stream session's carried frontier
+  survives via its per-sid ``JEPSEN_TPU_STREAM_CKPT`` checkpoint and
+  is re-adoptable (``stream-open`` with an explicit ``session``).
+- **Crash-recovering worker pool** (``JEPSEN_TPU_SERVICE_WORKERS``,
+  default 1 — the single-chip driver shape is unchanged): N decide
+  workers behind the one admission+binning tier. The scheduler's
+  supervisor tick detects a dead or deadline-wedged worker, requeues
+  its in-hand bin ONCE (the fault-requeue promise promoted from
+  per-batch to per-worker), ledger-records the bin shape, and
+  respawns — the daemon never dies with a worker, and the ``done``
+  guard means it never answers a verdict twice.
+- **Chaos hooks** (chaos.py drives them): ``inject_worker_kill()`` /
+  ``JEPSEN_TPU_SERVICE_KILL`` make a worker thread die with its batch
+  in hand; ``supervise.inject_fault`` / ``JEPSEN_TPU_FAULT`` fault a
+  supervised dispatch; ``crash()`` is the in-process SIGKILL
+  approximation (no drain, no settles) for restart-recovery tests.
+
 Every knob is tabled in doc/env.md (`JEPSEN_TPU_SERVICE_*`); stats are
 served on the wire (``stats`` message / ``cli.py service-stats``) and
 snapshotted to ``JEPSEN_TPU_SERVICE_STATS`` for ``web.py``'s
@@ -43,6 +68,7 @@ snapshotted to ``JEPSEN_TPU_SERVICE_STATS`` for ``web.py``'s
 
 from __future__ import annotations
 
+import hashlib
 import os
 import queue
 import socket
@@ -51,9 +77,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
-from jepsen_tpu import util
+from jepsen_tpu import codec, util
 from jepsen_tpu.obs import metrics as obs_metrics
 from jepsen_tpu.obs import trace as obs_trace
+from jepsen_tpu.service import journal as journal_mod
 from jepsen_tpu.service import protocol
 from jepsen_tpu.suites.common import SocketIO
 
@@ -98,6 +125,29 @@ def stream_session_bound() -> int:
     return util.env_int("JEPSEN_TPU_STREAM_SESSIONS", 4)
 
 
+def worker_count() -> int:
+    """Decide workers (``JEPSEN_TPU_SERVICE_WORKERS``). Default 1 —
+    one thread owning the one device, the single-chip driver shape.
+    CPU-mesh tests and multi-chip hosts raise it; device binding stays
+    per-worker (each worker just runs its dispatches on whatever its
+    thread's jax default device is)."""
+    return util.env_int("JEPSEN_TPU_SERVICE_WORKERS", 1)
+
+
+def worker_deadline_s(deadline_s: float) -> float:
+    """How long a worker may make NO PROGRESS (no request started or
+    answered — the progress clock refreshes per single and per finish,
+    not per work item, since a decline-heavy bin legitimately runs
+    many sequential supervised dispatches) before the supervisor
+    declares it wedged, requeues its pending bin once, and respawns a
+    replacement. Default derives from the per-request deadline: the
+    in-batch supervision (``supervise.call``) already bounds every
+    dispatch, so the worker-level deadline is a backstop strictly
+    wider than it — it fires only for non-dispatch hangs."""
+    env = util.env_float("JEPSEN_TPU_SERVICE_WORKER_DEADLINE_S", 0.0)
+    return env if env > 0 else deadline_s * 2 + 60.0
+
+
 @dataclass(eq=False)
 class Request:
     """One queued check: wire identity + packed shape + reply route.
@@ -116,6 +166,23 @@ class Request:
     attempts: int = 0              # fault requeues consumed
     no_batch: bool = False         # post-fault: keep off the batch path
     done: bool = False             # answered (guards double-finish)
+    kind: str = "check"            # "check" | "txn" (routing in
+    #                                _check_single; txn never bins)
+    txn_kw: dict | None = None     # txn-check params (kind == "txn")
+    journal_seq: int | None = None  # journal admit seq (settle target)
+
+
+@dataclass(eq=False)
+class _WorkerState:
+    """One decide worker: its thread plus the work item IN HAND — what
+    the supervisor requeues if the thread dies or wedges mid-item."""
+
+    wid: int
+    thread: threading.Thread | None = None
+    busy: Any = None               # batch / ("stream", job) in hand
+    busy_since: float = 0.0
+    abandoned: bool = False        # supervisor gave up on it; the
+    #                                thread exits at its next loop top
 
 
 @dataclass(eq=False)
@@ -123,7 +190,11 @@ class StreamSession:
     """One daemon-held streaming session (doc/streaming.md): the
     StreamChecker (carried frontier + incremental packer) plus its
     OWNING connection — a dropped client's sessions are reaped and
-    their slots freed; another connection can never touch them."""
+    their slots freed; another connection can never touch them.
+    ``lock`` serializes this session's checker work across the worker
+    POOL (StreamChecker is not thread-safe; with N>1 workers, or a
+    deadline-expired job still running on an abandoned worker, two
+    jobs for one session could otherwise interleave)."""
 
     sid: str
     model_name: str
@@ -131,6 +202,7 @@ class StreamSession:
     sock: Any
     opened: float = field(default_factory=time.monotonic)
     appends: int = 0
+    lock: threading.Lock = field(default_factory=threading.Lock)
 
 
 def bin_key(packed) -> str:
@@ -155,6 +227,23 @@ def bin_key(packed) -> str:
                                window=w_bucket, kernel=kern, rows=r_pad)
 
 
+def _txn_kw(msg: dict) -> dict:
+    """The txn-check params carried by a wire frame / journal record
+    (everything ``checker.txn_cycles`` takes)."""
+    anomalies = msg.get("anomalies")
+    return {"anomalies": tuple(anomalies) if anomalies else None,
+            "consistency": msg.get("consistency", "serializable"),
+            "realtime": msg.get("realtime"),
+            "algorithm": msg.get("algorithm", "tpu")}
+
+
+def _txn_bin(kw: dict) -> str:
+    """Txn requests never bin (the daemon decides them per-request
+    under the supervised fallthrough — ROADMAP's "txn-check on the
+    same wire" rung); the key exists for stats/ledger attribution."""
+    return f"svc-txn|{kw['algorithm']}|{kw['consistency']}"
+
+
 class CheckerService:
     """The daemon. ``start()`` binds and spawns the pipeline;
     ``serve_forever()`` blocks; ``stop()`` drains and joins.
@@ -169,6 +258,8 @@ class CheckerService:
                  max_batch_: int | None = None,
                  deadline_s: float | None = None,
                  stats_file: str | None = None,
+                 workers: int | None = None,
+                 journal: str | None = None,
                  check_fn: Callable | None = None,
                  batch_fn: Callable | None = None):
         self.host = host
@@ -182,6 +273,11 @@ class CheckerService:
             else request_deadline_s()
         self.stats_file = stats_file if stats_file is not None \
             else stats_path()
+        self.n_workers = max(1, workers if workers is not None
+                             else worker_count())
+        self.worker_deadline = worker_deadline_s(self.deadline_s)
+        self.journal_file = journal if journal is not None \
+            else journal_mod.journal_path()
         self._check_fn = check_fn
         self._batch_fn = batch_fn
 
@@ -201,7 +297,13 @@ class CheckerService:
         self._threads: list[threading.Thread] = []
         self._conns: set = set()
         self._conns_lock = threading.Lock()
-        self._worker_t: threading.Thread | None = None
+        self._workers: list[_WorkerState] = []
+        self._abandoned: list[threading.Thread] = []
+        self._worker_seq = 0
+        self._kill_armed = util.env_int("JEPSEN_TPU_SERVICE_KILL", 0)
+        self._kill_lock = threading.Lock()
+        self._crashed = False
+        self._journal: journal_mod.Journal | None = None
 
         self._streams: dict[str, StreamSession] = {}
         self._streams_lock = threading.Lock()
@@ -263,6 +365,11 @@ class CheckerService:
                     for s in self._streams.values()}
         with self._stats_lock:
             out["in_flight"] = self._inflight
+        out["workers"] = len(self._workers) or self.n_workers
+        out["workers_busy"] = sum(1 for w in self._workers
+                                  if w.busy is not None)
+        if self._journal is not None:
+            out.update(self._journal.stats())
         batches = out.get("batches", 0)
         out["avg_occupancy"] = round(
             out.get("batched_requests", 0) / batches, 2) if batches \
@@ -302,6 +409,8 @@ class CheckerService:
         # registry (doc/observability.md): one snapshot codec across
         # host-stats / mesh-stats / service stats.
         obs_metrics.REGISTRY.view("service", self._stats)
+        if self.journal_file:
+            self._journal = journal_mod.Journal(self.journal_file)
         self._listener = socket.create_server(
             (self.host, self.port), reuse_port=False)
         # Closing a socket does NOT wake a thread blocked in accept()
@@ -309,21 +418,28 @@ class CheckerService:
         # join timeout.
         self._listener.settimeout(0.5)
         self.port = self._listener.getsockname()[1]
-        # Worker FIRST: the scheduler's liveness check dereferences
-        # self._worker_t on its first iteration.
-        self._spawn_worker()
+        # Workers FIRST: the scheduler's supervisor tick dereferences
+        # the pool on its first iteration.
+        self._workers = [self._spawn_worker()
+                         for _ in range(self.n_workers)]
         for name, fn in (("accept", self._accept_loop),
                          ("scheduler", self._scheduler_loop)):
             t = threading.Thread(target=fn, daemon=True,
                                  name=f"svc-{name}")
             t.start()
             self._threads.append(t)
+        # Journal replay LAST: re-decides ride the live pipeline.
+        self._replay_journal()
         return self
 
-    def _spawn_worker(self) -> None:
-        self._worker_t = threading.Thread(
-            target=self._worker_loop, daemon=True, name="svc-worker")
-        self._worker_t.start()
+    def _spawn_worker(self) -> _WorkerState:
+        self._worker_seq += 1
+        st = _WorkerState(wid=self._worker_seq)
+        st.thread = threading.Thread(
+            target=self._worker_loop, args=(st,), daemon=True,
+            name=f"svc-worker-{st.wid}")
+        st.thread.start()
+        return st
 
     def serve_forever(self) -> None:
         while not self._stop.wait(0.5):
@@ -347,11 +463,17 @@ class CheckerService:
                 pass
         for t in self._threads:
             t.join(timeout)
-        # The scheduler flushed every bin before exiting; the sentinel
-        # queues BEHIND them, so the worker drains all pending work.
-        self._work.put(None)
-        if self._worker_t is not None:
-            self._worker_t.join(timeout)
+        # The scheduler flushed every bin before exiting; the
+        # sentinels queue BEHIND them, so the workers drain all
+        # pending work. One sentinel per live worker thread —
+        # including abandoned-but-alive ones, which also consume one.
+        live = [w.thread for w in self._workers
+                if w.thread is not None] \
+            + [t for t in self._abandoned if t.is_alive()]
+        for _ in live:
+            self._work.put(None)
+        for t in live:
+            t.join(timeout)
         with self._conns_lock:
             conns = list(self._conns)
         for c in conns:
@@ -360,7 +482,102 @@ class CheckerService:
             except OSError:
                 pass
         self._write_stats_snapshot(force=True)
+        if self._journal is not None:
+            self._journal.write_index()
+            self._journal.close()
         self._stopped.set()
+
+    def crash(self) -> None:
+        """Chaos/test hook: die like SIGKILL (the in-process
+        approximation restart-recovery tests use). No drain, no
+        further journal settles or wire replies — the journal is left
+        exactly as a process kill leaves it (admits without settles),
+        the listener and every connection drop, and in-flight worker
+        results are suppressed. The object is dead afterwards; start
+        a NEW CheckerService on the same journal to model the
+        restart."""
+        self._crashed = True
+        with self._stop_lock:
+            self._stop.set()
+        if self._journal is not None:
+            self._journal.freeze()   # close() alone would lazily
+            #                          reopen on an in-flight settle
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        # Unblock worker threads so test processes don't accumulate
+        # them (each drops its work at the crashed check in its loop).
+        for _ in range(len(self._workers) + len(self._abandoned)):
+            self._work.put(None)
+        self._stopped.set()
+
+    # --- journal replay -----------------------------------------------------
+
+    def _replay_journal(self) -> None:
+        """Re-decide every unsettled journal entry (restart recovery):
+        each replays through the live pipeline as a normal request
+        whose reply route is the journal settle record alone (the
+        original connection died with the previous process — its
+        client already completed indeterminate, per the wire
+        contract)."""
+        if self._journal is None:
+            return
+        replayed = 0
+        for rec in self._journal.unsettled():
+            seq, fp = rec.get("seq"), rec.get("fp", "")
+            try:
+                req = self._request_from_journal(rec)
+            except Exception as e:  # noqa: BLE001 - a corrupt record
+                # settles honestly instead of wedging the replay
+                self._journal.settle(seq, fp, {
+                    "valid?": "unknown",
+                    "error": f"journal replay failed: {e!r}"})
+                self._bump("journal_replay_errors")
+                continue
+            req.journal_seq = seq
+            with self._stats_lock:
+                self._inflight += 1   # replays bypass the admission
+                #                       bound: they WERE admitted once
+            self._queue.put(req)
+            replayed += 1
+        if replayed:
+            self._journal.replays += replayed
+            self._bump("journal_replays", replayed)
+            obs_metrics.REGISTRY.event("journal-replay", n=replayed)
+
+    def _request_from_journal(self, rec: dict) -> Request:
+        from jepsen_tpu.lin import prepare, supervise
+
+        history = protocol.history_from_wire(rec.get("history") or [])
+        if rec.get("kind") == "txn-check":
+            kw = _txn_kw(rec)
+            return Request(rid=f"journal-{rec.get('seq')}",
+                           model_name="txn", model=None,
+                           history=history, packed=None,
+                           bin=_txn_bin(kw), fingerprint=rec.get("fp"),
+                           respond=lambda msg: None, kind="txn",
+                           txn_kw=kw, no_batch=True)
+        model = protocol.model_by_name(rec.get("model"))
+        try:
+            packed = prepare.prepare(model, history)
+            key = bin_key(packed)
+            fp = supervise.history_fingerprint(packed)
+        except prepare.UnsupportedHistory as e:
+            packed, key = None, f"svc-cpu|{e.kind}"
+            fp = rec.get("fp")
+        return Request(rid=f"journal-{rec.get('seq')}",
+                       model_name=rec.get("model"), model=model,
+                       history=history, packed=packed, bin=key,
+                       fingerprint=fp, respond=lambda msg: None)
 
     # --- admission ----------------------------------------------------------
 
@@ -431,6 +648,8 @@ class CheckerService:
                     break
                 elif mtype == "check":
                     self._admit(msg, respond)
+                elif mtype == "txn-check":
+                    self._admit_txn(msg, respond)
                 elif mtype == "stream-open":
                     self._stream_open(msg, respond, sock)
                 elif mtype == "stream-append":
@@ -478,6 +697,44 @@ class CheckerService:
         req = Request(rid=rid, model_name=msg.get("model"),
                       model=model, history=history, packed=packed,
                       bin=key, fingerprint=fp, respond=respond)
+        self._enqueue_admitted(req, rid, respond, "check",
+                               {"model": msg.get("model"),
+                                "history": msg.get("history") or []})
+
+    def _admit_txn(self, msg: dict, respond: Callable) -> None:
+        """The protocol-v2 ``txn-check`` frame: a list-append txn
+        history decided by ``checker.txn_cycles`` under the existing
+        supervised per-request fallthrough (txn requests never bin —
+        there is no vmapped txn batch program today)."""
+        rid = msg.get("id")
+        self._bump("submitted")
+        self._bump("txn_submitted")
+        try:
+            history = protocol.history_from_wire(
+                msg.get("history") or [])
+            kw = _txn_kw(msg)
+            if kw["algorithm"] not in ("tpu", "cpu"):
+                raise ValueError(
+                    f"unknown txn algorithm {kw['algorithm']!r}")
+        except (ValueError, TypeError, KeyError) as e:
+            self._bump("bad_requests")
+            respond({"type": "error", "id": rid, "error": str(e)})
+            return
+        fp = hashlib.sha256(codec.encode(
+            {"history": msg.get("history") or [],
+             **{k: list(v) if isinstance(v, tuple) else v
+                for k, v in kw.items()}})).hexdigest()
+        req = Request(rid=rid, model_name="txn", model=None,
+                      history=history, packed=None, bin=_txn_bin(kw),
+                      fingerprint=fp, respond=respond, kind="txn",
+                      txn_kw=kw, no_batch=True)
+        self._enqueue_admitted(req, rid, respond, "txn-check",
+                               {"history": msg.get("history") or [],
+                                **{k: list(v) if isinstance(v, tuple)
+                                   else v for k, v in kw.items()}})
+
+    def _enqueue_admitted(self, req: Request, rid, respond: Callable,
+                          journal_kind: str, payload: dict) -> None:
         with self._stats_lock:
             admit = self._inflight < self.bound
             if admit:
@@ -491,19 +748,43 @@ class CheckerService:
                      "error": f"overload: {self.bound} requests in "
                               f"flight (bound)"})
             return
+        # Journal BEFORE queueing: once the request can be decided, a
+        # crash can no longer lose it (the durability ordering the
+        # restart-recovery test rests on).
+        if self._journal is not None and not self._crashed:
+            try:
+                req.journal_seq = self._journal.admit(
+                    journal_kind, req.fingerprint, payload)
+                self._bump("journal_appends")
+            except OSError:
+                self._bump("journal_errors")
         self._queue.put(req)
 
     # --- stream-check sessions (doc/streaming.md) ---------------------------
+
+    def _stream_ckpt_path(self, sid: str) -> str:
+        """Per-sid frontier checkpoint. ``JEPSEN_TPU_STREAM_CKPT`` is
+        the BASE path: each daemon session checkpoints to
+        ``<base>.<sid>.npz`` (sessions must not share one file — the
+        fingerprint gate would reject every resume), so a reaped or
+        crashed session's carried frontier survives into the journal
+        replay: re-adopting the sid re-feeds the journaled appends,
+        and the checkpoint fast-forwards them. Empty string = no
+        checkpointing (the StreamChecker falsy contract)."""
+        base = os.environ.get("JEPSEN_TPU_STREAM_CKPT", "")
+        return f"{base}.{sid}.npz" if base else ""
 
     def _stream_open(self, msg: dict, respond: Callable, sock) -> None:
         from jepsen_tpu.stream import StreamChecker
 
         rid = msg.get("id")
+        want_sid = msg.get("session")   # re-adopt a journaled session
         try:
             model = protocol.model_by_name(msg.get("model"))
         except (ValueError, TypeError) as e:
             respond({"type": "error", "id": rid, "error": str(e)})
             return
+        jrec = None
         with self._streams_lock:
             if len(self._streams) >= self.stream_bound:
                 self._bump("stream_overloads")
@@ -512,18 +793,63 @@ class CheckerService:
                                   f"{self.stream_bound} sessions open "
                                   f"(bound)"})
                 return
-            self._stream_seq += 1
-            sid = f"s{self._stream_seq}-{os.urandom(3).hex()}"
+            if want_sid is not None:
+                # Re-adoption: the sid must be journaled, still open,
+                # same model, and not LIVE (a live session is owned by
+                # its connection — no cross-connection capture).
+                jrec = (self._journal.stream_sessions().get(want_sid)
+                        if self._journal is not None else None)
+                if want_sid in self._streams or jrec is None \
+                        or jrec.get("model") != msg.get("model"):
+                    respond({"type": "error", "id": rid,
+                             "error": "unknown stream session"})
+                    return
+                sid = want_sid
+            else:
+                self._stream_seq += 1
+                sid = f"s{self._stream_seq}-{os.urandom(3).hex()}"
             # min_rows=1: over the wire the CLIENT owns the increment
             # windowing — every append is one increment, so the state
             # reply always reflects the appended ops.
             sess = StreamSession(
                 sid, msg.get("model"),
                 StreamChecker(model, min_rows=1,
+                              checkpoint=self._stream_ckpt_path(sid),
                               view_name=f"stream-{sid}"), sock)
             self._streams[sid] = sess
+        if jrec is not None:
+            # Re-feed the journaled appends on the worker (the
+            # per-sid checkpoint fast-forwards the re-fed prefix, so
+            # this costs host-side packing, not re-checking).
+            def refeed():
+                last = sess.checker.status()
+                for ops in jrec["appends"]:
+                    last = sess.checker.append(
+                        protocol.history_from_wire(ops))
+                return last
+            outcome, r = self._stream_run(sess, refeed)
+            if outcome != "ok":
+                self._drop_stream(sid)
+                respond({"type": "error", "id": rid, "error": r})
+                return
+            self._bump("stream_readopts")
+            respond({"type": "stream-opened", "id": rid,
+                     "session": sid, "resumed": True,
+                     "replayed_appends": len(jrec["appends"]),
+                     **protocol.jsonable(r)})
+            return
+        self._journal_stream("stream-open", sid,
+                             model=msg.get("model"))
         self._bump("stream_opens")
         respond({"type": "stream-opened", "id": rid, "session": sid})
+
+    def _journal_stream(self, kind: str, sid: str, **fields) -> None:
+        if self._journal is None or self._crashed:
+            return
+        try:
+            self._journal.stream_event(kind, sid, **fields)
+        except OSError:
+            self._bump("journal_errors")
 
     def _get_stream(self, msg: dict, sock) -> StreamSession | None:
         with self._streams_lock:
@@ -549,17 +875,22 @@ class CheckerService:
         if dead:
             self._bump("stream_reaped", len(dead))
 
-    def _stream_run(self, fn: Callable):
-        """Run session work on the WORKER thread (it owns the device;
-        stream increments must queue behind batches, not race them),
-        blocking the connection handler until done or deadline.
-        Returns (outcome, value): ("ok", r) | ("error", reason)."""
+    def _stream_run(self, sess: StreamSession, fn: Callable):
+        """Run session work on a WORKER thread (workers own the
+        device; stream increments must queue behind batches, not race
+        them), blocking the connection handler until done or deadline.
+        The session lock serializes the checker across the pool: a
+        job whose reply deadline expired may still be RUNNING on its
+        worker, and the next job for the same session must wait for
+        it, not interleave with it. Returns (outcome, value):
+        ("ok", r) | ("error", reason)."""
         done = threading.Event()
         box: dict = {}
 
         def job():
             try:
-                box["r"] = fn()
+                with sess.lock:
+                    box["r"] = fn()
             except Exception as e:  # noqa: BLE001 - reported, below
                 box["e"] = e
             finally:
@@ -590,7 +921,13 @@ class CheckerService:
             return
         self._bump("stream_appends")
         sess.appends += 1
-        outcome, r = self._stream_run(lambda: sess.checker.append(ops))
+        # Journal BEFORE the increment runs: a crash mid-increment
+        # replays the append into the re-adopted session (the per-sid
+        # checkpoint makes a re-fed settled prefix cheap).
+        self._journal_stream("stream-append", sess.sid,
+                             ops=msg.get("ops") or [])
+        outcome, r = self._stream_run(sess,
+                                      lambda: sess.checker.append(ops))
         if outcome != "ok":
             respond({"type": "error", "session": sess.sid, "error": r})
             return
@@ -604,8 +941,9 @@ class CheckerService:
             respond({"type": "error", "session": msg.get("session"),
                      "error": "unknown stream session"})
             return
-        outcome, r = self._stream_run(sess.checker.finalize)
+        outcome, r = self._stream_run(sess, sess.checker.finalize)
         self._drop_stream(sess.sid)   # slot freed either way
+        self._journal_stream("stream-close", sess.sid, how="finalize")
         self._bump("stream_finalizes")
         if outcome != "ok":
             respond({"type": "error", "session": sess.sid, "error": r})
@@ -621,8 +959,9 @@ class CheckerService:
             return
         # Through the worker like append/finalize: StreamChecker is not
         # thread-safe, and an in-flight increment may be running there.
-        self._stream_run(sess.checker.abort)
+        self._stream_run(sess, sess.checker.abort)
         self._drop_stream(sess.sid)
+        self._journal_stream("stream-close", sess.sid, how="abort")
         self._bump("stream_aborts")
         respond({"type": "ok", "session": sess.sid})
 
@@ -661,16 +1000,15 @@ class CheckerService:
                             oldest.pop(key, None)
             for batch in flush:
                 self._work.put(batch)
-            if not self._worker_t.is_alive() and not stopping:
-                # A worker thread must never die silently (its loop
-                # catches per-batch); if it somehow did, respawn so
-                # queued work is not stranded.
-                self._bump("worker_respawns")
-                self._spawn_worker()
+            if not stopping:
+                self._supervise_workers()
             self._write_stats_snapshot()
+        if self._crashed:
+            return   # SIGKILL semantics: nothing drains, nothing
+            #          settles — the journal replay owns recovery
         # Drain-and-stop: everything still queued flushes to the
-        # worker, THEN the sentinel (stop() enqueues it after joining
-        # this thread).
+        # workers, THEN the sentinels (stop() enqueues them after
+        # joining this thread).
         with self._bins_lock:
             for reqs in self._bins.values():
                 if reqs:
@@ -682,24 +1020,62 @@ class CheckerService:
             except queue.Empty:
                 break
 
-    # --- worker -------------------------------------------------------------
+    # --- worker pool --------------------------------------------------------
 
-    def _worker_loop(self) -> None:
+    def _consume_worker_kill(self) -> bool:
+        """The worker-kill chaos hook (``inject_worker_kill()`` /
+        ``JEPSEN_TPU_SERVICE_KILL``): True means THIS worker thread
+        must die now, with its work in hand — the supervisor's
+        detection/requeue/respawn path is what's under test."""
+        with self._kill_lock:
+            if self._kill_armed > 0:
+                self._kill_armed -= 1
+                return True
+            return False
+
+    def inject_worker_kill(self, n: int = 1) -> None:
+        """Arm the chaos hook: the next ``n`` work items each kill
+        their worker thread mid-item."""
+        with self._kill_lock:
+            self._kill_armed += n
+
+    def _worker_loop(self, state: _WorkerState) -> None:
         while True:
+            if state.abandoned or self._crashed:
+                return
             batch = self._work.get()
             if batch is None:
                 return
-            if isinstance(batch, tuple) and batch and \
-                    batch[0] == "stream":
-                # Stream-session job (already exception-proofed by
-                # _stream_run's wrapper): runs on this thread so
-                # increments serialize with batches on the one device.
-                batch[1]()
-                continue
+            # busy_since BEFORE busy: the supervisor reads (busy,
+            # busy_since) without a lock, and the reverse order lets a
+            # tick pair the fresh item with the PREVIOUS item's stale
+            # timestamp — an instant false wedge.
+            state.busy_since = time.monotonic()
+            state.busy = batch
+            # The kill hook is inert during drain-and-stop: the
+            # supervisor that would requeue the in-hand batch exits
+            # with the scheduler, so a drain-time kill would strand
+            # (not requeue) it — an armed event just lands on the
+            # drain instead.
+            if not self._stop.is_set() and self._consume_worker_kill():
+                # Simulated worker death: the thread exits abruptly
+                # with the batch IN HAND (state.busy still set) —
+                # exactly the state a real thread death leaves, which
+                # the supervisor must detect, requeue once, respawn.
+                self._bump("worker_kills")
+                return
             try:
+                if isinstance(batch, tuple) and batch and \
+                        batch[0] == "stream":
+                    # Stream-session job (already exception-proofed by
+                    # _stream_run's wrapper): runs on a worker thread
+                    # so increments serialize with batches on the
+                    # device, never race them.
+                    batch[1]()
+                    continue
                 self._process_batch(batch)
             except Exception:  # noqa: BLE001 - the daemon must survive
-                self._bump("worker_respawns")
+                self._bump("worker_errors")
                 import traceback
 
                 # Only the requests NOT already answered mid-batch:
@@ -714,6 +1090,80 @@ class CheckerService:
                             "error": "service worker error: "
                                      + traceback.format_exc(limit=3)},
                             batch_n=len(batch), t0=time.monotonic())
+            finally:
+                state.busy = None
+
+    def _touch_worker(self) -> None:
+        """Refresh the calling worker's progress clock. The wedge
+        backstop bounds progress-FREE time, not whole work items: one
+        batch legitimately runs many sequential supervised dispatches
+        (a decline-heavy bin falls through to per-request checks), so
+        each started single and each answered request resets the
+        clock — only a genuine hang accumulates."""
+        t = threading.current_thread()
+        for st in self._workers:
+            if st.thread is t:
+                st.busy_since = time.monotonic()
+                return
+
+    def _supervise_workers(self) -> None:
+        """One scheduler-tick pass over the pool: a DEAD worker (the
+        kill hook, or a bug past the loop's catch-all) or a WEDGED one
+        (busy past the worker deadline — a non-dispatch hang the
+        in-batch watchdog can't see) is abandoned; its in-hand work is
+        requeued ONCE (per-request ``attempts`` caps it — the PR 6
+        fault-requeue promise promoted to per-worker), the bin shape
+        is ledger-recorded, and a replacement spawns. The daemon never
+        dies with a worker; the ``done`` guard means a late result
+        from an abandoned-but-alive worker can never double-answer."""
+        now = time.monotonic()
+        for i, st in enumerate(self._workers):
+            alive = st.thread is not None and st.thread.is_alive()
+            wedged = (alive and st.busy is not None
+                      and now - st.busy_since > self.worker_deadline)
+            if alive and not wedged:
+                continue
+            batch = st.busy
+            st.busy = None
+            st.abandoned = True
+            kind = "wedge" if wedged else "death"
+            self._bump("worker_wedges" if wedged else "worker_deaths")
+            obs_metrics.REGISTRY.event("worker-" + kind,
+                                       worker=st.wid)
+            if wedged and st.thread is not None:
+                self._abandoned.append(st.thread)
+            if batch is not None:
+                self._requeue_worker_batch(batch, kind)
+            self._bump("worker_respawns")
+            self._workers[i] = self._spawn_worker()
+
+    def _requeue_worker_batch(self, batch, kind: str) -> None:
+        from jepsen_tpu.lin import supervise
+
+        if isinstance(batch, tuple) and batch and batch[0] == "stream":
+            if kind == "wedge":
+                # The job IS the hang, still running on the abandoned
+                # thread (it holds the session lock): re-putting it
+                # would just wedge the replacement worker too. The
+                # client already got its deadline error; drop it.
+                self._bump("stream_drops")
+                return
+            # A DEAD worker never started the job (jobs are
+            # exception-proofed; only the kill hook — which fires
+            # BEFORE the job runs — kills a worker): re-put it, and
+            # the waiting connection handler picks up the late result
+            # within its deadline.
+            self._work.put(batch)
+            self._bump("stream_requeues")
+            return
+        supervise.record_fault(batch[0].bin,
+                               "wedge" if kind == "wedge" else "fault",
+                               f"service worker {kind}")
+        pending = [r for r in batch if not r.done]
+        if pending:
+            self._requeue_or_fail(
+                pending, RuntimeError(f"service worker {kind}"),
+                time.monotonic())
 
     def _process_batch(self, reqs: list[Request]) -> None:
         from jepsen_tpu.lin import supervise
@@ -823,8 +1273,18 @@ class CheckerService:
 
         t0 = time.monotonic()
         self._bump("single_requests")
+        self._touch_worker()   # each single gets its own wedge budget
 
         def thunk():
+            if req.kind == "txn":
+                # The txn-check frame: checker.txn_cycles under this
+                # same supervised per-request fallthrough (wedge ->
+                # honest unknown, fault -> requeue once; the txn
+                # engine's own tier ladder rides inside the thunk).
+                from jepsen_tpu import checker as checker_ns
+
+                ck = checker_ns.txn_cycles(**req.txn_kw)
+                return ck.check(None, None, req.history, {})
             if self._check_fn is not None:
                 return self._check_fn(req.packed, req.model,
                                       req.history)
@@ -890,9 +1350,25 @@ class CheckerService:
 
     def _finish(self, req: Request, result: dict, *, batch_n: int,
                 t0: float) -> None:
-        if req.done:   # never answer (or account) a request twice
-            return
-        req.done = True
+        # Atomic test-and-set on done: with a worker POOL, a requeued
+        # request's replacement decide can race a late result from the
+        # abandoned worker — both must never answer (or account) the
+        # same request. A "crashed" daemon answers nothing at all.
+        with self._stats_lock:
+            if req.done or self._crashed:
+                return
+            req.done = True
+        self._touch_worker()   # an answered request is worker progress
+        # Settle the journal BEFORE the wire reply: the settle record
+        # is the durable answer (at-least-once settled; the done flag
+        # keeps the live reply exactly-once).
+        if self._journal is not None and req.journal_seq is not None:
+            try:
+                self._journal.settle(req.journal_seq, req.fingerprint,
+                                     protocol.jsonable(result))
+                self._bump("journal_settles")
+            except OSError:
+                self._bump("journal_errors")
         now = time.monotonic()
         wait = t0 - req.t_enqueue
         valid = result.get("valid?")

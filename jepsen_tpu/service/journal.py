@@ -1,0 +1,342 @@
+"""Durable request journal: the daemon's crash-survival record.
+
+The checker daemon (daemon.py) is itself a distributed-systems
+participant: clients hand it histories and expect a verdict, and the
+wire contract (protocol.py) already says a lost reply is INDETERMINATE
+— the daemon may have decided. What a daemon CRASH must not do is
+silently drop admitted work: the journal makes every admitted request
+durable before it is decided, and a restarted daemon re-decides
+everything unsettled — the PR 5 checkpoint/ledger machinery promoted
+from one engine run to the whole service.
+
+Design (the trace-spill + quarantine-ledger patterns combined):
+
+- **Append-only JSONL** (``JEPSEN_TPU_SERVICE_JOURNAL``): one record
+  per line, encoded with :mod:`jepsen_tpu.codec` so histories
+  round-trip exactly (tuples/sets survive). Record kinds:
+
+  - ``{"kind": "check", "seq": N, "fp": F, "model": M, "history":
+    [...]}`` — an admitted check (``txn-check`` twins carry the txn
+    params instead of a model). Appended (and flushed) BEFORE the
+    request enters the queue, so a crash after admission can never
+    lose it.
+  - ``{"kind": "settle", "seq": N, "fp": F, "verdict": V, "result":
+    {...}}`` — the answer. The settle record IS the durable reply: a
+    client that lost its connection (or never reconnects) can read the
+    verdict here, and the restart-recovery test asserts these against
+    the CPU oracle.
+  - ``{"kind": "stream-open", "seq": N, "sid": S, "model": M}`` /
+    ``{"kind": "stream-append", "seq": N, "sid": S, "ops": [...]}`` /
+    ``{"kind": "stream-close", "seq": N, "sid": S, "how": ...}`` — a
+    daemon stream session's lifecycle. A crashed session's carried
+    frontier survives via its per-sid ``JEPSEN_TPU_STREAM_CKPT``
+    checkpoint; re-feeding the journaled appends fast-forwards to it
+    (the settled-prefix fingerprint gate, stream/session.py).
+
+- **Torn-tail-tolerant replay**: a SIGKILL can tear the last line;
+  ``load()`` skips unparseable lines (counting them) exactly like the
+  trace spill reader — a torn tail costs that one record, never the
+  journal.
+
+- **Atomic index** (``<path>.index.json``, ``util.write_json_atomic``):
+  a compact summary (next seq, unsettled depth, settled count, replay
+  counter) written atomically so monitoring (``cli.py journal list``,
+  the ``/service`` page) reads a consistent snapshot without parsing
+  the whole JSONL. The index is derived state — replay trusts only
+  the JSONL.
+
+- **gc** rewrites the file keeping unsettled checks and open stream
+  sessions (atomic tmp+replace), dropping settled pairs — the journal
+  stays O(in-flight), not O(history of the service).
+
+Thread-safety: one lock around the append path (handler threads,
+worker threads, and the supervisor all write). Fsync is NOT issued per
+line — the flush gives os-crash durability for process kills (the
+failure mode the fleet story defends against); powerfail durability
+would need fsync and is not worth the per-request latency here.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Iterable
+
+from jepsen_tpu import codec, util
+
+JOURNAL_VERSION = 1
+
+
+def journal_path() -> str | None:
+    """``JEPSEN_TPU_SERVICE_JOURNAL``: the journal file; unset/empty or
+    ``0`` disables journaling entirely (the pre-fleet daemon)."""
+    env = os.environ.get("JEPSEN_TPU_SERVICE_JOURNAL", "")
+    if not env or env == "0":
+        return None
+    return env
+
+
+class Journal:
+    """One daemon's request journal. All methods are thread-safe."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh = None
+        self._next_seq = 1
+        self._unsettled: dict[int, dict] = {}   # seq -> admit record
+        self._settled = 0
+        self._torn = 0
+        self._frozen = False    # crash(): drop writes, never reopen
+        self.replays = 0        # entries re-decided by a restart
+        self._streams: dict[str, dict] = {}     # sid -> session record
+        self._recover()
+
+    # --- load / recovery ----------------------------------------------------
+
+    def _recover(self) -> None:
+        """Rebuild in-memory state from the JSONL (startup)."""
+        for rec in self.load():
+            self._apply(rec)
+
+    def _apply(self, rec: dict) -> None:
+        seq = rec.get("seq")
+        if isinstance(seq, int):
+            self._next_seq = max(self._next_seq, seq + 1)
+        kind = rec.get("kind")
+        if kind in ("check", "txn-check"):
+            self._unsettled[seq] = rec
+        elif kind == "settle":
+            if self._unsettled.pop(rec.get("of", seq), None) is not None:
+                self._settled += 1
+        elif kind == "stream-open":
+            self._streams[rec["sid"]] = {"model": rec.get("model"),
+                                         "appends": [], "closed": False,
+                                         "seq": seq}
+        elif kind == "stream-append":
+            s = self._streams.get(rec.get("sid"))
+            if s is not None and not s["closed"]:
+                s["appends"].append(rec.get("ops") or [])
+        elif kind == "stream-close":
+            s = self._streams.get(rec.get("sid"))
+            if s is not None:
+                s["closed"] = True
+
+    def load(self) -> list[dict]:
+        """Every parseable record, in order (torn-tail tolerant: an
+        unparseable line — the SIGKILL-torn tail — is skipped and
+        counted, like the trace-spill reader)."""
+        out: list[dict] = []
+        try:
+            with open(self.path, "rb") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = codec.decode(line)
+                    except Exception:  # noqa: BLE001 - torn tail
+                        self._torn += 1
+                        continue
+                    if isinstance(rec, dict):
+                        out.append(rec)
+        except OSError:
+            pass
+        return out
+
+    # --- writing ------------------------------------------------------------
+
+    def _append(self, rec: dict) -> int:
+        with self._lock:
+            if self._frozen:
+                # A frozen (crashed) journal drops writes instead of
+                # lazily reopening the file: an in-flight worker's
+                # settle landing AFTER the simulated SIGKILL would be
+                # a record a real kill could never produce.
+                return -1
+            seq = rec.get("seq")
+            if seq is None:
+                seq = self._next_seq
+                rec = {**rec, "seq": seq}
+            self._next_seq = max(self._next_seq, seq + 1)
+            if self._fh is not None:
+                # A compaction (`cli.py journal gc`) in ANOTHER
+                # process swaps the inode under our append handle;
+                # writing on would scribble on an unlinked file and
+                # silently lose every later admit/settle. Detect and
+                # reopen.
+                try:
+                    if os.stat(self.path).st_ino \
+                            != os.fstat(self._fh.fileno()).st_ino:
+                        self._fh.close()
+                        self._fh = None
+                except OSError:
+                    self._fh.close()
+                    self._fh = None
+            if self._fh is None:
+                os.makedirs(os.path.dirname(self.path) or ".",
+                            exist_ok=True)
+                self._fh = open(self.path, "ab")
+                # Heal a torn tail: a SIGKILL mid-write can leave the
+                # file without its final newline — appending straight
+                # on would glue the new record onto the torn line and
+                # corrupt BOTH.
+                try:
+                    if self._fh.tell() > 0:
+                        with open(self.path, "rb") as rf:
+                            rf.seek(-1, os.SEEK_END)
+                            if rf.read(1) != b"\n":
+                                self._fh.write(b"\n")
+                except OSError:
+                    pass
+            self._fh.write(codec.encode(rec) + b"\n")
+            self._fh.flush()
+            self._apply_locked(rec)
+        return seq
+
+    def _apply_locked(self, rec: dict) -> None:
+        # _apply mutates only dicts/ints; called under self._lock from
+        # the append path (recovery runs before any thread exists).
+        self._apply(rec)
+
+    def admit(self, kind: str, fp: str, payload: dict) -> int:
+        """Journal an admitted request BEFORE it is queued; returns the
+        seq the settle must reference."""
+        return self._append({"kind": kind, "fp": fp, **payload})
+
+    def settle(self, seq: int, fp: str, result: dict) -> None:
+        """Journal the answer for admit record ``seq`` — the durable
+        reply a crashed client (or a restarted daemon's monitoring)
+        reads back."""
+        self._append({"kind": "settle", "of": int(seq), "fp": fp,
+                      "verdict": result.get("valid?"),
+                      "result": result})
+
+    def stream_event(self, kind: str, sid: str, **fields) -> int:
+        return self._append({"kind": kind, "sid": sid, **fields})
+
+    # --- reading ------------------------------------------------------------
+
+    def unsettled(self) -> list[dict]:
+        """Admit records with no settle — what a restarted daemon must
+        re-decide (in admission order)."""
+        with self._lock:
+            return [self._unsettled[k]
+                    for k in sorted(self._unsettled)]
+
+    def stream_sessions(self, open_only: bool = True) -> dict[str, dict]:
+        """Journaled stream sessions (``sid -> {model, appends,
+        closed}``); by default only the ones never closed — the
+        sessions a crash orphaned, re-adoptable via ``stream-open``
+        with an explicit ``session``."""
+        with self._lock:
+            return {sid: {**s, "appends": [list(a) for a in s["appends"]]}
+                    for sid, s in self._streams.items()
+                    if not (open_only and s["closed"])}
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._unsettled)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"journal_path": self.path,
+                    "journal_depth": len(self._unsettled),
+                    "journal_settles": self._settled,
+                    "journal_streams_open": sum(
+                        1 for s in self._streams.values()
+                        if not s["closed"]),
+                    "journal_torn_lines": self._torn,
+                    "journal_replays": self.replays}
+
+    # --- maintenance --------------------------------------------------------
+
+    def write_index(self) -> None:
+        """Atomic monitoring snapshot beside the JSONL (derived state;
+        replay trusts only the JSONL)."""
+        try:
+            util.write_json_atomic(self.path + ".index.json",
+                                   {"version": JOURNAL_VERSION,
+                                    "next_seq": self._next_seq,
+                                    **self.stats()})
+        except OSError:
+            pass   # monitoring-grade: never take the daemon down
+
+    def gc(self) -> dict:
+        """Compact: rewrite keeping unsettled admits and OPEN stream
+        sessions (their open+appends, so re-adoption still replays);
+        settled pairs and closed sessions drop. Atomic (tmp+replace).
+        Returns ``{"kept": n, "dropped": m}``."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+        records = self.load()
+        with self._lock:
+            keep: list[dict] = []
+            dropped = 0
+            open_sids = {sid for sid, s in self._streams.items()
+                         if not s["closed"]}
+            for rec in records:
+                kind = rec.get("kind")
+                if kind in ("check", "txn-check"):
+                    take = rec.get("seq") in self._unsettled
+                elif kind in ("stream-open", "stream-append"):
+                    take = rec.get("sid") in open_sids
+                else:   # settle / stream-close: pairs with dropped work
+                    take = False
+                if take:
+                    keep.append(rec)
+                else:
+                    dropped += 1
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as fh:
+                for rec in keep:
+                    fh.write(codec.encode(rec) + b"\n")
+            os.replace(tmp, self.path)
+            self._settled = 0
+            self._streams = {sid: s for sid, s in self._streams.items()
+                             if sid in open_sids}
+        self.write_index()
+        return {"kept": len(keep), "dropped": dropped}
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+    def freeze(self) -> None:
+        """SIGKILL semantics (``CheckerService.crash()``): close AND
+        refuse all further writes — ``close()`` alone would lazily
+        reopen on the next append."""
+        with self._lock:
+            self._frozen = True
+        self.close()
+
+
+def describe(records: Iterable[dict]) -> list[dict[str, Any]]:
+    """Compact per-record summaries for ``cli.py journal list``."""
+    records = list(records)
+    settled = {r.get("of", r.get("seq")) for r in records
+               if r.get("kind") == "settle"}
+    out = []
+    for r in records:
+        kind = r.get("kind")
+        if kind in ("check", "txn-check"):
+            out.append({"seq": r.get("seq"), "kind": kind,
+                        "fp": str(r.get("fp", ""))[:16],
+                        "model": r.get("model",
+                                       r.get("consistency", "")),
+                        "ops": len(r.get("history") or []),
+                        "settled": r.get("seq") in settled})
+        elif kind == "stream-open":
+            out.append({"seq": r.get("seq"), "kind": kind,
+                        "sid": r.get("sid"), "model": r.get("model")})
+        elif kind == "stream-close":
+            out.append({"seq": r.get("seq"), "kind": kind,
+                        "sid": r.get("sid"), "how": r.get("how")})
+    return out

@@ -15,6 +15,12 @@ Messages (all dicts with a ``"type"`` key):
   ``result`` is the checker verdict (``valid?`` / ``analyzer`` / ...);
   ``timings`` carries ``queue_wait_s`` / ``decide_s`` / ``batch_n``
   (how many histories shared the request's device program).
+- ``{"type": "txn-check", "id": I, "history": [op dicts],
+  "anomalies": [...] | None, "consistency": NAME, "realtime":
+  bool | None, "algorithm": "tpu" | "cpu"}`` → a ``verdict`` frame.
+  The txn twin of ``check`` (v2): a list-append transaction history
+  decided by ``checker.txn_cycles`` under the daemon's supervised
+  per-request fallthrough (txn requests never bin).
 - ``{"type": "ping"}`` → ``{"type": "pong"}``
 - ``{"type": "stats"}`` → ``{"type": "stats", "stats": {...}}``
 - ``{"type": "shutdown"}`` → ``{"type": "ok"}`` then the daemon stops
@@ -28,7 +34,12 @@ daemon's warm chip:
 - ``{"type": "stream-open", "id": I, "model": NAME}``
   → ``{"type": "stream-opened", "id": I, "session": SID}`` (or an
   ``error`` when the session-slot bound is reached — backpressure,
-  like ``overload``).
+  like ``overload``). With ``"session": SID`` the open RE-ADOPTS a
+  journaled session a crash or client drop orphaned (doc/service.md
+  § Fleet): the daemon re-feeds the journaled appends (fast-forwarded
+  by the session's per-sid ``JEPSEN_TPU_STREAM_CKPT`` checkpoint) and
+  answers ``stream-opened`` with ``"resumed": true`` plus the current
+  session state.
 - ``{"type": "stream-append", "session": SID, "ops": [op dicts]}``
   → ``{"type": "stream-state", "session": SID, "row": R, ...}``; once
   an increment proves the history invalid the state carries
@@ -188,18 +199,58 @@ class CheckerClient:
             out["_timings"] = resp["timings"]
         return out
 
+    def txn_check(self, history, *, anomalies=None,
+                  consistency: str = "serializable",
+                  realtime: bool | None = None,
+                  algorithm: str = "tpu", req_id=None) -> dict:
+        """Submit a list-append TRANSACTION history (the txn-check
+        frame, v2); blocks for the verdict with the same indeterminate
+        semantics as ``submit``."""
+        self._next_id += 1
+        rid = req_id if req_id is not None else self._next_id
+        try:
+            resp = self._rpc({"type": "txn-check", "id": rid,
+                              "history": history_to_wire(history),
+                              "anomalies": (list(anomalies)
+                                            if anomalies else None),
+                              "consistency": consistency,
+                              "realtime": realtime,
+                              "algorithm": algorithm})
+            while resp.get("type") == "verdict" \
+                    and resp.get("id") != rid:
+                resp = read_msg(self.io)
+        except WireIndeterminate as e:
+            return {"valid?": "unknown",
+                    "error": f"indeterminate: {e}"}
+        if resp.get("type") == "error":
+            return {"valid?": "unknown",
+                    "error": resp.get("error", "daemon error")}
+        out = dict(resp.get("result") or {})
+        if resp.get("timings"):
+            out["_timings"] = resp["timings"]
+        return out
+
     # --- stream-check sessions (doc/streaming.md) -----------------------
 
-    def stream_open(self, model_name: str) -> str:
+    def stream_open(self, model_name: str,
+                    session: str | None = None):
         """Open a daemon-side streaming session; returns its id.
-        Raises RuntimeError on refusal (bound reached, version skew)."""
+        With ``session``, RE-ADOPT that journaled session (a crashed
+        or dropped producer resuming its stream) — returns the full
+        ``stream-opened`` reply dict, which carries ``resumed`` /
+        ``replayed_appends`` and the current session state. Raises
+        RuntimeError on refusal (bound reached, unknown session,
+        version skew)."""
         self._next_id += 1
-        resp = self._rpc({"type": "stream-open", "id": self._next_id,
-                          "model": model_name})
+        msg = {"type": "stream-open", "id": self._next_id,
+               "model": model_name}
+        if session is not None:
+            msg["session"] = session
+        resp = self._rpc(msg)
         if resp.get("type") != "stream-opened":
             raise RuntimeError(
                 f"stream-open refused: {resp.get('error', resp)!r}")
-        return resp["session"]
+        return dict(resp) if session is not None else resp["session"]
 
     def stream_append(self, session: str, ops) -> dict:
         """Append history events to a stream session; returns the

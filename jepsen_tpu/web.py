@@ -140,7 +140,25 @@ def service_html(stats_file: str | None = None) -> str:
 
     scalars = sorted((k, v) for k, v in snap.items()
                      if not isinstance(v, (dict, list)))
-    parts = [head, table("counters & gauges", scalars)]
+    # Fleet health up front (doc/service.md § Fleet): pool size and
+    # journal depth are the two numbers that say whether the daemon is
+    # keeping its crash-recovery promises right now.
+    fleet = []
+    if snap.get("workers") is not None:
+        fleet.append(f"workers {snap.get('workers')} "
+                     f"({snap.get('workers_busy', 0)} busy, "
+                     f"{snap.get('worker_deaths', 0)} deaths, "
+                     f"{snap.get('worker_respawns', 0)} respawns)")
+    if snap.get("journal_path"):
+        fleet.append(f"journal depth {snap.get('journal_depth', 0)} "
+                     f"unsettled, {snap.get('journal_settles', 0)} "
+                     f"settled, {snap.get('journal_replays', 0)} "
+                     f"replayed")
+    parts = [head]
+    if fleet:
+        parts.append("<p><b>fleet:</b> "
+                     + _html.escape(" · ".join(fleet)) + "</p>")
+    parts.append(table("counters & gauges", scalars))
     for k in sorted(k for k, v in snap.items() if isinstance(v, dict)):
         if snap[k]:
             parts.append(table(k, sorted(snap[k].items())))
