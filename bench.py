@@ -264,35 +264,52 @@ def _probe_partitioned_c30():
 
 
 def _probe_wave_smoke():
-    """Small-input probe of the round-7 K-row wave program
-    (bfs._host_closure_fixpoint_rows) at the TOP host capacity — the
-    rows*cap envelope the program has never run on this chip. The
-    window-34 pair-band witness shape (140 ops) is forced entirely
-    through host rows with K=4 at cap 524288, so one seconds-scale
-    fault-isolated run exercises exactly what the multi-hour wave
-    rungs would; the ladder skips those rungs if this fails
+    """Small-input probe of the never-on-chip host-row fast paths at
+    the TOP host capacity — the rows*cap envelope neither program has
+    run on this chip. Two legs over the window-34 pair-band witness
+    shape (140 ops), PROVEN leg first so an experimental fault cannot
+    cost its gating evidence: (1) WAVE — the round-7 K=4 program
+    (bfs._host_closure_fixpoint_rows, scheduler forced off); (2)
+    SCHED — the device-resident episode scheduler
+    (bfs._host_sched_rows) under its ``sched`` result key. One
+    seconds-scale fault-isolated run exercises exactly what the
+    multi-hour partitioned rungs would; the ladder skips the wave
+    rungs when leg 1 fails and the sched rung when either fails
     (probe-small-first, CLAUDE.md)."""
     from jepsen_tpu import models as m
     from jepsen_tpu.lin import bfs, prepare, synth
 
-    os.environ["JEPSEN_TPU_HOST_STICKY"] = "1"
-    os.environ["JEPSEN_TPU_HOST_ROWS_K"] = "4"
     h = synth.generate_partitioned_register_history(
         140, concurrency=40, seed=0, partition_every=60,
         partition_len=20, max_crashes=10)
     p = prepare.prepare(m.cas_register(), h)
-    t0 = time.time()
-    r = bfs.check_packed(p, cap_schedule=(8,),
-                         host_caps=bfs.HOST_ROW_CAPS[-1:])
+
+    def leg(sched: bool) -> dict:
+        os.environ["JEPSEN_TPU_HOST_STICKY"] = "1"
+        os.environ["JEPSEN_TPU_HOST_ROWS_K"] = "4"
+        os.environ["JEPSEN_TPU_HOST_SCHED"] = "1" if sched else "0"
+        t0 = time.time()
+        r = bfs.check_packed(p, cap_schedule=(8,),
+                             host_caps=bfs.HOST_ROW_CAPS[-1:])
+        res = {"verdict": r.get("valid?"),
+               "seconds": round(time.time() - t0, 1),
+               "host_stats": r.get("host-stats")}
+        if r.get("valid?") is not True:
+            res["error"] = f"smoke verdict {r.get('valid?')!r}"
+        return res
+
     out = {"events": len(h), "window": p.window,
-           "host_cap": bfs.HOST_ROW_CAPS[-1],
-           "verdict": r.get("valid?"),
-           "seconds": round(time.time() - t0, 1),
-           "host_stats": r.get("host-stats")}
-    if r.get("valid?") is not True:
-        out["error"] = f"wave smoke verdict {r.get('valid?')!r}"
-    elif not (r.get("host-stats") or {}).get("multi_rows"):
+           "host_cap": bfs.HOST_ROW_CAPS[-1]}
+    out.update(leg(False))
+    if "error" not in out \
+            and not (out.get("host_stats") or {}).get("multi_rows"):
         out["error"] = "wave smoke ran no wave batches (vacuous probe)"
+    sched = leg(True)
+    if "error" not in sched \
+            and not (sched.get("host_stats") or {}).get("sched_rows"):
+        sched["error"] = ("sched smoke ran no scheduler episodes "
+                          "(vacuous probe)")
+    out["sched"] = sched
     return out
 
 
@@ -777,36 +794,43 @@ def _wide_probes(detail: dict, out: dict, t_start: float) -> None:
     partitioned_c30 runs an ATTEMPT LADDER, most experimental first,
     each rung fault-isolated in its own subprocess with its config
     recorded so failures archive as gating evidence instead of erasing
-    the headline. The round-7 ladder peels the wave-executor axes off
+    the headline. The ladder peels the host-row executor axes off
     one at a time, so a fault names its own culprit and the final rung
-    is always a shape already proven on this chip. The wave rungs are
-    additionally gated by a ``wave_smoke`` pre-probe — the K-row
-    program on the SMALL window-34 witness shape at the top host cap
+    is always a shape already proven on this chip. The sched/wave
+    rungs are additionally gated by the two-leg ``wave_smoke``
+    pre-probe — the K-row wave AND the episode-scheduler programs on
+    the SMALL window-34 witness shape at the top host cap
     (probe-small-first, CLAUDE.md): if the seconds-scale probe fails,
-    the wave rungs are skipped (recorded) instead of spending
+    the matching rungs are skipped (recorded) instead of spending
     multi-hour budgets discovering the same fault. The rungs:
-    (1) ``wave8`` —
-    sticky caps + K=4 fused wave batches + SYNC_CHUNKS=8 (the full
-    round-7 configuration, including the round-6 queue-depth re-test);
-    (2) ``wave`` — the same at the conservative SYNC_CHUNKS=2, so a
-    wave fault is separated from a queue-depth fault; (3) ``sticky``
-    — sticky caps only (K=1: no never-probed device program, the
-    wave's host-side scheduling half); (4) ``r6`` — the literal
-    round-6 fused shape (sticky off, K=1); (5) ``unfused`` —
-    FUSED_CLOSURE=0, the round-5 per-pass shape PROVEN to decide on
-    this chip, so no experimental fault can cost the headline
-    partitioned number. Every env var is forced explicitly on every
-    rung (children inherit the parent env; an exported override must
-    not run a rung at a config other than the one its artifact
-    records). Each rung's result carries ``host_stats`` (per-cap wall
-    seconds, wasted escalation passes, sticky hit/miss, wave-batch
-    dispatch counts — bfs._host_rows), so the dispatch-drop factor
-    and the residual cost profile read directly off the artifact."""
+    (1) ``sched`` — the device-resident episode scheduler
+    (JEPSEN_TPU_HOST_SCHED=1, ~1 dispatch per clean episode; the
+    kill-the-tunnel tentpole) over sticky caps at the conservative
+    SYNC_CHUNKS=2 so a scheduler fault is isolated from every other
+    axis; (2) ``wave8`` — sticky caps + K=4 fused wave batches +
+    SYNC_CHUNKS=8 (the full round-7 configuration, including the
+    round-6 queue-depth re-test); (3) ``wave`` — the same at the
+    conservative SYNC_CHUNKS=2, so a wave fault is separated from a
+    queue-depth fault; (4) ``sticky`` — sticky caps only (K=1: no
+    never-probed device program, the wave's host-side scheduling
+    half); (5) ``r6`` — the literal round-6 fused shape (sticky off,
+    K=1); (6) ``unfused`` — FUSED_CLOSURE=0, the round-5 per-pass
+    shape PROVEN to decide on this chip, so no experimental fault can
+    cost the headline partitioned number. Every env var is forced
+    explicitly on every rung (children inherit the parent env; an
+    exported override must not run a rung at a config other than the
+    one its artifact records; JEPSEN_TPU_PSORT_FUSED is forced 0 —
+    the crash-dom band never engages the fused psort kernel, and the
+    artifact must record that). Each rung's result carries
+    ``host_stats`` (per-cap wall seconds, wasted escalation passes,
+    sticky hit/miss, wave-batch and scheduler dispatch counts —
+    bfs._host_rows), so the dispatch-drop factor and the residual
+    cost profile read directly off the artifact."""
     if os.environ.get("JEPSEN_TPU_BENCH_WIDE", "1") == "0":
         return
     for i, (key, ceiling) in enumerate(PROBE_ORDER):
         if key == "partitioned_c30":
-            def _rung(sync, fused, sticky, k, tag):
+            def _rung(sync, fused, sticky, k, sched, tag):
                 # Per-rung frontier checkpoint: a stall-killed child's
                 # retry (and a bench re-run after an external kill)
                 # RESUMES the partitioned decide mid-history instead of
@@ -822,6 +846,11 @@ def _wide_probes(detail: dict, out: dict, t_start: float) -> None:
                          "JEPSEN_TPU_FUSED_CLOSURE": str(fused),
                          "JEPSEN_TPU_HOST_STICKY": str(sticky),
                          "JEPSEN_TPU_HOST_ROWS_K": str(k),
+                         "JEPSEN_TPU_HOST_SCHED": str(sched),
+                         # The crash-dom band never engages the fused
+                         # psort kernel; force it off so the artifact
+                         # records the exact (inert-anyway) config.
+                         "JEPSEN_TPU_PSORT_FUSED": "0",
                          # The static gate must never ROUTE a bench
                          # rung (an exported route mode would run a
                          # rung at a config other than the one its
@@ -831,14 +860,15 @@ def _wide_probes(detail: dict, out: dict, t_start: float) -> None:
                          "JEPSEN_TPU_CKPT": ck},
                         {"sync_chunks": sync, "fused_closure": fused,
                          "host_sticky": sticky, "host_rows_k": k,
-                         "checkpoint": ck}, tag)
+                         "host_sched": sched, "checkpoint": ck}, tag)
 
             attempts = (
-                _rung(8, 1, 1, 4, "wave8"),
-                _rung(2, 1, 1, 4, "wave"),
-                _rung(2, 1, 1, 1, "sticky"),
-                _rung(2, 1, 0, 1, "r6"),
-                _rung(2, 0, 0, 1, "unfused"),
+                _rung(2, 1, 1, 4, 1, "sched"),
+                _rung(8, 1, 1, 4, 0, "wave8"),
+                _rung(2, 1, 1, 4, 0, "wave"),
+                _rung(2, 1, 1, 1, 0, "sticky"),
+                _rung(2, 1, 0, 1, 0, "r6"),
+                _rung(2, 0, 0, 1, 0, "unfused"),
             )
             # Probe-small-first gate (CLAUDE.md): the K-row wave
             # program has never run on this chip, so a seconds-scale
@@ -847,6 +877,7 @@ def _wide_probes(detail: dict, out: dict, t_start: float) -> None:
             # wedge in an ungated rung would burn a full
             # PARTITIONED_STALL_S window (plus a retry) per rung.
             wave_ok = False
+            sched_ok = False
             smoke_ran = False
             remaining = TOTAL_BUDGET_S - (time.time() - t_start)
             # Only run the smoke when a wave rung could still run
@@ -861,12 +892,18 @@ def _wide_probes(detail: dict, out: dict, t_start: float) -> None:
                                "JEPSEN_TPU_FUSED_CLOSURE": "1",
                                "JEPSEN_TPU_HOST_STICKY": "1",
                                "JEPSEN_TPU_HOST_ROWS_K": "4",
+                               "JEPSEN_TPU_PSORT_FUSED": "0",
                                "JEPSEN_TPU_STATIC_GATE": "warn"},
                     stall_s=WAVE_SMOKE_BUDGET_S / 2)
                 detail["wave_smoke"] = smoke
                 _emit(out)
                 wave_ok = "error" not in smoke
-                if not wave_ok:
+                sched_leg = smoke.get("sched") or {}
+                # The sched rung also runs K=4 waves as its fallback
+                # rung, so it needs BOTH legs clean.
+                sched_ok = wave_ok and bool(sched_leg) \
+                    and "error" not in sched_leg
+                if not wave_ok or "error" in sched_leg:
                     # The smoke fault may have killed the worker; the
                     # remaining (non-wave) rungs need it back. A
                     # failed recovery abandons the whole ladder (the
@@ -899,13 +936,15 @@ def _wide_probes(detail: dict, out: dict, t_start: float) -> None:
                                        "fallback rung")
                     detail[f"partitioned_c30_{tag}"] = skipped
                     continue
-                if tags["host_rows_k"] > 1 and not wave_ok:
+                if (tags.get("host_sched") and not sched_ok) or \
+                        (tags["host_rows_k"] > 1 and not wave_ok):
                     # Honest skip reason: a smoke that FAILED is
-                    # gating evidence against the wave program; a
-                    # smoke that never ran (no clock for it) is not.
+                    # gating evidence against the wave/scheduler
+                    # program; a smoke that never ran (no clock for
+                    # it) is not.
                     skipped = dict(tags)
                     skipped["error"] = (
-                        "skipped: wave smoke probe failed "
+                        "skipped: wave/sched smoke probe failed "
                         "(probe-small-first)" if smoke_ran else
                         "skipped: no budget to smoke-probe the wave "
                         "program (probe-small-first)")

@@ -54,7 +54,8 @@ MODES = ("off", "warn", "route")
 # The sites with a proven fallback rung below them — the same set that
 # consults the quarantine ledger for routing (supervise docstring).
 ROUTED_SITES = frozenset(
-    {"host-wave", "host-fixpoint", "host-pass", "txn-scc"})
+    {"host-sched", "host-wave", "host-fixpoint", "host-pass",
+     "txn-scc"})
 
 # Per-site rule waivers: the jaxpr twin of the source-level
 # `# lint: unbounded-ok` comments. The mesh closure fixpoints

@@ -732,9 +732,12 @@ def trace_cmd() -> dict:
             "description":
                 "Flight-recorder attribution (doc/observability.md): "
                 "`trace report` prints per-site x per-cap wall "
-                "seconds, compile time, tunnel-overhead estimate and "
-                "wasted-rung cost; `trace export --chrome` emits "
-                "Perfetto-loadable trace-event JSON."}
+                "seconds, compile time, tunnel-overhead estimate, "
+                "wasted-rung cost, and the per-episode dispatch "
+                "histogram (dispatches/episode — the episode "
+                "scheduler's acceptance metric); `trace export "
+                "--chrome` emits Perfetto-loadable trace-event "
+                "JSON."}
 
 
 def run(commands, argv=None) -> int:

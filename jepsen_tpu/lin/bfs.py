@@ -53,7 +53,7 @@ import numpy as np
 from jax import lax
 
 from jepsen_tpu import util
-from jepsen_tpu.lin import psort, supervise
+from jepsen_tpu.lin import psort, psort_fused, supervise
 from jepsen_tpu.lin.prepare import PackedHistory
 from jepsen_tpu.obs import metrics as obs_metrics
 from jepsen_tpu.obs import trace as obs_trace
@@ -199,6 +199,32 @@ def _host_it_max(W: int) -> int:
     JEPSEN_TPU_HOST_IT_MAX overrides for fault triage and tests."""
     env = os.environ.get("JEPSEN_TPU_HOST_IT_MAX", "")
     return int(env) if env else 4 * W + 16
+
+
+def _host_sched() -> bool:
+    """Device-resident episode SCHEDULER (the kill-the-tunnel
+    tentpole): the host-row wave LOOP itself runs on device —
+    one ``lax.while_loop`` over a row QUEUE whose body is the proven
+    per-row fixpoint + filter pipeline, with the escalation decision
+    (trip on overflow/budget/death) made in-program and only per-row
+    trip metadata returned. ~1 dispatch per clean EPISODE (up to
+    ``JEPSEN_TPU_SCHED_QUEUE`` rows) instead of per K=4 wave rows.
+    ``JEPSEN_TPU_HOST_SCHED=0`` restores the round-7 wave executor for
+    fault triage and A/B timing (also forced off by
+    ``JEPSEN_TPU_FUSED_CLOSURE=0``)."""
+    return os.environ.get("JEPSEN_TPU_HOST_SCHED", "1") != "0"
+
+
+def _sched_queue() -> int:
+    """Rows per scheduler episode program. Default 32 — the largest
+    row count proven clean at the big caps on this runtime (32-row
+    spike mini-chunks ran clean at cap 2^20; rows*cap program
+    complexity is the fault driver, round-2/3/5 lore), so the queue
+    stays inside the probed envelope while amortizing ~32 rows per
+    tunnel round trip. ``JEPSEN_TPU_SCHED_QUEUE`` overrides for fault
+    triage and envelope probes."""
+    env = os.environ.get("JEPSEN_TPU_SCHED_QUEUE", "")
+    return max(2, int(env)) if env else 32
 
 
 KEY_FILL = jnp.uint32(0xFFFFFFFF)  # pad beyond count; sorts after any config
@@ -713,12 +739,14 @@ def reduction_bit_tables(p: PackedHistory, nw: int):
 @partial(jax.jit, static_argnames=("cap", "step_fn", "state_bits",
                                    "nil_id", "read_value_match",
                                    "use_psort", "row_tiers", "key_hi",
-                                   "crash_dom", "max_tier", "cand_max"))
+                                   "crash_dom", "max_tier", "cand_max",
+                                   "use_fused"))
 def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v, pure, pred_bit,
                   bits, state, count, exp_tables=None, *, cap, step_fn,
                   state_bits=None, nil_id=None, read_value_match=False,
                   use_psort=False, row_tiers=True, key_hi=False,
-                  crash_dom=False, max_tier=None, cand_max=None):
+                  crash_dom=False, max_tier=None, cand_max=None,
+                  use_fused=False):
     """Process up to n_rows return events (tables are CHUNK-row static
     shapes; rows past n_rows are ignored) starting from a carried frontier.
 
@@ -747,7 +775,7 @@ def _search_chunk(n_rows, ret_slot, active, slot_f, slot_v, pure, pred_bit,
             state_bits=state_bits, nil_id=nil_id,
             read_value_match=read_value_match, use_psort=use_psort,
             row_tiers=row_tiers, key_hi=key_hi, crash_dom=crash_dom,
-            max_tier=max_tier, cand_max=cand_max)
+            max_tier=max_tier, cand_max=cand_max, use_fused=use_fused)
     C, W = active.shape
     nw = bits.shape[1]
     # Closure-iteration ceiling (post-round-5 invariant: every closure
@@ -954,6 +982,75 @@ def _closure_pass_keys(keys_in, count, act, f_row, v_row, pure_row,
     return k2, n2, changed, o2
 
 
+def _sat_tables(act, v_row, pure_row, *, W, b, nil_id):
+    """Per-row saturation tables for the compact register band: the
+    pure-slot legality is a plain value match, so the key-space
+    saturation mask depends only on the state id — ``(sat_lo[2^b],
+    sat_hi[2^b])`` u32 tables (hi all-zero for windows inside one
+    word). THE single definition, shared by the unfused compact pass
+    (:func:`_closure_pass_keys_compact`) and the fused in-VMEM
+    fixpoint kernel (:mod:`jepsen_tpu.lin.psort_fused`) so their
+    saturation semantics cannot drift."""
+    from jepsen_tpu.models.kernels import NIL
+
+    kbit_lo, kbit_hi = _key_bit_words(b + np.arange(W))
+    sid = jnp.arange(1 << b, dtype=jnp.int32)
+    raw = jnp.where(sid == nil_id, NIL, sid)
+    sat_tbl_lo = jnp.zeros(1 << b, jnp.uint32)
+    sat_tbl_hi = jnp.zeros(1 << b, jnp.uint32)
+    for k in range(W):
+        m = (v_row[k, 0] == NIL) | (v_row[k, 0] == raw)
+        cond = m & pure_row[k] & act[k]
+        if int(kbit_lo[k]):
+            sat_tbl_lo = sat_tbl_lo | jnp.where(
+                cond, jnp.uint32(int(kbit_lo[k])), jnp.uint32(0))
+        else:
+            sat_tbl_hi = sat_tbl_hi | jnp.where(
+                cond, jnp.uint32(int(kbit_hi[k])), jnp.uint32(0))
+    return sat_tbl_lo, sat_tbl_hi
+
+
+def _fused_row_tables(exp_r, act, v_row, pure_row, *, W, b, nil_id):
+    """Per-row scalar tables for the fused in-VMEM fixpoint kernel
+    (:mod:`jepsen_tpu.lin.psort_fused`): the register family's mutator
+    step is a pure value match (write always applies, cas applies iff
+    the state equals its precondition), so ok/post per (column, state)
+    collapse to per-column scalars — ``cols`` u32[10, M] (key bit,
+    chain mask, rv mask, the OR-in word for new keys incl. the
+    post-state id and its saturation mask, the cas precondition id,
+    and act/write/jit flags) plus the shared saturation tables
+    (``sats`` u32[2, 2^b], :func:`_sat_tables`). Gated to the compact
+    register band (read_value_match, b <= 6) whose parity the fused
+    kernel is fuzzed on — tests/test_lin_psort_fused.py."""
+    from jepsen_tpu.lin import psort_fused
+    from jepsen_tpu.models.kernels import F_CAS, F_WRITE, NIL
+
+    (exp_lo, exp_hi, exp_f, exp_v, exp_act, exp_pred_lo, exp_pred_hi,
+     _cl, _ch, _rl, _rh, exp_jit, exp_rv_lo, exp_rv_hi) = exp_r
+    sat_lo, sat_hi = _sat_tables(act, v_row, pure_row, W=W, b=b,
+                                 nil_id=nil_id)
+    is_cas = exp_f == F_CAS
+    is_wr = exp_f == F_WRITE
+
+    def as_sid(w):
+        return jnp.where(w == NIL, nil_id, w).astype(jnp.uint32)
+
+    # A write's precondition never matches (state ids are < 2^b).
+    pre = jnp.where(is_cas, as_sid(exp_v[:, 0]), jnp.uint32(0xFFFF))
+    post = jnp.where(is_cas, as_sid(exp_v[:, 1]), as_sid(exp_v[:, 0]))
+    post_i = post.astype(jnp.int32)
+    or_lo = exp_lo | jnp.take(sat_lo, post_i) | post
+    or_hi = exp_hi | jnp.take(sat_hi, post_i)
+    flags = (exp_act.astype(jnp.uint32) * psort_fused.FLAG_ACT
+             | is_wr.astype(jnp.uint32) * psort_fused.FLAG_WRITE
+             | exp_jit.astype(jnp.uint32) * psort_fused.FLAG_JIT)
+    cols = jnp.stack([exp_lo, exp_hi, exp_pred_lo, exp_pred_hi,
+                      exp_rv_lo, exp_rv_hi, or_lo, or_hi, pre,
+                      flags]).astype(jnp.uint32)
+    sats = jnp.stack([sat_lo, sat_hi])
+    return cols, sats
+
+
 def _closure_pass_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
                                exp, *, cap, W, b, nil_id, step_fn,
                                use_psort=False, crash_dom=False,
@@ -978,7 +1075,6 @@ def _closure_pass_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
      crash_lo, crash_hi, read_lo, read_hi, exp_jit, exp_rv_lo,
      exp_rv_hi) = exp
     pair = hi_in is not None
-    kbit_lo, kbit_hi = _key_bit_words(b + np.arange(W))
     step_cfg_slot = jax.vmap(
         jax.vmap(step_fn, in_axes=(None, 0, 0)),
         in_axes=(0, None, None))
@@ -990,20 +1086,11 @@ def _closure_pass_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
                       0)[:, None]
 
     # Saturation tables: pure-slot legality is a plain value match, so
-    # the mask depends only on the state id (see _expand_keys).
-    sid = jnp.arange(1 << b, dtype=jnp.int32)
-    raw = jnp.where(sid == nil_id, NIL, sid)
-    sat_tbl_lo = jnp.zeros(1 << b, jnp.uint32)
-    sat_tbl_hi = jnp.zeros(1 << b, jnp.uint32)
-    for k in range(W):
-        m = (v_row[k, 0] == NIL) | (v_row[k, 0] == raw)
-        cond = m & pure_row[k] & act[k]
-        if int(kbit_lo[k]):
-            sat_tbl_lo = sat_tbl_lo | jnp.where(
-                cond, jnp.uint32(int(kbit_lo[k])), jnp.uint32(0))
-        else:
-            sat_tbl_hi = sat_tbl_hi | jnp.where(
-                cond, jnp.uint32(int(kbit_hi[k])), jnp.uint32(0))
+    # the mask depends only on the state id (see _expand_keys); the
+    # shared _sat_tables definition also feeds the fused in-VMEM
+    # fixpoint kernel (psort_fused).
+    sat_tbl_lo, sat_tbl_hi = _sat_tables(act, v_row, pure_row, W=W,
+                                         b=b, nil_id=nil_id)
 
     # Expansion over the M mutator columns only.
     ok, new_state = step_cfg_slot(state, exp_f, exp_v)
@@ -1170,7 +1257,8 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
                        exp_tables=None, *, cap, step_fn,
                        state_bits, nil_id, read_value_match=False,
                        use_psort=False, row_tiers=True, key_hi=False,
-                       crash_dom=False, max_tier=None, cand_max=None):
+                       crash_dom=False, max_tier=None, cand_max=None,
+                       use_fused=False):
     """Packed-key row loop (see _search_chunk): each config is ONE
     uint32 (bits << state_bits | state id) — or an (lo, hi) u32 pair
     when ``key_hi`` (windows up to 60+state bits; the cockroach-class
@@ -1301,7 +1389,35 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
                 return (l2, h2, n2, g2, since2, it + 1, o3)
             return (l2, n2, g2, since2, it + 1, o3)
 
-        if key_hi:
+        if exp_tables is not None and not crash_dom and use_fused \
+                and psort_fused.fits(tier, M_cols, b):
+            # Fused in-VMEM fixpoint: the whole expand -> sort-dedup
+            # pass chain as ONE pallas kernel with the frontier
+            # resident in VMEM across passes (psort_fused — the
+            # kill-the-tunnel stage-floor half). Non-dominance dedups
+            # only: the crash-dom band keeps the forced-lax chain
+            # rule (round-5 lore), enforced by the crash_dom gate
+            # here. Ungrouped by construction (semantically identical
+            # for this band's monotone closure); non-convergence at
+            # the ceiling maps to the same honest overflow the
+            # unfused chain flags.
+            exp_row = tuple(t[r] for t in exp_tables)
+            cols, sats = _fused_row_tables(exp_row, act, v_row,
+                                           pure_row, W=W, b=b,
+                                           nil_id=nil_id)
+            l_t, h_t, count, conv, o2 = psort_fused.fixpoint(
+                l_t, h_t, count, cols, sats, cap=tier, b=b,
+                it_max=it_max)
+            ovf = o2 | ~conv
+            if key_hi:
+                l_t, h_t, count, dead = _filter_pass_keys2(
+                    l_t, h_t, count, ret_slot[r], cap=tier, b=b,
+                    use_psort=use_psort)
+            else:
+                l_t, count, dead = _filter_pass_keys(
+                    l_t, count, ret_slot[r], cap=tier, b=b,
+                    use_psort=use_psort)
+        elif key_hi:
             init = (l_t, h_t, count, jnp.int32(0), jnp.int32(0),
                     jnp.int32(0), jnp.bool_(False))
             l_t, h_t, count, _, _, _, ovf = lax.while_loop(
@@ -1683,6 +1799,98 @@ def _host_closure_fixpoint_rows(lo, hi, count, acts, v_rows, pure_rows,
                               count])
 
 
+@partial(jax.jit, static_argnames=("cap", "W", "b", "nil_id", "step_fn",
+                                   "use_psort", "crash_dom", "key_hi",
+                                   "it_max", "Q", "dom_iters"))
+def _host_sched_rows(lo, hi, count, acts, v_rows, pure_rows, exp_rs,
+                     rets, n_rows, dropback, min_left, *, cap, W, b,
+                     nil_id, step_fn, use_psort, crash_dom, key_hi,
+                     it_max, Q, dom_iters=6):
+    """The DEVICE-RESIDENT EPISODE SCHEDULER (the kill-the-tunnel
+    tentpole): one ``lax.while_loop`` over a row QUEUE of up to ``Q``
+    rows whose body is exactly the proven per-row pipeline — the
+    shared closure fixpoint (:func:`_closure_fixpoint_loop`, the same
+    traceable the one-row and K-row wave programs run, so the
+    scheduler can never drift from the per-row semantics) followed by
+    the shared return filter (:func:`_filter_keys_any`) — with the
+    ESCALATION DECISION made in-program: a row whose fixpoint
+    overflows the capacity, exhausts its pass budget, or dies exits
+    the loop immediately, and only per-row trip metadata comes back.
+
+    Unlike the round-7 wave batch (strictly optimistic: ANY trip
+    discards the whole K-row batch), the scheduler carries a COMMITTED
+    frontier copy updated after every cleanly-converged row, so a trip
+    at row i costs exactly row i's work — rows 0..i-1 stay committed.
+    The host re-runs the tripped row on the proven
+    per-row/unfused/CPU ladder (escalation, overflow taxonomy, and
+    death/witness anchoring all live there), exactly like the wave
+    discard; soundness therefore never rests on this program: a
+    committed row is one that ran the identical per-row pass/filter
+    pipeline to convergence, merely queued.
+
+    Runtime-safety envelope: Q rows at one cap — Q defaults to 32
+    (:func:`_sched_queue`), the row count proven clean at cap 2^20 by
+    the spike executor's mini-chunks (rows*cap program complexity is
+    the fault driver); the closure is ungrouped everywhere so each
+    per-row fixpoint terminates for the round-5 reason, and every
+    loop carries its iteration ceiling (``it_max`` per row; the row
+    loop is bounded by ``n_rows``).
+
+    In-program exit conditions: queue end, trip (overflow/budget),
+    death, or — the dropback hand-off — the committed frontier
+    shrinking to ``dropback`` after at least ``min_left`` rows (the
+    host returns the search to the cheap chunked engine there, as it
+    does after per-row commits).
+
+    Rows past ``n_rows`` are zero padding and never execute. ``peak``
+    (max settled per-pass count across the queue) is the sticky-cap
+    decay signal, as in the wave program.
+
+    Returns (committed lo, committed hi, flags) with flags = i32[8]:
+    [rows committed, trip kind (0 none / 1 capacity / 2 budget),
+    dead, total passes, peak settled count, committed count,
+    rows attempted, passes spent in the non-committed row]."""
+    def row_cond(c):
+        i, _, _, _, _, _, ccount, crow, _, _, _, trip, dead = c
+        return (i < n_rows) & (trip == 0) & ~dead \
+            & ((i < min_left) | (ccount > dropback))
+
+    def row_body(c):
+        (i, lo, hi, count, clo, chi, ccount, crow, it_tot, it_last,
+         peak, _, _) = c
+        exp_r = tuple(t[i] for t in exp_rs)
+        lo2, hi2, n2, it, converged, ovf, peak = _closure_fixpoint_loop(
+            lo, hi, count, acts[i], v_rows[i], pure_rows[i], exp_r,
+            peak, cap=cap, W=W, b=b, nil_id=nil_id, step_fn=step_fn,
+            crash_dom=crash_dom, it_max=it_max, dom_iters=dom_iters)
+        lo2, hi2, n2 = _filter_keys_any(lo2, hi2, n2, rets[i], cap=cap,
+                                        b=b, use_psort=use_psort,
+                                        key_hi=key_hi)
+        dead2 = converged & (n2 == 0)
+        commit = converged & ~dead2
+        trip2 = jnp.where(converged, jnp.int32(0),
+                          jnp.where(ovf, jnp.int32(1), jnp.int32(2)))
+        clo2 = jnp.where(commit, lo2, clo)
+        chi2 = None if chi is None else jnp.where(commit, hi2, chi)
+        ccount2 = jnp.where(commit, n2, ccount)
+        crow2 = jnp.where(commit, i + 1, crow)
+        return (i + 1, lo2, hi2, n2, clo2, chi2, ccount2, crow2,
+                it_tot + it, it, peak, trip2, dead2)
+
+    (i, lo, hi, count, clo, chi, ccount, crow, it_tot, it_last, peak,
+     trip, dead) = lax.while_loop(
+        row_cond, row_body,
+        (jnp.int32(0), lo, hi, count, lo, hi, count, jnp.int32(0),
+         jnp.int32(0), jnp.int32(0), count, jnp.int32(0),
+         jnp.bool_(False)))
+    # Passes inside a TRIPPED row are the thrown-away work the host's
+    # waste observability prices (a dead row's passes produced the
+    # verdict — not waste).
+    wasted = jnp.where(trip != 0, it_last, jnp.int32(0))
+    return clo, chi, jnp.stack([crow, trip, dead.astype(jnp.int32),
+                                it_tot, peak, ccount, i, wasted])
+
+
 def _filter_keys_any(lo, hi, count, s, *, cap, b, use_psort, key_hi):
     """The key_hi/use_psort return-filter dispatch, shared (traceable,
     not jitted itself) by the fused fixpoint and _host_filter_pass so
@@ -1880,8 +2088,16 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
     pruning leaves 389k configs; rep+window converges to ~14k). Only
     rows whose frontiers outgrow the chunked tiers ever come here.
 
-    The executor is WAVE-AWARE (round 7), on three independently
-    env-gated axes over the unchanged escalation core:
+    The executor is EPISODE-SCHEDULED (the kill-the-tunnel tentpole):
+    by default a queue of up to ``JEPSEN_TPU_SCHED_QUEUE`` rows runs
+    as ONE device program (:func:`_host_sched_rows`) that commits the
+    clean prefix in-program and returns trip metadata — ~1 dispatch
+    per clean episode. A tripped/quarantined/wedged scheduler row
+    falls to the round-7 wave batch and then the proven per-row
+    ladder below; ``JEPSEN_TPU_HOST_SCHED=0`` disables it.
+
+    The executor is additionally WAVE-AWARE (round 7), on three
+    independently env-gated axes over the unchanged escalation core:
 
     - STICKY CAPS (_host_sticky): a wave's last converged capacity
       level seeds the next row's starting level instead of the cold
@@ -1908,12 +2124,14 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
     dispatches — the tunnel round trips the wave axes are cutting),
     ``passes`` (closure passes executed inside them),
     ``wasted_passes`` (passes whose output was discarded: failed
-    escalation rungs and tripped wave batches), ``sticky_hits`` /
-    ``sticky_misses`` (rows whose sticky-raised starting level
-    converged without / despite further escalation), ``multi_rows`` /
-    ``multi_dispatches`` / ``multi_trips`` (wave-batch traffic), and
-    ``cap_seconds`` (wall seconds of closure dispatches per
-    capacity).
+    escalation rungs, tripped wave batches, and tripped scheduler
+    rows), ``sticky_hits`` / ``sticky_misses`` (rows whose
+    sticky-raised starting level converged without / despite further
+    escalation), ``multi_rows`` / ``multi_dispatches`` /
+    ``multi_trips`` (wave-batch traffic), ``sched_rows`` /
+    ``sched_dispatches`` / ``sched_trips`` (episode-scheduler
+    traffic), and ``cap_seconds`` (wall seconds of closure dispatches
+    per capacity).
 
     Same contract as _spike_rows: returns (bits, state, count_int,
     next_row, dead, overflowed, cancelled, top_cap_used) — except
@@ -1930,6 +2148,8 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
     fused = _fused_closure()
     sticky = _host_sticky()
     K = _host_rows_k() if fused else 1
+    sched_on = _host_sched() and fused
+    Q = _sched_queue()
     # Pass budget per (row, capacity): ungrouped convergence needs
     # O(window) passes; exhaustion escalates like an overflow (sound —
     # the row restarts from its entry frontier).
@@ -1940,7 +2160,8 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
         stats = {}
     for k in ("rows", "dispatches", "passes", "wasted_passes",
               "sticky_hits", "sticky_misses", "multi_rows",
-              "multi_dispatches", "multi_trips", "watchdog_trips",
+              "multi_dispatches", "multi_trips", "sched_rows",
+              "sched_dispatches", "sched_trips", "watchdog_trips",
               "faults", "quarantine_skips", "static_skips",
               "cpu_rows"):
         stats.setdefault(k, 0)
@@ -2008,8 +2229,130 @@ def _host_rows(p, r0, bits, state, count, *, tables_h, exp_h, caps,
         natural = lvl_for(count_i)
         start_lvl = max(natural, sticky_lvl) if sticky else natural
         raised = start_lvl > natural
+        # ---- device-resident episode scheduler: a row QUEUE as ONE
+        # dispatch (the kill-the-tunnel tentpole). Commits the clean
+        # prefix in-program; a trip costs only the tripped row, which
+        # the proven per-row ladder below then owns.
+        qn = min(Q, p.R - r)
+        use_sched = sched_on and qn > 1 and r >= per_row_until
+        if use_sched and supervise.quarantined(
+                skey("host-sched", caps[start_lvl], qn)):
+            # A quarantined scheduler shape routes to the proven
+            # wave/per-row rungs — the fault lore as machine state.
+            util.stat_bump(stats, "quarantine_skips")
+            use_sched = False
+        if use_sched:
+            lvl = start_lvl
+            cap = caps[lvl]
+            top_used = max(top_used, cap)
+            snap(r, lo, hi, count)
+            lo, hi = _fit_keys(lo, hi, cap)
+            entry = (lo, hi, count, lvl)
+            acts = jnp.asarray(_chunk_slice(active_h, r, Q))
+            v_rows = jnp.asarray(_chunk_slice(slot_v_h, r, Q))
+            pure_rows = jnp.asarray(_chunk_slice(pure_h, r, Q))
+            rets = jnp.asarray(_chunk_slice(ret_slot_h, r, Q))
+            exp_rs = tuple(jnp.asarray(_chunk_slice(t, r, Q))
+                           for t in exp_h)
+            # Rows that must run regardless of the in-program dropback
+            # exit (the min_rows contract is relative to the episode
+            # entry r0, not this dispatch).
+            min_left = max(1, min(qn, min_rows - (r - r0)))
+            util.progress_tick()
+            t0 = _time.monotonic()
+
+            def _sched_prog(lo=lo, hi=hi, count=count, qn=qn,
+                            min_left=min_left, acts=acts,
+                            v_rows=v_rows, pure_rows=pure_rows,
+                            exp_rs=exp_rs, rets=rets, cap=cap):
+                return _host_sched_rows(
+                    lo, hi, count, acts, v_rows, pure_rows, exp_rs,
+                    rets, jnp.int32(qn), jnp.int32(dropback),
+                    jnp.int32(min_left), cap=cap, W=W, b=b,
+                    nil_id=nil_id, step_fn=step_fn,
+                    use_psort=use_psort, crash_dom=crash_dom,
+                    key_hi=key_hi, it_max=it_max, Q=Q)
+
+            def _sched():
+                clo, chi, flags = _sched_prog()
+                return clo, chi, np.asarray(flags)
+
+            # A whole episode legitimately runs many fixpoints in ONE
+            # dispatch: scale the watchdog deadline with the queue
+            # (the K-row wave's 3x, per 4 queued rows).
+            outcome, val = supervise.run_guarded(
+                "host-sched", skey("host-sched", cap, qn), _sched,
+                scale=3.0 * max(1.0, qn / 4.0), stats=stats,
+                traceable=_sched_prog)
+            if outcome != "ok":
+                # Wedged/faulted/static-flagged scheduler dispatch:
+                # the proven wave/per-row rungs own the next row (its
+                # non-ok dispatch span already prices the wall); the
+                # scheduler resumes after it.
+                lo, hi, count, lvl = entry
+                per_row_until = r + 1
+                continue
+            clo, chi, flags = val
+            (crow, trip, dead_f, it_tot, pk, ccnt, attempted,
+             wasted) = (int(x) for x in flags)
+            util.stat_time(stats, "cap_seconds", cap,
+                           _time.monotonic() - t0)
+            util.stat_bump(stats, "dispatches")
+            util.stat_bump(stats, "sched_dispatches")
+            util.stat_bump(stats, "passes", it_tot)
+            obs_trace.tail_note(row=r, rows=crow, passes=it_tot,
+                                count=ccnt)
+            if dbg:
+                print(f"[host] r={r} cap={cap} sched qn={qn} "
+                      f"crow={crow} trip={trip} dead={dead_f} "
+                      f"it={it_tot} peak={pk} count={ccnt}",
+                      flush=True)
+            # The committed copy is always valid (it initializes to
+            # the episode entry), so the carried frontier advances to
+            # it unconditionally.
+            lo, hi, count = clo, chi, jnp.int32(ccnt)
+            count_i = ccnt
+            r += crow
+            if crow:
+                util.stat_bump(stats, "rows", crow)
+                util.stat_bump(stats, "sched_rows", crow)
+                if sticky:
+                    if raised:
+                        util.stat_bump(stats, "sticky_hits", crow)
+                    if lvl > sticky_lvl:
+                        sticky_lvl = lvl
+                    elif lvl_for(pk) < sticky_lvl:
+                        sticky_lvl -= 1
+                save_ckpt(r, lo, hi, count_i)
+                obs_metrics.REGISTRY.progress(row=r, frontier=count_i)
+            if dead_f:
+                # The committed frontier IS the dead row's entry —
+                # anchor the explain snapshot there so the CPU replay
+                # spans ONE row, exactly like the per-row dead path.
+                snap(r, lo, hi, count)
+                r += 1
+                return (jnp.zeros((1, nw), jnp.uint32),
+                        jnp.zeros((1, 1), jnp.int32), 0, r, True,
+                        False, False, top_used)
+            if trip:
+                # Overflow/budget at row r: the proven per-row ladder
+                # owns escalation and the overflow taxonomy for it.
+                util.stat_bump(stats, "sched_trips")
+                util.stat_bump(stats, "wasted_passes", wasted)
+                obs_trace.instant(
+                    "sched-trip", row=r, cap=cap, passes=wasted,
+                    kind="capacity" if trip == 1 else "budget")
+                per_row_until = r + 1
+                continue
+            if r >= p.R or (r - r0 >= min_rows
+                            and count_i <= dropback):
+                break
+            continue
         # ---- wave fast path: K rows fused into ONE dispatch --------
         kn = min(K, p.R - r)
+        # Reached only when the scheduler did not handle this
+        # iteration (off, quarantined, or recovering per-row): the
+        # wave batch is the scheduler's first fallback rung.
         use_wave = kn > 1 and r >= per_row_until
         if use_wave and supervise.quarantined(
                 skey("host-wave", caps[start_lvl], kn)):
@@ -2632,12 +2975,21 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     # path's dispatch queue depth between host flag syncs.
     cand_max = _cand_max()
     sync_chunks = _sync_chunks()
+    # Fused in-VMEM fixpoint kernel (psort_fused) for the compact
+    # band's row tiers: NON-dominance dedups only — the crash-dom
+    # band keeps the forced-lax chain rule (round-5 lore). Static
+    # argname of _search_chunk so flipping JEPSEN_TPU_PSORT_FUSED
+    # retraces.
+    use_fused = (exp_h is not None and not crash_dom
+                 and psort_fused.enabled())
     kname = p.kernel.name if p.kernel is not None else "generic"
     host_stats: dict = {"episodes": 0, "rows": 0, "dispatches": 0,
                         "passes": 0, "wasted_passes": 0,
                         "sticky_hits": 0, "sticky_misses": 0,
                         "multi_rows": 0, "multi_dispatches": 0,
-                        "multi_trips": 0, "watchdog_trips": 0,
+                        "multi_trips": 0, "sched_rows": 0,
+                        "sched_dispatches": 0, "sched_trips": 0,
+                        "watchdog_trips": 0,
                         "faults": 0, "quarantine_skips": 0,
                         "static_skips": 0, "cpu_rows": 0,
                         "cap_seconds": {}, "wasted_seconds": {}}
@@ -2916,6 +3268,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
             hdrop = min(spike_dropback,
                         (max_tier or cap_schedule[-1]) // TIER_MARGIN)
             _ep0 = _time.monotonic()
+            _d0, _r0 = host_stats["dispatches"], host_stats["rows"]
             spiked = _host_rows(
                 p, base, jnp.asarray(rbits), jnp.asarray(rstate),
                 jnp.int32(rcount),
@@ -2926,9 +3279,11 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                 use_psort=use_psort, key_hi=key_hi, crash_dom=crash_dom,
                 cancel=cancel, snapshots=snapshots, stats=host_stats,
                 ckpt=ckpt, sticky0=rsticky)
-            obs_trace.complete("host-episode", _ep0,
-                               _time.monotonic() - _ep0, row=base,
-                               resumed=True, next_row=spiked[3])
+            obs_trace.complete(
+                "host-episode", _ep0, _time.monotonic() - _ep0,
+                row=base, resumed=True, next_row=spiked[3],
+                dispatches=host_stats["dispatches"] - _d0,
+                rows=host_stats["rows"] - _r0)
             act_, payload = _consume_spiked(spiked, host_caps[-1])
             if act_ == "return":
                 return payload
@@ -2966,7 +3321,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                         read_value_match=read_value_match,
                         use_psort=use_psort, key_hi=key_hi,
                         crash_dom=crash_dom, max_tier=max_tier,
-                        cand_max=cand_max)
+                        cand_max=cand_max, use_fused=use_fused)
                     flags.append(jnp.stack((ovf.astype(jnp.int32),
                                             dead.astype(jnp.int32),
                                             c2)))
@@ -3050,7 +3405,7 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                     read_value_match=read_value_match,
                     use_psort=use_psort, key_hi=key_hi,
                     crash_dom=crash_dom, max_tier=max_tier,
-                    cand_max=cand_max)
+                    cand_max=cand_max, use_fused=use_fused)
 
             def _chunk():
                 out = _chunk_prog()
@@ -3133,13 +3488,15 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                         read_value_match=read_value_match,
                         use_psort=use_psort, key_hi=key_hi,
                         crash_dom=crash_dom, max_tier=max_tier,
-                        cand_max=cand_max)
+                        cand_max=cand_max, use_fused=use_fused)
                     if not bool(o_pre):
                         bits, state, count = b2, s2, c2
                     else:
                         n_pre = 0  # extremely rare: spike at first row
                 _dlog(f"recovered; host/spike from {base + n_pre}")
                 _ep0 = _time.monotonic()
+                _d0, _r0 = (host_stats["dispatches"],
+                            host_stats["rows"])
                 if host_mode:
                     # Dropback clamped so the handed-back frontier fits
                     # the capped in-chunk tiers with selection margin.
@@ -3175,7 +3532,9 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
                 obs_trace.complete(
                     "host-episode" if host_mode else "spike-episode",
                     _ep0, _time.monotonic() - _ep0, row=base + n_pre,
-                    next_row=spiked[3])
+                    next_row=spiked[3],
+                    dispatches=host_stats["dispatches"] - _d0,
+                    rows=host_stats["rows"] - _r0)
                 spike_top = sp_caps[-1]
                 break
             # Retry this chunk from its entry frontier at the next cap.

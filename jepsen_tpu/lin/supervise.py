@@ -21,7 +21,8 @@ down into the library, per DISPATCH:
   window, kernel family). A dispatch that faults (or repeatedly
   wedges — one wedge is often environmental, see
   WEDGE_QUARANTINE_COUNT) records its shape. The HOST-ROW sites
-  (host-wave / host-fixpoint / host-pass) consult the ledger and route
+  (host-sched / host-wave / host-fixpoint / host-pass) consult the
+  ledger and route
   quarantined shapes straight to their proven fallback rung in future
   runs, including fresh processes — the round 2-5 fault lore as
   machine state instead of CLAUDE.md prose. The base-rung sites

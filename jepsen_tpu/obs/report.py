@@ -85,6 +85,8 @@ def attribution(events: list[dict]) -> dict:
     wasted_n = 0
     static_n = 0
     static_saved_s = 0.0
+    ep_disp: list[int] = []
+    ep_rows = 0
     for ev in events:
         name = ev.get("name")
         dur = float(ev.get("dur") or 0.0)
@@ -95,6 +97,19 @@ def attribution(events: list[dict]) -> dict:
         elif name == "xla-compile":
             compile_s += dur
             compile_n += 1
+        elif name == "host-episode" and ev.get("ph") == "X":
+            # Per-episode dispatch counts (bfs stamps the host-stats
+            # deltas on the episode span): the histogram that reads
+            # the episode scheduler's dispatch drop straight off a
+            # probe-config5 trace — dispatches/episode before vs
+            # after scheduling.
+            if args.get("dispatches") is not None:
+                ep_disp.append(int(args["dispatches"]))
+                ep_rows += int(args.get("rows") or 0)
+            o = other.setdefault("host-episode", {"n": 0,
+                                                  "wall_s": 0.0})
+            o["n"] += 1
+            o["wall_s"] += dur
         elif name == "dispatch" and ev.get("ph") == "X":
             dispatch_s += dur
             dispatch_n += 1
@@ -151,6 +166,25 @@ def attribution(events: list[dict]) -> dict:
         "other": {k: {"n": v["n"], "wall_s": round(v["wall_s"], 3)}
                   for k, v in sorted(other.items())},
     }
+    if ep_disp:
+        hist: dict[str, int] = {}
+        for d in ep_disp:
+            # Power-of-two buckets: "1", "2-3", "4-7", "8-15", ...
+            lo = 1
+            while d > lo * 2 - 1:
+                lo *= 2
+            key = "1" if lo == 1 else f"{lo}-{lo * 2 - 1}"
+            hist[key] = hist.get(key, 0) + 1
+        out["episodes"] = {
+            "n": len(ep_disp),
+            "dispatches": sum(ep_disp),
+            "rows": ep_rows,
+            "dispatches_per_episode": round(
+                sum(ep_disp) / len(ep_disp), 2),
+            "rows_per_dispatch": round(
+                ep_rows / max(1, sum(ep_disp)), 2),
+            "histogram": hist,
+        }
     if total_s > 0:
         out["host_other_s"] = round(max(0.0, total_s - dispatch_s), 3)
     return out
@@ -206,6 +240,21 @@ def render(agg: dict) -> str:
     lines.append(f"wasted (failed rungs)   "
                  f"{agg.get('wasted_s', 0.0):10.2f} s "
                  f"({agg.get('wasted_events', 0)} events)")
+    ep = agg.get("episodes")
+    if ep:
+        # The episode scheduler's acceptance metric read off the
+        # trace: dispatches per host episode, before vs after
+        # scheduling (the wave path runs ~rows/4; the device-resident
+        # scheduler ~1 per clean episode).
+        hist = " ".join(f"{k}:{v}"
+                        for k, v in sorted(
+                            ep.get("histogram", {}).items(),
+                            key=lambda kv: int(kv[0].split("-")[0])))
+        lines.append(f"host episodes           {ep['n']:7d}    "
+                     f"{ep['dispatches']} dispatches / {ep['rows']} "
+                     f"rows ({ep['dispatches_per_episode']}/episode, "
+                     f"{ep['rows_per_dispatch']} rows/dispatch)")
+        lines.append(f"  dispatches/episode    {hist}")
     if agg.get("static_skips"):
         lines.append(f"avoided (static gate)   "
                      f"{agg.get('static_saved_est_s', 0.0):10.2f} s "

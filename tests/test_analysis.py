@@ -315,9 +315,10 @@ class TestShippedPrograms:
     def test_supervised_sites_analyze_clean_small_band(
             self, monkeypatch, small_packed):
         # A REAL host-row run under the default warn gate: every shape
-        # the engines actually dispatched (chunk, chunk-batch, fused
-        # fixpoint, K-row wave) was traced by the gate and found
-        # clean, and nothing was unanalyzable.
+        # the engines actually dispatched (chunk, chunk-batch, the
+        # episode scheduler, fused fixpoint — and, scheduler off, the
+        # K-row wave) was traced by the gate and found clean, and
+        # nothing was unanalyzable.
         from jepsen_tpu.lin import bfs
 
         monkeypatch.setenv("JEPSEN_TPU_STATIC_GATE", "warn")
@@ -328,7 +329,15 @@ class TestShippedPrograms:
         assert r["valid?"] is True
         seen = gate.analyzed()
         sites = {k.split("|", 1)[0] for k in seen}
-        assert {"chunk", "host-fixpoint", "host-wave"} <= sites, sites
+        assert {"chunk", "host-sched"} <= sites, sites
+        monkeypatch.setenv("JEPSEN_TPU_HOST_SCHED", "0")
+        r = bfs.check_packed(small_packed, cap_schedule=(1,),
+                             host_caps=(8, 64, 512))
+        assert r["valid?"] is True
+        seen = gate.analyzed()
+        sites = {k.split("|", 1)[0] for k in seen}
+        assert {"chunk", "host-fixpoint", "host-wave",
+                "host-sched"} <= sites, sites
         flagged = {k: [str(f) for f in v]
                    for k, v in seen.items() if v}
         assert flagged == {}

@@ -330,7 +330,8 @@ def test_wide_probes_reemit_after_every_probe(bench, monkeypatch,
     monkeypatch.setattr(
         bench, "_run_probe",
         lambda key, timeout, env_extra=None, stall_s=None:
-        {"verdict": True, "probe": key})
+        {"verdict": True, "probe": key,
+         "sched": {"verdict": True}})
     out = {"metric": "m", "value": 1, "detail": {}}
     bench._wide_probes(out["detail"], out, time.time())
     lines = [json.loads(ln) for ln in
@@ -344,12 +345,14 @@ def test_wide_probes_reemit_after_every_probe(bench, monkeypatch,
     assert set(lines[2]["detail"]) == {"alpha", "beta", "wave_smoke"}
     assert set(lines[3]["detail"]) == {"alpha", "beta", "wave_smoke",
                                        "partitioned_c30"}
-    # The partitioned probe ran the full round-7 wave configuration
-    # (sticky caps + K=4 wave batches + SYNC_CHUNKS=8) first and
-    # recorded the gating evidence + its derived budget.
+    # The partitioned probe ran the episode-scheduler rung (the
+    # kill-the-tunnel tentpole: scheduler + sticky caps + K=4
+    # fallback at the conservative queue depth) first and recorded
+    # the gating evidence + its derived budget.
     part = lines[3]["detail"]["partitioned_c30"]
-    assert part["sync_chunks"] == 8 and part["fused_closure"] == 1
+    assert part["sync_chunks"] == 2 and part["fused_closure"] == 1
     assert part["host_sticky"] == 1 and part["host_rows_k"] == 4
+    assert part["host_sched"] == 1
     # Experimental (non-final) rungs get the remaining clock capped by
     # the ceiling, NOT the PARTITIONED_MIN_S floor (the floor is
     # reserved for the final proven rung).
@@ -376,9 +379,9 @@ def test_partitioned_attempt_ladder_preserves_headline(bench,
     out = {"detail": detail}
     bench._wide_probes(detail, out, time.time())
     # The failed smoke pre-probe (first call, SYNC 2 / K 4) gates the
-    # wave rungs off (probe-small-first): only the K=1 rungs run, and
-    # the ladder ends on the round-5 per-pass shape proven on this
-    # chip.
+    # sched + wave rungs off (probe-small-first): only the K=1 rungs
+    # run, and the ladder ends on the round-5 per-pass shape proven
+    # on this chip.
     assert [e["JEPSEN_TPU_HOST_ROWS_K"] for e in seen] == \
         ["4", "1", "1", "1"]
     assert [e["JEPSEN_TPU_FUSED_CLOSURE"] for e in seen] == \
@@ -386,7 +389,7 @@ def test_partitioned_attempt_ladder_preserves_headline(bench,
     assert [e["JEPSEN_TPU_HOST_STICKY"] for e in seen] == \
         ["1", "1", "0", "0"]
     assert "error" in detail["wave_smoke"]
-    for tag in ("wave8", "wave"):
+    for tag in ("sched", "wave8", "wave"):
         assert "probe-small-first" in \
             detail[f"partitioned_c30_{tag}"]["error"]
     for tag in ("sticky", "r6", "unfused"):
@@ -394,6 +397,7 @@ def test_partitioned_attempt_ladder_preserves_headline(bench,
     final = detail["partitioned_c30"]
     assert final["fused_closure"] == 0 and final["sync_chunks"] == 2
     assert final["host_sticky"] == 0 and final["host_rows_k"] == 1
+    assert final["host_sched"] == 0
 
     # A passing smoke admits the wave rungs; a success mid-ladder
     # stops escalation: the wave rung at the conservative queue depth
@@ -409,16 +413,47 @@ def test_partitioned_attempt_ladder_preserves_headline(bench,
 
     monkeypatch.setattr(bench, "_run_probe", flaky_probe)
     bench._wide_probes(detail, out, time.time())
-    # smoke (passes), wave8 (fails), wave (wins).
+    # smoke (passes, but carries no clean sched leg so the sched rung
+    # is skipped), wave8 (fails), wave (wins).
     assert len(seen) == 3
     assert [e["JEPSEN_TPU_SYNC_CHUNKS"] for e in seen] == \
         ["2", "8", "2"]
     assert detail["partitioned_c30"]["verdict"] is True
     assert detail["partitioned_c30"]["fused_closure"] == 1
     assert detail["partitioned_c30"]["host_rows_k"] == 4
+    assert "partitioned_c30_sched" in detail
     assert "partitioned_c30_wave8" in detail
     assert "partitioned_c30_sticky" not in detail
     assert "partitioned_c30_unfused" not in detail
+
+
+def test_sched_rung_wins_when_both_smoke_legs_pass(bench, monkeypatch):
+    # A clean two-leg smoke admits the episode-scheduler rung, which
+    # runs FIRST (most experimental) and — succeeding — ends the
+    # ladder with the scheduler configuration in the headline slot.
+    monkeypatch.setattr(bench, "PROBE_ORDER", (("partitioned_c30", 100),))
+    monkeypatch.setattr(bench, "_verify_recovery", lambda: True)
+    seen = []
+
+    def fake_probe(key, timeout, env_extra=None, stall_s=None):
+        seen.append((key, dict(env_extra or {})))
+        if key == "wave_smoke":
+            return {"verdict": True, "sched": {"verdict": True}}
+        return {"verdict": True}
+
+    monkeypatch.setattr(bench, "_run_probe", fake_probe)
+    detail: dict = {}
+    bench._wide_probes(detail, {"detail": detail}, time.time())
+    assert [k for k, _ in seen] == ["wave_smoke", "partitioned_c30"]
+    final = detail["partitioned_c30"]
+    assert final["host_sched"] == 1 and final["host_rows_k"] == 4
+    assert final["sync_chunks"] == 2
+    # The scheduler rung's env was forced explicitly, fused psort off
+    # (inert on the crash-dom band; the artifact records the config).
+    env = seen[1][1]
+    assert env["JEPSEN_TPU_HOST_SCHED"] == "1"
+    assert env["JEPSEN_TPU_PSORT_FUSED"] == "0"
+    assert "partitioned_c30_wave8" not in detail
 
 
 def test_wave_rungs_skip_honestly_when_smoke_has_no_budget(
@@ -442,7 +477,7 @@ def test_wave_rungs_skip_honestly_when_smoke_has_no_budget(
     detail: dict = {}
     bench._wide_probes(detail, {"detail": detail}, time.time())
     assert "wave_smoke" not in seen and "wave_smoke" not in detail
-    for tag in ("wave8", "wave"):
+    for tag in ("sched", "wave8", "wave"):
         err = detail[f"partitioned_c30_{tag}"]["error"]
         assert "no budget to smoke-probe" in err
         assert "failed" not in err
@@ -499,7 +534,7 @@ def test_partitioned_ladder_reserves_floor_for_fallback(bench,
     # skipped, and the skips record the BUDGET reason, not a smoke
     # verdict that never existed.
     assert "wave_smoke" not in detail
-    for tag in ("wave8", "wave", "sticky", "r6"):
+    for tag in ("sched", "wave8", "wave", "sticky", "r6"):
         assert "budget" in detail[f"partitioned_c30_{tag}"]["error"]
     assert detail["partitioned_c30"]["verdict"] is True
     assert detail["partitioned_c30"]["budget_seconds"] == \

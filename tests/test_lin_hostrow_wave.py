@@ -51,6 +51,10 @@ def small_band_packed():
 def _run(monkeypatch, p, *, sticky, k, cap_schedule, host_caps, **kw):
     monkeypatch.setenv("JEPSEN_TPU_HOST_STICKY", str(sticky))
     monkeypatch.setenv("JEPSEN_TPU_HOST_ROWS_K", str(k))
+    # These tests cover the WAVE axes specifically; the episode
+    # scheduler (default on, its own coverage in test_lin_sched.py)
+    # would otherwise absorb every row before the wave path runs.
+    monkeypatch.setenv("JEPSEN_TPU_HOST_SCHED", "0")
     return bfs.check_packed(p, cap_schedule=cap_schedule,
                             host_caps=host_caps, **kw)
 

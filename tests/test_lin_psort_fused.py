@@ -1,0 +1,240 @@
+"""Fused in-VMEM closure-fixpoint kernel parity
+(lin/psort_fused.py, the kill-the-tunnel stage-floor half): one fused
+fixpoint must equal the unfused pass chain
+(bfs._closure_pass_keys_compact iterated to convergence) bit for bit —
+keys, count, convergence/overflow flags — in interpreter mode (the
+psort parity precedent; the real Mosaic backend rides the bench).
+
+The engine-level tests drive bfs.check_packed fused-on vs fused-off
+over the compact register band, single-key AND pair-key widths; the
+kernel-level test compares one fixpoint against the literal unfused
+loop on real per-row tables.
+
+Only the chip-free gate test rides the quick tier: the parity tests
+compile interpret-mode kernels at several (cap, M) shapes — minutes
+on a cold cache (the pair-band wave-parity precedent: compile-heavy
+parity stays in the default tier)."""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu.lin import bfs, prepare, psort_fused, synth
+
+quick = pytest.mark.quick
+pytestmark = pytest.mark.compiles
+
+
+@pytest.fixture(autouse=True)
+def _interpret(monkeypatch):
+    # The kernel's own gate (decoupled from JEPSEN_TPU_PSORT, whose
+    # pallas kernels need a newer pltpu API than some sandboxes have).
+    monkeypatch.setenv("JEPSEN_TPU_PSORT_FUSED", "interpret")
+
+
+@quick
+def test_fits_gate():
+    assert psort_fused.fits(1024, 8, 3)
+    assert not psort_fused.fits(8, 8, 3)          # below LANE
+    assert not psort_fused.fits(1000, 8, 3)       # not a power of two
+    assert not psort_fused.fits(1 << 17, 32, 3)   # past the VMEM bound
+    assert not psort_fused.fits(1024, 8, 7)       # state id past 6 bits
+
+
+def _packed(n, concurrency, seed, value_range=5):
+    h = synth.generate_register_history(
+        n, concurrency=concurrency, seed=seed,
+        value_range=value_range, crash_prob=0)
+    return prepare.prepare(m.cas_register(), h)
+
+
+def _parity(monkeypatch, p, cap_schedule):
+    monkeypatch.setenv("JEPSEN_TPU_PSORT_FUSED", "0")
+    off = bfs.check_packed(p, cap_schedule=cap_schedule)
+    monkeypatch.setenv("JEPSEN_TPU_PSORT_FUSED", "interpret")
+    on = bfs.check_packed(p, cap_schedule=cap_schedule)
+    assert on["valid?"] is off["valid?"]
+    assert on.get("final-frontier-size") == \
+        off.get("final-frontier-size")
+    return on
+
+
+def test_engine_parity_single_key(monkeypatch):
+    # Window ~20 + 3 state bits: single-u32 keys, compact tables.
+    # Shapes kept SMALL: the interpret-mode bitonic chain is
+    # O(n log^2 n) per pass per row on the CPU mesh.
+    p = _packed(60, 16, 7)
+    assert p.window + max(len(p.unintern), 2).bit_length() <= 31
+    r = _parity(monkeypatch, p, (256,))
+    assert r["valid?"] is True
+
+
+@pytest.mark.slow
+def test_engine_parity_pair_key(monkeypatch):
+    # Wider window pushes past 31 bits: (hi, lo) pair keys — the
+    # cockroach-class band the fused kernel exists for. SLOW tier:
+    # ~70 rows each paying the interpret-mode pair-bitonic chain run
+    # minutes on the CPU mesh; tier-1 pair coverage is the one-row
+    # kernel-level parity below (the 100k-txn acceptance-twin
+    # precedent).
+    p = _packed(140, 40, 3)
+    assert p.window + max(len(p.unintern), 2).bit_length() > 31
+    r = _parity(monkeypatch, p, (128,))
+    assert r["valid?"] is True
+
+
+def test_kernel_fixpoint_matches_unfused_chain_pair(monkeypatch):
+    # One PAIR-KEY fused fixpoint vs the literal unfused loop on a
+    # real wide-window row: keys (both words), count, flags.
+    import jax.numpy as jnp
+
+    p = _packed(140, 40, 3)
+    b = max(len(p.unintern), 2).bit_length()
+    nil_id = max(len(p.unintern), 2)
+    W = p.window
+    assert W + b > 31
+    exp_h = bfs.expansion_tables(p, b)
+    pure_h, _ = bfs.reduction_bit_tables(p, (W + 31) // 32)
+    r = next(i for i in range(p.R)
+             if np.asarray(exp_h[4])[i].any())
+    act = jnp.asarray(np.asarray(p.active)[r])
+    v_row = jnp.asarray(np.asarray(p.slot_v)[r])
+    pure_row = jnp.asarray(pure_h[r])
+    exp_r = tuple(jnp.asarray(t[r]) for t in exp_h)
+    M = int(exp_h[0].shape[-1])
+    cap = 128
+    assert psort_fused.fits(cap, M, b)
+    it_max = W + 12
+
+    fill = np.full(cap, 0xFFFFFFFF, np.uint32)
+    lo0, hi0 = fill.copy(), fill.copy()
+    lo0[0] = nil_id       # initial config: empty bitset, nil state
+    hi0[0] = 0
+    lo = jnp.asarray(lo0)
+    hi = jnp.asarray(hi0)
+    count = jnp.int32(1)
+
+    ulo, uhi, ucnt = lo, hi, count
+    passes = 0
+    while True:
+        ulo, uhi, ucnt, changed, ovf = bfs._closure_pass_keys_compact(
+            ulo, uhi, ucnt, act, v_row, pure_row, exp_r, cap=cap,
+            W=W, b=b, nil_id=nil_id, step_fn=p.kernel.step,
+            use_psort=False, crash_dom=False)
+        passes += 1
+        assert not bool(ovf)
+        if not bool(changed):
+            break
+        assert passes < it_max
+
+    cols, sats = bfs._fused_row_tables(exp_r, act, v_row, pure_row,
+                                       W=W, b=b, nil_id=nil_id)
+    flo, fhi, fcnt, conv, fovf = psort_fused.fixpoint(
+        lo, hi, count, cols, sats, cap=cap, b=b, it_max=it_max)
+    assert bool(conv) and not bool(fovf)
+    assert int(fcnt) == int(ucnt)
+    assert np.array_equal(np.asarray(flo), np.asarray(ulo))
+    assert np.array_equal(np.asarray(fhi), np.asarray(uhi))
+
+
+def test_engine_parity_on_corrupted_history(monkeypatch):
+    # An invalid history must die at the same row fused and unfused.
+    h = synth.corrupt_history(
+        synth.generate_register_history(60, concurrency=16, seed=7,
+                                        value_range=5, crash_prob=0),
+        seed=2)
+    p = prepare.prepare(m.cas_register(), h)
+    monkeypatch.setenv("JEPSEN_TPU_PSORT_FUSED", "0")
+    off = bfs.check_packed(p, cap_schedule=(256,))
+    monkeypatch.setenv("JEPSEN_TPU_PSORT_FUSED", "interpret")
+    on = bfs.check_packed(p, cap_schedule=(256,))
+    assert on["valid?"] is off["valid?"]
+    if off["valid?"] is False:
+        assert on["op"] == off["op"]
+        assert on["dead-row"] == off["dead-row"]
+
+
+def test_kernel_fixpoint_matches_unfused_chain(monkeypatch):
+    # One fused fixpoint vs the literal unfused pass loop on real
+    # per-row tables: keys, count, and flags must match exactly.
+    import jax.numpy as jnp
+
+    p = _packed(60, 16, 7)
+    b = max(len(p.unintern), 2).bit_length()
+    nil_id = max(len(p.unintern), 2)
+    W = p.window
+    exp_h = bfs.expansion_tables(p, b)
+    pure_h, _ = bfs.reduction_bit_tables(p, (W + 31) // 32)
+    active_h = np.asarray(p.active)
+    slot_v_h = np.asarray(p.slot_v)
+    step_fn = p.kernel.step
+    cap = 256
+    it_max = W + 12
+
+    # A mid-history row with live mutator columns.
+    r = next(i for i in range(p.R)
+             if np.asarray(exp_h[4])[i].any())
+    act = jnp.asarray(active_h[r])
+    v_row = jnp.asarray(slot_v_h[r])
+    pure_row = jnp.asarray(pure_h[r])
+    exp_r = tuple(jnp.asarray(t[r]) for t in exp_h)
+    M = int(exp_h[0].shape[-1])
+    assert psort_fused.fits(cap, M, b)
+
+    # Entry frontier: the initial config.
+    lo0 = np.full(cap, 0xFFFFFFFF, np.uint32)
+    lo0[0] = nil_id if int(p.init_state[0]) < 0 else int(p.init_state[0])
+    lo = jnp.asarray(lo0)
+    count = jnp.int32(1)
+
+    # Unfused chain to convergence.
+    ulo, ucnt = lo, count
+    passes = 0
+    while True:
+        ulo, _, ucnt, changed, ovf = bfs._closure_pass_keys_compact(
+            ulo, None, ucnt, act, v_row, pure_row, exp_r, cap=cap,
+            W=W, b=b, nil_id=nil_id, step_fn=step_fn, use_psort=False,
+            crash_dom=False)
+        passes += 1
+        assert not bool(ovf)
+        if not bool(changed):
+            break
+        assert passes < it_max
+
+    cols, sats = bfs._fused_row_tables(exp_r, act, v_row, pure_row,
+                                       W=W, b=b, nil_id=nil_id)
+    flo, fhi, fcnt, conv, fovf = psort_fused.fixpoint(
+        lo, None, count, cols, sats, cap=cap, b=b, it_max=it_max)
+    assert fhi is None
+    assert bool(conv) and not bool(fovf)
+    assert int(fcnt) == int(ucnt)
+    assert np.array_equal(np.asarray(flo), np.asarray(ulo))
+
+
+def test_kernel_reports_budget_exhaustion(monkeypatch):
+    # it_max=1 on a row needing several passes: the kernel must report
+    # non-convergence (the engine's honest overflow signal), never
+    # loop or lie.
+    import jax.numpy as jnp
+
+    p = _packed(60, 16, 7)
+    b = max(len(p.unintern), 2).bit_length()
+    nil_id = max(len(p.unintern), 2)
+    W = p.window
+    exp_h = bfs.expansion_tables(p, b)
+    pure_h, _ = bfs.reduction_bit_tables(p, (W + 31) // 32)
+    r = next(i for i in range(p.R)
+             if np.asarray(exp_h[4])[i].sum() >= 2)
+    act = jnp.asarray(np.asarray(p.active)[r])
+    v_row = jnp.asarray(np.asarray(p.slot_v)[r])
+    pure_row = jnp.asarray(pure_h[r])
+    exp_r = tuple(jnp.asarray(t[r]) for t in exp_h)
+    cap = 256
+    lo0 = np.full(cap, 0xFFFFFFFF, np.uint32)
+    lo0[0] = nil_id
+    cols, sats = bfs._fused_row_tables(exp_r, act, v_row, pure_row,
+                                       W=W, b=b, nil_id=nil_id)
+    _, _, _, conv, ovf = psort_fused.fixpoint(
+        jnp.asarray(lo0), None, jnp.int32(1), cols, sats, cap=cap,
+        b=b, it_max=1)
+    assert not bool(conv) and not bool(ovf)

@@ -1,0 +1,198 @@
+"""Device-resident episode scheduler (the kill-the-tunnel tentpole,
+bfs._host_sched_rows): a row QUEUE runs as ONE device program that
+commits its clean prefix in-program — an OPTIMIZATION over the proven
+per-row/wave ladder that must change dispatch counts, never verdicts.
+
+Coverage split by cost (the test_lin_hostrow_wave precedent): the
+window-34 pair-band witness shape carries the acceptance criterion —
+verdict/death-row/final-paths parity vs the K=4 wave path AND the CPU
+oracle, with STRICTLY FEWER dispatches — while the cheap single-key
+crash-dom band carries the mechanics: forced-trip per-row resume,
+quarantined-shape routing, and checkpoint/resume mid-episode."""
+
+import os
+import threading
+
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu.lin import bfs, cpu, prepare, supervise, synth
+
+quick = pytest.mark.quick
+pytestmark = pytest.mark.compiles
+
+
+@pytest.fixture(autouse=True)
+def _ledger(tmp_path, monkeypatch):
+    # Isolated quarantine ledger: these tests write real entries.
+    monkeypatch.setenv("JEPSEN_TPU_QUARANTINE",
+                       str(tmp_path / "quarantine.json"))
+
+
+@pytest.fixture(scope="module")
+def pair_band_packed():
+    # The corrupted window-34 partition shape of the crashdom witness
+    # suite (identical params — shared compiled shapes).
+    h = synth.generate_partitioned_register_history(
+        140, concurrency=40, seed=0, partition_every=60,
+        partition_len=20, max_crashes=10)
+    return prepare.prepare(m.cas_register(),
+                           synth.corrupt_history(h, seed=3))
+
+
+@pytest.fixture(scope="module")
+def small_band_packed():
+    h = synth.generate_register_history(60, concurrency=6, seed=1,
+                                        crash_prob=0.25)
+    return prepare.prepare(m.cas_register(), h)
+
+
+def _run(monkeypatch, p, *, sched, cap_schedule, host_caps, **kw):
+    monkeypatch.setenv("JEPSEN_TPU_HOST_STICKY", "1")
+    monkeypatch.setenv("JEPSEN_TPU_HOST_ROWS_K", "4")
+    monkeypatch.setenv("JEPSEN_TPU_HOST_SCHED", str(sched))
+    return bfs.check_packed(p, cap_schedule=cap_schedule,
+                            host_caps=host_caps, **kw)
+
+
+def _run_pair(monkeypatch, p, *, sched, **kw):
+    return _run(monkeypatch, p, sched=sched, cap_schedule=(8,),
+                host_caps=(64, 4096), **kw)
+
+
+def _run_small(monkeypatch, p, *, sched=1, host_caps=(8, 64, 512)):
+    return _run(monkeypatch, p, sched=sched, cap_schedule=(1,),
+                host_caps=host_caps)
+
+
+def test_sched_matches_wave_and_oracle_with_fewer_dispatches(
+        monkeypatch, pair_band_packed):
+    # THE acceptance criterion (ISSUE 14): on the window-34 pair-band
+    # witness shape the scheduler decides with strictly fewer
+    # dispatches than the K=4 wave path, with verdict / death row /
+    # final-paths identical to the wave path and the CPU oracle.
+    p = pair_band_packed
+    assert p.window + max(len(p.unintern), 2).bit_length() > 31
+    assert len(p.crashed_ops) > 0
+
+    wave = _run_pair(monkeypatch, p, sched=0, explain=True)
+    assert wave["valid?"] is False and wave["final-paths"]
+
+    got = _run_pair(monkeypatch, p, sched=1, explain=True)
+    assert got["valid?"] is False
+    assert got["op"] == wave["op"]
+    assert got["dead-row"] == wave["dead-row"]
+    assert got["final-paths"]
+
+    want = cpu.check_packed(p)
+    assert want["valid?"] is False and got["op"] == want["op"]
+
+    s, w = got["host-stats"], wave["host-stats"]
+    assert s["sched_dispatches"] >= 1 and s["sched_rows"] >= 1
+    assert s["dispatches"] < w["dispatches"], (
+        f"scheduler must cut dispatches: sched={s} wave={w}")
+
+
+@quick
+def test_sched_commits_queue_rows_per_dispatch(monkeypatch,
+                                               small_band_packed):
+    # With a comfortable single cap (no escalation) the scheduler
+    # must amortize: strictly fewer closure dispatches than rows.
+    got = _run_small(monkeypatch, small_band_packed, host_caps=(512,))
+    assert got["valid?"] is True
+    s = got["host-stats"]
+    assert s["sched_rows"] > 0 and s["sched_trips"] == 0
+    assert s["dispatches"] < s["rows"], s
+
+
+@quick
+def test_forced_trip_resumes_per_row(monkeypatch, small_band_packed):
+    # A tiny first host cap trips scheduler rows on overflow; the
+    # committed prefix must stand and the tripped row must resume on
+    # the proven per-row ladder — same verdict as the scheduler-off
+    # run, with the trip visible in the stats.
+    p = small_band_packed
+    off = _run_small(monkeypatch, p, sched=0)
+    assert off["valid?"] is True
+
+    on = _run_small(monkeypatch, p, sched=1)
+    assert on["valid?"] is True
+    s = on["host-stats"]
+    assert s["sched_trips"] >= 1, \
+        "caps this tiny must trip at least one scheduler row"
+    # The tripped row's passes are discarded work; committed rows are
+    # not — both visible in the waste observability.
+    assert s["wasted_passes"] >= 1
+    assert s["rows"] > s["sched_rows"] - s["rows"]  # per-row activity
+
+
+@quick
+def test_quarantined_sched_shape_routes_to_wave(monkeypatch,
+                                                small_band_packed):
+    # A quarantined scheduler shape must skip the scheduler program
+    # entirely (sched_dispatches == 0) and still decide on the proven
+    # wave/per-row rungs.
+    p = small_band_packed
+    for cap in (8, 64, 512):
+        for qn in range(2, bfs._sched_queue() + 1):
+            supervise.record_fault(
+                supervise.shape_key("host-sched", rows=qn, cap=cap,
+                                    window=p.window,
+                                    kernel="cas-register"), "fault")
+    r = _run_small(monkeypatch, p, sched=1)
+    assert r["valid?"] is True
+    s = r["host-stats"]
+    assert s["sched_dispatches"] == 0
+    assert s["quarantine_skips"] >= 1
+    assert s["rows"] > 0
+
+
+@quick
+def test_wedged_sched_dispatch_falls_back_and_recovers(monkeypatch,
+                                                       small_band_packed):
+    # A wedged scheduler dispatch costs its detection window, falls to
+    # the proven rungs for one row, and the search still decides.
+    supervise.inject_wedge("host-sched", 2, deadline_s=0.2)
+    try:
+        r = _run_small(monkeypatch, small_band_packed, sched=1)
+    finally:
+        supervise._injected.clear()
+    assert r["valid?"] is True
+    assert r["host-stats"]["watchdog_trips"] >= 1
+
+
+def test_ckpt_resume_mid_episode_parity(monkeypatch, pair_band_packed,
+                                        tmp_path):
+    # Kill the search right after a scheduler-committed episode
+    # boundary checkpoint; the resumed run must produce an identical
+    # verdict/death-row/final-paths (the test_lin_ckpt_resume
+    # invariant, now with the scheduler owning the episode commits).
+    p = pair_band_packed
+    full = _run_pair(monkeypatch, p, sched=1, explain=True)
+    assert full["valid?"] is False and full["final-paths"]
+
+    ck = str(tmp_path / "sched.ckpt.npz")
+    ckpt = supervise.Checkpointer(ck, supervise.history_fingerprint(p),
+                                  every_s=0)
+    cancel = threading.Event()
+    saves = []
+
+    def on_save(kind, row):
+        saves.append((kind, row))
+        if kind == "host":
+            cancel.set()
+
+    ckpt.on_save = on_save
+    killed = _run_pair(monkeypatch, p, sched=1, cancel=cancel,
+                       checkpoint=ckpt, explain=True)
+    assert killed["valid?"] == "unknown"
+    assert os.path.exists(ck)
+    assert any(kind == "host" for kind, _ in saves)
+
+    resumed = _run_pair(monkeypatch, p, sched=1, checkpoint=ck,
+                        explain=True)
+    assert resumed["valid?"] is False
+    assert resumed["resumed-from-row"] == saves[-1][1]
+    assert resumed["op"] == full["op"]
+    assert resumed["dead-row"] == full["dead-row"]
+    assert not os.path.exists(ck)

@@ -28,6 +28,16 @@ def _clean_injections():
     supervise._injected.clear()
 
 
+@pytest.fixture(autouse=True)
+def _no_sched(monkeypatch):
+    # The e2e ladder tests here inject/quarantine at the per-row sites
+    # (host-fixpoint / host-pass / host-wave) — force the episode
+    # scheduler off so those sites actually dispatch (the scheduler,
+    # default on, would absorb every clean row first; its own ladder
+    # coverage lives in tests/test_lin_sched.py).
+    monkeypatch.setenv("JEPSEN_TPU_HOST_SCHED", "0")
+
+
 @pytest.fixture()
 def ledger(tmp_path, monkeypatch):
     path = str(tmp_path / "quarantine.json")

@@ -317,6 +317,34 @@ def test_render_and_summary():
     assert "dispatch_s" in s and "compile_s" in s
 
 
+def test_attribution_episode_dispatch_histogram():
+    # The per-episode dispatch histogram (ISSUE 14 satellite): bfs
+    # stamps host-stats dispatch/row deltas on each host-episode
+    # span; attribution buckets dispatches/episode so the episode
+    # scheduler's drop reads straight off a probe-config5 trace.
+    evs = [
+        _ev("check", 10.0),
+        _ev("host-episode", 2.0, row=0, dispatches=1, rows=30),
+        _ev("host-episode", 2.0, row=30, dispatches=2, rows=32),
+        _ev("host-episode", 2.0, row=62, dispatches=9, rows=12),
+    ]
+    agg = report.attribution(evs)
+    ep = agg["episodes"]
+    assert ep["n"] == 3 and ep["dispatches"] == 12
+    assert ep["rows"] == 74
+    assert ep["dispatches_per_episode"] == 4.0
+    assert ep["rows_per_dispatch"] == round(74 / 12, 2)
+    assert ep["histogram"] == {"1": 1, "2-3": 1, "8-15": 1}
+    text = report.render(agg)
+    assert "host episodes" in text and "dispatches/episode" in text
+    # Episodes WITHOUT the deltas (pre-ISSUE-14 traces) keep the old
+    # "other" row and no episodes block.
+    agg2 = report.attribution([_ev("check", 1.0),
+                               _ev("host-episode", 0.5, row=0)])
+    assert "episodes" not in agg2
+    assert agg2["other"]["host-episode"]["n"] == 1
+
+
 # --- metrics registry -------------------------------------------------------
 
 

@@ -413,3 +413,105 @@ class TestG2Coverage:
             [("ok", "fail"), ("fail", "ok")]), {})
         assert r["valid?"] is True
         assert r["keys-exercised"] == 2
+
+
+class TestFastInferenceParity:
+    """pack.infer_fast (the ISSUE 14 numpy vectorization) must be
+    BYTE-IDENTICAL to oracle.infer — edge arrays, anomaly witnesses
+    (order included), and stats — on every history class; the oracle
+    stays the spec and the cpu-algorithm leg never shares the fast
+    code."""
+
+    @staticmethod
+    def _assert_same(h, realtime=False):
+        import numpy as np
+
+        from jepsen_tpu.txn import pack
+
+        a = oracle.infer(h, realtime=realtime)
+        b = pack.infer_fast(h, realtime=realtime)
+        assert a.n == b.n
+        assert np.array_equal(a.src, b.src)
+        assert np.array_equal(a.dst, b.dst)
+        assert np.array_equal(a.typ, b.typ)
+        assert a.anomalies == b.anomalies
+        assert a.stats == b.stats
+
+    def test_healthy_fuzz(self):
+        for seed in range(4):
+            h = synth.generate_list_append_history(
+                400, concurrency=8, keys=5, seed=seed,
+                crash_prob=0.02)
+            self._assert_same(h)
+            self._assert_same(h, realtime=True)
+
+    def test_seeded_anomaly_corpora(self):
+        h = synth.generate_list_append_history(
+            300, concurrency=8, keys=4, seed=11, crash_prob=0.01)
+        for kind in ("G0", "G1c", "G-single", "G2-item", "G1a"):
+            self._assert_same(synth.splice_anomaly(h, kind, seed=5))
+
+    def test_corrupted_reads_take_the_oracle_path(self):
+        # Mutated read heads fail the prefix check (incompatible-order
+        # + garbage-read): the fast path must fall to the literal
+        # per-element loop and still match exactly.
+        h = list(synth.generate_list_append_history(
+            200, concurrency=6, keys=3, seed=9))
+        mutated = 0
+        for op in h:
+            if op.type == "ok" and op.value and mutated < 3:
+                for m in op.value:
+                    if m[0] == "r" and m[2] and len(m[2]) > 1:
+                        m[2][0] = 10 ** 6 + mutated
+                        mutated += 1
+                        break
+        assert mutated
+        self._assert_same(h)
+
+    def test_float_values_never_truncate_into_false_prefix(self):
+        # Regression (review finding): a corrupt store returning 1.5
+        # must NOT truncate to 1 in the int columns and pass the
+        # prefix check — oracle reports garbage-read +
+        # incompatible-order, and the fast path must match exactly.
+        h = []
+        _txn(h, 0, [["append", "x", 1]])
+        _txn(h, 1, [["append", "x", 2]])
+        _txn(h, 2, [["r", "x", None]], [["r", "x", [1, 2]]])
+        _txn(h, 3, [["r", "x", None]], [["r", "x", [1.5]]])
+        g = oracle.infer(h)
+        assert "garbage-read" in g.anomalies
+        assert "incompatible-order" in g.anomalies
+        self._assert_same(h)
+
+    def test_non_int_values_degrade_to_spec(self):
+        # String values defeat the int columns: every read takes the
+        # oracle's literal path — same answers, no crash.
+        h = []
+        _txn(h, 0, [["append", "x", "a"]])
+        _txn(h, 1, [["append", "x", "b"]])
+        _txn(h, 2, [["r", "x", None]], [["r", "x", ["a"]]])
+        _txn(h, 3, [["r", "x", None]], [["r", "x", ["a", "b"]]])
+        self._assert_same(h)
+
+    def test_duplicate_and_aborted_reads(self):
+        # A failed append observed by a read (G1a) plus an in-read
+        # duplicate: witness dicts and counts must match exactly.
+        h = []
+        _txn(h, 0, [["append", "x", 1]])
+        _txn(h, 1, [["append", "x", 9]], typ="fail")
+        _txn(h, 2, [["r", "x", None]], [["r", "x", [1, 9, 9]]])
+        self._assert_same(h)
+
+    def test_pack_uses_fast_inference(self):
+        from jepsen_tpu.txn import pack
+
+        h = synth.generate_list_append_history(
+            200, concurrency=6, keys=3, seed=4)
+        pt = pack.pack(h)
+        g = oracle.infer(h)
+        import numpy as np
+
+        order = np.lexsort((g.typ, g.dst, g.src))
+        assert np.array_equal(pt.edge_src, g.src[order])
+        assert np.array_equal(pt.edge_dst, g.dst[order])
+        assert np.array_equal(pt.edge_typ, g.typ[order])
