@@ -2,7 +2,7 @@
 
 .PHONY: test test-quick integration integration-local bench \
 	probe-config5 serve-smoke txn-smoke trace-smoke stream-smoke \
-	fleet-smoke lint
+	fleet-smoke perf-smoke lint
 
 # Unit + parity suite on the virtual 8-device CPU mesh (no cluster).
 # Hardware note: ~8 min on a 4-core box; the compile-heavy lin parity
@@ -130,6 +130,20 @@ trace-smoke:
 	timeout -k 15 $(TRACE_SMOKE_TIMEOUT) \
 		python -m jepsen_tpu.obs.smoke
 
+# Perf-ledger smoke (doc/observability.md § Perf ledger): chip-free
+# record -> report -> gate round trip on a CPU-mesh check — a real
+# check recorded with git sha + env fingerprint, `perf report` renders
+# its trend row, `perf gate` passes the healthy history AND catches a
+# seeded injected regression (wall-time and verdict-flip cases both
+# demonstrated, against a throwaway ledger so fabricated evidence
+# never pollutes the real trajectory). Run it after touching
+# jepsen_tpu/obs/ledger.py, the bench's ledger recording, or the gate
+# rules.
+PERF_SMOKE_TIMEOUT ?= 600
+perf-smoke:
+	timeout -k 15 $(PERF_SMOKE_TIMEOUT) \
+		python -m jepsen_tpu.obs.perf_smoke
+
 PROBE_CONFIG5_TIMEOUT ?= 5400
 # Frontier checkpoint: a probe killed by the timeout (or a fault)
 # leaves .jax_cache/probe_config5.ckpt.npz, and the NEXT probe-config5
@@ -142,15 +156,26 @@ PROBE_CONFIG5_CKPT ?= .jax_cache/probe_config5.ckpt.npz
 # per-cap dispatch wall, compile, wasted rungs) and the trace summary
 # rides in the probe JSON (doc/observability.md).
 PROBE_CONFIG5_TRACE ?= .jax_cache/probe_config5.trace.jsonl
+# After the run BOTH evidence deltas print: the quarantine-ledger
+# delta (newly faulting shapes) and the perf-ledger delta (the probe's
+# new record vs its trailing median — cli.py perf diff, the
+# cross-run memory of doc/observability.md § Perf ledger).
 probe-config5:
 	@mkdir -p .jax_cache
-	@cp .jax_cache/quarantine.json /tmp/jepsen_tpu_q5_before.json \
+	@cp "$${JEPSEN_TPU_QUARANTINE:-.jax_cache/quarantine.json}" \
+		/tmp/jepsen_tpu_q5_before.json \
 		2>/dev/null || echo '{"shapes": {}}' \
 		> /tmp/jepsen_tpu_q5_before.json
+	@cp "$${JEPSEN_TPU_PERF_LEDGER:-.jax_cache/perf_ledger.jsonl}" \
+		/tmp/jepsen_tpu_p5_before.jsonl \
+		2>/dev/null || : > /tmp/jepsen_tpu_p5_before.jsonl
 	timeout -k 30 $(PROBE_CONFIG5_TIMEOUT) \
 		env JEPSEN_TPU_CKPT=$(PROBE_CONFIG5_CKPT) \
 		JEPSEN_TPU_TRACE=1 \
 		JEPSEN_TPU_TRACE_FILE=$(PROBE_CONFIG5_TRACE) \
+		JEPSEN_TPU_PERF_TAG=probe-config5 \
 		python bench.py --probe partitioned_c30; rc=$$?; \
 	python -m jepsen_tpu.cli quarantine diff \
-		--before /tmp/jepsen_tpu_q5_before.json; exit $$rc
+		--before /tmp/jepsen_tpu_q5_before.json; \
+	python -m jepsen_tpu.cli perf diff \
+		--before /tmp/jepsen_tpu_p5_before.jsonl; exit $$rc

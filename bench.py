@@ -743,15 +743,22 @@ def _run_probe_subprocess(key: str, timeout: int, env_extra=None,
             return r, None
         except json.JSONDecodeError:
             continue
+    # no_child_result marks a PARENT-synthesized error: the child died
+    # without printing (and so without writing its perf-ledger record
+    # — the record happens just before the print). _run_probe records
+    # on its behalf, or a persistently wedging probe would read green
+    # to `perf gate` forever.
     if why == "timeout":
         return {"error": f"probe timed out after {timeout}s",
-                "kill": kill_info}, why
+                "kill": kill_info, "no_child_result": True}, why
     if why == "stall":
         return {"error": (f"probe stalled: no progress for "
                           f"{int(stall_s)}s (wedged dispatch), "
-                          "killed"), "kill": kill_info}, why
+                          "killed"), "kill": kill_info,
+                "no_child_result": True}, why
     tail = (state.get("stderr", "") + "\n".join(lines))[-2000:]
-    return {"error": f"probe exited rc={proc.returncode}: {tail}"}, None
+    return {"error": f"probe exited rc={proc.returncode}: {tail}",
+            "no_child_result": True}, None
 
 
 def _run_probe(key: str, timeout: int, env_extra=None,
@@ -759,19 +766,40 @@ def _run_probe(key: str, timeout: int, env_extra=None,
     """_run_probe_subprocess + ONE kill-and-retry on a stall (the
     shared-chip tunnel wedge is transient; a wedged probe should cost
     its detection window, not its full budget). The retry gets the
-    budget that remains and is recorded in the artifact."""
+    budget that remains and is recorded in the artifact. A FINAL
+    result whose child died without printing is ledger-recorded here
+    on the child's behalf (see no_child_result) — error evidence the
+    perf gate's error-appeared/still-erroring rules need."""
     t0 = time.time()
     r, why = _run_probe_subprocess(key, timeout, env_extra=env_extra,
                                    stall_s=stall_s)
-    if why != "stall":
-        return r
-    first = r
-    remaining = max(60, int(timeout - (time.time() - t0)))
-    r2, _ = _run_probe_subprocess(key, remaining, env_extra=env_extra,
-                                  stall_s=stall_s)
-    r2["stall_retries"] = 1
-    r2["first_attempt"] = first
-    return r2
+    if why == "stall":
+        first = r
+        remaining = max(60, int(timeout - (time.time() - t0)))
+        r, _ = _run_probe_subprocess(key, remaining,
+                                     env_extra=env_extra,
+                                     stall_s=stall_s)
+        r["stall_retries"] = 1
+        r["first_attempt"] = first
+    if r.get("no_child_result"):
+        try:
+            from jepsen_tpu.obs import ledger as perf_ledger
+
+            tag = (env_extra or {}).get("JEPSEN_TPU_PERF_TAG") \
+                or os.environ.get("JEPSEN_TPU_PERF_TAG") or key
+            perf_ledger.record(
+                tag, kind="bench", verdict=None,
+                error=str(r.get("error"))[:300],
+                # The record must carry the RUNG's forced config, not
+                # the parent's environment (the documented env/env_fp
+                # schema — forensics on a wedged rung must name the
+                # right knob set).
+                env_overlay=env_extra,
+                extra={"recorded_by": "parent",
+                       "kill": r.get("kill")})
+        except Exception:  # noqa: BLE001 - loss-proof contract
+            pass
+    return r
 
 
 def _verify_recovery() -> bool:
@@ -857,6 +885,12 @@ def _wide_probes(detail: dict, out: dict, t_start: float) -> None:
                          # artifact records): force the observe-only
                          # default on every rung.
                          "JEPSEN_TPU_STATIC_GATE": "warn",
+                         # Perf-ledger trend identity: each ladder
+                         # rung records under its own tag so the
+                         # sched/wave/unfused trajectories never mix
+                         # in one trend row (obs/ledger).
+                         "JEPSEN_TPU_PERF_TAG":
+                             f"partitioned_c30.{tag}",
                          "JEPSEN_TPU_CKPT": ck},
                         {"sync_chunks": sync, "fused_closure": fused,
                          "host_sticky": sticky, "host_rows_k": k,
@@ -893,7 +927,8 @@ def _wide_probes(detail: dict, out: dict, t_start: float) -> None:
                                "JEPSEN_TPU_HOST_STICKY": "1",
                                "JEPSEN_TPU_HOST_ROWS_K": "4",
                                "JEPSEN_TPU_PSORT_FUSED": "0",
-                               "JEPSEN_TPU_STATIC_GATE": "warn"},
+                               "JEPSEN_TPU_STATIC_GATE": "warn",
+                               "JEPSEN_TPU_PERF_TAG": "wave_smoke"},
                     stall_s=WAVE_SMOKE_BUDGET_S / 2)
                 detail["wave_smoke"] = smoke
                 _emit(out)
@@ -979,7 +1014,11 @@ def _wide_probes(detail: dict, out: dict, t_start: float) -> None:
             # Cap the stall window below the probe budget, or the
             # timeout check (evaluated first) always wins and the
             # kill-and-retry path can never fire for these probes.
+            # PERF_TAG is forced to the probe key (the rungs'
+            # forced-env invariant): an exported override must not
+            # collapse every probe's ledger record into one trend row.
             r = _run_probe(key, ceiling,
+                           env_extra={"JEPSEN_TPU_PERF_TAG": key},
                            stall_s=min(STALL_S, ceiling / 2))
         detail[key] = r
         _emit(out)
@@ -1003,6 +1042,18 @@ def _probe_main(key: str) -> None:
     enable_compile_cache()
     stop = threading.Event()
     lock = threading.Lock()
+    # Cross-run perf ledger (jepsen_tpu.obs.ledger, doc/observability.md
+    # § Perf ledger): snapshot the quarantine ledger NOW so the record
+    # this probe appends can carry the delta it caused. Best-effort —
+    # the ledger must never cost a probe result.
+    q_before = {}
+    try:
+        from jepsen_tpu.lin import supervise as _sup
+
+        q_before = dict(_sup.load_ledger())
+    except Exception:  # noqa: BLE001 - observability only
+        _sup = None
+    t_probe = time.time()
 
     def _heartbeat():
         # "HB <progress>": the engines' liveness counter
@@ -1021,6 +1072,7 @@ def _probe_main(key: str) -> None:
         r = PROBES[key]()
     except Exception:
         r = {"error": traceback.format_exc(limit=4)}
+    wall_s = time.time() - t_probe
     stop.set()
     # Flight recorder: a probe run under JEPSEN_TPU_TRACE=1 attaches
     # its attribution summary (per-site wall seconds, compile time,
@@ -1042,12 +1094,93 @@ def _probe_main(key: str) -> None:
             r["trace"] = obs_report.summary(evs)
             if spill:
                 r["trace"]["file"] = spill
+            if obs_trace.rotations():
+                # The spill rotated (JEPSEN_TPU_TRACE_MAX_MB): the
+                # summary covers only the live file's tail — say so,
+                # in the artifact AND the perf-ledger record.
+                r["trace"]["rotations"] = obs_trace.rotations()
     except Exception:  # noqa: BLE001 - observability must not cost
         pass           # the probe result
+    # ONE perf-ledger record per probe run (the cross-run memory every
+    # bench/probe-config5 rung feeds; ping is the recovery helper, not
+    # evidence). JEPSEN_TPU_PERF_TAG names the partitioned ladder's
+    # rung so each rung trends as its own row. record() never raises —
+    # a ledger I/O failure cannot cost the probe result below.
+    if key != "ping" and isinstance(r, dict):
+        try:
+            from jepsen_tpu.obs import ledger as perf_ledger
+
+            q_new = []
+            if _sup is not None:
+                # Only CRASH EVIDENCE fails the perf gate — judged by
+                # THE authoritative predicate (supervise.quarantined:
+                # faults always, wedges only at the quarantine
+                # streak, never the static gate's predictions), so
+                # the gate's evidence cannot drift from what actually
+                # routes.
+                q_new = sorted(
+                    k for k in _sup.ledger_delta(q_before)
+                    if _sup.quarantined(k) is not None)
+            extra = {}
+            if r.get("resumed_from_row") is not None:
+                # A checkpoint-resumed run's wall covers only the
+                # tail: the record says so, and ledger trend/gate
+                # exclude it from the wall/dispatch baselines (a
+                # 300 s resumed tail must not poison the median full
+                # 3217 s runs are judged against).
+                extra["resumed_from_row"] = r["resumed_from_row"]
+            perf_ledger.record(
+                os.environ.get("JEPSEN_TPU_PERF_TAG") or key,
+                kind="bench", wall_s=wall_s, verdict=r.get("verdict"),
+                error=r.get("error"), host_stats=r.get("host_stats"),
+                trace=r.get("trace"), fleet=r.get("fleet"),
+                quarantine_new=q_new, extra=extra)
+        except Exception:  # noqa: BLE001 - loss-proof contract
+            pass
     with lock:
         print(json.dumps(r))
         sys.stdout.flush()
     sys.exit(0)
+
+
+def _ledger_headline(detail: dict, rate: float,
+                     error: str | None = None) -> None:
+    """One perf-ledger record for the headline check (the probe
+    children record their own runs in ``_probe_main``). The crash-free
+    FALLBACK run stamps its error + variant so the gate's
+    error-appeared rule can see the degradation — a fallback that
+    looked like a healthy headline would blind the sentinel to exactly
+    the failure class it exists to catch. Never raises — obs/ledger's
+    loss-proof contract."""
+    try:
+        from jepsen_tpu.obs import ledger as perf_ledger
+
+        perf_ledger.record(
+            "headline", kind="bench",
+            wall_s=detail.get("check_seconds"),
+            verdict=detail.get("verdict"),
+            error=error,
+            extra={"ops_per_sec": round(rate, 1),
+                   "variant": detail.get("variant"),
+                   "check_seconds_runs":
+                       detail.get("check_seconds_runs"),
+                   "dense_backend": detail.get("dense_backend")})
+    except Exception:  # noqa: BLE001 - observability only
+        pass
+
+
+def _ledger_wide(wall_s: float, error: str | None) -> None:
+    """The wide-probes sweep's health row (see the call sites in
+    ``main``). Never raises — obs/ledger's loss-proof contract."""
+    try:
+        from jepsen_tpu.obs import ledger as perf_ledger
+
+        perf_ledger.record("wide-probes", kind="bench",
+                           wall_s=wall_s,
+                           verdict=True if error is None else None,
+                           error=error)
+    except Exception:  # noqa: BLE001 - observability only
+        pass
 
 
 def main() -> None:
@@ -1070,8 +1203,17 @@ def main() -> None:
                    vs_baseline=round(rate / target_rate, 3),
                    detail=detail)
         _emit(out)   # the headline survives any later timeout/fault
+        _ledger_headline(detail, rate)
         try:
+            t_wide = time.time()
             _wide_probes(detail, out, t_start)
+            # The probe MACHINERY's own health row: recorded True on
+            # every completed sweep so a later machinery crash (the
+            # except below) FLIPS it — without a baseline row, a
+            # bench whose probes silently stopped running would leave
+            # the sentinel green (the probes' own records just
+            # wouldn't exist).
+            _ledger_wide(time.time() - t_wide, None)
         except Exception:
             # A probe-machinery crash must not reach the headline
             # except-branch below: the crash-free fallback there
@@ -1086,6 +1228,8 @@ def main() -> None:
             out["error"] = ("wide probes crashed (headline + completed "
                             "probes retained): see "
                             "detail.wide_probes_error")
+            _ledger_wide(time.time() - t_wide,
+                         detail["wide_probes_error"])
     except Exception:
         err = traceback.format_exc(limit=3)
         # Partial signal: the crash-free 100k history on the same engine.
@@ -1098,10 +1242,15 @@ def main() -> None:
                        vs_baseline=round(rate / target_rate, 3),
                        detail=detail,
                        error=f"crashed-op run failed: {err}")
+            _ledger_headline(detail, rate, error=out.get("error"))
         except Exception:
             out.update(error=f"crashed-op run failed: {err}; "
                              f"fallback failed: "
                              f"{traceback.format_exc(limit=3)}")
+            # Even a total headline failure is evidence: a None
+            # verdict on the headline row makes the next `perf gate`
+            # flip against the last healthy record.
+            _ledger_headline({}, 0.0, error=out.get("error"))
 
     _emit(out)
     sys.exit(0 if "error" not in out else (0 if out["value"] else 1))

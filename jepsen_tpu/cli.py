@@ -740,6 +740,134 @@ def trace_cmd() -> dict:
                 "JSON."}
 
 
+@command
+def perf_cmd() -> dict:
+    """The cross-run perf ledger (jepsen_tpu.obs.ledger,
+    doc/observability.md § Perf ledger): ``report`` prints the
+    per-(probe, platform) trend table, ``diff --before SNAPSHOT``
+    prints records appended since a prior copy (the ``quarantine
+    diff`` precedent; ``make probe-config5`` runs it), and ``gate`` is
+    the CI-consumable regression sentinel — nonzero exit on a verdict
+    flip, a wall-time regression past ``JEPSEN_TPU_PERF_GATE_FRAC`` x
+    the trailing median, new quarantine entries, or
+    dispatches/episode growth."""
+
+    def build_parser(p: argparse.ArgumentParser):
+        p.add_argument("action", choices=["report", "diff", "gate"])
+        p.add_argument("--ledger", help="perf ledger path (default: "
+                                        "JEPSEN_TPU_PERF_LEDGER "
+                                        "resolution)")
+        p.add_argument("--probe", help="restrict to one probe tag")
+        p.add_argument("--before",
+                       help="for diff (required there): a prior copy "
+                            "of the ledger file")
+        p.add_argument("--frac", type=float, default=None,
+                       help="gate: regression threshold override "
+                            "(default JEPSEN_TPU_PERF_GATE_FRAC, "
+                            "1.5)")
+        p.add_argument("--json", action="store_true",
+                       help="machine-readable output")
+
+    def run_cmd(opts: argparse.Namespace) -> int:
+        import json
+
+        from jepsen_tpu.obs import ledger as ledger_mod
+
+        path = opts.ledger or ledger_mod.ledger_path()
+        if path is None:
+            print("perf ledger disabled (JEPSEN_TPU_PERF_LEDGER=0) "
+                  "and no --ledger given", file=sys.stderr)
+            return EXIT_ERROR
+        records = ledger_mod.load(path)
+        if opts.probe:
+            records = [r for r in records
+                       if r.get("probe") == opts.probe]
+        if opts.action == "report":
+            if not records:
+                print(f"no perf-ledger records at {path!r} — run a "
+                      f"bench probe or any `make *-smoke` first "
+                      f"(doc/observability.md § Perf ledger)",
+                      file=sys.stderr)
+                return EXIT_ERROR
+            rows = ledger_mod.trend(records)
+            if opts.json:
+                print(json.dumps(rows, indent=1, sort_keys=True))
+            else:
+                print(f"perf ledger: {path} ({len(records)} "
+                      f"record(s))")
+                print(ledger_mod.render_trend(rows))
+            return EXIT_OK
+        if opts.action == "diff":
+            # An unreadable --before must fail loudly (the quarantine
+            # diff precedent): silently treating it as empty would
+            # report every long-standing record as new.
+            if not opts.before:
+                print("perf diff requires --before SNAPSHOT",
+                      file=sys.stderr)
+                return EXIT_USAGE
+            try:
+                # Actually open it: exists() passes for a directory
+                # or a permission-denied file, which load() would
+                # silently treat as empty — the bogus full delta.
+                with open(opts.before) as fh:
+                    fh.read(1)
+            except OSError as e:
+                print(f"cannot read --before snapshot "
+                      f"{opts.before!r}: {e}", file=sys.stderr)
+                return EXIT_ERROR
+            before = ledger_mod.load(opts.before)
+            if opts.probe:
+                before = [r for r in before
+                          if r.get("probe") == opts.probe]
+            new = ledger_mod.diff(before, records)
+            if opts.json:
+                print(json.dumps(new, indent=1, default=str))
+            else:
+                print(ledger_mod.render_diff(
+                    new, ledger_mod.trend(records)))
+            return EXIT_OK
+        # gate — zero matching records must fail LOUDLY, not pass: a
+        # wrong --ledger path or a typo'd --probe tag would otherwise
+        # keep CI green forever with nothing under guard.
+        if not records:
+            print(f"perf gate: no records"
+                  + (f" for probe {opts.probe!r}" if opts.probe
+                     else "")
+                  + f" at {path!r} — nothing is under guard "
+                  f"(wrong path or tag?)", file=sys.stderr)
+            return EXIT_ERROR
+        # records are already --probe-filtered above: the cli owns
+        # the filter, gate() sees the final list. A malformed
+        # JEPSEN_TPU_PERF_GATE_FRAC fails LOUDLY with a clean message
+        # (not a traceback) — silently falling back to the default
+        # would gate at a threshold the operator did not choose,
+        # while trace's cosmetic MAX_MB knob may safely self-default.
+        try:
+            findings = ledger_mod.gate(records, frac=opts.frac)
+        except ValueError as e:
+            print(f"malformed JEPSEN_TPU_PERF_GATE_FRAC (or --frac): "
+                  f"{e}", file=sys.stderr)
+            return EXIT_ERROR
+        if opts.json:
+            print(json.dumps(findings, indent=1, sort_keys=True))
+        else:
+            print(ledger_mod.render_gate(findings))
+        return EXIT_OK if not findings else EXIT_INVALID
+
+    return {"name": "perf", "parser": build_parser, "run": run_cmd,
+            "help": "report/diff/gate the cross-run perf ledger "
+                    "(regression sentinel)",
+            "description":
+                "Cross-run perf ledger (doc/observability.md § Perf "
+                "ledger): every bench probe rung, probe-config5, and "
+                "chip-free smoke appends one record (git sha, "
+                "platform, env fingerprint, wall/verdict/host-stats/"
+                "quarantine delta). `report` prints the trend table, "
+                "`diff --before` the delta since a snapshot, `gate` "
+                "exits nonzero on a verdict flip / wall regression / "
+                "new quarantine entries / dispatch growth."}
+
+
 def run(commands, argv=None) -> int:
     """Dispatch subcommands (cli.clj:201-276). Returns the exit code; the
     `main` wrapper calls sys.exit with it."""

@@ -416,6 +416,12 @@ def run(test: dict) -> dict:
         log.info("Analysis complete")
         if test.get("name"):
             store.save_2(test)
+            # Evidence backfill (doc/observability.md § Perf ledger):
+            # the run directory always carries its latency/rate/
+            # timeline artifacts, whether or not the configured
+            # checker composed perf()/timeline — web.py links them
+            # from the home and dir pages. Best-effort by contract.
+            store.write_run_artifacts(test)
         _log_results(test)
         return test
     finally:

@@ -17,11 +17,17 @@ load them without dragging a backend in):
   where-did-the-time-go table (``cli.py trace report``), the
   Chrome/Perfetto trace-event export (``cli.py trace export``), and
   the compact summary bench probes attach to their JSON artifacts.
+- :mod:`jepsen_tpu.obs.ledger` — the CROSS-run perf ledger
+  (``JEPSEN_TPU_PERF_LEDGER``): every bench probe rung, probe-config5,
+  and chip-free smoke appends one record (git sha, platform, env-knob
+  fingerprint, wall/verdict/host-stats/trace/quarantine delta);
+  ``cli.py perf report|diff|gate`` and ``web.py /perf`` read it, and
+  ``perf gate`` is the CI-consumable regression sentinel.
 
 The tracer OBSERVES; it never routes — soundness-critical paths are
 untouched whether tracing is on or off.
 """
 
-from jepsen_tpu.obs import metrics, report, trace  # noqa: F401
+from jepsen_tpu.obs import ledger, metrics, report, trace  # noqa: F401
 from jepsen_tpu.obs.metrics import REGISTRY, load_json_snapshot  # noqa: F401
 from jepsen_tpu.obs.trace import enabled, span, tail_note  # noqa: F401

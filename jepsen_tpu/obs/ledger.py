@@ -1,0 +1,662 @@
+"""Cross-run perf ledger: every bench/probe/smoke run recorded.
+
+Jepsen keeps durable evidence of every run (``jepsen.store`` run
+directories, ``checker.perf`` graphs); the TPU rebuild had the in-run
+flight recorder (obs/trace) but no CROSS-run memory — the bench
+trajectory lived in BENCH_*.json files nothing collated, and a perf
+regression or verdict flip introduced by an engine change stayed
+invisible until someone re-read raw JSON. This module is that memory:
+
+- an APPEND-ONLY JSONL ledger (``JEPSEN_TPU_PERF_LEDGER``, default
+  ``<repo>/.jax_cache/perf_ledger.jsonl``; ``0`` disables) that every
+  evidence producer writes ONE record into — each ``bench.py`` probe
+  rung (via ``_probe_main``), the headline, ``make probe-config5``,
+  and the five chip-free smokes (serve/txn/trace/stream/fleet) plus
+  ``make perf-smoke``;
+- each record stamped with the git sha, the platform (``cpu`` mesh vs
+  ``tpu``), an env-knob FINGERPRINT of the forced rung config (every
+  ``JEPSEN_TPU_*`` var in the environment), and the quarantine-ledger
+  delta the run produced;
+- an atomic ``<ledger>.index.json`` summary (``util.write_json_atomic``)
+  for monitoring without parsing the JSONL;
+- :func:`trend` — the per-(probe, platform) trend table behind
+  ``cli.py perf report`` and ``web.py /perf``;
+- :func:`diff` — records appended since a prior snapshot (the
+  ``quarantine diff`` precedent; ``make probe-config5`` prints it);
+- :func:`gate` — the CI-consumable regression sentinel behind
+  ``cli.py perf gate``: nonzero exit on a verdict flip vs the last
+  same-shape record (hard fail), wall_s past
+  ``JEPSEN_TPU_PERF_GATE_FRAC`` (1.5x) of the trailing median, new
+  quarantine entries, or dispatches/episode growth.
+
+Writes are FAULT-ISOLATED at the producer: :func:`record` never
+raises, so a ledger I/O failure can never cost a probe result (the
+loss-proof bench contract). The reader is torn-tail tolerant — a
+SIGKILL mid-append costs one record, never the ledger.
+
+jax-free at import time (web.py and the CLI load this without dragging
+a backend in); platform detection only consults jax when the caller's
+process already imported it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import statistics
+import sys
+import time
+
+from jepsen_tpu import util
+
+# Trailing-window length for medians (wall seconds, dispatches/episode):
+# long enough to ride out tunnel variance (bench takes best-of-3 for
+# the same reason), short enough that a genuine perf change re-anchors
+# the baseline within a few runs.
+TRAIL = 8
+# Minimum prior same-shape records before the RATIO gates (wall,
+# dispatches/episode) fire: one sample is not a trend on a shared-chip
+# tunnel with run-to-run variance. The verdict-flip and new-quarantine
+# gates need only one prior record / none.
+MIN_TREND = 2
+
+_TS_FMT = "%Y-%m-%dT%H:%M:%SZ"
+
+
+def ledger_path() -> str | None:
+    """The perf ledger path; ``JEPSEN_TPU_PERF_LEDGER=0`` disables
+    recording entirely (tests that must not touch the shared file set
+    their own path instead)."""
+    env = os.environ.get("JEPSEN_TPU_PERF_LEDGER", "")
+    if env == "0":
+        return None
+    if env:
+        return env
+    return os.path.join(util.cache_dir(), "perf_ledger.jsonl")
+
+
+def gate_frac() -> float:
+    """Regression threshold: a run slower than ``frac`` x the trailing
+    median (or with dispatches/episode grown past it) fails the gate."""
+    return util.env_float("JEPSEN_TPU_PERF_GATE_FRAC", 1.5)
+
+
+# --- record construction ----------------------------------------------------
+
+
+def _git_sha(root: str | None = None) -> str | None:
+    """HEAD's sha read straight off ``.git`` (no subprocess — the
+    ledger writes from inside probe children where a fork can race a
+    teardown). Linked WORKTREES (``.git`` is a ``gitdir: ...`` file)
+    resolve HEAD under their private gitdir and refs/packed-refs under
+    the shared commondir. None when the checkout has no readable git
+    state."""
+    root = root or os.path.dirname(util.cache_dir())
+    git = os.path.join(root, ".git")
+    try:
+        if os.path.isfile(git):
+            with open(git) as fh:
+                head_line = fh.read().strip()
+            if not head_line.startswith("gitdir:"):
+                return None
+            git = head_line.split(":", 1)[1].strip()
+            if not os.path.isabs(git):
+                git = os.path.join(root, git)
+        # Refs live under the COMMON dir when this is a worktree's
+        # private gitdir; HEAD stays private.
+        common = git
+        try:
+            with open(os.path.join(git, "commondir")) as fh:
+                common = os.path.normpath(
+                    os.path.join(git, fh.read().strip()))
+        except OSError:
+            pass
+        with open(os.path.join(git, "HEAD")) as fh:
+            head = fh.read().strip()
+        if head.startswith("ref:"):
+            ref = head.split(None, 1)[1]
+            try:
+                with open(os.path.join(common, ref)) as fh:
+                    return fh.read().strip()[:12]
+            except OSError:
+                # Packed refs (post-gc): scan the one flat file.
+                with open(os.path.join(common, "packed-refs")) as fh:
+                    for ln in fh:
+                        parts = ln.split()
+                        # Exact ref-name match ("<sha> <refname>") —
+                        # endswith would let refs/backup/refs/heads/X
+                        # shadow refs/heads/X.
+                        if len(parts) == 2 and parts[1] == ref:
+                            return parts[0][:12]
+                return None
+        return head[:12]
+    except OSError:
+        return None
+
+
+def _platform() -> dict:
+    """Platform stamp: jax's platform + device count when jax is
+    ALREADY loaded in this process (probes, smokes); ``host``
+    otherwise. Never imports jax — the ledger is also written from
+    jax-free tooling and must not drag a backend in."""
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            devs = jax.devices()
+            return {"platform": devs[0].platform, "devices": len(devs)}
+        except Exception:  # noqa: BLE001 - backend init can fail late
+            pass
+    return {"platform": "host", "devices": 0}
+
+
+def env_fingerprint(overlay: dict | None = None) -> tuple[dict, str]:
+    """The forced rung config as evidence: every ``JEPSEN_TPU_*`` var
+    set in this environment (the bench ladder forces each rung's knobs
+    explicitly, so the child environment IS the rung config), plus a
+    short stable hash for same-config grouping. ``overlay`` merges a
+    config the caller forced on a DIFFERENT process — how the bench
+    parent records a killed child's rung config instead of its own."""
+    env = {k: v for k, v in os.environ.items()
+           if k.startswith("JEPSEN_TPU_")}
+    if overlay:
+        env.update({k: v for k, v in overlay.items()
+                    if k.startswith("JEPSEN_TPU_")})
+    env = dict(sorted(env.items()))
+    fp = hashlib.sha256(
+        json.dumps(env, sort_keys=True).encode()).hexdigest()[:10]
+    return env, fp
+
+
+def _sum_bucketed(v) -> float | None:
+    """Total of a per-cap timing histogram (``stat_time`` dicts); a
+    bare number passes through."""
+    if isinstance(v, dict):
+        try:
+            return round(sum(float(x) for x in v.values()), 3)
+        except (TypeError, ValueError):
+            return None
+    if isinstance(v, (int, float)):
+        return float(v)
+    return None
+
+
+def make_record(probe: str, *, wall_s=None, verdict=None,
+                kind: str = "probe", host_stats=None, trace=None,
+                fleet=None, error=None, quarantine_new=None,
+                extra=None, env_overlay=None) -> dict:
+    """One ledger record. ``probe`` is the trend-row tag (a bench probe
+    key, a partitioned rung tag like ``partitioned_c30.sched``, or a
+    smoke name); ``host_stats``/``trace``/``fleet`` ride verbatim (the
+    engines' counters), with the headline derivatives (dispatches,
+    episodes, dispatches/episode, wasted seconds) lifted to the top
+    level so trend/gate never dig."""
+    env, fp = env_fingerprint(env_overlay)
+    plat = _platform()
+    rec = {"t": time.strftime(_TS_FMT, time.gmtime()),
+           "probe": str(probe), "kind": kind,
+           "platform": plat["platform"], "devices": plat["devices"],
+           "git": _git_sha(), "env_fp": fp, "env": env,
+           "wall_s": None if wall_s is None else round(float(wall_s), 3),
+           "verdict": verdict}
+    if error:
+        rec["error"] = str(error)[:500]
+    hs = host_stats or {}
+    if hs:
+        rec["host_stats"] = util.round_stats(dict(hs), 3)
+        disp = hs.get("dispatches")
+        eps = hs.get("episodes")
+        if disp is not None:
+            rec["dispatches"] = int(disp)
+        if eps is not None:
+            rec["episodes"] = int(eps)
+            if disp and eps:
+                rec["dispatches_per_episode"] = round(disp / eps, 2)
+        wasted = _sum_bucketed(hs.get("wasted_seconds"))
+        if wasted is not None:
+            rec["wasted_seconds"] = round(wasted, 3)
+    if trace:
+        rec["trace"] = trace
+    if fleet:
+        rec["fleet"] = fleet
+    if quarantine_new:
+        rec["quarantine_new"] = sorted(quarantine_new)
+    if extra:
+        rec.update(extra)
+    return rec
+
+
+# --- append + index ---------------------------------------------------------
+
+
+def append(rec: dict, path: str | None = None) -> str | None:
+    """Append one record (a newline-terminated JSON line, flushed) and
+    refresh the atomic index. Returns the path, or None when the
+    ledger is disabled. RAISES on I/O failure — producers go through
+    :func:`record`, which swallows (a ledger failure must never cost a
+    probe result)."""
+    path = path or ledger_path()
+    if path is None:
+        return None
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    # Newline-heal a torn tail (util helper shared with the service
+    # journal): a SIGKILL mid-append can leave a partial line;
+    # appending straight after it would glue two records into one
+    # unparseable line.
+    heal = b"\n" if util.file_needs_newline_heal(path) else b""
+    try:
+        pre_size = os.path.getsize(path)
+    except OSError:
+        pre_size = 0
+    buf = heal + json.dumps(rec, default=str).encode() + b"\n"
+    with open(path, "ab") as fh:
+        fh.write(buf)
+        fh.flush()
+    _write_index(path, rec, pre_size=pre_size,
+                 post_size=pre_size + len(buf))
+    return path
+
+
+def record(probe: str, path: str | None = None, **kw) -> dict | None:
+    """:func:`make_record` + :func:`append`, NEVER raises — the
+    producer-facing entry point (bench probes, smokes). Returns the
+    record, or None when disabled or the write failed."""
+    try:
+        rec = make_record(probe, **kw)
+        if append(rec, path) is None:
+            return None
+        return rec
+    except Exception:  # noqa: BLE001 - observability must never cost
+        return None    # a probe result (the loss-proof contract)
+
+
+def _bump_index(probes: dict, r: dict) -> None:
+    p = probes.setdefault(str(r.get("probe")), {"n": 0})
+    p["n"] += 1
+    p["last_t"] = r.get("t")
+    p["last_wall_s"] = r.get("wall_s")
+    p["last_verdict"] = r.get("verdict")
+    p["last_git"] = r.get("git")
+
+
+def _write_index(path: str, rec: dict | None = None,
+                 pre_size: int | None = None,
+                 post_size: int | None = None) -> None:
+    """``<ledger>.index.json``: per-probe last/total summary for
+    monitoring without parsing the JSONL. INCREMENTAL when the prior
+    index parses AND its stamped ledger byte-size matches the file
+    size this append started from (O(1) staleness detection: another
+    producer's append — or a crash between JSONL write and index
+    write — changes the size, and the next append self-heals with a
+    full rebuild); otherwise rebuilds from the JSONL. Best-effort
+    (the ledger line already landed)."""
+    try:
+        idx = None
+        if rec is not None and pre_size is not None:
+            try:
+                with open(path + ".index.json") as fh:
+                    idx = json.load(fh)
+            except (OSError, ValueError):
+                idx = None
+            if not (isinstance(idx, dict)
+                    and isinstance(idx.get("records"), int)
+                    and isinstance(idx.get("probes"), dict)
+                    and idx.get("bytes") == pre_size):
+                idx = None
+        if idx is not None:
+            idx["records"] += 1
+            _bump_index(idx["probes"], rec)
+            # Stamp OUR append's end offset, never the live getsize():
+            # a concurrent producer's bytes landing between our write
+            # and a getsize() would be folded into the stamp as if
+            # counted, defeating the next append's staleness check.
+            idx["bytes"] = post_size
+        else:
+            probes: dict[str, dict] = {}
+            recs = load(path)
+            for r in recs:
+                _bump_index(probes, r)
+            idx = {"records": len(recs), "probes": probes,
+                   "bytes": os.path.getsize(path)}
+        idx["updated"] = time.strftime(_TS_FMT, time.gmtime())
+        util.write_json_atomic(path + ".index.json", idx, default=str)
+    except Exception:  # noqa: BLE001 - index is derived state
+        pass
+
+
+def load(path: str | None = None) -> list[dict]:
+    """Every parseable record, in append order. Torn/garbage lines are
+    skipped (a killed run's tail is expected, not fatal); a missing
+    file is an empty ledger."""
+    path = path or ledger_path()
+    out: list[dict] = []
+    if path is None:
+        return out
+    try:
+        with open(path) as fh:
+            for ln in fh:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    rec = json.loads(ln)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and rec.get("probe"):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+# --- trend ------------------------------------------------------------------
+
+
+def group_key(rec: dict) -> str:
+    """Trend-row identity: (probe, platform). The env fingerprint is
+    stamped per record for forensics but does NOT split rows — a knob
+    change that moves a probe's cost should be visible IN its trend,
+    not hidden in a fresh row."""
+    return f"{rec.get('probe')}|{rec.get('platform')}"
+
+
+def _median(xs: list[float]) -> float | None:
+    return statistics.median(xs) if xs else None
+
+
+def _resumed(rec: dict) -> bool:
+    """A checkpoint-resumed run: its wall covers only the tail since
+    the checkpoint."""
+    return rec.get("resumed_from_row") is not None
+
+
+def ratio_evidence(rec: dict) -> bool:
+    """Whether a record's wall/dispatch numbers are comparable
+    full-run evidence for the ratio baselines and gates. Resumed
+    tails cover only the post-checkpoint slice; ERRORED runs stop at
+    the crash (a 60 s crashed wall must not become the median a
+    recovered 3200 s run is judged against). Verdict/quarantine/error
+    rules still apply to both in full."""
+    return not _resumed(rec) and not rec.get("error")
+
+
+def _verdict_char(v) -> str:
+    return {True: "T", False: "F"}.get(v, "?")
+
+
+def trend(records: list[dict]) -> dict[str, dict]:
+    """Per-(probe, platform) trend rows: run count, last/trailing-median
+    wall seconds, last verdict + the trailing verdict history string,
+    dispatches/episode, wasted seconds, last git sha — what ``perf
+    report`` prints and ``/perf`` renders."""
+    groups: dict[str, list[dict]] = {}
+    for r in records:
+        groups.setdefault(group_key(r), []).append(r)
+    out: dict[str, dict] = {}
+    for key, recs in groups.items():
+        last = recs[-1]
+        # Medians over PRIOR records only — the same window gate()
+        # judges, so the report's "x med" never dilutes a regression
+        # with the regressing run itself. A first-ever record has no
+        # trailing history (median "-"). Filter BEFORE slicing the
+        # trailing window: a streak of resumed tails must not evict
+        # the valid full-run baselines from the window.
+        walls = [r["wall_s"] for r in recs[:-1]
+                 if isinstance(r.get("wall_s"), (int, float))
+                 and ratio_evidence(r)][-TRAIL:]
+        dpes = [r["dispatches_per_episode"] for r in recs[:-1]
+                if isinstance(r.get("dispatches_per_episode"),
+                              (int, float))
+                and ratio_evidence(r)][-TRAIL:]
+        med = _median(walls)
+        row = {"probe": last.get("probe"),
+               "platform": last.get("platform"),
+               "n": len(recs),
+               "last_t": last.get("t"),
+               "last_git": last.get("git"),
+               "last_wall_s": last.get("wall_s"),
+               "median_wall_s": None if med is None
+               else round(med, 3),
+               "last_verdict": last.get("verdict"),
+               "verdicts": "".join(_verdict_char(r.get("verdict"))
+                                   for r in recs[-TRAIL:]),
+               "last_dispatches_per_episode":
+                   last.get("dispatches_per_episode"),
+               "median_dispatches_per_episode":
+                   None if not dpes else round(_median(dpes), 2),
+               "last_wasted_s": last.get("wasted_seconds"),
+               "last_error": last.get("error"),
+               "quarantine_new": last.get("quarantine_new") or []}
+        if med and isinstance(last.get("wall_s"), (int, float)) \
+                and ratio_evidence(last):
+            row["wall_vs_median"] = round(last["wall_s"] / med, 2)
+        if _resumed(last):
+            row["resumed_from_row"] = last["resumed_from_row"]
+        out[key] = row
+    return dict(sorted(out.items()))
+
+
+def render_trend(rows: dict[str, dict]) -> str:
+    """The ``perf report`` table."""
+    if not rows:
+        return "perf ledger empty"
+    lines = [f"{'probe':<28}{'plat':>6}{'n':>4}{'last s':>10}"
+             f"{'med s':>10}{'x med':>7}{'d/ep':>7}{'verdicts':>10}"]
+    for row in rows.values():
+        lines.append(
+            f"{str(row['probe'])[:27]:<28}"
+            f"{str(row['platform'])[:5]:>6}"
+            f"{row['n']:>4}"
+            f"{_fmt(row['last_wall_s']):>10}"
+            f"{_fmt(row['median_wall_s']):>10}"
+            f"{_fmt(row.get('wall_vs_median')):>7}"
+            f"{_fmt(row['last_dispatches_per_episode']):>7}"
+            f"{row['verdicts']:>10}"
+            + (f"  ! {row['last_error'][:40]}" if row.get("last_error")
+               else "")
+            + (f"  +quarantine:{len(row['quarantine_new'])}"
+               if row.get("quarantine_new") else ""))
+    return "\n".join(lines)
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.2f}"
+    return str(v)
+
+
+# --- diff -------------------------------------------------------------------
+
+
+def diff(before: list[dict], records: list[dict]) -> list[dict]:
+    """Records appended since a prior snapshot of the SAME append-only
+    ledger (the ``quarantine diff`` precedent). The ledger only grows,
+    so the delta is the suffix past the snapshot's length; a current
+    ledger SHORTER than the snapshot means it was cleared/rotated —
+    report everything current rather than a bogus empty delta."""
+    if len(records) < len(before):
+        return list(records)
+    return records[len(before):]
+
+
+def render_diff(new: list[dict], trend_rows: dict | None = None) -> str:
+    """One line per new record, each against its trend row's median
+    (what ``make probe-config5`` prints after the quarantine delta)."""
+    if not new:
+        return "perf delta: none"
+    lines = [f"perf delta: {len(new)} new record(s)"]
+    for r in new:
+        row = (trend_rows or {}).get(group_key(r)) or {}
+        med = row.get("median_wall_s")
+        vs = ""
+        if med and isinstance(r.get("wall_s"), (int, float)):
+            vs = f"  ({r['wall_s'] / med:.2f}x trailing median)"
+        lines.append(
+            f"  {r.get('t')}  {r.get('probe')}  "
+            f"[{r.get('platform')}]  wall {_fmt(r.get('wall_s'))} s  "
+            f"verdict {r.get('verdict')}{vs}"
+            + (f"  ERROR {str(r.get('error'))[:60]}"
+               if r.get("error") else "")
+            + (f"  +quarantine {len(r['quarantine_new'])}"
+               if r.get("quarantine_new") else ""))
+    return "\n".join(lines)
+
+
+# --- gate -------------------------------------------------------------------
+
+
+def gate(records: list[dict], probe: str | None = None,
+         frac: float | None = None) -> list[dict]:
+    """The regression sentinel: judge the LAST record of each (probe,
+    platform) group against its trailing history. Findings (empty =
+    pass):
+
+    - ``verdict-flip`` — the verdict differs from the previous
+      same-shape record's (hard fail: an engine change flipped or
+      degraded a decided history, incl. ok -> error). A clean flip
+      back TO True is RECOVERY, not a flip — the flip away already
+      fired and the still-* rules kept the row red since;
+    - ``error-appeared`` — the verdict matches but the run newly
+      carries an error where its predecessor was clean (the bench
+      headline's crash-free FALLBACK records verdict True + the
+      crashed-op failure — same verdict, degraded run);
+    - ``still-erroring`` — consecutive errored runs with the same
+      verdict: the gate is LEVEL-triggered on errors, so a
+      persistently broken probe stays red every run until it
+      recovers (not just on the first failure);
+    - ``still-flipped`` — the clean twin: a verdict stuck non-True
+      after an established True baseline (every producer's True
+      means its contract held) stays red until it recovers;
+    - ``wall-regression`` — wall_s > frac x trailing median of the
+      prior ``TRAIL`` runs (needs ``MIN_TREND`` priors);
+    - ``new-quarantine`` — the run recorded new quarantine-ledger
+      entries (an engine change newly faulted a shape);
+    - ``dispatch-growth`` — dispatches/episode > frac x trailing
+      median (the kill-the-tunnel metric regressing).
+
+    Checkpoint-RESUMED records (``resumed_from_row``) are excluded
+    from the wall/dispatch baselines and never judged by the ratio
+    rules — their numbers cover only the tail since the checkpoint;
+    verdict and quarantine rules still apply to them in full.
+    """
+    frac = gate_frac() if frac is None else frac
+    groups: dict[str, list[dict]] = {}
+    for r in records:
+        if probe is not None and r.get("probe") != probe:
+            continue
+        groups.setdefault(group_key(r), []).append(r)
+    findings: list[dict] = []
+
+    def hit(rule, rec, detail):
+        findings.append({"rule": rule, "probe": rec.get("probe"),
+                         "platform": rec.get("platform"),
+                         "t": rec.get("t"), "git": rec.get("git"),
+                         "detail": detail})
+
+    for key, recs in sorted(groups.items()):
+        last = recs[-1]
+        prior = recs[:-1]
+        # verdict flip: vs the most recent prior record. An errored
+        # run counts as verdict None — ok -> error IS a flip.
+        if prior:
+            pv, lv = prior[-1].get("verdict"), last.get("verdict")
+            verdict_handled = False
+            if pv != lv:
+                # RECOVERY is not a flip. Two recovery shapes: (a) a
+                # clean flip TO True — every producer's True means
+                # its contract held, and the flip AWAY already fired
+                # (with still-flipped/still-erroring keeping the row
+                # red since), so the fix run must not fail CI again;
+                # (b) a clean run matching the last clean verdict
+                # before an errored streak (or a new tag whose only
+                # priors errored) re-establishes that baseline.
+                recovery = lv is True and not last.get("error")
+                if not recovery and not last.get("error") \
+                        and prior[-1].get("error"):
+                    clean = [r for r in prior if not r.get("error")]
+                    recovery = not clean \
+                        or clean[-1].get("verdict") == lv
+                if not recovery:
+                    verdict_handled = True
+                    hit("verdict-flip", last,
+                        f"verdict {pv!r} -> {lv!r}"
+                        + (f" (error: "
+                           f"{str(last.get('error'))[:80]})"
+                           if last.get("error") else ""))
+            elif last.get("error") and not prior[-1].get("error"):
+                verdict_handled = True
+                hit("error-appeared", last,
+                    f"verdict unchanged ({lv!r}) but the run newly "
+                    f"carries an error: "
+                    f"{str(last.get('error'))[:100]}")
+            elif last.get("error") and prior[-1].get("error"):
+                # LEVEL-triggered, not edge-triggered: a persistently
+                # failing probe must stay red on every run until it
+                # recovers — the first errored run fired
+                # verdict-flip/error-appeared, and without this rule
+                # the second identical failure would read as PASS.
+                verdict_handled = True
+                hit("still-erroring", last,
+                    f"run still erroring (verdict {lv!r}): "
+                    f"{str(last.get('error'))[:100]}")
+            if not verdict_handled and lv is not True and any(
+                    r.get("verdict") is True for r in prior):
+                # The clean twin of still-erroring: every producer's
+                # True means its contract held, so a verdict stuck
+                # non-True after an established True baseline is a
+                # PERSISTING soundness regression — red on EVERY run
+                # until recovery, including one that merely cleared
+                # its error while staying non-True (a recovery-(b)
+                # pass above must not skip this rule).
+                hit("still-flipped", last,
+                    f"verdict still {lv!r} after an established "
+                    f"True baseline")
+        if last.get("quarantine_new"):
+            hit("new-quarantine", last,
+                f"{len(last['quarantine_new'])} newly faulted "
+                f"shape(s): "
+                + ", ".join(last["quarantine_new"][:4]))
+        if not ratio_evidence(last):
+            # A resumed run's numbers cover only the tail and an
+            # errored run's stop at the crash — meaningless against
+            # full-run baselines in either direction. The flip/error/
+            # quarantine rules above already ran.
+            continue
+        # Filter BEFORE slicing (the trend() rule): a streak of
+        # resumed tails must not evict valid baselines and silently
+        # disable the ratio gates for a resume-heavy probe.
+        walls = [r["wall_s"] for r in prior
+                 if isinstance(r.get("wall_s"), (int, float))
+                 and ratio_evidence(r)][-TRAIL:]
+        if len(walls) >= MIN_TREND \
+                and isinstance(last.get("wall_s"), (int, float)):
+            med = _median(walls)
+            if med and last["wall_s"] > frac * med:
+                hit("wall-regression", last,
+                    f"wall {last['wall_s']} s > {frac}x trailing "
+                    f"median {med:.3f} s ({last['wall_s'] / med:.2f}x)")
+        dpes = [r["dispatches_per_episode"] for r in prior
+                if isinstance(r.get("dispatches_per_episode"),
+                              (int, float))
+                and ratio_evidence(r)][-TRAIL:]
+        if len(dpes) >= MIN_TREND and isinstance(
+                last.get("dispatches_per_episode"), (int, float)):
+            med = _median(dpes)
+            if med and last["dispatches_per_episode"] > frac * med:
+                hit("dispatch-growth", last,
+                    f"dispatches/episode "
+                    f"{last['dispatches_per_episode']} > {frac}x "
+                    f"trailing median {med:.2f}")
+    return findings
+
+
+def render_gate(findings: list[dict]) -> str:
+    if not findings:
+        return "perf gate: PASS"
+    lines = [f"perf gate: FAIL ({len(findings)} finding(s))"]
+    for f in findings:
+        lines.append(f"  [{f['rule']}] {f['probe']} "
+                     f"[{f['platform']}] {f['t']}: {f['detail']}")
+    return "\n".join(lines)

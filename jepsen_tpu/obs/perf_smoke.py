@@ -1,0 +1,159 @@
+"""`make perf-smoke`: record -> report -> gate, with seeded regressions.
+
+The chip-free habit for the cross-run perf ledger (obs/ledger,
+doc/observability.md § Perf ledger), in the serve/txn/trace/stream
+smoke mold: a FRESH-process proof on the forced CPU mesh that
+
+- a real CPU-mesh check records to the ledger (git sha + env
+  fingerprint stamped, index written),
+- ``cli.py perf report`` renders its trend row,
+- ``cli.py perf gate`` PASSES on the healthy history, and
+- a seeded injected regression is CAUGHT: both the wall-time case
+  (one run at many x the trailing median) and the verdict-flip case
+  (True -> False) exit nonzero with the right rule named.
+
+The seeded regressions go into a THROWAWAY ledger
+(``.jax_cache/perf_smoke.ledger.jsonl``, truncated per run) so
+fabricated evidence never pollutes the real trajectory — the
+quarantine-redirect precedent in service/chaos.py. The smoke's own
+run is recorded to the REAL ledger like every other smoke. Prints one
+JSON result line and exits 0/1 — timeout-guarded by the Makefile.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    t_start = time.time()
+    # CPU mesh BEFORE any jax backend init (CLAUDE.md: the TPU plugin
+    # force-selects its platform; the smoke must never take the chip).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from jepsen_tpu import cli, util, web
+    from jepsen_tpu.lin import cpu, device_check_packed, prepare, synth
+    from jepsen_tpu import models as m
+    from jepsen_tpu.obs import ledger
+
+    util.enable_compile_cache()
+    # The real ledger path (for the smoke's own producer record),
+    # resolved BEFORE the throwaway override below. The throwaway is
+    # cache_dir-anchored like every on-disk artifact, so running the
+    # smoke from any cwd cleans up the same file.
+    real_ledger = ledger.ledger_path()
+    smoke_ledger = os.path.join(util.cache_dir(),
+                                "perf_smoke.ledger.jsonl")
+    for f in (smoke_ledger, smoke_ledger + ".index.json"):
+        try:
+            os.remove(f)
+        except OSError:
+            pass
+    # Every record the smoke fabricates lands in the throwaway file;
+    # env_fp still stamps honestly (it reads the environment).
+    os.environ["JEPSEN_TPU_PERF_LEDGER"] = smoke_ledger
+
+    out: dict = {"ledger": smoke_ledger, "checks": []}
+    ok = True
+
+    # --- a real CPU-mesh check, recorded -----------------------------------
+    h = synth.generate_register_history(
+        300, concurrency=5, seed=7, value_range=5, crash_prob=0.01,
+        max_crashes=3)
+    p = prepare.prepare(m.cas_register(), h)
+    want = cpu.check_packed(p)["valid?"]
+    device_check_packed(p)                      # warm/compile
+    walls = []
+    for _ in range(3):
+        t0 = time.time()
+        r = device_check_packed(p)
+        walls.append(time.time() - t0)
+        rec = ledger.record("cpu-mesh-check", kind="smoke",
+                            wall_s=walls[-1], verdict=r.get("valid?"))
+        ok = ok and rec is not None and r.get("valid?") == want
+    out["checks"].append({"leg": "record", "want": want,
+                          "got": r.get("valid?"),
+                          "walls": [round(w, 3) for w in walls],
+                          "git": (rec or {}).get("git"),
+                          "env_fp": (rec or {}).get("env_fp")})
+    ok = ok and os.path.exists(smoke_ledger) \
+        and os.path.exists(smoke_ledger + ".index.json") \
+        and bool((rec or {}).get("env_fp"))
+
+    # --- report renders the trend row ---------------------------------------
+    rows = ledger.trend(ledger.load(smoke_ledger))
+    report_rc = cli.run(cli.standard_commands(["perf"]),
+                        ["perf", "report", "--ledger", smoke_ledger])
+    out["checks"].append({"leg": "report", "rc": report_rc,
+                          "rows": sorted(rows)})
+    ok = ok and report_rc == 0 and any(
+        row["probe"] == "cpu-mesh-check" for row in rows.values())
+
+    # --- gate passes on the healthy history ---------------------------------
+    # Loose --frac for THIS leg only: the real walls are milliseconds,
+    # where ordinary scheduler/GC jitter on shared hardware can exceed
+    # 1.5x run to run — a healthy-checkout smoke must not flake on
+    # noise. The verdict/quarantine/error rules still run at full
+    # strength, and the seeded legs below use the real default
+    # threshold against a 10x spike.
+    healthy_rc = cli.run(cli.standard_commands(["perf"]),
+                         ["perf", "gate", "--ledger", smoke_ledger,
+                          "--frac", "10"])
+    out["checks"].append({"leg": "gate-healthy", "rc": healthy_rc})
+    ok = ok and healthy_rc == 0
+
+    # --- seeded WALL regression must be caught ------------------------------
+    # The seeded legs PIN --frac at the shipped default: an exported
+    # JEPSEN_TPU_PERF_GATE_FRAC tuned for a noisy tunnel (doc/env.md
+    # invites it) must not make the 10x spike pass and fail the smoke
+    # on a healthy checkout.
+    med = sorted(walls)[1]
+    ledger.record("cpu-mesh-check", kind="smoke",
+                  wall_s=med * 10, verdict=want)
+    findings = ledger.gate(ledger.load(smoke_ledger), frac=1.5)
+    wall_rc = cli.run(cli.standard_commands(["perf"]),
+                      ["perf", "gate", "--ledger", smoke_ledger,
+                       "--frac", "1.5"])
+    out["checks"].append({"leg": "gate-wall-regression", "rc": wall_rc,
+                          "rules": sorted(f["rule"] for f in findings)})
+    ok = ok and wall_rc != 0 \
+        and any(f["rule"] == "wall-regression" for f in findings)
+
+    # --- seeded VERDICT FLIP must be caught ---------------------------------
+    ledger.record("cpu-mesh-check", kind="smoke", wall_s=med,
+                  verdict=not want)
+    findings = ledger.gate(ledger.load(smoke_ledger), frac=1.5)
+    flip_rc = cli.run(cli.standard_commands(["perf"]),
+                      ["perf", "gate", "--ledger", smoke_ledger,
+                       "--frac", "1.5"])
+    out["checks"].append({"leg": "gate-verdict-flip", "rc": flip_rc,
+                          "rules": sorted(f["rule"] for f in findings)})
+    ok = ok and flip_rc != 0 \
+        and any(f["rule"] == "verdict-flip" for f in findings)
+
+    # --- /perf page renders the trajectory ----------------------------------
+    html = web.perf_html(smoke_ledger)
+    out["checks"].append({"leg": "/perf", "bytes": len(html)})
+    ok = ok and "perf ledger" in html and "cpu-mesh-check" in html
+
+    out["ok"] = bool(ok)
+    # The smoke's own producer record goes to the REAL ledger (the
+    # other smokes' habit) — never the throwaway one it judged.
+    if real_ledger is not None:
+        ledger.record("perf-smoke", path=real_ledger, kind="smoke",
+                      wall_s=time.time() - t_start, verdict=bool(ok))
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

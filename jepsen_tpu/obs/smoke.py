@@ -22,9 +22,11 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
 
 
 def main() -> int:
+    t_start = time.time()
     # CPU mesh BEFORE any jax backend init (CLAUDE.md: the TPU plugin
     # force-selects its platform; the smoke must never take the chip).
     flags = os.environ.get("XLA_FLAGS", "")
@@ -93,6 +95,16 @@ def main() -> int:
     ok = ok and "run telemetry" in html and "host-stats" in html
 
     out["ok"] = bool(ok)
+    # Cross-run perf ledger (doc/observability.md § Perf ledger): the
+    # smoke records its own run like every evidence producer; record()
+    # never raises, so a ledger failure cannot cost the smoke verdict.
+    from jepsen_tpu.obs import ledger as perf_ledger
+
+    perf_ledger.record(
+        "trace-smoke", kind="smoke", wall_s=time.time() - t_start,
+        verdict=bool(ok),
+        trace={k: agg.get(k) for k in ("total_s", "dispatches",
+                                       "compile_s")})
     print(json.dumps(out))
     return 0 if ok else 1
 

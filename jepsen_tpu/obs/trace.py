@@ -66,6 +66,24 @@ def buf_cap() -> int:
     return util.env_int("JEPSEN_TPU_TRACE_BUF", 65536)
 
 
+def max_bytes() -> int:
+    """Spill-file rotation threshold (``JEPSEN_TPU_TRACE_MAX_MB``,
+    default 256 MB; ``0`` = unlimited): looped probes under
+    ``JEPSEN_TPU_TRACE=1`` must not grow the spill unbounded. Past it
+    the live file rotates to ``<path>.1`` (one generation kept) and
+    the spill continues fresh — ``trace report`` keeps reading the
+    live path, which holds the newest events, and :func:`rotations`
+    lets producers note the rotation in their perf-ledger record."""
+    try:
+        mb = util.env_float("JEPSEN_TPU_TRACE_MAX_MB", 256.0)
+    except ValueError:
+        # A malformed knob must not escape _flush_locked mid-dispatch
+        # (run_guarded would read it as a device fault): tracing must
+        # never take a run down — fall back to the default cap.
+        mb = 256.0
+    return int(mb * 1024 * 1024) if mb > 0 else 0
+
+
 # Spill well before the ring cap so a configured file loses nothing;
 # without a file the buffer is a true ring (oldest events drop).
 _SPILL_BATCH = 4096
@@ -77,6 +95,7 @@ _SPILL_KEEP = 64
 _lock = threading.Lock()
 _buf: list[dict] = []
 _spilled = 0
+_rotations = 0
 _file_started = False
 _file_dead = False
 _atexit_on = False
@@ -225,6 +244,22 @@ def _flush_locked(path: str, keep: int = 0) -> None:
                 pass
     try:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # Spill hygiene (JEPSEN_TPU_TRACE_MAX_MB): a live file already
+        # past the cap rotates to <path>.1 BEFORE this write (one
+        # generation kept), so looped probes can't fill the disk and
+        # the configured path always exists holding the NEWEST events
+        # — `trace report` reads it unchanged. Best-effort: rotation
+        # failure degrades to an uncapped file, never a lost run.
+        cap_bytes = max_bytes()
+        if cap_bytes and _file_started:
+            try:
+                if os.path.getsize(path) >= cap_bytes:
+                    os.replace(path, path + ".1")
+                    _file_started = False
+                    global _rotations
+                    _rotations += 1
+            except OSError:
+                pass
         mode = "a" if _file_started else "w"
         with open(path, mode) as fh:
             for ln in lines:
@@ -267,13 +302,21 @@ def spilled() -> int:
     return _spilled
 
 
+def rotations() -> int:
+    """Spill-file rotations this process (``JEPSEN_TPU_TRACE_MAX_MB``)
+    — producers stamp it into their perf-ledger record so a truncated
+    trace summary is attributable."""
+    return _rotations
+
+
 def reset() -> None:
     """Drop all in-memory state (tests; the next flush truncates the
     file again so a test's trace file holds only its own run)."""
-    global _spilled, _file_started, _file_dead
+    global _spilled, _rotations, _file_started, _file_dead
     with _lock:
         _buf.clear()
         _spilled = 0
+        _rotations = 0
         _file_started = False
         _file_dead = False
     _tls.last = None

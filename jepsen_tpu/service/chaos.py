@@ -278,6 +278,7 @@ def _spawn_daemon(env: dict) -> tuple[subprocess.Popen, int]:
 
 
 def main() -> int:
+    t_start = time.time()
     # CPU mesh BEFORE any jax backend init (CLAUDE.md: the TPU plugin
     # force-selects its platform; the smoke must never take the chip).
     flags = os.environ.get("XLA_FLAGS", "")
@@ -433,6 +434,12 @@ def main() -> int:
         except subprocess.TimeoutExpired:
             proc2.kill()
     out["ok"] = ok
+    # Cross-run perf ledger (doc/observability.md § Perf ledger):
+    # record() never raises — a ledger failure cannot cost the smoke.
+    from jepsen_tpu.obs import ledger as perf_ledger
+
+    perf_ledger.record("fleet-smoke", kind="smoke",
+                       wall_s=time.time() - t_start, verdict=ok)
     print(json.dumps(out, default=str))
     return 0 if ok else 1
 

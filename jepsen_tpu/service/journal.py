@@ -177,18 +177,13 @@ class Journal:
                 os.makedirs(os.path.dirname(self.path) or ".",
                             exist_ok=True)
                 self._fh = open(self.path, "ab")
-                # Heal a torn tail: a SIGKILL mid-write can leave the
-                # file without its final newline — appending straight
-                # on would glue the new record onto the torn line and
+                # Heal a torn tail (util helper shared with the perf
+                # ledger): a SIGKILL mid-write can leave the file
+                # without its final newline — appending straight on
+                # would glue the new record onto the torn line and
                 # corrupt BOTH.
-                try:
-                    if self._fh.tell() > 0:
-                        with open(self.path, "rb") as rf:
-                            rf.seek(-1, os.SEEK_END)
-                            if rf.read(1) != b"\n":
-                                self._fh.write(b"\n")
-                except OSError:
-                    pass
+                if util.file_needs_newline_heal(self.path):
+                    self._fh.write(b"\n")
             self._fh.write(codec.encode(rec) + b"\n")
             self._fh.flush()
             self._apply_locked(rec)

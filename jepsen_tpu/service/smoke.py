@@ -14,9 +14,11 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
 
 
 def main() -> int:
+    t_start = time.time()
     # CPU mesh BEFORE any jax backend init (CLAUDE.md: the TPU plugin
     # force-selects its platform; the smoke must never take the chip).
     flags = os.environ.get("XLA_FLAGS", "")
@@ -66,6 +68,14 @@ def main() -> int:
     finally:
         svc.stop()
     out["ok"] = ok
+    # Cross-run perf ledger (doc/observability.md § Perf ledger): the
+    # smoke is an evidence producer; record() never raises, so a
+    # ledger failure cannot cost the smoke verdict.
+    from jepsen_tpu.obs import ledger as perf_ledger
+
+    perf_ledger.record("serve-smoke", kind="smoke",
+                       wall_s=time.time() - t_start, verdict=ok,
+                       extra={"stats": out.get("stats")})
     print(json.dumps(out))
     return 0 if ok else 1
 

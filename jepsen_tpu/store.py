@@ -134,6 +134,107 @@ def save_2(test: dict) -> dict:
     return test
 
 
+# Evidence artifacts the run-directory flow guarantees (and web.py's
+# home/dir pages link): the perf graphs + timeline next to
+# history/results, whether or not the test composed the
+# checker.perf()/timeline checkers.
+RUN_ARTIFACTS = ("timeline.html", "latency-raw.png",
+                 "latency-quantiles.png", "rate.png")
+
+# Backfill ceiling: past this many ops the timeline's div-per-op HTML
+# reaches tens of MB and the matplotlib renders take seconds of
+# serial wall at run completion — big runs keep the OPT-IN cost model
+# (compose checker.perf()/timeline.checker() explicitly).
+ARTIFACT_MAX_OPS = 20_000
+
+
+def find_artifacts(run_dir: Path) -> dict[str, Path]:
+    """First match of each evidence artifact in a run dir's root or
+    ONE subdirectory level down (a composed checker's
+    ``opts["subdirectory"]``), root winning. Deliberately NOT a full
+    tree walk: deeper matches (e.g. the independent checker's per-KEY
+    ``independent/<key>/timeline.html``) are a key's evidence, not
+    the run's, and web's home page pays this scan per run per
+    request. THE lookup shared by the backfill's skip rule and
+    web.py's evidence links, so what the backfill counts as present
+    is exactly what the pages link."""
+    out: dict[str, Path] = {}
+    if not run_dir.is_dir():
+        return out
+    try:
+        entries = sorted(os.scandir(run_dir), key=lambda e: e.name)
+    except OSError:
+        return out
+    subdirs = []
+    for e in entries:
+        if e.is_dir(follow_symlinks=False):
+            subdirs.append(e.path)
+        elif e.name in RUN_ARTIFACTS and e.name not in out:
+            out[e.name] = run_dir / e.name
+    for sd in subdirs:
+        try:
+            for e in sorted(os.scandir(sd), key=lambda e: e.name):
+                if not e.is_dir(follow_symlinks=False) \
+                        and e.name in RUN_ARTIFACTS \
+                        and e.name not in out:
+                    out[e.name] = Path(e.path)
+        except OSError:
+            continue
+    return out
+
+
+def write_run_artifacts(test: dict) -> list[str]:
+    """Backfill a run directory's latency/rate/timeline evidence
+    (checker/perf_graphs.py + checker/timeline.py) after analysis:
+    artifacts a composed checker already wrote are left alone; missing
+    ones are rendered best-effort per file (matplotlib or an empty
+    history must never fail a run — the timeline.checker() contract).
+    Histories past ``ARTIFACT_MAX_OPS`` are skipped entirely (cost
+    guard; see the constant). Returns the filenames written. Called
+    from ``core.run`` as part of the store flow, so every named run's
+    evidence is one click from its perf-ledger row (web.py home/dir
+    pages, doc/observability.md § Perf ledger)."""
+    written: list[str] = []
+    if not isinstance(test, dict):
+        return written
+    hist = test.get("history") or []
+    if not (test.get("name") and hist
+            and len(hist) <= ARTIFACT_MAX_OPS):
+        return written
+
+    present = find_artifacts(path(test))
+
+    def missing(fname: str) -> bool:
+        return fname not in present
+
+    try:
+        if missing("timeline.html"):
+            from jepsen_tpu.checker import timeline as timeline_mod
+
+            p = path(test, "timeline.html", make=True)
+            p.write_text(timeline_mod.html(test, hist))
+            written.append("timeline.html")
+    except Exception:  # noqa: BLE001 - artifacts are best-effort
+        pass
+    try:
+        from jepsen_tpu.checker import perf_graphs as perf_mod
+
+        for fname, fn in (("latency-raw.png", perf_mod.point_graph),
+                          ("latency-quantiles.png",
+                           perf_mod.quantiles_graph),
+                          ("rate.png", perf_mod.rate_graph)):
+            if not missing(fname):
+                continue
+            try:
+                fn(test, hist)
+                written.append(fname)
+            except Exception:  # noqa: BLE001 - per-graph isolation
+                pass
+    except Exception:  # noqa: BLE001 - no matplotlib, no graphs
+        pass
+    return written
+
+
 def load(name: str, ts: str, base=BASE) -> dict:
     """Reload a saved test for re-analysis (store.clj:165-171)."""
     d = Path(base) / name / ts

@@ -24,6 +24,9 @@ import sys
 
 
 def main() -> int:
+    import time
+
+    t_start = time.time()
     # CPU mesh BEFORE any jax backend init (CLAUDE.md: the TPU plugin
     # force-selects its platform; the smoke must never take the chip).
     flags = os.environ.get("XLA_FLAGS", "")
@@ -112,6 +115,13 @@ def main() -> int:
     finally:
         svc.stop()
     out["ok"] = ok
+    # Cross-run perf ledger (doc/observability.md § Perf ledger):
+    # record() never raises — a ledger failure cannot cost the smoke.
+    from jepsen_tpu.obs import ledger as perf_ledger
+
+    perf_ledger.record("stream-smoke", kind="smoke",
+                       wall_s=time.time() - t_start, verdict=ok,
+                       extra={"stats": out.get("stats")})
     print(json.dumps(out))
     return 0 if ok else 1
 

@@ -19,6 +19,7 @@ import time
 
 
 def main() -> int:
+    t_start = time.time()
     # CPU mesh BEFORE any jax backend init (CLAUDE.md: the TPU plugin
     # force-selects its platform; the smoke must never take the chip).
     flags = os.environ.get("XLA_FLAGS", "")
@@ -90,6 +91,12 @@ def main() -> int:
     out["graph_stats"] = oracle.infer(
         synth.generate_list_append_history(200, seed=1)).stats
     out["ok"] = ok
+    # Cross-run perf ledger (doc/observability.md § Perf ledger):
+    # record() never raises — a ledger failure cannot cost the smoke.
+    from jepsen_tpu.obs import ledger as perf_ledger
+
+    perf_ledger.record("txn-smoke", kind="smoke",
+                       wall_s=time.time() - t_start, verdict=ok)
     print(json.dumps(out, default=str))
     return 0 if ok else 1
 

@@ -243,6 +243,23 @@ def env_float(name: str, default: float) -> float:
     return float(v) if v else default
 
 
+def file_needs_newline_heal(path: str) -> bool:
+    """True when an append-only JSONL file's last byte exists and is
+    not a newline — a SIGKILL-torn tail that would glue the next
+    record onto the torn line and corrupt BOTH. The one crash-recovery
+    rule shared by the service journal and the perf ledger (their
+    append paths must never drift). Missing/empty files need no
+    heal."""
+    import os
+
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(-1, os.SEEK_END)
+            return fh.read(1) not in (b"\n", b"")
+    except OSError:
+        return False
+
+
 def write_json_atomic(path: str, obj, default=None) -> None:
     """Atomic JSON file write: pid-suffixed tmp + ``os.replace`` (the
     quarantine-ledger / service-stats / txn-snapshot pattern — last

@@ -35,6 +35,15 @@ from jepsen_tpu.obs.metrics import (  # noqa: E402
     load_json_snapshot as _load_snapshot,
 )
 
+# Run-directory evidence the home/dir pages link when present — ONE
+# definition (and ONE lookup, subdirectory-aware) shared with the
+# store flow that writes it (store.write_run_artifacts), so the link
+# list cannot drift from what runs actually contain.
+from jepsen_tpu.store import (  # noqa: E402
+    RUN_ARTIFACTS,
+    find_artifacts as _find_artifacts,
+)
+
 VALID_COLORS = {True: "#ADF6B0", False: "#F6AEAD", "unknown": "#F3F6AD"}
 
 
@@ -59,8 +68,12 @@ def _run_rows(base: Path) -> list[dict]:
                     valid = json.loads(results.read_text()).get("valid?")
                 except (ValueError, OSError):
                     valid = "unknown"
+            found = _find_artifacts(run)
+            arts = [(a, found[a].relative_to(run).as_posix())
+                    for a in RUN_ARTIFACTS if a in found]
             rows.append({"name": name, "ts": ts, "valid": valid,
-                         "path": f"{name}/{ts}"})
+                         "path": f"{name}/{ts}",
+                         "artifacts": arts})
     rows.sort(key=lambda r: r["ts"], reverse=True)
     return rows
 
@@ -69,6 +82,13 @@ def home_html(base: Path) -> str:
     rows = []
     for r in _run_rows(base):
         color = VALID_COLORS.get(r["valid"], "#FFFFFF")
+        # One-click evidence links (the perf-ledger satellite,
+        # doc/observability.md): a run's latency/rate/timeline
+        # artifacts next to its row.
+        evidence = " · ".join(
+            f'<a href="/files/{quote(r["path"])}/{quote(rel)}">'
+            f"{_html.escape(a.split('.')[0].replace('latency-', 'lat-'))}"
+            f"</a>" for a, rel in r["artifacts"]) or "-"
         rows.append(
             f'<tr style="background:{color}">'
             f'<td><a href="/files/{quote(r["path"])}/">'
@@ -76,6 +96,7 @@ def home_html(base: Path) -> str:
             f'<td><a href="/files/{quote(r["path"])}/">'
             f'{_html.escape(r["ts"])}</a></td>'
             f'<td>{_html.escape(str(r["valid"]))}</td>'
+            f"<td>{evidence}</td>"
             f'<td><a href="/zip/{quote(r["path"])}">zip</a></td></tr>')
     return ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
             "<title>jepsen-tpu</title><style>"
@@ -84,9 +105,10 @@ def home_html(base: Path) -> str:
             "</style></head><body><h1>jepsen-tpu results</h1>"
             '<p><a href="/service">checker service stats</a> · '
             '<a href="/txn">txn anomaly panel</a> · '
-            '<a href="/run">run telemetry</a></p>'
+            '<a href="/run">run telemetry</a> · '
+            '<a href="/perf">perf ledger</a></p>'
             "<table><tr><th>test</th><th>run</th><th>valid?</th>"
-            "<th>download</th></tr>" + "".join(rows) +
+            "<th>evidence</th><th>download</th></tr>" + "".join(rows) +
             "</table></body></html>")
 
 
@@ -103,9 +125,28 @@ def dir_html(base: Path, rel: str) -> str:
                        f'<img src="{href}" style="max-width:600px"></a>')
         entries.append(f'<li><a href="{href}">{_html.escape(name)}</a>'
                        f"{preview}</li>")
+    # Evidence shortcuts: a RUN dir's latency/rate/timeline artifacts
+    # one click from the top (wherever a checker placed them), next
+    # to the perf-ledger trend page. Only for run directories — for
+    # the store root or a test-name dir holding many runs, the walk
+    # would present some arbitrary run's files as "evidence".
+    ev = []
+    if any((d / marker).exists()
+           for marker in ("results.json", "test.json",
+                          "history.jsonl")):
+        found = _find_artifacts(d)
+        for a in RUN_ARTIFACTS:
+            if a in found:
+                rel_a = found[a].relative_to(d).as_posix()
+                ev.append(
+                    f'<a href="/files/{quote(rel)}/{quote(rel_a)}">'
+                    f"{_html.escape(a)}</a>")
+    evidence = " · ".join(ev)
+    ev_line = f"<p>evidence: {evidence}</p>" if evidence else ""
     return ("<!DOCTYPE html><html><head><meta charset='utf-8'></head>"
             f"<body><h2>{_html.escape(rel)}</h2>"
-            '<p><a href="/">home</a></p><ul>' + "".join(entries) +
+            '<p><a href="/">home</a> · <a href="/perf">perf ledger</a>'
+            "</p>" + ev_line + "<ul>" + "".join(entries) +
             "</ul></body></html>")
 
 
@@ -226,14 +267,13 @@ def txn_html(stats_file: str | None = None) -> str:
     return "".join(parts)
 
 
-def _sparkline_svg(samples: list, width=600, height=60) -> str:
-    """Inline SVG sparkline of frontier size over elapsed seconds
-    (no JS, no external assets — the page must render from a file)."""
-    pts = [(s[0], s[2]) for s in samples
-           if isinstance(s, (list, tuple)) and len(s) >= 3
-           and s[2] is not None]
+def _spark_svg(pts: list[tuple[float, float]], label: str = "",
+               width=600, height=60, color="#4078c0") -> str:
+    """Inline SVG sparkline over (x, y) points (no JS, no external
+    assets — the page must render from a file). Shared by the /run
+    frontier sparkline and the /perf wall/dispatch trend rows."""
     if len(pts) < 2:
-        return "<p>(not enough samples for a sparkline yet)</p>"
+        return "<span>(not enough samples)</span>"
     t0, t1 = pts[0][0], pts[-1][0]
     vmax = max(v for _, v in pts) or 1
     dt = (t1 - t0) or 1
@@ -242,12 +282,25 @@ def _sparkline_svg(samples: list, width=600, height=60) -> str:
         f"{(t - t0) / dt * (width - 4) + 2:.1f},"
         f"{height - 2 - v / vmax * (height - 14):.1f}"
         for i, (t, v) in enumerate(pts))
+    text = (f'<text x="4" y="12" font-size="10">'
+            f"{_html.escape(label)}</text>" if label else "")
     return (f'<svg width="{width}" height="{height}" '
             f'style="border:1px solid #ccc">'
-            f'<path d="{path}" fill="none" stroke="#4078c0" '
-            f'stroke-width="1.5"/>'
-            f'<text x="4" y="12" font-size="10">frontier (max '
-            f'{vmax})</text></svg>')
+            f'<path d="{path}" fill="none" stroke="{color}" '
+            f'stroke-width="1.5"/>{text}</svg>')
+
+
+def _sparkline_svg(samples: list, width=600, height=60) -> str:
+    """The /run frontier sparkline: frontier size over elapsed
+    seconds, through the shared :func:`_spark_svg` helper."""
+    pts = [(s[0], s[2]) for s in samples
+           if isinstance(s, (list, tuple)) and len(s) >= 3
+           and s[2] is not None]
+    if len(pts) < 2:
+        return "<p>(not enough samples for a sparkline yet)</p>"
+    vmax = max(v for _, v in pts) or 1
+    return _spark_svg(pts, label=f"frontier (max {vmax})",
+                      width=width, height=height)
 
 
 def run_html(snapshot_file: str | None = None) -> str:
@@ -353,6 +406,90 @@ def run_html(snapshot_file: str | None = None) -> str:
     return "".join(parts)
 
 
+def perf_html(ledger_file: str | None = None) -> str:
+    """The /perf trend page: the cross-run perf ledger
+    (jepsen_tpu.obs.ledger, doc/observability.md § Perf ledger) as one
+    row per (probe, platform) — run count, wall-seconds sparkline,
+    dispatches/episode sparkline, trailing median, verdict history
+    (colored chips), last git sha — so a perf regression or verdict
+    flip reads off a browser the way `cli.py perf report` prints it."""
+    from jepsen_tpu.obs import ledger as ledger_mod
+
+    path = ledger_file or ledger_mod.ledger_path()
+    head = ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            "<title>perf ledger</title><style>"
+            "body{font-family:sans-serif} table{border-collapse:collapse;"
+            "margin-bottom:1em} td,th{padding:3px 10px;"
+            "border:1px solid #ccc} th{text-align:left}"
+            ".chip{display:inline-block;width:12px;height:12px;"
+            "margin-right:1px;border:1px solid #999}"
+            "</style></head><body><h1>perf ledger</h1>"
+            '<p><a href="/">home</a> · <a href="/run">run telemetry</a>'
+            "</p>")
+    if path is None:
+        # Recording disabled: telling the operator to run a smoke
+        # would be wrong guidance — nothing can produce records.
+        return (head + "<p>perf ledger disabled "
+                "(<code>JEPSEN_TPU_PERF_LEDGER=0</code>) — unset it "
+                "(doc/env.md) to start recording</p></body></html>")
+    records = ledger_mod.load(path)
+    if not records:
+        return (head + f"<p>no perf-ledger records at "
+                f"<code>{_html.escape(str(path))}</code> — run a "
+                f"bench probe or any <code>make *-smoke</code> "
+                f"(doc/observability.md § Perf ledger)</p>"
+                "</body></html>")
+    by_group: dict[str, list[dict]] = {}
+    for r in records:
+        by_group.setdefault(ledger_mod.group_key(r), []).append(r)
+    rows_html = []
+    for key, row in ledger_mod.trend(records).items():
+        recs = by_group.get(key, [])
+        # Same evidence rule as trend()/gate(): resumed tails and
+        # errored runs are excluded, or the sparkline would show a
+        # dip the median annotation under it (rightly) ignores.
+        walls = [(i, r["wall_s"]) for i, r in enumerate(recs)
+                 if isinstance(r.get("wall_s"), (int, float))
+                 and ledger_mod.ratio_evidence(r)]
+        dpes = [(i, r["dispatches_per_episode"])
+                for i, r in enumerate(recs)
+                if isinstance(r.get("dispatches_per_episode"),
+                              (int, float))
+                and ledger_mod.ratio_evidence(r)]
+        chips = "".join(
+            f'<span class="chip" title="{_html.escape(str(r.get("t")))}'
+            f' {_html.escape(str(r.get("verdict")))}" '
+            f'style="background:'
+            f'{VALID_COLORS.get(r.get("verdict"), "#DDD")}"></span>'
+            for r in recs[-16:])
+        rows_html.append(
+            f"<tr><td><b>{_html.escape(str(row['probe']))}</b><br>"
+            f"<small>{_html.escape(str(row['platform']))} · "
+            f"git {_html.escape(str(row['last_git']))}</small></td>"
+            f"<td>{row['n']}</td>"
+            f"<td>{_spark_svg(walls, label='wall s', width=220, height=36)}"
+            f"<br><small>last {row['last_wall_s']} s · median "
+            f"{row['median_wall_s']} s"
+            + (f" · <b>{row['wall_vs_median']}x</b>"
+               if row.get("wall_vs_median") else "") + "</small></td>"
+            f"<td>{_spark_svg(dpes, label='disp/ep', width=160, height=36, color='#c07840')}"
+            f"<br><small>{row['last_dispatches_per_episode'] or '-'}"
+            "</small></td>"
+            f"<td>{chips}<br><small>{_html.escape(row['verdicts'])}"
+            "</small>"
+            + (f"<br><small>! {_html.escape(str(row['last_error'])[:60])}"
+               f"</small>" if row.get("last_error") else "")
+            + (f"<br><small>+{len(row['quarantine_new'])} quarantine"
+               f"</small>" if row.get("quarantine_new") else "")
+            + "</td></tr>")
+    return (head
+            + f"<p>{len(records)} record(s) in "
+              f"<code>{_html.escape(str(path))}</code></p>"
+              "<table><tr><th>probe</th><th>runs</th><th>wall</th>"
+              "<th>dispatches/episode</th><th>verdicts</th></tr>"
+            + "".join(rows_html) + "</table></body></html>")
+
+
 def zip_run(base: Path, rel: str) -> bytes:
     """Zip a run directory in memory (web.clj:250-271 streams; runs are
     small enough to buffer)."""
@@ -371,6 +508,7 @@ class _Handler(BaseHTTPRequestHandler):
     stats_file: str | None = None   # None -> the daemon's default path
     txn_stats_file: str | None = None   # None -> txn.device default
     run_stats_file: str | None = None   # None -> obs registry default
+    perf_ledger_file: str | None = None   # None -> obs ledger default
 
     def log_message(self, fmt, *args):  # route through logging
         log.debug(fmt, *args)
@@ -403,6 +541,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, txn_html(self.txn_stats_file).encode())
             elif path == "/run":
                 self._send(200, run_html(self.run_stats_file).encode())
+            elif path == "/perf":
+                self._send(200,
+                           perf_html(self.perf_ledger_file).encode())
             elif path.startswith("/zip/"):
                 rel = self._safe_rel(path[len("/zip/"):].strip("/"))
                 if rel is None:
@@ -447,11 +588,14 @@ class _Handler(BaseHTTPRequestHandler):
 def make_server(host="0.0.0.0", port=8080, base="store",
                 stats_file: str | None = None,
                 txn_stats_file: str | None = None,
-                run_stats_file: str | None = None) -> ThreadingHTTPServer:
+                run_stats_file: str | None = None,
+                perf_ledger_file: str | None = None,
+                ) -> ThreadingHTTPServer:
     handler = type("Handler", (_Handler,),
                    {"base": Path(base), "stats_file": stats_file,
                     "txn_stats_file": txn_stats_file,
-                    "run_stats_file": run_stats_file})
+                    "run_stats_file": run_stats_file,
+                    "perf_ledger_file": perf_ledger_file})
     return ThreadingHTTPServer((host, port), handler)
 
 

@@ -1,0 +1,966 @@
+"""Cross-run perf ledger + regression sentinel (jepsen_tpu/obs/ledger
+— doc/observability.md § Perf ledger): append/torn-tail/index units,
+every gate rule firing (and a healthy history passing), the cli
+report/diff/gate drives, the /perf page render, the bench-artifact
+passthrough (every probe rung writes exactly ONE record, and a ledger
+write failure can never cost a probe result), and the trace-spill
+rotation satellite (JEPSEN_TPU_TRACE_MAX_MB).
+
+Pure host Python — quick tier, no XLA. The bench passthrough tests
+load bench.py the way test_bench_artifact does and stub its PROBES
+table, so no device is touched.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+from jepsen_tpu import cli, web
+from jepsen_tpu.obs import ledger, trace
+
+pytestmark = pytest.mark.quick
+
+_BENCH_PATH = os.path.join(os.path.dirname(__file__), os.pardir,
+                           "bench.py")
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench_under_perf",
+                                                  _BENCH_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _ledger_sandbox(monkeypatch, tmp_path):
+    """Every test writes its own ledger file — the shared
+    .jax_cache/perf_ledger.jsonl must never see fabricated evidence
+    (the perf-smoke throwaway precedent)."""
+    monkeypatch.setenv("JEPSEN_TPU_PERF_LEDGER",
+                       str(tmp_path / "ledger.jsonl"))
+    monkeypatch.delenv("JEPSEN_TPU_PERF_GATE_FRAC", raising=False)
+    monkeypatch.delenv("JEPSEN_TPU_PERF_TAG", raising=False)
+    yield
+
+
+def _fill(path, probe="p", n=3, wall=1.0, verdict=True, **kw):
+    for _ in range(n):
+        assert ledger.record(probe, path=str(path), wall_s=wall,
+                             verdict=verdict, **kw) is not None
+
+
+# --- append / load / index --------------------------------------------------
+
+
+def test_append_stamps_git_platform_env_fingerprint(tmp_path):
+    p = tmp_path / "l.jsonl"
+    rec = ledger.record("probe-a", path=str(p), wall_s=1.5,
+                        verdict=True)
+    assert rec is not None
+    (got,) = ledger.load(str(p))
+    # The three stamps the acceptance criteria name: git sha, env-knob
+    # fingerprint, platform.
+    assert got["git"] and len(got["git"]) == 12
+    assert got["env_fp"] and got["env"], "env fingerprint missing"
+    assert any(k.startswith("JEPSEN_TPU_") for k in got["env"])
+    assert got["platform"]
+    assert got["wall_s"] == 1.5 and got["verdict"] is True
+
+
+def test_torn_tail_costs_one_record_and_heals(tmp_path):
+    p = tmp_path / "l.jsonl"
+    _fill(p, n=2)
+    # A SIGKILL-torn tail: unparseable, unterminated.
+    with open(p, "a") as fh:
+        fh.write('{"probe": "torn", "wall_s"')
+    assert len(ledger.load(str(p))) == 2
+    # The next append newline-heals the tail instead of gluing onto it
+    # (the service-journal lesson).
+    ledger.record("p", path=str(p), wall_s=1.0, verdict=True)
+    recs = ledger.load(str(p))
+    assert len(recs) == 3
+    assert all(r["probe"] == "p" for r in recs)
+
+
+def test_index_summarizes_per_probe(tmp_path):
+    p = tmp_path / "l.jsonl"
+    _fill(p, probe="a", n=2, wall=2.0)
+    _fill(p, probe="b", n=1, wall=9.0, verdict=False)
+    idx = json.loads((tmp_path / "l.jsonl.index.json").read_text())
+    assert idx["records"] == 3
+    assert idx["probes"]["a"]["n"] == 2
+    assert idx["probes"]["b"]["last_verdict"] is False
+    assert idx["probes"]["b"]["last_wall_s"] == 9.0
+
+
+def test_record_never_raises_and_disabled_is_none(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_PERF_LEDGER", "0")
+    assert ledger.ledger_path() is None
+    assert ledger.record("p", wall_s=1.0, verdict=True) is None
+    # An unwritable path: record() swallows (the loss-proof contract);
+    # append() raises (unit-testable failure channel).
+    assert ledger.record("p", path="/dev/null/nope/l.jsonl",
+                         wall_s=1.0, verdict=True) is None
+    with pytest.raises(OSError):
+        ledger.append({"probe": "p"}, path="/dev/null/nope/l.jsonl")
+
+
+def test_host_stats_derivatives_lift_to_top_level(tmp_path):
+    p = tmp_path / "l.jsonl"
+    ledger.record("p", path=str(p), wall_s=1.0, verdict=True,
+                  host_stats={"dispatches": 90, "episodes": 30,
+                              "wasted_seconds": {"4096": 1.25,
+                                                 "8192": 0.25}})
+    (r,) = ledger.load(str(p))
+    assert r["dispatches"] == 90 and r["episodes"] == 30
+    assert r["dispatches_per_episode"] == 3.0
+    assert r["wasted_seconds"] == 1.5
+
+
+# --- gate rules -------------------------------------------------------------
+
+
+def test_gate_passes_healthy_history(tmp_path):
+    p = tmp_path / "l.jsonl"
+    for w in (1.0, 1.1, 0.9, 1.05):
+        ledger.record("p", path=str(p), wall_s=w, verdict=True)
+    assert ledger.gate(ledger.load(str(p))) == []
+
+
+def test_gate_verdict_flip_fires(tmp_path):
+    p = tmp_path / "l.jsonl"
+    _fill(p, n=2, verdict=True)
+    ledger.record("p", path=str(p), wall_s=1.0, verdict=False)
+    rules = [f["rule"] for f in ledger.gate(ledger.load(str(p)))]
+    assert rules == ["verdict-flip"]
+
+
+def test_gate_ok_to_error_is_a_flip(tmp_path):
+    # A probe that used to decide and now errors REGRESSED — verdict
+    # None counts as changed, not as gate-invisible.
+    p = tmp_path / "l.jsonl"
+    _fill(p, n=2, verdict=True)
+    ledger.record("p", path=str(p), verdict=None, error="kernel fault")
+    findings = ledger.gate(ledger.load(str(p)))
+    assert [f["rule"] for f in findings] == ["verdict-flip"]
+    assert "kernel fault" in findings[0]["detail"]
+
+
+def test_gate_error_appeared_fires_on_same_verdict(tmp_path):
+    # The bench headline's crash-free FALLBACK records verdict True
+    # plus the crashed-op failure: same verdict as the healthy tail,
+    # degraded run — the sentinel must still fail.
+    p = tmp_path / "l.jsonl"
+    _fill(p, n=2, verdict=True)
+    ledger.record("p", path=str(p), wall_s=1.0, verdict=True,
+                  error="crashed-op run failed: kernel fault")
+    findings = ledger.gate(ledger.load(str(p)))
+    assert [f["rule"] for f in findings] == ["error-appeared"]
+    assert "kernel fault" in findings[0]["detail"]
+    # The gate is LEVEL-triggered on errors: a second identical
+    # failure stays red (a persistently broken probe must not read
+    # as PASS after its first trip), and so does every one after.
+    for _ in range(2):
+        ledger.record("p", path=str(p), wall_s=1.0, verdict=True,
+                      error="crashed-op run failed: kernel fault")
+        assert [f["rule"] for f in
+                ledger.gate(ledger.load(str(p)))] == \
+            ["still-erroring"]
+
+
+def test_git_sha_resolves_linked_worktrees(tmp_path):
+    # A linked worktree's .git is a `gitdir: ...` FILE; refs live
+    # under the shared commondir. git=None there would strip every
+    # record of its which-commit forensics.
+    main_git = tmp_path / "main" / ".git"
+    (main_git / "refs" / "heads").mkdir(parents=True)
+    (main_git / "refs" / "heads" / "work").write_text("a" * 40 + "\n")
+    wt_git = main_git / "worktrees" / "wt"
+    wt_git.mkdir(parents=True)
+    (wt_git / "HEAD").write_text("ref: refs/heads/work\n")
+    (wt_git / "commondir").write_text("../..\n")
+    wt = tmp_path / "wt"
+    wt.mkdir()
+    (wt / ".git").write_text(f"gitdir: {wt_git}\n")
+    assert ledger._git_sha(str(wt)) == "a" * 12
+    # The plain-directory layout still resolves (this checkout).
+    (main_git / "HEAD").write_text("ref: refs/heads/work\n")
+    assert ledger._git_sha(str(tmp_path / "main")) == "a" * 12
+    # No git state at all: None, never a raise.
+    assert ledger._git_sha(str(tmp_path / "wt2")) is None
+
+
+def test_bench_fallback_headline_stamps_error(bench, monkeypatch,
+                                              tmp_path):
+    # The fallback record must carry the crashed-op error so
+    # error-appeared can fire against a healthy history.
+    p = tmp_path / "l.jsonl"
+    monkeypatch.setenv("JEPSEN_TPU_PERF_LEDGER", str(p))
+    ledger.record("headline", path=str(p), wall_s=1.0, verdict=True)
+    bench._ledger_headline(
+        {"check_seconds": 1.0, "verdict": True,
+         "variant": "crash-free fallback"}, 100000.0,
+        error="crashed-op run failed: boom")
+    rec = ledger.load(str(p))[-1]
+    assert rec["probe"] == "headline" and rec["verdict"] is True
+    assert "boom" in rec["error"]
+    assert rec["variant"] == "crash-free fallback"
+    assert [f["rule"] for f in ledger.gate(ledger.load(str(p)))] == \
+        ["error-appeared"]
+
+
+def test_gate_recovery_after_error_is_not_a_flip(tmp_path):
+    # True -> errored(None) -> True again: the errored run already
+    # failed its own gate; the healthy recovery re-establishing the
+    # clean baseline must not fail CI a second time.
+    p = tmp_path / "l.jsonl"
+    _fill(p, n=2, verdict=True)
+    ledger.record("p", path=str(p), verdict=None, error="wedge")
+    assert [f["rule"] for f in ledger.gate(ledger.load(str(p)))] == \
+        ["verdict-flip"]
+    ledger.record("p", path=str(p), wall_s=1.0, verdict=True)
+    assert ledger.gate(ledger.load(str(p))) == []
+    # But a DEGRADED recovery (clean run, different verdict than the
+    # pre-error baseline) is still a flip.
+    ledger.record("p", path=str(p), verdict=None, error="wedge")
+    ledger.record("p", path=str(p), wall_s=1.0, verdict=False)
+    assert [f["rule"] for f in ledger.gate(ledger.load(str(p)))] == \
+        ["verdict-flip"]
+
+
+def test_gate_still_flipped_stays_red_until_recovery(tmp_path):
+    # The clean twin of still-erroring: a persistent verdict
+    # regression (True baseline -> False forever) must stay red on
+    # every run, not just the first flip.
+    p = tmp_path / "l.jsonl"
+    _fill(p, n=3, verdict=True)
+    ledger.record("p", path=str(p), wall_s=1.0, verdict=False)
+    assert [f["rule"] for f in ledger.gate(ledger.load(str(p)))] == \
+        ["verdict-flip"]
+    for _ in range(2):
+        ledger.record("p", path=str(p), wall_s=1.0, verdict=False)
+        assert [f["rule"] for f in
+                ledger.gate(ledger.load(str(p)))] == ["still-flipped"]
+    # Recovery goes fully green: a clean flip back TO True (how every
+    # smoke records a fix after an errorless False failure) is not a
+    # flip — the flip away already fired and still-flipped kept the
+    # row red since.
+    ledger.record("p", path=str(p), wall_s=1.0, verdict=True)
+    assert ledger.gate(ledger.load(str(p))) == []
+
+
+def test_gate_error_cleared_but_still_flipped_stays_red(tmp_path):
+    # True -> False (flip) -> None+error (flip) -> False CLEAN: the
+    # recovery carve-out suppresses a flip verdict for returning to
+    # the pre-error (flipped) baseline, but the run is still non-True
+    # after an established True baseline — still-flipped must fire,
+    # not a green pass.
+    p = tmp_path / "l.jsonl"
+    ledger.record("p", path=str(p), wall_s=1.0, verdict=True)
+    ledger.record("p", path=str(p), wall_s=1.0, verdict=False)
+    ledger.record("p", path=str(p), verdict=None, error="wedge")
+    ledger.record("p", path=str(p), wall_s=1.0, verdict=False)
+    assert [f["rule"] for f in ledger.gate(ledger.load(str(p)))] == \
+        ["still-flipped"]
+
+
+def test_gate_never_true_probe_does_not_still_flip(tmp_path):
+    # A probe whose verdict was never True has no established good
+    # baseline: repeated "unknown" must not hold the gate red.
+    p = tmp_path / "l.jsonl"
+    _fill(p, n=3, verdict="unknown")
+    assert ledger.gate(ledger.load(str(p))) == []
+
+
+def test_probe_main_quarantine_delta_is_crash_evidence_only(
+        bench, monkeypatch, capsys, tmp_path):
+    # Single wedges (environmental, sub-streak) and the static gate's
+    # predictions must not hard-fail the perf gate as "newly faulted
+    # shapes" — only real crash evidence does (the
+    # supervise.quarantined() distinction).
+    from jepsen_tpu.lin import supervise
+
+    ledger_file = tmp_path / "l.jsonl"
+    qfile = tmp_path / "q.json"
+    monkeypatch.setenv("JEPSEN_TPU_PERF_LEDGER", str(ledger_file))
+    monkeypatch.setenv("JEPSEN_TPU_QUARANTINE", str(qfile))
+
+    def probe():
+        # Mid-probe, three quarantine entries appear: a real fault, a
+        # single environmental wedge, and a static-gate prediction.
+        from jepsen_tpu import util as u
+
+        u.write_json_atomic(str(qfile), {"shapes": {
+            "chunk|rows1|cap8|w5|k": {"reason": "fault", "count": 1,
+                                      "faulted": True},
+            "host-wave|rows4|cap8|w5|k": {"reason": "wedge",
+                                          "count": 1, "streak": 1},
+            "host-pass|rows1|cap8|w5|k": {"reason": "static",
+                                          "count": 1},
+        }})
+        return {"verdict": True, "seconds": 0.1}
+
+    monkeypatch.setitem(bench.PROBES, "stub", probe)
+    with pytest.raises(SystemExit):
+        bench._probe_main("stub")
+    capsys.readouterr()
+    (rec,) = ledger.load(str(ledger_file))
+    assert rec["quarantine_new"] == ["chunk|rows1|cap8|w5|k"], \
+        "wedge/static entries leaked into the gate's hard-fail rule"
+    assert supervise  # imported to assert the policy source exists
+
+
+def test_gate_first_clean_run_after_errored_start_passes(tmp_path):
+    # A NEW tag whose very first ladder attempt faulted and whose
+    # second attempt decided: the clean run IS the baseline, not a
+    # flip from the faulty attempt.
+    p = tmp_path / "l.jsonl"
+    ledger.record("new-rung", path=str(p), verdict=None,
+                  error="fault")
+    ledger.record("new-rung", path=str(p), wall_s=100.0, verdict=True)
+    assert ledger.gate(ledger.load(str(p))) == []
+
+
+def test_gate_wall_regression_fires_and_respects_frac(tmp_path,
+                                                      monkeypatch):
+    p = tmp_path / "l.jsonl"
+    _fill(p, n=3, wall=1.0)
+    ledger.record("p", path=str(p), wall_s=1.4, verdict=True)
+    # 1.4x the median: under the default 1.5x threshold.
+    assert ledger.gate(ledger.load(str(p))) == []
+    ledger.record("p", path=str(p), wall_s=2.0, verdict=True)
+    rules = [f["rule"] for f in ledger.gate(ledger.load(str(p)))]
+    assert rules == ["wall-regression"]
+    # The env knob retunes the sentinel (doc/env.md).
+    monkeypatch.setenv("JEPSEN_TPU_PERF_GATE_FRAC", "3.0")
+    assert ledger.gate(ledger.load(str(p))) == []
+
+
+def test_gate_wall_needs_trend_history(tmp_path):
+    # One prior sample is not a trend on a tunnel with run-to-run
+    # variance: the ratio gates need MIN_TREND priors.
+    p = tmp_path / "l.jsonl"
+    _fill(p, n=1, wall=1.0)
+    ledger.record("p", path=str(p), wall_s=100.0, verdict=True)
+    assert ledger.gate(ledger.load(str(p))) == []
+
+
+def test_gate_new_quarantine_fires(tmp_path):
+    p = tmp_path / "l.jsonl"
+    ledger.record("p", path=str(p), wall_s=1.0, verdict=True,
+                  quarantine_new=["host-wave|rows4|cap524288|w49|k"])
+    findings = ledger.gate(ledger.load(str(p)))
+    assert [f["rule"] for f in findings] == ["new-quarantine"]
+    assert "host-wave" in findings[0]["detail"]
+
+
+def test_gate_dispatch_growth_fires(tmp_path):
+    p = tmp_path / "l.jsonl"
+    for _ in range(3):
+        ledger.record("p", path=str(p), wall_s=1.0, verdict=True,
+                      host_stats={"dispatches": 30, "episodes": 30})
+    ledger.record("p", path=str(p), wall_s=1.0, verdict=True,
+                  host_stats={"dispatches": 300, "episodes": 30})
+    rules = [f["rule"] for f in ledger.gate(ledger.load(str(p)))]
+    assert rules == ["dispatch-growth"]
+
+
+def test_resumed_records_are_not_wall_evidence(tmp_path):
+    # A checkpoint-resumed run's wall covers only the tail since the
+    # checkpoint: it must neither BE judged by the ratio gates nor
+    # poison the baseline full runs are judged against.
+    p = tmp_path / "l.jsonl"
+    _fill(p, n=3, wall=3000.0)
+    # Resumed tail (cheap wall): no wall-regression verdict on it...
+    ledger.record("p", path=str(p), wall_s=300.0, verdict=True,
+                  extra={"resumed_from_row": 90000})
+    assert ledger.gate(ledger.load(str(p))) == []
+    # ...twice, so the resumed walls could form a fake-cheap median...
+    ledger.record("p", path=str(p), wall_s=290.0, verdict=True,
+                  extra={"resumed_from_row": 91000})
+    # ...and the next healthy FULL run must not false-fail against it.
+    ledger.record("p", path=str(p), wall_s=3100.0, verdict=True)
+    assert ledger.gate(ledger.load(str(p))) == []
+    (row,) = ledger.trend(ledger.load(str(p))).values()
+    assert row["median_wall_s"] == 3000.0, \
+        "resumed tails leaked into the trend baseline"
+    # Verdict rules still apply to resumed runs in full.
+    ledger.record("p", path=str(p), wall_s=200.0, verdict=False,
+                  extra={"resumed_from_row": 90000})
+    assert [f["rule"] for f in ledger.gate(ledger.load(str(p)))] == \
+        ["verdict-flip"]
+
+
+def test_resumed_streak_does_not_evict_the_baseline_window(tmp_path):
+    # Filter-then-slice: probe-config5 is resume-heavy, and a streak
+    # of >= TRAIL resumed tails inside the trailing window must not
+    # make the ratio gates vacuous while valid full-run baselines
+    # exist just outside it.
+    p = tmp_path / "l.jsonl"
+    _fill(p, n=3, wall=1000.0)
+    for i in range(ledger.TRAIL + 1):
+        ledger.record("p", path=str(p), wall_s=50.0, verdict=True,
+                      extra={"resumed_from_row": 1000 * i + 1})
+    ledger.record("p", path=str(p), wall_s=2000.0, verdict=True)
+    rules = [f["rule"] for f in ledger.gate(ledger.load(str(p)))]
+    assert rules == ["wall-regression"], \
+        "resumed streak disabled the wall gate"
+    (row,) = ledger.trend(ledger.load(str(p))).values()
+    assert row["median_wall_s"] == 1000.0
+
+
+def test_index_is_incremental_and_rebuilds(tmp_path):
+    p = tmp_path / "l.jsonl"
+    idx_path = tmp_path / "l.jsonl.index.json"
+    _fill(p, probe="a", n=2)
+    # A deleted/corrupt index rebuilds from the JSONL on next append.
+    idx_path.unlink()
+    _fill(p, probe="b", n=1)
+    idx = json.loads(idx_path.read_text())
+    assert idx["records"] == 3 and idx["probes"]["a"]["n"] == 2
+    # And the incremental path stays consistent with a full rebuild.
+    _fill(p, probe="a", n=1, wall=4.0)
+    idx = json.loads(idx_path.read_text())
+    assert idx["records"] == 4 and idx["probes"]["a"]["n"] == 3
+    assert idx["probes"]["a"]["last_wall_s"] == 4.0
+
+
+def test_index_self_heals_after_foreign_append(tmp_path):
+    # Another producer (or a crash between JSONL write and index
+    # write) grows the ledger without updating the index: the stamped
+    # byte-size mismatch forces a full rebuild on the next append —
+    # the undercount never persists.
+    p = tmp_path / "l.jsonl"
+    idx_path = tmp_path / "l.jsonl.index.json"
+    _fill(p, probe="a", n=2)
+    with open(p, "a") as fh:   # bypasses the index entirely
+        fh.write('{"probe": "foreign", "wall_s": 1.0}\n')
+    _fill(p, probe="a", n=1)
+    idx = json.loads(idx_path.read_text())
+    assert idx["records"] == 4
+    assert idx["probes"]["foreign"]["n"] == 1
+
+
+def test_cli_diff_unreadable_before_fails_loudly(tmp_path, capsys):
+    # exists() is not readability: a directory (or chmod-000 file)
+    # must error, not silently diff against an empty snapshot.
+    p = tmp_path / "l.jsonl"
+    _fill(p, n=2)
+    d = tmp_path / "adir"
+    d.mkdir()
+    assert _cli(["perf", "diff", "--ledger", str(p), "--before",
+                 str(d)]) == cli.EXIT_ERROR
+    assert "cannot read" in capsys.readouterr().err
+
+
+def test_errored_walls_are_not_ratio_evidence(tmp_path):
+    # A crashed run stops early: its short wall must not become the
+    # baseline a recovered full-length run is judged against (the
+    # resumed-tail rule, same incomparable-evidence class).
+    p = tmp_path / "l.jsonl"
+    _fill(p, n=3, wall=1000.0)
+    for _ in range(2):
+        ledger.record("p", path=str(p), wall_s=60.0, verdict=True,
+                      error="crashed early")
+    # Recovered full run at a healthy wall: no wall-regression
+    # verdict against the 60 s crashed walls.
+    ledger.record("p", path=str(p), wall_s=1100.0, verdict=True)
+    assert [f["rule"] for f in ledger.gate(ledger.load(str(p)))] == []
+    (row,) = ledger.trend(ledger.load(str(p))).values()
+    assert row["median_wall_s"] == 1000.0, \
+        "crashed walls leaked into the trend baseline"
+    # And an errored LAST record is never ratio-judged itself.
+    ledger.record("p", path=str(p), wall_s=9000.0, verdict=True,
+                  error="crashed late")
+    rules = [f["rule"] for f in ledger.gate(ledger.load(str(p)))]
+    assert rules == ["error-appeared"]
+
+
+def test_wide_probes_force_perf_tag_per_child(bench, monkeypatch):
+    # An exported JEPSEN_TPU_PERF_TAG (the knob probe-config5 sets)
+    # must not collapse every probe's record into one trend row: the
+    # generic branch forces tag=key, wave_smoke its own, and the
+    # partitioned rungs their per-rung tags.
+    monkeypatch.setenv("JEPSEN_TPU_PERF_TAG", "leaked-tag")
+    monkeypatch.setattr(bench, "TOTAL_BUDGET_S", 10_000_000)
+    seen = []
+
+    def fake_probe(key, timeout, env_extra=None, stall_s=None):
+        seen.append((key, dict(env_extra or {})))
+        if key == "wave_smoke":
+            return {"seconds": 0.1, "host_stats": {"multi_rows": 4},
+                    "sched": {"seconds": 0.1,
+                              "host_stats": {"sched_rows": 4}}}
+        return {"verdict": True, "seconds": 0.1}
+
+    monkeypatch.setattr(bench, "_run_probe", fake_probe)
+    detail, out = {}, {"detail": {}}
+    bench._wide_probes(detail, out, __import__("time").time())
+    tags = {k: e.get("JEPSEN_TPU_PERF_TAG") for k, e in seen}
+    for key, tag in tags.items():
+        assert tag is not None and tag != "leaked-tag", \
+            f"{key} child inherited the exported PERF_TAG"
+    assert tags["mutex_c30"] == "mutex_c30"
+    assert tags["wave_smoke"] == "wave_smoke"
+    assert tags["partitioned_c30"].startswith("partitioned_c30.")
+
+
+def test_parent_records_for_a_child_that_died_silently(
+        bench, monkeypatch, tmp_path):
+    # A killed/stalled/crashed child never reaches its own record()
+    # (it sits just before the result print): the parent must record
+    # the error on its behalf, or a persistently wedging probe reads
+    # green to `perf gate` forever.
+    p = tmp_path / "l.jsonl"
+    monkeypatch.setenv("JEPSEN_TPU_PERF_LEDGER", str(p))
+
+    def fake_sub(key, timeout, env_extra=None, stall_s=None,
+                 argv=None):
+        return ({"error": "probe stalled: no progress for 2s, killed",
+                 "kill": {"why": "stall"},
+                 "no_child_result": True}, "stall")
+
+    monkeypatch.setattr(bench, "_run_probe_subprocess", fake_sub)
+    r = bench._run_probe("partitioned_c30", 60,
+                         env_extra={"JEPSEN_TPU_PERF_TAG":
+                                    "partitioned_c30.sched",
+                                    "JEPSEN_TPU_HOST_SCHED": "1"})
+    assert "error" in r
+    (rec,) = ledger.load(str(p))
+    assert rec["probe"] == "partitioned_c30.sched"
+    assert rec["verdict"] is None
+    assert "stalled" in rec["error"]
+    assert rec["recorded_by"] == "parent"
+    # The record carries the RUNG's forced config, not the parent's
+    # environment (the env/env_fp schema promise).
+    assert rec["env"]["JEPSEN_TPU_HOST_SCHED"] == "1"
+    # A child that PRINTED its result records itself — no parent
+    # double-record.
+    monkeypatch.setattr(
+        bench, "_run_probe_subprocess",
+        lambda *a, **k: ({"verdict": True, "seconds": 0.1}, None))
+    bench._run_probe("mutex_c30", 60)
+    assert len(ledger.load(str(p))) == 1
+
+
+def test_probe_main_stamps_resumed_from_row(bench, monkeypatch,
+                                            capsys, tmp_path):
+    p = tmp_path / "l.jsonl"
+    monkeypatch.setenv("JEPSEN_TPU_PERF_LEDGER", str(p))
+    _drive_probe_main(
+        bench, monkeypatch, capsys,
+        result={"verdict": True, "seconds": 12.0,
+                "resumed_from_row": 88000})
+    (rec,) = ledger.load(str(p))
+    assert rec["resumed_from_row"] == 88000
+
+
+def test_gate_groups_by_probe_and_platform(tmp_path):
+    # probe b's flip must not hide behind probe a's healthy tail, and
+    # --probe filters to one row.
+    p = tmp_path / "l.jsonl"
+    _fill(p, probe="a", n=3)
+    _fill(p, probe="b", n=2, verdict=True)
+    ledger.record("b", path=str(p), wall_s=1.0, verdict=False)
+    findings = ledger.gate(ledger.load(str(p)))
+    assert [(f["probe"], f["rule"]) for f in findings] == \
+        [("b", "verdict-flip")]
+    assert ledger.gate(ledger.load(str(p)), probe="a") == []
+
+
+# --- trend / diff -----------------------------------------------------------
+
+
+def test_trend_rows_and_render(tmp_path):
+    p = tmp_path / "l.jsonl"
+    for w in (1.0, 2.0, 3.0):
+        ledger.record("p", path=str(p), wall_s=w, verdict=True,
+                      host_stats={"dispatches": 8, "episodes": 4})
+    rows = ledger.trend(ledger.load(str(p)))
+    (row,) = rows.values()
+    # Median over PRIOR records only — the gate's window, so the
+    # report's ratio never dilutes a regression with the regressing
+    # run itself: priors [1, 2] -> median 1.5, last 3.0 -> 2.0x.
+    assert row["n"] == 3 and row["median_wall_s"] == 1.5
+    assert row["last_wall_s"] == 3.0 and row["wall_vs_median"] == 2.0
+    assert row["verdicts"] == "TTT"
+    assert row["last_dispatches_per_episode"] == 2.0
+    text = ledger.render_trend(rows)
+    assert "p" in text and "TTT" in text
+
+
+def test_trend_first_record_has_no_baseline(tmp_path):
+    p = tmp_path / "l.jsonl"
+    _fill(p, n=1, wall=7.0)
+    (row,) = ledger.trend(ledger.load(str(p))).values()
+    assert row["median_wall_s"] is None
+    assert "wall_vs_median" not in row
+    assert "-" in ledger.render_trend({"k": row})
+
+
+def test_diff_is_the_appended_suffix(tmp_path):
+    p = tmp_path / "l.jsonl"
+    _fill(p, n=2)
+    before = ledger.load(str(p))
+    _fill(p, n=1, wall=5.0)
+    new = ledger.diff(before, ledger.load(str(p)))
+    assert len(new) == 1 and new[0]["wall_s"] == 5.0
+    assert "perf delta: 1 new" in ledger.render_diff(
+        new, ledger.trend(ledger.load(str(p))))
+    # A current ledger SHORTER than the snapshot (cleared/rotated):
+    # report everything current, never a bogus empty delta.
+    assert len(ledger.diff(before + before, before)) == len(before)
+
+
+# --- cli drives -------------------------------------------------------------
+
+
+def _cli(args):
+    return cli.run(cli.standard_commands(["perf"]), args)
+
+
+def test_cli_report_and_gate(tmp_path, capsys):
+    p = tmp_path / "l.jsonl"
+    _fill(p, probe="cpu-mesh-check", n=3)
+    assert _cli(["perf", "report", "--ledger", str(p)]) == cli.EXIT_OK
+    out = capsys.readouterr().out
+    assert "cpu-mesh-check" in out
+    assert _cli(["perf", "gate", "--ledger", str(p)]) == cli.EXIT_OK
+    assert "PASS" in capsys.readouterr().out
+    ledger.record("cpu-mesh-check", path=str(p), wall_s=1.0,
+                  verdict=False)
+    assert _cli(["perf", "gate", "--ledger", str(p)]) == \
+        cli.EXIT_INVALID
+    out = capsys.readouterr().out
+    assert "FAIL" in out and "verdict-flip" in out
+
+
+def test_cli_gate_json_and_probe_filter(tmp_path, capsys):
+    p = tmp_path / "l.jsonl"
+    _fill(p, probe="a", n=2)
+    ledger.record("a", path=str(p), wall_s=1.0, verdict=False)
+    _fill(p, probe="b", n=2)
+    assert _cli(["perf", "gate", "--ledger", str(p), "--probe", "b",
+                 "--json"]) == cli.EXIT_OK
+    assert json.loads(capsys.readouterr().out) == []
+    assert _cli(["perf", "gate", "--ledger", str(p), "--probe", "a",
+                 "--json"]) == cli.EXIT_INVALID
+    (f,) = json.loads(capsys.readouterr().out)
+    assert f["rule"] == "verdict-flip"
+
+
+def test_cli_diff_requires_readable_before(tmp_path, capsys):
+    # The quarantine-diff precedent: a missing --before must fail
+    # loudly, not report the whole ledger as new.
+    p = tmp_path / "l.jsonl"
+    _fill(p, n=2)
+    assert _cli(["perf", "diff", "--ledger", str(p)]) == \
+        cli.EXIT_USAGE
+    assert _cli(["perf", "diff", "--ledger", str(p), "--before",
+                 str(tmp_path / "missing.jsonl")]) == cli.EXIT_ERROR
+    before = tmp_path / "before.jsonl"
+    before.write_text((tmp_path / "l.jsonl").read_text())
+    _fill(p, n=1, wall=7.0)
+    capsys.readouterr()
+    assert _cli(["perf", "diff", "--ledger", str(p), "--before",
+                 str(before)]) == cli.EXIT_OK
+    assert "1 new record" in capsys.readouterr().out
+
+
+def test_cli_gate_malformed_frac_fails_cleanly(tmp_path, capsys,
+                                               monkeypatch):
+    # A garbage JEPSEN_TPU_PERF_GATE_FRAC must produce a clean error
+    # (the gate's output contract), never a traceback — and never a
+    # silent fallback to a threshold the operator did not choose.
+    p = tmp_path / "l.jsonl"
+    _fill(p, n=2)
+    monkeypatch.setenv("JEPSEN_TPU_PERF_GATE_FRAC", "1,5")
+    assert _cli(["perf", "gate", "--ledger", str(p)]) == \
+        cli.EXIT_ERROR
+    assert "JEPSEN_TPU_PERF_GATE_FRAC" in capsys.readouterr().err
+    # An explicit --frac overrides the broken env and works.
+    assert _cli(["perf", "gate", "--ledger", str(p), "--frac",
+                 "1.5"]) == cli.EXIT_OK
+
+
+def test_cli_report_empty_ledger_errors(tmp_path, capsys):
+    assert _cli(["perf", "report", "--ledger",
+                 str(tmp_path / "none.jsonl")]) == cli.EXIT_ERROR
+
+
+def test_cli_gate_empty_or_unmatched_fails_loudly(tmp_path, capsys):
+    # A wrong --ledger path or a typo'd --probe tag must NOT keep CI
+    # green with nothing under guard.
+    assert _cli(["perf", "gate", "--ledger",
+                 str(tmp_path / "none.jsonl")]) == cli.EXIT_ERROR
+    assert "nothing is under guard" in capsys.readouterr().err
+    p = tmp_path / "l.jsonl"
+    _fill(p, probe="real-probe", n=2)
+    assert _cli(["perf", "gate", "--ledger", str(p), "--probe",
+                 "typo-probe"]) == cli.EXIT_ERROR
+    assert "typo-probe" in capsys.readouterr().err
+    assert _cli(["perf", "gate", "--ledger", str(p), "--probe",
+                 "real-probe"]) == cli.EXIT_OK
+
+
+# --- /perf page -------------------------------------------------------------
+
+
+def test_perf_page_renders_rows_sparklines_and_chips(tmp_path):
+    p = tmp_path / "l.jsonl"
+    for w in (1.0, 1.1, 1.2):
+        ledger.record("partitioned_c30.sched", path=str(p), wall_s=w,
+                      verdict=True,
+                      host_stats={"dispatches": 9, "episodes": 9})
+    ledger.record("serve-smoke", path=str(p), wall_s=9.0,
+                  verdict=False, error="boom")
+    html = web.perf_html(str(p))
+    assert "perf ledger" in html
+    assert "partitioned_c30.sched" in html and "serve-smoke" in html
+    assert "<svg" in html, "wall sparkline missing"
+    assert 'class="chip"' in html, "verdict chips missing"
+    assert "boom" in html
+
+
+def test_perf_page_empty_ledger_says_so(tmp_path):
+    html = web.perf_html(str(tmp_path / "none.jsonl"))
+    assert "no perf-ledger records" in html
+
+
+def test_home_page_links_perf_and_run_artifacts(tmp_path):
+    run = tmp_path / "demo" / "20260101T000000.000"
+    run.mkdir(parents=True)
+    (run / "results.json").write_text('{"valid?": true}')
+    (run / "timeline.html").write_text("<html></html>")
+    # A composed checker's subdirectory artifact must link too (the
+    # same subdirectory-aware lookup the backfill skip rule uses).
+    (run / "perf").mkdir()
+    (run / "perf" / "rate.png").write_bytes(b"png")
+    html = web.home_html(tmp_path)
+    assert 'href="/perf"' in html
+    assert "timeline.html" in html
+    assert "perf/rate.png" in html, \
+        "subdirectory evidence missing from the home table"
+    d = web.dir_html(tmp_path, "demo/20260101T000000.000")
+    assert "evidence:" in d and "timeline.html" in d
+    assert "perf/rate.png" in d
+    # NON-run directories (the test-name dir holding many runs) must
+    # not present some arbitrary run's files as their evidence.
+    parent = web.dir_html(tmp_path, "demo")
+    assert "evidence:" not in parent
+
+
+# --- bench passthrough ------------------------------------------------------
+
+
+def _drive_probe_main(bench, monkeypatch, capsys, key="stub",
+                      result=None):
+    monkeypatch.setitem(bench.PROBES, key,
+                        lambda: dict(result if result is not None
+                                     else {"verdict": True,
+                                           "seconds": 0.1}))
+    with pytest.raises(SystemExit) as e:
+        bench._probe_main(key)
+    assert e.value.code == 0
+    out_lines = [ln for ln in capsys.readouterr().out.splitlines()
+                 if ln.lstrip().startswith("{")]
+    return json.loads(out_lines[-1])
+
+
+def test_probe_main_writes_exactly_one_record(bench, monkeypatch,
+                                              capsys, tmp_path):
+    p = tmp_path / "l.jsonl"
+    monkeypatch.setenv("JEPSEN_TPU_PERF_LEDGER", str(p))
+    r = _drive_probe_main(
+        bench, monkeypatch, capsys,
+        result={"verdict": True, "seconds": 0.1,
+                "host_stats": {"dispatches": 4, "episodes": 2}})
+    assert r["verdict"] is True
+    recs = ledger.load(str(p))
+    assert len(recs) == 1, "exactly one record per probe rung"
+    rec = recs[0]
+    assert rec["probe"] == "stub" and rec["kind"] == "bench"
+    assert rec["git"] and rec["env_fp"], \
+        "acceptance: git sha + env fingerprint on every bench record"
+    assert rec["verdict"] is True
+    assert rec["dispatches_per_episode"] == 2.0
+    assert isinstance(rec["wall_s"], float)
+
+
+def test_probe_main_perf_tag_names_the_rung(bench, monkeypatch,
+                                            capsys, tmp_path):
+    p = tmp_path / "l.jsonl"
+    monkeypatch.setenv("JEPSEN_TPU_PERF_LEDGER", str(p))
+    monkeypatch.setenv("JEPSEN_TPU_PERF_TAG", "partitioned_c30.sched")
+    _drive_probe_main(bench, monkeypatch, capsys)
+    (rec,) = ledger.load(str(p))
+    assert rec["probe"] == "partitioned_c30.sched"
+
+
+def test_probe_main_ledger_failure_cannot_cost_the_result(
+        bench, monkeypatch, capsys, tmp_path):
+    # The acceptance criterion verbatim: a ledger I/O failure can
+    # never cost a probe result. append() blowing up must leave the
+    # probe's JSON line on stdout untouched.
+    monkeypatch.setenv("JEPSEN_TPU_PERF_LEDGER",
+                       str(tmp_path / "l.jsonl"))
+
+    def boom(rec, path=None):
+        raise OSError("disk full")
+
+    monkeypatch.setattr("jepsen_tpu.obs.ledger.append", boom)
+    r = _drive_probe_main(bench, monkeypatch, capsys)
+    assert r == {"verdict": True, "seconds": 0.1}
+    assert ledger.load(str(tmp_path / "l.jsonl")) == []
+
+
+def test_probe_main_failed_probe_records_the_error(bench, monkeypatch,
+                                                   capsys, tmp_path):
+    p = tmp_path / "l.jsonl"
+    monkeypatch.setenv("JEPSEN_TPU_PERF_LEDGER", str(p))
+    monkeypatch.setitem(bench.PROBES, "stub",
+                        lambda: (_ for _ in ()).throw(
+                            RuntimeError("kernel fault")))
+    with pytest.raises(SystemExit):
+        bench._probe_main("stub")
+    (rec,) = ledger.load(str(p))
+    assert rec["verdict"] is None and "kernel fault" in rec["error"]
+
+
+def test_probe_main_ping_is_not_evidence(bench, monkeypatch, capsys,
+                                         tmp_path):
+    # ping is the worker-recovery helper: recording every recovery
+    # check would flood the trend rows with non-evidence.
+    p = tmp_path / "l.jsonl"
+    monkeypatch.setenv("JEPSEN_TPU_PERF_LEDGER", str(p))
+    _drive_probe_main(bench, monkeypatch, capsys, key="ping",
+                      result={"ok": True})
+    assert ledger.load(str(p)) == []
+
+
+def test_partitioned_rungs_carry_perf_tags(bench):
+    # Every ladder rung forces its own trend-row tag so sched/wave/
+    # unfused trajectories never mix (the _rung helper contract).
+    src = open(_BENCH_PATH).read()
+    assert "JEPSEN_TPU_PERF_TAG" in src
+    # And the tag rides the env the same way the other forced knobs do
+    # — via the rung env_extra (asserted through the live helper).
+    import inspect
+
+    assert "PERF_TAG" in inspect.getsource(bench._wide_probes)
+
+
+# --- trace rotation (JEPSEN_TPU_TRACE_MAX_MB) -------------------------------
+
+
+def test_trace_spill_rotates_past_cap(monkeypatch, tmp_path):
+    spill = tmp_path / "t.jsonl"
+    monkeypatch.setenv("JEPSEN_TPU_TRACE", "1")
+    monkeypatch.setenv("JEPSEN_TPU_TRACE_FILE", str(spill))
+    # ~2 KB cap: a few hundred events must rotate at least once.
+    monkeypatch.setenv("JEPSEN_TPU_TRACE_MAX_MB", "0.002")
+    trace.reset()
+    try:
+        for i in range(3 * trace._SPILL_BATCH):
+            trace.instant("ev", i=i, pad="x" * 40)
+        trace.flush()
+        assert trace.rotations() >= 1
+        assert (tmp_path / "t.jsonl.1").exists(), \
+            "rotated generation missing"
+        # The live path holds the NEWEST events and still parses —
+        # trace report reads it unchanged.
+        from jepsen_tpu.obs import report
+
+        live = report.load(str(spill))
+        assert live, "live spill empty after rotation"
+        assert live[-1]["args"]["i"] == 3 * trace._SPILL_BATCH - 1
+        assert len(live) < 3 * trace._SPILL_BATCH, \
+            "rotation kept every event in the live file"
+    finally:
+        trace.reset()
+
+
+def test_trace_no_rotation_under_cap(monkeypatch, tmp_path):
+    spill = tmp_path / "t.jsonl"
+    monkeypatch.setenv("JEPSEN_TPU_TRACE", "1")
+    monkeypatch.setenv("JEPSEN_TPU_TRACE_FILE", str(spill))
+    monkeypatch.delenv("JEPSEN_TPU_TRACE_MAX_MB", raising=False)
+    trace.reset()
+    try:
+        for i in range(8):
+            trace.instant("ev", i=i)
+        trace.flush()
+        assert trace.rotations() == 0
+        assert not (tmp_path / "t.jsonl.1").exists()
+    finally:
+        trace.reset()
+
+
+# --- store run-artifact backfill --------------------------------------------
+
+
+def test_write_run_artifacts_backfills_and_respects_existing(tmp_path):
+    from jepsen_tpu import store
+    from jepsen_tpu.history import Op
+
+    hist = [Op(process=0, type="invoke", f="read", value=None, time=0),
+            Op(process=0, type="ok", f="read", value=1, time=int(5e6))]
+    test = {"name": "artifact-demo", "store-base": str(tmp_path),
+            "start-time": "t1", "history": hist, "concurrency": 1}
+    written = store.write_run_artifacts(test)
+    assert "timeline.html" in written
+    p = store.path(test, "timeline.html")
+    assert p.exists() and "timeline" in p.read_text()
+    # Idempotent: existing artifacts are the checker's — left alone.
+    assert store.write_run_artifacts(test) == []
+    # Including ones a composed checker wrote under a SUBDIRECTORY
+    # (the independent-checker opts convention): no second copy at
+    # the run root.
+    test2 = dict(test, name="artifact-subdir")
+    sub = store.path(test2, "perf", "timeline.html", make=True)
+    sub.write_text("<html>checker's copy</html>")
+    written2 = store.write_run_artifacts(test2)
+    assert "timeline.html" not in written2
+    assert not store.path(test2, "timeline.html").exists()
+    # Unnamed tests persist nothing (the timeline.checker contract).
+    assert store.write_run_artifacts({"history": hist}) == []
+    # RUN_ARTIFACTS is the ONE list web links from (no drift).
+    assert web.RUN_ARTIFACTS is store.RUN_ARTIFACTS
+    # The cost guard: giant histories keep the opt-in model (a
+    # div-per-op timeline over 100k ops is tens of MB of serial work
+    # at run completion).
+    big = {"name": "big", "store-base": str(tmp_path),
+           "start-time": "t2", "concurrency": 1,
+           "history": hist * ((store.ARTIFACT_MAX_OPS // 2) + 1)}
+    assert store.write_run_artifacts(big) == []
+
+
+def test_wide_probes_health_row_flips_on_machinery_crash(
+        bench, monkeypatch, tmp_path):
+    # A _wide_probes machinery crash must not leave the sentinel
+    # green: the sweep records a True health row on every completed
+    # run, so the crash's errored row FLIPS it.
+    p = tmp_path / "l.jsonl"
+    monkeypatch.setenv("JEPSEN_TPU_PERF_LEDGER", str(p))
+    bench._ledger_wide(10.0, None)
+    assert ledger.gate(ledger.load(str(p))) == []
+    bench._ledger_wide(0.1, "ImportError: probe machinery broke")
+    rules = [f["rule"] for f in ledger.gate(ledger.load(str(p)))]
+    assert rules == ["verdict-flip"]
+    (bad,) = [r for r in ledger.load(str(p)) if r.get("error")]
+    assert bad["probe"] == "wide-probes" and bad["verdict"] is None
+
+
+def test_perf_smoke_module_importable():
+    # The Makefile target's module exists and exposes main() — the
+    # smoke itself runs chip-free under `make perf-smoke` (compiles,
+    # so not driven here in the quick tier).
+    import importlib
+
+    mod = importlib.import_module("jepsen_tpu.obs.perf_smoke")
+    assert callable(mod.main)
