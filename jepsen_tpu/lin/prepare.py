@@ -55,7 +55,8 @@ def fast_pack_enabled() -> bool:
 # Pack-wall accounting (bench's pack rung + the service's pack-seconds
 # counter read this; obs/trace spans carry the per-call attribution).
 _pack_stats = {"prepare_s": 0.0, "prepare_calls": 0,
-               "reduction_s": 0.0, "reduction_calls": 0, "mode": ""}
+               "reduction_s": 0.0, "reduction_calls": 0,
+               "incr_s": 0.0, "incr_calls": 0, "mode": ""}
 
 
 def pack_stats() -> dict:

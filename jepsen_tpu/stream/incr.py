@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import hashlib
 import heapq
+import time
 
 import numpy as np
 
@@ -107,6 +108,15 @@ class IncrementalPacker:
         self._op_v: list[list[int]] = []
         self._vw = self.kernel.value_width if self.kernel is not None \
             else int(prepare.VALUE_WIDTH)
+        # Growing per-op arrays for the vectorized settle: gathers and
+        # chain ordkeys without re-scanning self.ops each increment.
+        # One sentinel slot past the live count lets slot_op = -1
+        # fancy-index the inactive fill values (one-shot walk idiom).
+        self._n_arr = 0
+        self._op_f_a = np.zeros(0, np.int32)
+        self._op_v_a = np.zeros((0, self._vw), np.int32)
+        self._inv_pos_a = np.zeros(0, np.int64)
+        self._ret_pos_a = np.zeros(0, np.int64)
         # Row blocks at full alloc width (sliced to the live window in
         # packed()); block lists amortize the per-settle concatenation.
         self._blocks: dict[str, list[np.ndarray]] = {
@@ -205,7 +215,14 @@ class IncrementalPacker:
     def settle(self, final: bool = False) -> int:
         """Walk every endpoint event in the settled prefix (position
         < q_min; everything once ``final``), extending the row tables.
-        Returns the number of NEW return-event rows."""
+        Returns the number of NEW return-event rows.
+
+        Under JEPSEN_TPU_FAST_PACK (default) the batch goes through the
+        vectorized walk — prepare's sort/cumsum bracket passes with the
+        carried free stack as the virgin slot region — so per-increment
+        cost is O(new events + new rows x W), never a re-scan of the
+        settled prefix. Bit-identical to the per-event spec loop, which
+        stays behind ``=0`` as the executable reference."""
         if not self.incremental:
             return 0
         if final and not self.finalized:
@@ -218,11 +235,41 @@ class IncrementalPacker:
             self._pending.clear()
         q_min = _NEVER if not self._pending else \
             min(pos for pos, _ in self._pending.values())
+        evs = []
+        heap = self._heap
+        while heap and heap[0][0] < q_min:
+            evs.append(heapq.heappop(heap))
+        if not evs:
+            return 0
+        from jepsen_tpu.obs import trace as obs_trace
+
+        t0 = time.perf_counter()
+        with obs_trace.span("pack-incr", events=len(evs)) as sp:
+            # A batch that would overflow the window defers to the spec
+            # loop, which raises mid-walk exactly like the one-shot pack.
+            if prepare.fast_pack_enabled() and not self._overflows(evs):
+                n_new = self._settle_vec(evs)
+                sp.note(rows=n_new, walk="vec")
+            else:
+                n_new = self._settle_spec(evs)
+                sp.note(rows=n_new, walk="spec")
+        st = prepare._pack_stats
+        st["incr_s"] += time.perf_counter() - t0
+        st["incr_calls"] += 1
+        return n_new
+
+    def _overflows(self, evs) -> bool:
+        d = np.fromiter((1 - 2 * e[1] for e in evs), np.int64, len(evs))
+        return len(self._cur_active) + int(
+            np.cumsum(d).max(initial=0)) > self.max_window
+
+    def _settle_spec(self, evs) -> int:
+        """The per-event reference walk (JEPSEN_TPU_FAST_PACK=0):
+        prepare._pack_events_py's loop with carried state."""
         rows = {k: [] for k in self._blocks}
         W = self.max_window
         vw = self._vw
-        while self._heap and self._heap[0][0] < q_min:
-            pos, kind, _, o = heapq.heappop(self._heap)
+        for pos, kind, _, o in evs:
             self.events_processed += 1
             if kind == 0:                                   # invoke
                 if not self._free:
@@ -278,6 +325,248 @@ class IncrementalPacker:
             self._red_blocks.append(self._reduce_rows(block, lo, self.R))
             self._red_cache = None
         return n_new
+
+    # --- the vectorized settle (JEPSEN_TPU_FAST_PACK) -----------------------
+
+    def _ensure_op_capacity(self, need: int) -> None:
+        cap = self._op_f_a.shape[0]
+        if need <= cap:
+            return
+        new_cap = max(need, 2 * cap, 256)
+        pad = new_cap - cap
+        self._op_f_a = np.concatenate(
+            [self._op_f_a, np.zeros(pad, np.int32)])
+        self._op_v_a = np.concatenate(
+            [self._op_v_a, np.zeros((pad, self._vw), np.int32)])
+        self._inv_pos_a = np.concatenate(
+            [self._inv_pos_a, np.zeros(pad, np.int64)])
+        self._ret_pos_a = np.concatenate(
+            [self._ret_pos_a, np.zeros(pad, np.int64)])
+
+    def _materialize_ops(self, n0, n1, new_f, new_v, new_ip, new_rp):
+        """Batch-append the new ops' interned tables and endpoint
+        positions to the growing arrays (+ the sentinel slot at n1).
+        Backfills ops packed by earlier spec-mode settles, so flipping
+        JEPSEN_TPU_FAST_PACK mid-stream stays correct."""
+        self._ensure_op_capacity(n1 + 1)
+        if self._n_arr < n0:
+            lo = self._n_arr
+            self._op_f_a[lo:n0] = np.asarray(self._op_f[lo:n0], np.int32)
+            self._op_v_a[lo:n0] = np.asarray(self._op_v[lo:n0], np.int32)
+            self._inv_pos_a[lo:n0] = np.fromiter(
+                (o.invoke_pos for o in self.ops[lo:n0]),
+                np.int64, n0 - lo)
+            self._ret_pos_a[lo:n0] = np.fromiter(
+                (int(_NEVER) if o.return_pos is None else o.return_pos
+                 for o in self.ops[lo:n0]), np.int64, n0 - lo)
+        if n1 > n0:
+            self._op_f_a[n0:n1] = np.asarray(new_f, np.int32)
+            self._op_v_a[n0:n1] = np.asarray(new_v, np.int32)
+            self._inv_pos_a[n0:n1] = np.asarray(new_ip, np.int64)
+            self._ret_pos_a[n0:n1] = np.asarray(new_rp, np.int64)
+        self._n_arr = n1
+        self._op_f_a[n1] = 0
+        self._op_v_a[n1] = int(prepare.NIL)
+        self._inv_pos_a[n1] = 0
+        self._ret_pos_a[n1] = 0
+
+    def _settle_vec(self, evs) -> int:
+        """One batched pass over the settled events: the same
+        sort/cumsum bracket walk as prepare._pack_events_vec — returns
+        are opens, invokes are closes — with two carry twists: fresh
+        invokes (running-min records of the return-minus-invoke sum)
+        pop the CARRIED free stack top-down instead of the virgin
+        0,1,2... region, and the carried actives paint as row intervals
+        from batch row 0. No per-row Python snapshot, no per-settle
+        re-concatenation, no re-scan of settled ops. Bit-identical to
+        _settle_spec (fuzzed in tests/test_stream.py)."""
+        E = len(evs)
+        self.events_processed += E
+        W = self.max_window
+        vw = self._vw
+        n0 = len(self.ops)
+        kind_ret = np.empty(E, bool)
+        ev_pos = np.empty(E, np.int64)
+        ev_gid = np.empty(E, np.int64)
+        new_f, new_v, new_ip, new_rp = [], [], [], []
+        for e, (pos, kind, _, o) in enumerate(evs):
+            ev_pos[e] = pos
+            if kind == 0:                                   # invoke
+                o._id = len(self.ops)
+                self.ops.append(o)
+                f_id, v = prepare._op_f_and_values(o, self.intern)
+                vv = v[:vw] + [0] * (vw - len(v))
+                self._op_f.append(f_id)
+                self._op_v.append(vv)
+                new_f.append(f_id)
+                new_v.append(vv)
+                new_ip.append(o.invoke_pos)
+                new_rp.append(int(_NEVER) if o.return_pos is None
+                              else o.return_pos)
+                kind_ret[e] = False
+            else:                                           # ok return
+                kind_ret[e] = True
+            ev_gid[e] = o._id
+        k = len(new_f)
+        n1 = n0 + k
+        self._materialize_ops(n0, n1, new_f, new_v, new_ip, new_rp)
+
+        # Fresh invokes: the batch recycle stack is empty exactly when
+        # the return-minus-invoke running sum hits a new minimum; they
+        # take the carried free-stack slots top-down, in order.
+        sigma = np.cumsum(np.where(kind_ret, 1, -1))
+        runmin = np.minimum.accumulate(np.minimum(sigma, 0))
+        prev_runmin = np.empty_like(runmin)
+        prev_runmin[0] = 0
+        prev_runmin[1:] = runmin[:-1]
+        fresh = (~kind_ret) & (sigma < prev_runmin)
+        n_fresh = int(fresh.sum())
+
+        # Local op table: batch-invoked ops [0, k) in invoke order, then
+        # the carried ops that return in this batch.
+        ret_gids = ev_gid[kind_ret]
+        carried = ret_gids < n0
+        carried_gids = ret_gids[carried]
+        n_car = len(carried_gids)
+        L = k + n_car
+        lop = np.empty(E, np.int64)
+        lop[~kind_ret] = ev_gid[~kind_ret] - n0
+        c_idx = np.cumsum(carried) - 1
+        lop[np.flatnonzero(kind_ret)] = np.where(
+            carried, k + c_idx, ret_gids - n0)
+        slot_root = np.full(max(1, L), -1, np.int32)
+        if n_fresh:
+            reserve = np.asarray(self._free[::-1][:n_fresh], np.int32)
+            slot_root[ev_gid[fresh] - n0] = reserve
+            self.max_used = max(self.max_used, int(reserve.max()) + 1)
+        if n_car:
+            slot_root[k:L] = [self._slot_of[int(g)]
+                              for g in carried_gids.tolist()]
+        # Bracket-match recycled invokes to the return they reuse, then
+        # propagate slots along reuse chains by pointer doubling (roots:
+        # fresh batch ops and carried ops).
+        sub = kind_ret | ((~kind_ret) & ~fresh)
+        si = np.flatnonzero(sub)
+        lev = sigma - runmin
+        lv = np.where(kind_ret[si], lev[si], lev[si] + 1)
+        so = np.argsort(lv, kind="stable")
+        ss = si[so]
+        lvs = lv[so]
+        parent = np.arange(max(1, L), dtype=np.int64)
+        if len(ss):
+            run_first = np.empty(len(ss), bool)
+            run_first[0] = True
+            run_first[1:] = lvs[1:] != lvs[:-1]
+            base = np.maximum.accumulate(
+                np.where(run_first, np.arange(len(ss)), 0))
+            rank = np.arange(len(ss)) - base
+            mpair = rank % 2 == 1
+            parent[lop[ss[mpair]]] = lop[ss[np.flatnonzero(mpair) - 1]]
+            while True:
+                pp = parent[parent]
+                if np.array_equal(pp, parent):
+                    break
+                parent = pp
+        slot_l = slot_root[parent]
+
+        n_new = int(kind_ret.sum())
+        if n_new:
+            rlop = lop[kind_ret]
+            # Row intervals in batch-row space: carried actives from row
+            # 0, batch ops from their invoke; still-active ops paint
+            # through the last row (next batch re-paints them from 0).
+            n_car0 = len(self._cur_active)
+            ca_slots = np.fromiter(self._cur_active.keys(), np.int64,
+                                   n_car0)
+            ca_gids = np.fromiter(self._cur_active.values(), np.int64,
+                                  n_car0)
+            p_gid = np.concatenate([ca_gids, np.arange(n0, n1)])
+            p_slot = np.concatenate(
+                [ca_slots, slot_l[:k].astype(np.int64, copy=False)])
+            ret_pos_sorted = ev_pos[kind_ret]
+            r0 = np.concatenate([
+                np.zeros(n_car0, np.int64),
+                np.searchsorted(ret_pos_sorted, self._inv_pos_a[n0:n1])])
+            r1 = np.full(n_car0 + k, n_new, np.int64)
+            rows_idx = np.arange(n_new, dtype=np.int64)
+            bm = ~carried
+            r1[n_car0 + (ret_gids[bm] - n0)] = rows_idx[bm] + 1
+            if n_car:
+                ca_pos = {int(g): j for j, g in
+                          enumerate(ca_gids.tolist())}
+                for rr, g in zip(rows_idx[carried].tolist(),
+                                 carried_gids.tolist()):
+                    r1[ca_pos[g]] = rr + 1
+            # Column-major paint (cumsum along the contiguous axis) of
+            # op id + 1, as in the one-shot walk.
+            occ = np.zeros((W, n_new + 1), np.int32)
+            flat = occ.reshape(-1)
+            ids1 = (p_gid + 1).astype(np.int32)
+            np.add.at(flat, p_slot * (n_new + 1) + r0, ids1)
+            np.subtract.at(flat, p_slot * (n_new + 1) + r1, ids1)
+            np.cumsum(occ, axis=1, out=occ)
+            grid = np.ascontiguousarray(occ[:, :n_new].T)
+            active = grid != 0
+            slot_op = grid - 1
+            fview = self._op_f_a[:n1 + 1]
+            vview = self._op_v_a[:n1 + 1]
+            rview = self._ret_pos_a[:n1 + 1]
+            slot_f = fview[slot_op]
+            slot_v = vview[slot_op]
+            crashed = (rview[slot_op] >= _NEVER) & active
+            b = self._blocks
+            b["ret_slot"].append(slot_l[rlop].astype(np.int32,
+                                                     copy=False))
+            b["ret_op"].append(ret_gids.astype(np.int32, copy=False))
+            b["active"].append(active)
+            b["slot_f"].append(slot_f)
+            b["slot_v"].append(slot_v)
+            b["slot_op"].append(slot_op)
+            b["crashed"].append(crashed)
+            self._tables = None
+            self.R += n_new
+            self._red_blocks.append(self._reduce_rows_vec(
+                active, slot_f, slot_v, slot_op, grid))
+            self._red_cache = None
+
+        # Replay the walk-state bookkeeping (dicts + the LIFO free
+        # list) — pure O(new events) Python, no per-row numpy.
+        free = self._free
+        sl = slot_l[lop].tolist()
+        kl = kind_ret.tolist()
+        gl = ev_gid.tolist()
+        for e in range(E):
+            g = gl[e]
+            s = sl[e]
+            if kl[e]:
+                del self._cur_active[s]
+                del self._slot_of[g]
+                free.append(s)
+            else:
+                free.pop()
+                self._slot_of[g] = s
+                self._cur_active[s] = g
+        return n_new
+
+    def _reduce_rows_vec(self, active, slot_f, slot_v, slot_op, grid):
+        """(pure, pred) for a fresh block via the shared vectorized
+        chain core (prepare._chain_tables_vec) with position-based
+        ordkeys, restricted to the ops the block references — O(block),
+        never a re-scan of all settled ops. Restriction preserves both
+        class equality (per-op values) and pairwise ordkey order, so
+        the result is bit-identical to _reduce_rows."""
+        part = np.unique(grid)
+        part_g = part[part > 0].astype(np.int64) - 1
+        pr = self._ret_pos_a[part_g]
+        pi = self._inv_pos_a[part_g]
+        p_crashed = pr >= _NEVER
+        p_ord = np.where(p_crashed, _NEVER + 2 + pi, pr)
+        loc = np.searchsorted(part_g, np.clip(slot_op, 0, None))
+        slot_op_l = np.where(slot_op >= 0, loc, -1).astype(np.int32)
+        return prepare._chain_tables_vec(
+            active, slot_f, slot_v, slot_op_l, p_ord, p_crashed,
+            op_f_ops=self._op_f_a[part_g],
+            op_v_ops=self._op_v_a[part_g])
 
     def _tables_concat(self) -> dict[str, np.ndarray]:
         if self._tables is None:

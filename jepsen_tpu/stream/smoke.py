@@ -85,6 +85,41 @@ def main() -> int:
     out["checks"].append(rec)
     ok = ok and r2.get("valid?") == want_bad and fed < len(bad)
 
+    # --- increment scaling (packer-only, chip-free) -------------------------
+    # Acceptance gate for the vectorized settle (doc/streaming.md): the
+    # per-increment pack wall must stay ~flat as the settled prefix
+    # grows — late increments no worse than ~early ones. The spec loop
+    # (JEPSEN_TPU_FAST_PACK=0) re-concatenates and re-scans the prefix,
+    # so only the default vec mode is held to the bound.
+    from jepsen_tpu.stream import IncrementalPacker
+
+    prepare.reset_pack_stats()
+    hs = list(synth.generate_register_history(
+        40000, concurrency=8, seed=7, crash_prob=0.005, max_crashes=8))
+    pk = IncrementalPacker(m.cas_register())
+    walls = []
+    for i in range(0, len(hs), 1000):
+        pk.feed_many(hs[i:i + 1000])
+        t0 = time.perf_counter()
+        pk.settle()
+        walls.append(time.perf_counter() - t0)
+    pk.settle(final=True)
+    q = len(walls) // 4
+    early = sum(walls[q:2 * q]) / q
+    late = sum(walls[-q:]) / q
+    ratio = late / early
+    vec_mode = prepare.fast_pack_enabled()
+    scale_ok = (ratio < 1.8) or not vec_mode
+    rec = {"leg": "increment-scaling", "ops": len(hs),
+           "increments": len(walls), "rows": pk.R,
+           "early_ms": round(early * 1e3, 2),
+           "late_ms": round(late * 1e3, 2),
+           "late_over_early": round(ratio, 2),
+           "packer_mode": "vec" if vec_mode else "spec",
+           "pack_incr_s": round(prepare.pack_stats()["incr_s"], 3)}
+    out["checks"].append(rec)
+    ok = ok and scale_ok
+
     # --- over the wire ------------------------------------------------------
     svc = CheckerService("127.0.0.1", 0, flush_ms_=20).start()
     out["port"] = svc.port
@@ -121,7 +156,8 @@ def main() -> int:
 
     perf_ledger.record("stream-smoke", kind="smoke",
                        wall_s=time.time() - t_start, verdict=ok,
-                       extra={"stats": out.get("stats")})
+                       extra={"stats": out.get("stats"),
+                              "increment_scaling": rec})
     print(json.dumps(out))
     return 0 if ok else 1
 

@@ -42,6 +42,7 @@ inference, never a second set of rules.
 from __future__ import annotations
 
 import hashlib
+import time
 from collections import defaultdict
 from dataclasses import dataclass
 
@@ -49,6 +50,21 @@ import numpy as np
 
 from jepsen_tpu.txn import oracle
 from jepsen_tpu.txn.oracle import RT, RW, WR, WW
+
+# Pack-wall accounting (bench's txn artifacts + the service's
+# pack-seconds counter read this; the pack-txn trace span carries the
+# per-call attribution). Mirrors lin/prepare's _pack_stats convention.
+_pack_stats = {"pack_s": 0.0, "pack_calls": 0}
+
+
+def pack_stats() -> dict:
+    """Snapshot of cumulative txn packing wall this process (seconds)."""
+    return dict(_pack_stats)
+
+
+def reset_pack_stats() -> None:
+    for k in _pack_stats:
+        _pack_stats[k] = 0.0 if k.endswith("_s") else 0
 
 
 @dataclass
@@ -83,18 +99,40 @@ class _KeyInfo:
     docstring): writer per position, anomaly positions/entries, and
     duplicate positions — everything the oracle's per-element read
     loop looks up, hoisted so a prefix-verified read costs
-    O(log) searchsorted counts instead of O(len(obs)) Python."""
+    O(log) searchsorted counts instead of O(len(obs)) Python.
 
-    __slots__ = ("arr", "fast", "warr", "g1a_pos", "g1a_ent",
+    When the key's order, written values, and failed values are all
+    lossless ints (the dtype gate below), the construction itself is
+    vectorized (ISSUE 16 tentpole c): the per-position writer lookup
+    becomes one searchsorted join against the key's write column, the
+    duplicate scan one stable sort, and the G1a/garbage split one more
+    join against the failed column — the last linear-Python pass over
+    version orders. Witness entries still carry the ORIGINAL history
+    objects (JSON-safe over the wire), materialized per anomaly entry
+    only."""
+
+    __slots__ = ("arr", "fast", "warr", "warr_a", "g1a_pos", "g1a_ent",
                  "never_pos", "never_ent", "dup_pos", "dup_ent")
 
-    def __init__(self, k, order, writer, failed):
+    def __init__(self, k, order, writer, failed, kw=None, kf=None):
         # Lossless-int gate: np.asarray infers the dtype, so a float
         # (1.5), bool, mixed, or bignum order comes back non-"iu" and
         # the key's reads take the oracle's literal path — fromiter
         # with a forced int64 would silently TRUNCATE 1.5 -> 1 and
         # mask exactly the corrupt reads the checker exists to catch.
         arr = np.asarray(order)
+        if arr.dtype.kind in "iu" and kw is not None and kf is not None:
+            wv = kw[0] if kw else ()
+            fv = kf[0] if kf else ()
+            va = np.asarray(wv) if len(wv) else np.zeros(0, np.int64)
+            fa = np.asarray(fv) if len(fv) else np.zeros(0, np.int64)
+            # The dict paths compare with Python ==, so the write and
+            # failed columns must be lossless ints too (True == 1,
+            # 1.0 == 1: a "b"/"f"/"O" column falls back to the spec
+            # loop rather than risk a dtype-coerced false join).
+            if va.dtype.kind in "iu" and fa.dtype.kind in "iu":
+                self._init_vec(k, order, arr, kw, kf, failed)
+                return
         if arr.dtype.kind in "iu":
             self.arr = arr.astype(np.int64)
             self.fast = True
@@ -102,6 +140,7 @@ class _KeyInfo:
             self.arr = None
             self.fast = False
         self.warr = [writer.get((k, v)) for v in order]
+        self.warr_a = None
         g1a_pos: list = []
         g1a_ent: list = []
         never_pos: list = []
@@ -128,6 +167,60 @@ class _KeyInfo:
         self.dup_pos = np.asarray(dup_pos, np.int64)
         self.dup_ent = dup_ent
 
+    def _init_vec(self, k, order, arr, kw, kf, failed):
+        self.fast = True
+        oa = arr.astype(np.int64)
+        self.arr = oa
+        self.warr = None
+        m = len(oa)
+        wid = np.full(m, -1, np.int64)
+        if kw[0]:
+            va = np.asarray(kw[0]).astype(np.int64)
+            ia = np.asarray(kw[1], np.int64)
+            sv = np.argsort(va, kind="stable")
+            svals = va[sv]
+            sids = ia[sv]
+            pos = np.searchsorted(svals, oa)
+            inb = pos < len(svals)
+            hit = np.zeros(m, bool)
+            hit[inb] = svals[pos[inb]] == oa[inb]
+            wid[hit] = sids[pos[hit]]
+        self.warr_a = wid
+        # Duplicates: for equal values the stable sort keeps position
+        # order, so all but the first of each run are the dups.
+        so = np.argsort(oa, kind="stable")
+        svo = oa[so]
+        dm = np.zeros(m, bool)
+        dm[1:] = svo[1:] == svo[:-1]
+        dup_p = np.sort(so[dm])
+        # Unwritten positions split into failed (G1a) vs never-written.
+        miss = np.flatnonzero(wid < 0)
+        g1a_m = np.zeros(len(miss), bool)
+        if kf[0] and len(miss):
+            fva = np.asarray(kf[0]).astype(np.int64)
+            sfv = np.sort(fva)
+            ov = oa[miss]
+            fpos = np.searchsorted(sfv, ov)
+            finb = fpos < len(sfv)
+            g1a_m[finb] = sfv[fpos[finb]] == ov[finb]
+        g1a_p = miss[g1a_m]
+        never_p = miss[~g1a_m]
+        self.g1a_pos = g1a_p
+        self.never_pos = never_p
+        self.dup_pos = dup_p
+        self.g1a_ent = [(order[p], failed[(k, order[p])])
+                        for p in g1a_p.tolist()]
+        self.never_ent = [order[p] for p in never_p.tolist()]
+        self.dup_ent = [order[p] for p in dup_p.tolist()]
+
+    def wid(self, p):
+        """Writer txn id at order position p, or None (the oracle's
+        ``writer.get`` contract), from whichever column form exists."""
+        if self.warr_a is not None:
+            w = int(self.warr_a[p])
+            return None if w < 0 else w
+        return self.warr[p]
+
 
 def infer_fast(history=None, nodes=None, failed=None,
                realtime: bool = False) -> oracle.TxnGraph:
@@ -152,6 +245,7 @@ def infer_fast(history=None, nodes=None, failed=None,
     dupes_w: list = []          # append-duplicate witnesses (full —
     dup_count = 0               # bounded by the append count)
     appends_per_key: dict = defaultdict(int)
+    per_key_w: dict = defaultdict(lambda: ([], []))  # k -> (vals, ids)
     for t in nodes:
         for f, k, v in t.mops:
             if f != "append":
@@ -162,7 +256,16 @@ def infer_fast(history=None, nodes=None, failed=None,
                                 "txns": [writer[(k, v)], t.idx]})
                 dup_count += 1
             else:
+                if (k, v) not in writer:       # first-occurrence column
+                    kw = per_key_w[k]
+                    kw[0].append(v)
+                    kw[1].append(t.idx)
                 writer[(k, v)] = t.idx
+    failed_by_key: dict = defaultdict(lambda: ([], []))
+    for (fk, fv), fidx in failed.items():
+        kf = failed_by_key[fk]
+        kf[0].append(fv)
+        kf[1].append(fidx)
 
     longest: dict = {}
     reads: list = []
@@ -195,8 +298,29 @@ def infer_fast(history=None, nodes=None, failed=None,
         if w in ok_txn and v not in observed_vals.get(k, ()):
             unobserved[k].append(w)
 
+    # The version-order WW join, vectorized (tentpole c): per key the
+    # writer lookups are one searchsorted join (_KeyInfo.warr_a) and
+    # the chain edges one pairwise pass over the present writers —
+    # identical to the per-element loop, which non-int keys still run.
     observed = 0
+    keyinfo: dict = {}
     for k, order in longest.items():
+        ki = keyinfo[k] = _KeyInfo(k, order, writer, failed,
+                                   per_key_w.get(k, ((), ())),
+                                   failed_by_key.get(k, ((), ())))
+        if ki.warr_a is not None:
+            idx = np.flatnonzero(ki.warr_a >= 0)
+            observed += len(idx)
+            if len(idx):
+                a = ki.warr_a[idx]
+                keep = a[:-1] != a[1:]
+                es.extend(a[:-1][keep].tolist())
+                ed.extend(a[1:][keep].tolist())
+                et.extend([WW] * int(keep.sum()))
+                prev = int(a[-1])
+                for w in unobserved.get(k, ()):
+                    edge(prev, w, WW)
+            continue
         prev = None
         for v in order:
             w = writer.get((k, v))
@@ -210,7 +334,6 @@ def infer_fast(history=None, nodes=None, failed=None,
                 edge(prev, w, WW)
 
     # --- per-read pass: vectorized prefix path --------------------
-    keyinfo: dict = {}
     incompatible: list = []
     g1a_w: list = []
     never_w: list = []
@@ -229,7 +352,9 @@ def infer_fast(history=None, nodes=None, failed=None,
         L = len(obs)
         ki = keyinfo.get(k)
         if ki is None:
-            ki = keyinfo[k] = _KeyInfo(k, order, writer, failed)
+            ki = keyinfo[k] = _KeyInfo(k, order, writer, failed,
+                                       per_key_w.get(k, ((), ())),
+                                       failed_by_key.get(k, ((), ())))
         fast = False
         if L == 0:
             fast = True
@@ -270,11 +395,11 @@ def infer_fast(history=None, nodes=None, failed=None,
                                          "txns": [i],
                                          "kind": "read-duplicate"})
             if L:
-                w = ki.warr[L - 1]
+                w = ki.wid(L - 1)
                 if w is not None:
                     edge(w, i, WR)
             if L < len(order):
-                nxt = ki.warr[L]
+                nxt = ki.wid(L)
                 if nxt is not None:
                     edge(i, nxt, RW)
             else:               # obs == order (verified prefix, full)
@@ -367,15 +492,24 @@ def pack(history=None, graph: oracle.TxnGraph | None = None,
     oracle-identical vectorization); ``algorithm="cpu"`` checks keep
     running ``oracle.infer`` end to end, so the parity leg never
     shares this code."""
-    if graph is None:
-        graph = infer_fast(history, realtime=realtime)
+    from jepsen_tpu.obs import trace as obs_trace
 
-    src, dst, typ = graph.src, graph.dst, graph.typ
-    order = np.lexsort((typ, dst, src)) if len(src) else \
-        np.zeros(0, np.int64)
-    return PackedTxnHistory(
-        graph=graph, n=graph.n,
-        edge_src=src[order].astype(np.int32),
-        edge_dst=dst[order].astype(np.int32),
-        edge_typ=typ[order].astype(np.int8),
-        realtime=realtime)
+    t0 = time.perf_counter()
+    with obs_trace.span("pack-txn",
+                        prepacked=graph is not None) as sp:
+        if graph is None:
+            graph = infer_fast(history, realtime=realtime)
+
+        src, dst, typ = graph.src, graph.dst, graph.typ
+        order = np.lexsort((typ, dst, src)) if len(src) else \
+            np.zeros(0, np.int64)
+        out = PackedTxnHistory(
+            graph=graph, n=graph.n,
+            edge_src=src[order].astype(np.int32),
+            edge_dst=dst[order].astype(np.int32),
+            edge_typ=typ[order].astype(np.int8),
+            realtime=realtime)
+        sp.note(txns=out.n, edges=out.n_edges)
+    _pack_stats["pack_s"] += time.perf_counter() - t0
+    _pack_stats["pack_calls"] += 1
+    return out
