@@ -2,7 +2,7 @@
 
 .PHONY: test test-quick integration integration-local bench \
 	probe-config5 serve-smoke txn-smoke trace-smoke stream-smoke \
-	fleet-smoke perf-smoke lint
+	fleet-smoke perf-smoke pack-smoke lint
 
 # Unit + parity suite on the virtual 8-device CPU mesh (no cluster).
 # Hardware note: ~8 min on a 4-core box; the compile-heavy lin parity
@@ -143,6 +143,19 @@ PERF_SMOKE_TIMEOUT ?= 600
 perf-smoke:
 	timeout -k 15 $(PERF_SMOKE_TIMEOUT) \
 		python -m jepsen_tpu.obs.perf_smoke
+
+# Packer smoke (ISSUE 16): chip-free proof that the vectorized packer
+# (JEPSEN_TPU_FAST_PACK=1, the default) is BIT-IDENTICAL to the Python
+# spec walk (history fingerprint + slot_op) on the partitioned and
+# mutex families, actually faster (soft >=1.5x gate at the smoke's
+# mid-size; bench's `pack` micro-rung holds the 100k-op >=5x
+# evidence), and that the pack meter's fields ride the perf-ledger
+# record schema. Run it after touching lin/prepare.py, txn/pack.py,
+# stream/incr.py, or the packer env knobs.
+PACK_SMOKE_TIMEOUT ?= 600
+pack-smoke:
+	timeout -k 15 $(PACK_SMOKE_TIMEOUT) \
+		python -m jepsen_tpu.lin.pack_smoke
 
 PROBE_CONFIG5_TIMEOUT ?= 5400
 # Frontier checkpoint: a probe killed by the timeout (or a fault)

@@ -24,6 +24,11 @@ case that makes list-based checkers struggle — checked by the dense
 config-space bitmap engine (jepsen_tpu.lin.dense), which crashed ops cost
 nothing extra. Secondary probes cover BASELINE configs 3-5:
 
+- ``pack``: chip-free host-pack micro-rung — the 100k-op config-5
+  history packed under both packer modes (vectorized vs Python spec
+  walk), bit-parity asserted, speedup recorded to the perf ledger.
+  Runs FIRST: it needs no chip and its ledger record is the standing
+  pack-wall evidence.
 - ``mutex_c30``: lock histories at concurrency 30 (config 3).
 - ``wide_window_c30``: a saturated single-register history at
   concurrency 30 (window ~26) — the class knossos DNFs on.
@@ -32,6 +37,13 @@ nothing extra. Secondary probes cover BASELINE configs 3-5:
 - ``txn_c30``: 100k-op list-append transactional history through the
   txn dependency-graph checker (jepsen_tpu.txn) — healthy leg plus a
   spliced-anomaly leg with oracle parity (edges/s, anomaly counts).
+- ``fused_pair``: the raised-bound PAIR-KEY fused fixpoint tier
+  (JEPSEN_TPU_PSORT_FUSED_MAX_N) on the crash-free saturated pair
+  band — the only band where it can engage (crash-dom histories keep
+  the forced-lax chain rule) — small-input smoke first, then an
+  unfused/default/raised A/B/A with verdict parity. Fault-isolated
+  and ordered before partitioned_c30 so a Mosaic fault in the
+  never-probed shape cannot cost the headline.
 - ``partitioned_c30``: the literal config-5 shape — a 100k-op
   partition-nemesis history, 24 crashed mutators, window 49.
 
@@ -65,10 +77,11 @@ TARGET_SECONDS = 60.0
 # time already spent (_partitioned_budget), so the bench total stays
 # inside the driver's budget instead of losing the artifact to an
 # external timeout (BENCH_r05: rc=124, parsed=null).
-PROBE_ORDER = (("mutex_c30", 600), ("wide_window_c30", 600),
+PROBE_ORDER = (("pack", 300), ("mutex_c30", 600),
+               ("wide_window_c30", 600),
                ("independent_keys", 900), ("service_c30", 900),
                ("txn_c30", 900), ("stream_c30", 900),
-               ("partitioned_c30", 5300))
+               ("fused_pair", 900), ("partitioned_c30", 5300))
 WORKER_RESTART_S = 75
 # Overall bench wall budget the partitioned probe must fit inside
 # (env-overridable for driver environments with different budgets).
@@ -188,8 +201,10 @@ def _timed_check(make_history, n_ops, model=None, warm=True):
     from jepsen_tpu.lin import device_check_packed, prepare
 
     h = make_history()
+    prepare.reset_pack_stats()
     p = prepare.prepare(model if model is not None
                         else m.cas_register(), h)
+    pack = prepare.pack_stats()
     if warm:
         r = device_check_packed(p)      # warm/compile
     t0 = time.time()
@@ -202,7 +217,12 @@ def _timed_check(make_history, n_ops, model=None, warm=True):
         "analyzer": r.get("analyzer"),
         "timed_run": "steady" if warm else "first",
         "seconds": round(dt, 1),
-        "ops_per_sec": round(n_ops / dt, 1)}
+        "ops_per_sec": round(n_ops / dt, 1),
+        # Host pack cost + packer mode (ISSUE 16): rides into the
+        # perf-ledger record via _probe_main so `perf report` trends
+        # the pack wall next to the check wall for every probe.
+        "pack": {"prepare_s": round(pack["prepare_s"], 3),
+                 "mode": pack["mode"]}}
     # Engine observability: the host-row executor's episode/dispatch/
     # pass counters (the tunnel round trips the fused closure fixpoint
     # is cutting — the round-6 acceptance metric) and the top capacity.
@@ -310,6 +330,26 @@ def _probe_wave_smoke():
         sched["error"] = ("sched smoke ran no scheduler episodes "
                           "(vacuous probe)")
     out["sched"] = sched
+    # SCHED_QUEUE tuning leg (ISSUE 16): queue depth 64 at the top
+    # host cap 2^19 keeps rows*cap at 2^25 — the same envelope the
+    # spike executor proved at 32 rows x cap 2^20 (rows*cap program
+    # complexity is the fault driver, round-2/3/5 lore), but a shape
+    # this chip has never run. A clean leg lets the ladder's sched
+    # rung run the deeper queue; a fault/wedge here gates it back to
+    # the proven 32 without costing the multi-hour rung.
+    q64: dict = {}
+    if "error" not in sched:
+        os.environ["JEPSEN_TPU_SCHED_QUEUE"] = "64"
+        try:
+            q64 = leg(True)
+        finally:
+            os.environ.pop("JEPSEN_TPU_SCHED_QUEUE", None)
+        if "error" not in q64 \
+                and not (q64.get("host_stats") or {}).get("sched_rows"):
+            q64["error"] = ("q64 sched smoke ran no scheduler "
+                            "episodes (vacuous probe)")
+        q64["sched_queue"] = 64
+    out["sched_q64"] = q64
     return out
 
 
@@ -466,6 +506,11 @@ def _probe_service_c30():
     out["fleet"] = {k: st.get(k) for k in
                     ("workers", "worker_deaths", "worker_respawns",
                      "requeues", "journal_depth", "journal_settles")}
+    # The daemon's process-wide pack meter (svc-request satellite,
+    # ISSUE 16): host seconds spent packing across every request this
+    # process served, forwarded into the ledger record by _probe_main.
+    if st.get("pack_seconds") is not None:
+        out["pack"] = {"pack_seconds": st["pack_seconds"]}
     if st.get("journal_depth"):
         out["note_fleet"] = (f"journal depth {st['journal_depth']} "
                              f"after drain: requests LOST (bug)")
@@ -490,6 +535,7 @@ def _probe_stream_c30():
     n_ops = 5000
     h = list(synth.generate_partitioned_register_history(
         n_ops, seed=7, invoke_bias=0.45))
+    prepare.reset_pack_stats()
     p = prepare.prepare(m.cas_register(), h)
     device_check_packed(p)                      # warm/compile
     t0 = time.time()
@@ -548,6 +594,15 @@ def _probe_stream_c30():
            "abort_seconds": None if abort_s is None
            else round(abort_s, 3),
            "ops_saved_by_abort": saved_ops}
+    # Pack cost split (ISSUE 16): the one-shot full pack vs the
+    # stream sessions' per-increment settled-row packs (incr_s — the
+    # sublinear path the vectorized settle bought), with the packer
+    # mode, forwarded into the ledger record by _probe_main.
+    st_pack = prepare.pack_stats()
+    out["pack"] = {"prepare_s": round(st_pack["prepare_s"], 3),
+                   "incr_s": round(st_pack["incr_s"], 3),
+                   "incr_calls": st_pack["incr_calls"],
+                   "mode": st_pack["mode"]}
     # Contract: parity with the one-shot verdict, and the injected
     # violation aborts the stream before the history runs out.
     out["verdict"] = (one.get("valid?") is True
@@ -571,8 +626,10 @@ def _probe_txn_c30():
     and classify with oracle parity (the real device leg; its cost and
     tier stats ride in the artifact)."""
     from jepsen_tpu import txn
+    from jepsen_tpu.txn import pack as txn_pack
     from jepsen_tpu.txn import synth
 
+    txn_pack.reset_pack_stats()
     n_txns = 50_000
     h = synth.generate_list_append_history(
         n_txns, concurrency=30, keys=32, seed=7, crash_prob=0.0005)
@@ -611,7 +668,12 @@ def _probe_txn_c30():
                            (seeded.get("anomalies") or {}).items()},
         "witness_parity": parity,
         "device_stats": stats,
-        "fallbacks": seeded.get("fallbacks")}
+        "fallbacks": seeded.get("fallbacks"),
+        # Version-order join pack cost across all three legs
+        # (ISSUE 16): the vectorized join's wall, forwarded into the
+        # ledger record by _probe_main.
+        "pack": {"pack_s": round(txn_pack.pack_stats()["pack_s"], 3),
+                 "pack_calls": txn_pack.pack_stats()["pack_calls"]}}
     # Contract: healthy decides valid, every spliced anomaly class is
     # found, and the device classification matches the oracle.
     out["verdict"] = (healthy.get("valid?") is True
@@ -623,6 +685,155 @@ def _probe_txn_c30():
     return out
 
 
+def _probe_pack():
+    """Chip-free pack micro-rung (ISSUE 16): the literal config-5
+    100k-op history packed under BOTH packer modes — the vectorized
+    pipeline (JEPSEN_TPU_FAST_PACK=1, the default) against the Python
+    spec walk — with bit-parity asserted (supervise.history_fingerprint
+    covers every packed array the fingerprint hashes; slot_op is
+    compared explicitly because the fingerprint excludes it) and the
+    speedup recorded. The perf-ledger record this rung appends is the
+    standing before/after pack-wall evidence `cli.py perf report`
+    shows. Never needs the chip — packing is pure numpy — and the cpu
+    platform is forced anyway so an accidental device init cannot take
+    the TPU ahead of the real probes (this rung runs FIRST)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu.lin import prepare, supervise, synth
+
+    h = list(synth.generate_partitioned_register_history(
+        100_000, seed=7, invoke_bias=0.45))
+    model = m.cas_register()
+
+    def one(mode):
+        os.environ["JEPSEN_TPU_FAST_PACK"] = mode
+        # The spec leg must be the PYTHON walk: NATIVE_PACK=1 would
+        # swap in the ctypes slot walk and the "py" wall would measure
+        # the wrong baseline (doc/env.md § JEPSEN_TPU_NATIVE_PACK).
+        os.environ["JEPSEN_TPU_NATIVE_PACK"] = mode
+        prepare.reset_pack_stats()
+        t0 = time.time()
+        p = prepare.prepare(model, list(h))
+        return p, time.time() - t0, prepare.pack_stats()["mode"]
+
+    # Interleaved best-of-3 per leg (the headline's best-of-3 habit):
+    # shared-box CPU throughput swings tens of percent run to run, and
+    # interleaving the legs makes a throttled window tax both modes
+    # instead of whichever leg it landed on.
+    vec_runs: list = []
+    py_runs: list = []
+    try:
+        for _ in range(3):
+            p_vec, w, vec_mode = one("1")
+            vec_runs.append(w)
+            p_py, w, py_mode = one("0")
+            py_runs.append(w)
+    finally:
+        os.environ.pop("JEPSEN_TPU_FAST_PACK", None)
+        os.environ.pop("JEPSEN_TPU_NATIVE_PACK", None)
+    vec_s, py_s = min(vec_runs), min(py_runs)
+    parity = (supervise.history_fingerprint(p_vec)
+              == supervise.history_fingerprint(p_py)
+              and np.array_equal(np.asarray(p_vec.slot_op),
+                                 np.asarray(p_py.slot_op)))
+    speedup = round(py_s / vec_s, 2) if vec_s else None
+    out = {"n_ops": len(h) // 2, "n_events": len(h),
+           "return_events": int(p_vec.R),
+           "window": p_vec.window,
+           "vec_seconds": round(vec_s, 3), "vec_mode": vec_mode,
+           "vec_seconds_runs": [round(w, 3) for w in vec_runs],
+           "py_seconds": round(py_s, 3), "py_mode": py_mode,
+           "py_seconds_runs": [round(w, 3) for w in py_runs],
+           "speedup": speedup, "bit_parity": parity,
+           # pack sub-dict: _probe_main forwards it into the ledger
+           # record so `perf report`/`perf diff` trend the pack wall.
+           "pack": {"prepare_s": round(vec_s, 3), "mode": vec_mode,
+                    "py_s": round(py_s, 3), "speedup": speedup}}
+    # Contract: bit-parity always; the ISSUE 16 acceptance floor is
+    # >=5x on this shape, but the probe's own soft gate is 3x so a
+    # noisy shared box flags degradation without flapping the rung.
+    out["verdict"] = bool(parity and speedup and speedup >= 3.0)
+    if not out["verdict"]:
+        out["error"] = "pack parity/speedup contract failed (see fields)"
+    return out
+
+
+def _probe_fused_pair():
+    """Env-gated probe of the PAIR-KEY fused fixpoint tier at the
+    raised candidate-space bound (JEPSEN_TPU_PSORT_FUSED_MAX_N,
+    psort_fused.max_n) — fault-ISOLATED in its own subprocess rung,
+    ordered before the partitioned ladder, so a Mosaic fault in the
+    never-probed raised shape can never cost the headline. The shape
+    under test is the CRASH-FREE saturated pair band: crash_dom
+    histories (every partitioned rung) keep use_fused=0 by design
+    (round-5 forced-lax lore), so this standalone probe is the only
+    place the raised tier can honestly engage. Legs, proven-first per
+    the fault lore: (0) a seconds-scale 140-op small-input smoke at a
+    single big cap — the raised-bound tier programs compile and any
+    reached tier dispatches HERE, where a fault costs seconds; then
+    the timed A/B/A over a 500-op window-~26 history: unfused chain,
+    fused at the proven default bound (2^19), fused at the raised
+    bound (MAX_N=20). Verdict parity across all legs is the contract;
+    max_cap and walls are recorded honestly (when the frontier never
+    reaches a raised tier, equal walls ARE the honest A/B result)."""
+    from jepsen_tpu import models as m
+    from jepsen_tpu.lin import bfs, prepare, synth
+
+    # Small-input probe first (CLAUDE.md fault lore: probe new kernel
+    # shapes on SMALL inputs before spending budget on them).
+    hs = synth.generate_register_history(
+        140, concurrency=40, seed=3, value_range=5, crash_prob=0)
+    ps = prepare.prepare(m.cas_register(), hs)
+    os.environ["JEPSEN_TPU_PSORT_FUSED"] = "1"
+    os.environ["JEPSEN_TPU_PSORT_FUSED_MAX_N"] = "20"
+    t0 = time.time()
+    r = bfs.check_packed(ps, cap_schedule=(1 << 15,))
+    out = {"smoke": {"events": len(hs), "window": ps.window,
+                     "verdict": r.get("valid?"),
+                     "seconds": round(time.time() - t0, 1)}}
+    if r.get("valid?") is not True:
+        out["error"] = f"raised-bound smoke verdict {r.get('valid?')!r}"
+        return out
+
+    h = synth.generate_register_history(
+        500, concurrency=40, seed=7, value_range=5, crash_prob=0)
+    p = prepare.prepare(m.cas_register(), h)
+    b = max(len(p.unintern), 2).bit_length()
+    out.update({"n_ops": len(h), "window": p.window,
+                "pair_keys": p.window + b > 31})
+
+    def leg(fused, max_exp=None):
+        os.environ["JEPSEN_TPU_PSORT_FUSED"] = "1" if fused else "0"
+        if max_exp:
+            os.environ["JEPSEN_TPU_PSORT_FUSED_MAX_N"] = str(max_exp)
+        else:
+            os.environ.pop("JEPSEN_TPU_PSORT_FUSED_MAX_N", None)
+        bfs.check_packed(p)                     # warm/compile
+        t0 = time.time()
+        rr = bfs.check_packed(p)
+        return {"verdict": rr.get("valid?"),
+                "seconds": round(time.time() - t0, 2),
+                "max_cap": rr.get("max-cap")}
+
+    try:
+        out["unfused"] = leg(False)
+        out["fused_default"] = leg(True)
+        out["fused_raised"] = leg(True, max_exp=20)
+    finally:
+        os.environ.pop("JEPSEN_TPU_PSORT_FUSED", None)
+        os.environ.pop("JEPSEN_TPU_PSORT_FUSED_MAX_N", None)
+    verdicts = {out[k]["verdict"]
+                for k in ("unfused", "fused_default", "fused_raised")}
+    out["verdict"] = verdicts == {True}
+    if not out["verdict"]:
+        out["error"] = "fused-pair legs disagree (see fields)"
+    return out
+
+
 PROBES = {"ping": _probe_ping, "mutex_c30": _probe_mutex_c30,
           "txn_c30": _probe_txn_c30,
           "wide_window_c30": _probe_wide_window_c30,
@@ -630,7 +841,8 @@ PROBES = {"ping": _probe_ping, "mutex_c30": _probe_mutex_c30,
           "independent_keys": _probe_independent_keys,
           "wave_smoke": _probe_wave_smoke,
           "service_c30": _probe_service_c30,
-          "stream_c30": _probe_stream_c30}
+          "stream_c30": _probe_stream_c30,
+          "pack": _probe_pack, "fused_pair": _probe_fused_pair}
 
 
 def _run_probe_subprocess(key: str, timeout: int, env_extra=None,
@@ -875,6 +1087,11 @@ def _wide_probes(detail: dict, out: dict, t_start: float) -> None:
                          "JEPSEN_TPU_HOST_STICKY": str(sticky),
                          "JEPSEN_TPU_HOST_ROWS_K": str(k),
                          "JEPSEN_TPU_HOST_SCHED": str(sched),
+                         # Queue depth is part of the rung's recorded
+                         # config (forced-env invariant). The proven
+                         # default; the smoke's q64 leg may promote
+                         # the sched rung below (ISSUE 16 tuning).
+                         "JEPSEN_TPU_SCHED_QUEUE": "32",
                          # The crash-dom band never engages the fused
                          # psort kernel; force it off so the artifact
                          # records the exact (inert-anyway) config.
@@ -894,7 +1111,8 @@ def _wide_probes(detail: dict, out: dict, t_start: float) -> None:
                          "JEPSEN_TPU_CKPT": ck},
                         {"sync_chunks": sync, "fused_closure": fused,
                          "host_sticky": sticky, "host_rows_k": k,
-                         "host_sched": sched, "checkpoint": ck}, tag)
+                         "host_sched": sched, "sched_queue": 32,
+                         "checkpoint": ck}, tag)
 
             attempts = (
                 _rung(2, 1, 1, 4, 1, "sched"),
@@ -938,6 +1156,16 @@ def _wide_probes(detail: dict, out: dict, t_start: float) -> None:
                 # rung, so it needs BOTH legs clean.
                 sched_ok = wave_ok and bool(sched_leg) \
                     and "error" not in sched_leg
+                # SCHED_QUEUE tuning (ISSUE 16): the sched rung runs
+                # queue depth 64 only when the smoke's q64 leg proved
+                # that exact rows*cap envelope clean on THIS chip —
+                # otherwise the proven 32 stands. The rung's env AND
+                # tags both move so the artifact records the config
+                # that actually ran.
+                q64_leg = smoke.get("sched_q64") or {}
+                if sched_ok and q64_leg and "error" not in q64_leg:
+                    attempts[0][0]["JEPSEN_TPU_SCHED_QUEUE"] = "64"
+                    attempts[0][1]["sched_queue"] = 64
                 if not wave_ok or "error" in sched_leg:
                     # The smoke fault may have killed the worker; the
                     # remaining (non-wave) rungs need it back. A
@@ -1129,6 +1357,11 @@ def _probe_main(key: str) -> None:
                 # 300 s resumed tail must not poison the median full
                 # 3217 s runs are judged against).
                 extra["resumed_from_row"] = r["resumed_from_row"]
+            if isinstance(r.get("pack"), dict):
+                # Pack-seconds + packer mode (ISSUE 16): inert to the
+                # gate rules, but `perf report`/`perf diff` trend it
+                # so a packer regression shows up cross-run.
+                extra["pack"] = r["pack"]
             perf_ledger.record(
                 os.environ.get("JEPSEN_TPU_PERF_TAG") or key,
                 kind="bench", wall_s=wall_s, verdict=r.get("verdict"),

@@ -212,20 +212,27 @@ def try_check_batch(model, subs: dict, declines: list | None = None) \
     if not subs:
         return {}
     packed: dict = {}
-    for k, sub in subs.items():
-        try:
-            p = prepare.prepare(model, sub)
-        except prepare.UnsupportedHistory as e:
-            if declines is not None:
-                declines.append(Decline("prepare", str(e), keys=[k]))
-            continue
-        if p.kernel is None:
-            if declines is not None:
-                declines.append(Decline(
-                    "kernel", "model/history has no device kernel",
-                    keys=[k]))
-            continue
-        packed[k] = p
+    # One batch-level pack span: per-key prepare spans exist, but a
+    # 1000-key batch would attribute its packing wall as 1000 dust
+    # motes — the rollup is what `cli.py trace report` can read.
+    with obs_trace.span("pack-batch", keys=len(subs)) as sp:
+        t0 = prepare.pack_stats()["prepare_s"]
+        for k, sub in subs.items():
+            try:
+                p = prepare.prepare(model, sub)
+            except prepare.UnsupportedHistory as e:
+                if declines is not None:
+                    declines.append(Decline("prepare", str(e), keys=[k]))
+                continue
+            if p.kernel is None:
+                if declines is not None:
+                    declines.append(Decline(
+                        "kernel", "model/history has no device kernel",
+                        keys=[k]))
+                continue
+            packed[k] = p
+        sp.note(packed=len(packed),
+                pack_s=round(prepare.pack_stats()["prepare_s"] - t0, 4))
 
     groups: dict = {}
     for k, p in packed.items():

@@ -1390,7 +1390,8 @@ def _search_chunk_keys(n_rows, ret_slot, active, slot_f, slot_v,
             return (l2, n2, g2, since2, it + 1, o3)
 
         if exp_tables is not None and not crash_dom and use_fused \
-                and psort_fused.fits(tier, M_cols, b):
+                and psort_fused.fits(tier, M_cols, b,
+                                     max_pad=int(use_fused)):
             # Fused in-VMEM fixpoint: the whole expand -> sort-dedup
             # pass chain as ONE pallas kernel with the frontier
             # resident in VMEM across passes (psort_fused — the
@@ -2977,11 +2978,14 @@ def check_packed(p: PackedHistory, cap_schedule=DEFAULT_CAP_SCHEDULE,
     sync_chunks = _sync_chunks()
     # Fused in-VMEM fixpoint kernel (psort_fused) for the compact
     # band's row tiers: NON-dominance dedups only — the crash-dom
-    # band keeps the forced-lax chain rule (round-5 lore). Static
-    # argname of _search_chunk so flipping JEPSEN_TPU_PSORT_FUSED
-    # retraces.
-    use_fused = (exp_h is not None and not crash_dom
-                 and psort_fused.enabled())
+    # band keeps the forced-lax chain rule (round-5 lore). The value
+    # is the env-resolved candidate-space BOUND (0 = off): a static
+    # argname of _search_chunk, so flipping JEPSEN_TPU_PSORT_FUSED or
+    # raising JEPSEN_TPU_PSORT_FUSED_MAX_N retraces instead of hitting
+    # a stale traced fits() gate.
+    use_fused = (psort_fused.max_n()
+                 if (exp_h is not None and not crash_dom
+                     and psort_fused.enabled()) else 0)
     kname = p.kernel.name if p.kernel is not None else "generic"
     host_stats: dict = {"episodes": 0, "rows": 0, "dispatches": 0,
                         "passes": 0, "wasted_passes": 0,

@@ -1,0 +1,128 @@
+"""`make pack-smoke`: both packer modes, bit parity + speedup sanity.
+
+The serve/txn/trace/stream/perf-smoke habit for the host packer
+(lin/prepare, ISSUE 16): a FRESH-process, chip-free proof on the forced
+CPU platform that
+
+- the vectorized packer (JEPSEN_TPU_FAST_PACK=1, the default) produces
+  a BIT-IDENTICAL packed history to the Python spec walk on the
+  partitioned register shape AND the mutex family
+  (supervise.history_fingerprint over every hashed array, plus an
+  explicit slot_op comparison — the fingerprint excludes it),
+- the vectorized path is actually faster (soft gate: >=1.5x on the
+  smoke's mid-size shape; the bench `pack` micro-rung holds the real
+  >=5x evidence at the 100k-op scale, this guard only catches a
+  packer that silently fell back to the walk), and
+- the pack meter accumulated and its fields ride the smoke's own
+  perf-ledger record (the `pack` sub-dict schema bench forwards).
+
+Packing is pure numpy — no device program runs — but the cpu platform
+is forced anyway so an accidental backend init can never take the
+chip. Prints one JSON result line and exits 0/1 — timeout-guarded by
+the Makefile so a wedge cannot hold the shell.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    t_start = time.time()
+    # CPU platform BEFORE any jax backend init (CLAUDE.md: the TPU
+    # plugin force-selects its platform; the smoke must never take the
+    # chip).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu.lin import prepare, supervise, synth
+
+    out: dict = {"checks": []}
+    ok = True
+
+    def both(model, h):
+        """Pack one history under both modes; return (vec, py, walls)."""
+        packs = {}
+        walls = {}
+        for mode in ("1", "0"):
+            os.environ["JEPSEN_TPU_FAST_PACK"] = mode
+            # The spec leg must be the PYTHON walk: NATIVE_PACK=1
+            # would swap in the ctypes slot walk and the "py" wall
+            # would measure the wrong baseline (doc/env.md).
+            os.environ["JEPSEN_TPU_NATIVE_PACK"] = mode
+            prepare.reset_pack_stats()
+            t0 = time.time()
+            packs[mode] = prepare.prepare(model, list(h))
+            walls[mode] = time.time() - t0
+        os.environ.pop("JEPSEN_TPU_FAST_PACK", None)
+        os.environ.pop("JEPSEN_TPU_NATIVE_PACK", None)
+        return packs["1"], packs["0"], walls
+
+    def parity(p_vec, p_py):
+        return (supervise.history_fingerprint(p_vec)
+                == supervise.history_fingerprint(p_py)
+                and np.array_equal(np.asarray(p_vec.slot_op),
+                                   np.asarray(p_py.slot_op)))
+
+    # 1. Partitioned register shape (the config-5 family) at a
+    # mid-size: big enough for the speedup to show, small enough to
+    # keep the smoke seconds-scale.
+    h = synth.generate_partitioned_register_history(
+        10_000, seed=7, invoke_bias=0.45)
+    p_vec, p_py, walls = both(m.cas_register(), h)
+    speedup = round(walls["0"] / walls["1"], 2) if walls["1"] else None
+    good = parity(p_vec, p_py) and bool(speedup) and speedup >= 1.5
+    out["checks"].append({"case": "partitioned-10k",
+                          "window": p_vec.window,
+                          "vec_s": round(walls["1"], 3),
+                          "py_s": round(walls["0"], 3),
+                          "speedup": speedup,
+                          "bit_parity": parity(p_vec, p_py),
+                          "ok": good})
+    ok = ok and good
+    pack = {"prepare_s": round(walls["1"], 3), "py_s": round(
+        walls["0"], 3), "speedup": speedup, "mode": "vec"}
+
+    # 2. Mutex family (different kernel, crashed ops): parity only —
+    # the speedup gate lives on the register shape above.
+    h = synth.generate_mutex_history(
+        2000, concurrency=10, seed=3, crash_prob=0.01, max_crashes=4)
+    p_vec, p_py, _ = both(m.mutex(), h)
+    good = parity(p_vec, p_py)
+    out["checks"].append({"case": "mutex-2k", "bit_parity": good,
+                          "ok": good})
+    ok = ok and good
+
+    # 3. The pack meter accumulated under the vec mode (the fields the
+    # service daemon's stats() and bench's artifacts surface).
+    st = prepare.pack_stats()
+    good = st["prepare_calls"] > 0 and st["prepare_s"] > 0
+    out["checks"].append({"case": "pack-meter",
+                          "stats": {k: (round(v, 4)
+                                        if isinstance(v, float) else v)
+                                    for k, v in st.items()},
+                          "ok": good})
+    ok = ok and good
+
+    out["ok"] = ok
+    # Cross-run perf ledger (doc/observability.md § Perf ledger): the
+    # smoke's own record carries the pack sub-dict so `cli.py perf
+    # report` trends the pack wall. record() never raises — a ledger
+    # failure cannot cost the smoke.
+    from jepsen_tpu.obs import ledger as perf_ledger
+
+    perf_ledger.record("pack-smoke", kind="smoke",
+                       wall_s=time.time() - t_start, verdict=ok,
+                       extra={"pack": pack})
+    print(json.dumps(out, default=str))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

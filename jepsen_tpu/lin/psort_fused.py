@@ -40,6 +40,13 @@ caps fall back to the unfused chain).
 
 Env: ``JEPSEN_TPU_PSORT_FUSED`` (doc/env.md) — ``0`` forces the
 unfused chain; platform/interpret gating follows ``psort.backend_ok``.
+``JEPSEN_TPU_PSORT_FUSED_MAX_N`` (an exponent) raises the candidate-
+space bound past the default ``psort.PSORT_MAX_N`` so the PAIR-KEY
+in-chunk tiers at the big caps engage the kernel too — see
+:func:`max_n`. The raise is env-gated OFF by default and the bench
+engages it only behind its small-input smoke probe (fault lore:
+rows*cap program complexity is the fault driver; never spend a
+multi-hour rung on an unprobed shape).
 """
 
 from __future__ import annotations
@@ -98,13 +105,40 @@ def _interpret() -> bool:
         or not psort._on_tpu()
 
 
-def fits(cap: int, M: int, b: int) -> bool:
+# Hard ceiling for the env-raised candidate-space bound: 2^21 is the
+# largest in-VMEM bitonic sort PROVEN on this chip (psort module
+# docstring — the raised scoped-VMEM limit sorts to 2^21). Beyond it
+# nothing has run; the knob clamps rather than trusts.
+FUSED_MAX_EXP = 21
+
+
+def max_n() -> int:
+    """The fused kernel's candidate-space bound (padded elements).
+    Default ``psort.PSORT_MAX_N`` — the proven envelope every rung
+    runs inside. ``JEPSEN_TPU_PSORT_FUSED_MAX_N`` (an EXPONENT, the
+    DOM_WINDOW convention) raises it so the pair-key in-chunk tiers at
+    the big caps engage the kernel, clamped to ``2^FUSED_MAX_EXP``
+    (the proven sort bound) — the bench's partitioned ladder sets it
+    on its ``fusedtier`` rung only after the small-input smoke leg ran
+    the raised shape clean on the chip. Read OUTSIDE jit and passed as
+    a static argument (``bfs`` plumbs it through ``use_fused``), so a
+    mid-process env change can never hit a stale traced gate."""
+    env = os.environ.get("JEPSEN_TPU_PSORT_FUSED_MAX_N", "")
+    if not env:
+        return psort.PSORT_MAX_N
+    return 1 << min(FUSED_MAX_EXP, max(10, int(env)))
+
+
+def fits(cap: int, M: int, b: int, max_pad: int | None = None) -> bool:
     """Size/shape gate: the candidate space must fit the in-VMEM sort
-    bound, the block roll trick needs cap to be a LANE-multiple power
-    of two, and the per-column scalar encoding needs the packed state
-    id to fit 6 bits (the compact band's own bound)."""
+    bound (``max_pad`` — callers inside jit pass the env-resolved
+    :func:`max_n` value; None keeps the proven default), the block
+    roll trick needs cap to be a LANE-multiple power of two, and the
+    per-column scalar encoding needs the packed state id to fit 6 bits
+    (the compact band's own bound)."""
+    bound = max_pad if max_pad else psort.PSORT_MAX_N
     return (b <= 6 and cap >= LANE and (cap & (cap - 1)) == 0
-            and psort.pad_size(cap * (1 + M)) <= psort.PSORT_MAX_N)
+            and psort.pad_size(cap * (1 + M)) <= bound)
 
 
 def _sat_select(sv, live, sat_ref, plane: int, nb: int):

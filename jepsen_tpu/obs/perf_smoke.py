@@ -111,12 +111,31 @@ def main() -> int:
     out["checks"].append({"leg": "gate-healthy", "rc": healthy_rc})
     ok = ok and healthy_rc == 0
 
+    # --- pack-seconds fields round-trip (ISSUE 16) --------------------------
+    # The ``pack`` sub-dict bench's _probe_main forwards (pack wall +
+    # packer mode) must survive the record -> load round trip verbatim
+    # AND stay inert to the gate rules: it is observability the report
+    # trends, never evidence the gate fires on.
+    med = sorted(walls)[1]
+    pack = {"prepare_s": 0.123, "incr_s": 0.0, "mode": "vec"}
+    ledger.record("cpu-mesh-check", kind="smoke", wall_s=med,
+                  verdict=want, extra={"pack": pack})
+    recs = [r for r in ledger.load(smoke_ledger)
+            if r.get("probe") == "cpu-mesh-check" and "pack" in r]
+    pack_rc = cli.run(cli.standard_commands(["perf"]),
+                      ["perf", "gate", "--ledger", smoke_ledger,
+                       "--frac", "10"])
+    out["checks"].append({"leg": "pack-roundtrip", "rc": pack_rc,
+                          "pack": recs[-1].get("pack") if recs
+                          else None})
+    ok = ok and bool(recs) and recs[-1]["pack"] == pack \
+        and pack_rc == 0
+
     # --- seeded WALL regression must be caught ------------------------------
     # The seeded legs PIN --frac at the shipped default: an exported
     # JEPSEN_TPU_PERF_GATE_FRAC tuned for a noisy tunnel (doc/env.md
     # invites it) must not make the 10x spike pass and fail the smoke
     # on a healthy checkout.
-    med = sorted(walls)[1]
     ledger.record("cpu-mesh-check", kind="smoke",
                   wall_s=med * 10, verdict=want)
     findings = ledger.gate(ledger.load(smoke_ledger), frac=1.5)

@@ -378,6 +378,7 @@ class CheckerService:
         out["latency_p99_s"] = self._percentile(lats, 0.99)
         out["latency_samples"] = len(lats)
         out.update(_compile_meter_snapshot())
+        out.update(_pack_meter_snapshot())
         return protocol.jsonable(out)
 
     def _write_stats_snapshot(self, force: bool = False) -> None:
@@ -1414,6 +1415,27 @@ def _install_compile_meter() -> None:
 
 def _compile_meter_snapshot() -> dict:
     return util.compile_meter()
+
+
+def _pack_meter_snapshot() -> dict:
+    """Process-wide host-pack meter (the compile-meter convention):
+    seconds this process spent packing histories — lin prepare passes
+    + stream settled-row increments + txn version-order joins — and
+    the packer mode that served the last pack. Best-effort: stats()
+    must never fail because a pack counter could not be read."""
+    try:
+        from jepsen_tpu.lin import prepare as _prep
+        from jepsen_tpu.txn import pack as _txn_pack
+
+        ps = _prep.pack_stats()
+        ts = _txn_pack.pack_stats()
+        return {"pack_seconds": round(
+                    ps["prepare_s"] + ps["incr_s"] + ts["pack_s"], 3),
+                "pack_calls": (ps["prepare_calls"] + ps["incr_calls"]
+                               + ts["pack_calls"]),
+                "pack_mode": ps["mode"]}
+    except Exception:  # noqa: BLE001 - observability only
+        return {}
 
 
 def serve_checker(host: str = "127.0.0.1", port: int | None = None,

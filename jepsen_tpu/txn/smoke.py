@@ -90,13 +90,41 @@ def main() -> int:
 
     out["graph_stats"] = oracle.infer(
         synth.generate_list_append_history(200, seed=1)).stats
+
+    # 4. Pack meter (ISSUE 16): every check above went through the
+    # version-order join, so the pack counters must have accumulated,
+    # and the pack sub-dict must survive the ledger record -> load
+    # round trip (the schema bench's _probe_main forwards) without
+    # tripping any gate rule — it is observability, not evidence.
+    from jepsen_tpu.obs import ledger as perf_ledger
+    from jepsen_tpu.txn import pack as txn_pack
+
+    ps = txn_pack.pack_stats()
+    pack = {"pack_s": round(ps["pack_s"], 3),
+            "pack_calls": ps["pack_calls"]}
+    good = ps["pack_calls"] > 0 and ps["pack_s"] >= 0
+    out["checks"].append({"case": "pack-meter", "pack": pack,
+                          "ok": good})
+    ok = ok and good
     out["ok"] = ok
     # Cross-run perf ledger (doc/observability.md § Perf ledger):
     # record() never raises — a ledger failure cannot cost the smoke.
-    from jepsen_tpu.obs import ledger as perf_ledger
-
-    perf_ledger.record("txn-smoke", kind="smoke",
-                       wall_s=time.time() - t_start, verdict=ok)
+    rec = perf_ledger.record("txn-smoke", kind="smoke",
+                             wall_s=time.time() - t_start, verdict=ok,
+                             extra={"pack": pack})
+    if rec is not None:
+        loaded = [r for r in perf_ledger.load()
+                  if r.get("probe") == "txn-smoke" and "pack" in r]
+        roundtrip = bool(loaded) and loaded[-1]["pack"] == pack \
+            and not [f for f in perf_ledger.gate(perf_ledger.load())
+                     if f["probe"] == "txn-smoke"
+                     and f["rule"] != "wall-regression"]
+        out["checks"].append({"case": "pack-roundtrip",
+                              "ok": roundtrip})
+        if not roundtrip:
+            out["ok"] = ok = False
+            print(json.dumps(out, default=str))
+            return 1
     print(json.dumps(out, default=str))
     return 0 if ok else 1
 

@@ -41,6 +41,29 @@ def test_fits_gate():
     assert not psort_fused.fits(1024, 8, 7)       # state id past 6 bits
 
 
+@quick
+def test_max_n_knob(monkeypatch):
+    # Default = the proven psort bound; the env exponent raises it;
+    # the clamp refuses anything past the proven 2^21 sort envelope.
+    from jepsen_tpu.lin import psort
+
+    monkeypatch.delenv("JEPSEN_TPU_PSORT_FUSED_MAX_N", raising=False)
+    assert psort_fused.max_n() == psort.PSORT_MAX_N
+    monkeypatch.setenv("JEPSEN_TPU_PSORT_FUSED_MAX_N", "20")
+    assert psort_fused.max_n() == 1 << 20
+    monkeypatch.setenv("JEPSEN_TPU_PSORT_FUSED_MAX_N", "25")
+    assert psort_fused.max_n() == 1 << psort_fused.FUSED_MAX_EXP
+    # fits() honors the raised bound only when the caller passes it —
+    # the default stays the proven envelope (bfs plumbs max_n() in as
+    # the static use_fused arg; an env change alone must never flip a
+    # traced gate).
+    # cap 2^14 x (1+40) columns pads to 2^20: past the default bound,
+    # inside a raised one.
+    assert not psort_fused.fits(1 << 14, 40, 3)
+    assert psort_fused.fits(1 << 14, 40, 3, max_pad=1 << 20)
+    assert not psort_fused.fits(1 << 14, 40, 3, max_pad=1 << 19)
+
+
 def _packed(n, concurrency, seed, value_range=5):
     h = synth.generate_register_history(
         n, concurrency=concurrency, seed=seed,
@@ -109,6 +132,71 @@ def test_kernel_fixpoint_matches_unfused_chain_pair(monkeypatch):
     fill = np.full(cap, 0xFFFFFFFF, np.uint32)
     lo0, hi0 = fill.copy(), fill.copy()
     lo0[0] = nil_id       # initial config: empty bitset, nil state
+    hi0[0] = 0
+    lo = jnp.asarray(lo0)
+    hi = jnp.asarray(hi0)
+    count = jnp.int32(1)
+
+    ulo, uhi, ucnt = lo, hi, count
+    passes = 0
+    while True:
+        ulo, uhi, ucnt, changed, ovf = bfs._closure_pass_keys_compact(
+            ulo, uhi, ucnt, act, v_row, pure_row, exp_r, cap=cap,
+            W=W, b=b, nil_id=nil_id, step_fn=p.kernel.step,
+            use_psort=False, crash_dom=False)
+        passes += 1
+        assert not bool(ovf)
+        if not bool(changed):
+            break
+        assert passes < it_max
+
+    cols, sats = bfs._fused_row_tables(exp_r, act, v_row, pure_row,
+                                       W=W, b=b, nil_id=nil_id)
+    flo, fhi, fcnt, conv, fovf = psort_fused.fixpoint(
+        lo, hi, count, cols, sats, cap=cap, b=b, it_max=it_max)
+    assert bool(conv) and not bool(fovf)
+    assert int(fcnt) == int(ucnt)
+    assert np.array_equal(np.asarray(flo), np.asarray(ulo))
+    assert np.array_equal(np.asarray(fhi), np.asarray(uhi))
+
+
+@pytest.mark.slow
+def test_kernel_fixpoint_pair_raised_bound(monkeypatch):
+    # The PAIR-KEY fused tier at a BIG cap: a (cap, M) shape whose
+    # candidate space pads past the default PSORT_MAX_N bound — only
+    # reachable through the JEPSEN_TPU_PSORT_FUSED_MAX_N raise — must
+    # still equal the unfused chain bit for bit. SLOW tier: each
+    # interpret-mode pass runs two 2^20-element pair-bitonic chains
+    # (~seconds each jitted on the CPU mesh).
+    import jax.numpy as jnp
+
+    p = _packed(140, 40, 3)
+    b = max(len(p.unintern), 2).bit_length()
+    nil_id = max(len(p.unintern), 2)
+    W = p.window
+    assert W + b > 31
+    exp_h = bfs.expansion_tables(p, b)
+    pure_h, _ = bfs.reduction_bit_tables(p, (W + 31) // 32)
+    r = next(i for i in range(p.R)
+             if np.asarray(exp_h[4])[i].any())
+    act = jnp.asarray(np.asarray(p.active)[r])
+    v_row = jnp.asarray(np.asarray(p.slot_v)[r])
+    pure_row = jnp.asarray(pure_h[r])
+    exp_r = tuple(jnp.asarray(t[r]) for t in exp_h)
+    M = int(exp_h[0].shape[-1])
+    # Smallest power-of-two cap whose padded candidate space exceeds
+    # the default bound — the raised-bound band, as small as it gets.
+    from jepsen_tpu.lin import psort
+    cap = 128
+    while psort.pad_size(cap * (1 + M)) <= psort.PSORT_MAX_N:
+        cap *= 2
+    assert not psort_fused.fits(cap, M, b)
+    assert psort_fused.fits(cap, M, b, max_pad=1 << 21)
+    it_max = W + 12
+
+    fill = np.full(cap, 0xFFFFFFFF, np.uint32)
+    lo0, hi0 = fill.copy(), fill.copy()
+    lo0[0] = nil_id
     hi0[0] = 0
     lo = jnp.asarray(lo0)
     hi = jnp.asarray(hi0)
