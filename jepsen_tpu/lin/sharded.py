@@ -59,7 +59,7 @@ def _global_dedup_keys(keys, valid, cap_local, axis):
     Returns (keys[cap_local], count_local, total, overflow); total and
     overflow are replicated."""
     d = lax.axis_index(axis)
-    n_dev = lax.axis_size(axis)
+    n_dev = util.axis_size(axis)
 
     key = keys | ((~valid).astype(jnp.uint32) << 31)
     key_all = lax.all_gather(key, axis, tiled=True)
@@ -84,7 +84,7 @@ def _global_dedup(bits, state, valid, cap_local, axis):
     slice. Returns (bits[cap_local], state[cap_local,S], count_local,
     total, overflow) — total/overflow are replicated."""
     d = lax.axis_index(axis)
-    n_dev = lax.axis_size(axis)
+    n_dev = util.axis_size(axis)
     s_width = state.shape[1]
 
     bits_all = lax.all_gather(bits, axis, tiled=True)
@@ -225,7 +225,7 @@ def _search_sharded(ret_slot, active, slot_f, slot_v, pure, pred_mask,
              False, False))
         return (~dead & ~ovf)[None], (r - 1)[None], ovf[None], total[None]
 
-    shard_map = jax.shard_map
+    shard_map = util.get_shard_map()
 
     # check_vma off: the carry deliberately mixes axis-varying values (the
     # frontier shard, via axis_index) with replicated control scalars
@@ -312,12 +312,13 @@ def _search_sharded_keys(ret_slot, active, slot_f, slot_v, pure, pred_mask,
         return (keys, count[None], r[None], dead[None], ovf[None],
                 total[None])
 
-    fn = jax.shard_map(shard_body, mesh=mesh,
-                       in_specs=(P(), P(), P(), P(), P(), P(), P(),
-                                 P(axis), P(axis)),
-                       out_specs=(P(axis), P(axis), P(axis), P(axis),
-                                  P(axis), P(axis)),
-                       check_vma=False)
+    fn = util.get_shard_map()(
+        shard_body, mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(), P(), P(),
+                  P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P(axis), P(axis),
+                   P(axis), P(axis)),
+        check_vma=False)
     keys, counts, r, dead, ovf, total = fn(
         n_rows, ret_slot, active, slot_f, slot_v, pure, pred_mask,
         keys, counts)

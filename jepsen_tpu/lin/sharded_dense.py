@@ -40,6 +40,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from jepsen_tpu import util
 from jepsen_tpu.lin import dense
 from jepsen_tpu.lin.prepare import PackedHistory
 
@@ -174,7 +175,7 @@ def _chunk_sharded(F_local, n_rows, nil_id, ret_slot, active, slot_f,
             row_cond, row_body, (jnp.int32(0), F, jnp.bool_(False)))
         return F.reshape(1, n_local), r[None], dead[None]
 
-    fn = jax.shard_map(
+    fn = util.get_shard_map()(
         body, mesh=mesh,
         in_specs=(P(axis), P(), P(), P(), P(), P(), P()),
         out_specs=(P(axis), P(axis), P(axis)),

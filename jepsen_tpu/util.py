@@ -399,6 +399,48 @@ def compile_meter() -> dict:
             "xla_cache_hits": hits}
 
 
+def get_shard_map():
+    """The ``shard_map`` entry point across jax versions: newer builds
+    export ``jax.shard_map`` (kwarg ``check_vma``); this image's jax
+    (0.4.x) only has ``jax.experimental.shard_map.shard_map`` (the
+    same knob spelled ``check_rep``). One shim — callers pass
+    ``check_vma`` and the old-jax path renames it — so the mesh
+    engines (lin/sharded.py, lin/sharded_dense.py) and their tests run
+    on BOTH; before this, every sharded test was driver-env-only (the
+    standing ROADMAP caveat)."""
+    import functools
+
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    from jax.experimental.shard_map import shard_map as fn_exp
+
+    @functools.wraps(fn_exp)
+    def shim(f, *args, check_vma=None, **kw):
+        if check_vma is not None:
+            kw.setdefault("check_rep", check_vma)
+        return fn_exp(f, *args, **kw)
+
+    return shim
+
+
+def axis_size(axis):
+    """``lax.axis_size`` across jax versions (absent in 0.4.x): the
+    fallback counts the axis with a psum of ones — a traced scalar,
+    which every mesh-engine use (capacity products, overflow tests)
+    accepts."""
+    from jax import lax
+
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis)
+    import jax.numpy as jnp
+
+    return lax.psum(jnp.int32(1), axis)
+
+
 def cache_dir() -> str:
     """``<repo>/.jax_cache`` — the one anchor for every on-disk
     artifact (compile cache, quarantine ledger, service stats, trace

@@ -73,9 +73,11 @@ def test_multiword_mesh_rejects_unchunked_long_history():
     # (The packed-key mesh path chunks and has no length bound.)
     from jepsen_tpu.lin import sharded
 
-    # set kernel is outside PACKED_STATE_KERNELS => multiword mesh path
+    # a >=32-element set packs its state as TWO words (S=2), which keeps
+    # it outside the packed-key gate => multiword mesh path
     p = prepare.prepare(m.set_model(), synth.generate_set_history(
-        30, concurrency=3, seed=1))
+        50, concurrency=4, seed=2))
+    assert p.init_state.shape[0] > 1  # guard the routing assumption
     import dataclasses
 
     big = dataclasses.replace(p, R=sharded.MAX_SHARDED_ROWS + 1)
@@ -159,8 +161,10 @@ def test_mesh_explain_final_paths():
     rs = sharded.check_packed(p, mesh=mesh(8), engine="sparse",
                               explain=True)
     assert rs["valid?"] is False and rs["final-paths"], rs
-    # multiword mesh path explains too (replay from the initial config)
-    hs = list(synth.generate_set_history(30, concurrency=3, seed=2))
+    # multiword mesh path explains too (replay from the initial config);
+    # the >=32-element set carries a 2-word state vector, which keeps it
+    # off the packed-key route
+    hs = list(synth.generate_set_history(50, concurrency=4, seed=2))
     for i in range(len(hs) - 1, -1, -1):
         if hs[i].is_ok and hs[i].f == "read" and hs[i].value is not None:
             hs[i] = hs[i].replace(value=list(hs[i].value) + [9999])
