@@ -58,12 +58,12 @@ ROUTED_SITES = frozenset(
      "txn-scc"})
 
 # Per-site rule waivers: the jaxpr twin of the source-level
-# `# lint: unbounded-ok` comments. The mesh closure fixpoints
-# (sharded.py) are provably monotone (no content-sensitive dominance
-# prune) and carry the justification at their while_loops; until the
-# crash-dom mesh work adds in-carry ceilings (ROADMAP), the gate must
-# not flag every healthy mesh chunk.
-SITE_WAIVERS = {"mesh-chunk": ("unbounded-while",)}
+# `# lint: unbounded-ok` comments. Empty since the mesh closure
+# fixpoints (sharded.py) gained in-carry iteration ceilings — every
+# supervised site's loops now carry an ordered-compare bound the
+# jaxpr walker can see; add entries only with a written termination
+# argument at the waived loop.
+SITE_WAIVERS: dict = {}
 
 # What one avoided fault is worth: a kernel fault kills the TPU worker
 # for ~a minute (CLAUDE.md round-1 lore) before the retry even starts.

@@ -1051,24 +1051,17 @@ def _fused_row_tables(exp_r, act, v_row, pure_row, *, W, b, nil_id):
     return cols, sats
 
 
-def _closure_pass_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
-                               exp, *, cap, W, b, nil_id, step_fn,
-                               use_psort=False, crash_dom=False,
-                               dom_iters=2):
-    """ONE closure pass over packed key configs with mutator-compacted
-    expansion columns (bfs.expansion_tables): semantically identical to
-    _closure_pass_keys for the read-value-match register family (fuzzed
-    in tests/test_lin_psort.py and the engine parity suites), but the
-    model step runs over M mutator columns instead of the full window,
-    and the candidate array is cap*(1+M) instead of cap*(1+W).
-    Carried-key saturation needs no step evaluation at all here: read
-    legality is a pure state-id match, so the per-row saturation table
-    (the rvm branch of _expand_keys) covers it.
-
-    Keys are KEY-space words: ``lo`` u32 (bits << b | state), plus
-    ``hi`` u32 for windows past 31-b bits (None otherwise — the
-    cockroach-class concurrency-30 band lives there). Returns
-    (lo, hi, count, changed, overflow)."""
+def _expand_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
+                         exp, *, cap, W, b, nil_id, step_fn):
+    """The CANDIDATE-GENERATION half of _closure_pass_keys_compact —
+    in-place saturation plus mutator-column expansion with the chain
+    and JIT gates — factored out so the mesh engine
+    (:mod:`jepsen_tpu.lin.sharded`) pairs the identical expansion with
+    its COLLECTIVE dedup while this module's passes keep the local
+    one; a single definition keeps the two engines' pass semantics
+    equal by construction (the _expand_keys precedent). Returns
+    (cand_lo, cand_hi, cand_valid) with cand_hi None for single-word
+    keys; candidate arrays are cap*(1+M)."""
     from jepsen_tpu.models.kernels import NIL
 
     (exp_lo, exp_hi, exp_f, exp_v, exp_act, exp_pred_lo, exp_pred_hi,
@@ -1134,10 +1127,40 @@ def _closure_pass_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
     cand_lo = jnp.concatenate([jnp.where(cfg_valid, lo1, 0),
                                new_lo.reshape(-1)])
     cand_valid = jnp.concatenate([cfg_valid, legal.reshape(-1)])
+    cand_hi = None
     if pair:
         new_hi = hi1[:, None] | exp_hi[None, :] | nsat_hi
         cand_hi = jnp.concatenate([jnp.where(cfg_valid, hi1, 0),
                                    new_hi.reshape(-1)])
+    return cand_lo, cand_hi, cand_valid
+
+
+def _closure_pass_keys_compact(lo_in, hi_in, count, act, v_row, pure_row,
+                               exp, *, cap, W, b, nil_id, step_fn,
+                               use_psort=False, crash_dom=False,
+                               dom_iters=2):
+    """ONE closure pass over packed key configs with mutator-compacted
+    expansion columns (bfs.expansion_tables): semantically identical to
+    _closure_pass_keys for the read-value-match register family (fuzzed
+    in tests/test_lin_psort.py and the engine parity suites), but the
+    model step runs over M mutator columns instead of the full window,
+    and the candidate array is cap*(1+M) instead of cap*(1+W).
+    Carried-key saturation needs no step evaluation at all here: read
+    legality is a pure state-id match, so the per-row saturation table
+    (the rvm branch of _expand_keys) covers it.
+
+    Keys are KEY-space words: ``lo`` u32 (bits << b | state), plus
+    ``hi`` u32 for windows past 31-b bits (None otherwise — the
+    cockroach-class concurrency-30 band lives there). Returns
+    (lo, hi, count, changed, overflow)."""
+    (_el, _eh, _ef, _ev, _ea, _epl, _eph,
+     crash_lo, crash_hi, read_lo, read_hi, _ej, _ervl,
+     _ervh) = exp
+    pair = hi_in is not None
+    cand_lo, cand_hi, cand_valid = _expand_keys_compact(
+        lo_in, hi_in, count, act, v_row, pure_row, exp, cap=cap, W=W,
+        b=b, nil_id=nil_id, step_fn=step_fn)
+    if pair:
         if crash_dom:
             # Dominance dedups ALWAYS take the forced lax path (window
             # + chain scan + iterated prune-compact rounds); the chain

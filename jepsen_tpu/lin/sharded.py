@@ -25,6 +25,8 @@ with collectives inlined where the dedup needs them.
 
 from __future__ import annotations
 
+import os
+import time
 from functools import partial
 
 import jax
@@ -35,11 +37,17 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from jepsen_tpu import util
 from jepsen_tpu.lin import supervise
-from jepsen_tpu.lin.bfs import KEY_FILL, _expand_keys, _pad_rows
+from jepsen_tpu.lin.bfs import (KEY_FILL, _dedup_keys, _dedup_keys2,
+                                _dedup_keys2_dom, _dedup_keys_dom,
+                                _expand_keys, _expand_keys_compact,
+                                _pad_rows, expansion_tables)
 from jepsen_tpu.obs import metrics as obs_metrics
+from jepsen_tpu.obs import trace as obs_trace
 
-# The sparse sharded frontier keeps single-word bitsets (the all_gather
-# dedup keys stay u32); wider windows fall back to the single-chip engine.
+# The sparse sharded MULTIWORD frontier keeps single-word bitsets (its
+# all_gather dedup keys stay u32); past 32 the read-value-match register
+# band rides the pair-key (lo, hi) compact path to window+b <= 60, and
+# only shapes outside BOTH bands fall back to the single-chip engine.
 MAX_DEVICE_WINDOW = 32
 # Whole-history single-program bound for the MULTIWORD mesh path (no
 # chunking there). The packed-key mesh path chunks like bfs.check_packed
@@ -77,6 +85,83 @@ def _global_dedup_keys(keys, valid, cap_local, axis):
     mine = lax.dynamic_slice(packed, (d * cap_local,), (cap_local,))
     count_local = jnp.clip(total - d * cap_local, 0, cap_local)
     return mine, count_local, total, overflow
+
+
+def _global_dedup_keys_dom(lo, hi, valid, cap_local, axis, *, key_hi,
+                           crash_dom, masks, dom_iters=1,
+                           preprune=True):
+    """The compact band's collective dedup, both key widths: per-shard
+    pre-prune, ONE all_gather of the (lo[, hi]) key words, a GLOBAL
+    sort-dedup, and the deterministic balanced re-shard of
+    _global_dedup_keys.
+
+    With ``crash_dom`` both the local and the global passes run the
+    EXACT crashed-subset/read-bit dominance prune — always on the
+    FORCED-LAX path (bfs._dedup_keys_dom / _dedup_keys2_dom with
+    ``dom_force=True``), never the psort dom kernels: the round-5
+    stability rule holds on the mesh too, and inside shard_map the
+    pallas kernels are off the table anyway. ``masks`` is this row's
+    (crash_lo, crash_hi, read_lo, read_hi) key-space mask quadruple
+    (hi words ignored for single keys).
+
+    The global pass runs at cap = gathered length, so it NEVER
+    truncates: on the same candidate multiset it is bit-identical to
+    the single-chip dedup (the sort canonicalizes shard order), which
+    is what the mesh/single-chip prune-parity test pins down. The
+    per-shard pre-prune (``preprune``, the default) bounds the
+    collective bytes at 2*cap_local words per device instead of
+    cap_local*(1+M); it can only REMOVE dominated/duplicate
+    candidates the global pass would also remove, so the surviving
+    SET is unchanged — only its pre-gather layout. A shard whose
+    survivors exceed its 2*cap_local pre-prune bound reports
+    overflow (psum'd, so every device escalates together).
+
+    Returns (lo[cap_local], hi[cap_local] | None, count_local, total,
+    overflow) — total/overflow replicated."""
+    d = lax.axis_index(axis)
+    n_dev = util.axis_size(axis)
+    c_lo, c_hi, r_lo, r_hi = masks
+    ovf_pre = None
+    if crash_dom and preprune:
+        pcap = min(lo.shape[0], 2 * cap_local)
+        if key_hi:
+            hi, lo, pcnt, ovf_pre = _dedup_keys2_dom(
+                hi, lo, valid, pcap, c_hi, c_lo, r_hi, r_lo,
+                use_psort=False, dom_force=True, dom_iters=dom_iters)
+        else:
+            lo, pcnt, ovf_pre = _dedup_keys_dom(
+                lo, valid, pcap, c_lo, r_lo, use_psort=False,
+                dom_force=True, dom_iters=dom_iters)
+        valid = jnp.arange(pcap) < pcnt
+    lo_all = lax.all_gather(lo, axis, tiled=True)
+    val_all = lax.all_gather(valid, axis, tiled=True)
+    n = lo_all.shape[0]
+    if key_hi:
+        hi_all = lax.all_gather(hi, axis, tiled=True)
+        if crash_dom:
+            hi_p, lo_p, total, _ = _dedup_keys2_dom(
+                hi_all, lo_all, val_all, n, c_hi, c_lo, r_hi, r_lo,
+                use_psort=False, dom_force=True, dom_iters=dom_iters)
+        else:
+            hi_p, lo_p, total, _ = _dedup_keys2(hi_all, lo_all,
+                                                val_all, n)
+        mine_hi = lax.dynamic_slice(hi_p, (d * cap_local,),
+                                    (cap_local,))
+    else:
+        if crash_dom:
+            lo_p, total, _ = _dedup_keys_dom(
+                lo_all, val_all, n, c_lo, r_lo, use_psort=False,
+                dom_force=True, dom_iters=dom_iters)
+        else:
+            lo_p, total, _ = _dedup_keys(lo_all, val_all, n)
+        mine_hi = None
+    mine_lo = lax.dynamic_slice(lo_p, (d * cap_local,), (cap_local,))
+    overflow = total > cap_local * n_dev
+    if ovf_pre is not None:
+        overflow = overflow | \
+            (lax.psum(ovf_pre.astype(jnp.int32), axis) > 0)
+    count_local = jnp.clip(total - d * cap_local, 0, cap_local)
+    return mine_lo, mine_hi, count_local, total, overflow
 
 
 def _global_dedup(bits, state, valid, cap_local, axis):
@@ -148,9 +233,19 @@ def _search_sharded(ret_slot, active, slot_f, slot_v, pure, pred_mask,
             jax.vmap(step_fn, in_axes=(None, 0, 0)),
             in_axes=(0, None, None))
 
+        # Closure pass ceiling: the mesh closures are MONOTONE (no
+        # content-sensitive dominance prune on these two paths;
+        # candidates include the current frontier), so they terminate
+        # in O(W) passes and the ceiling cannot bind — it exists so a
+        # regression that breaks monotonicity becomes an honest
+        # overflow instead of the round-5 orbit (an in-program
+        # infinite loop the runtime watchdog kills, presenting as a
+        # kernel fault).
+        it_max = jnp.int32(4 * W + 16)
+
         def closure_cond(c):
-            _, _, _, _, changed, ovf = c
-            return changed & ~ovf
+            _, _, _, _, changed, ovf, it = c
+            return changed & ~ovf & (it < it_max)
 
         def row_body(carry):
             r, bits, state, count, total, dead, ovf = carry
@@ -162,7 +257,7 @@ def _search_sharded(ret_slot, active, slot_f, slot_v, pure, pred_mask,
             s = ret_slot[r]
 
             def closure_body(c):
-                bits_in, state, count, total, _, ovf = c
+                bits_in, state, count, total, _, ovf, it = c
                 cfg_valid = jnp.arange(cap_local) < count
                 ok, new_state = step_cfg_slot(state, f_row, v_row)
                 already = (bits_in[:, None] & slot_bit[None, :]) != 0
@@ -195,16 +290,15 @@ def _search_sharded(ret_slot, active, slot_f, slot_v, pure, pred_mask,
                 changed = jnp.any(b2 != bits_in) | jnp.any(s2 != state) | \
                     (tot2 != total)
                 changed = lax.psum(changed.astype(jnp.int32), axis) > 0
-                return (b2, s2, n2, tot2, changed, ovf | o2)
+                return (b2, s2, n2, tot2, changed, ovf | o2, it + 1)
 
-            init = (bits, state, count, total, jnp.bool_(True), ovf)
-            # lint: unbounded-ok — monotone closure fixpoint (no
-            # content-sensitive dominance prune on the mesh path;
-            # candidates include the current frontier) so it
-            # terminates in O(W) passes; an in-carry ceiling rides
-            # with the crash-dom mesh work (ROADMAP mesh item).
-            bits, state, count, total, _, ovf = lax.while_loop(
-                closure_cond, closure_body, init)
+            init = (bits, state, count, total, jnp.bool_(True), ovf,
+                    jnp.int32(0))
+            bits, state, count, total, changed, ovf = lax.while_loop(
+                closure_cond, closure_body, init)[:6]
+            # Ceiling exhaustion (still `changed` at exit) folds into
+            # overflow — an honest unknown, never a hang.
+            ovf = ovf | changed
 
             s_bit = jnp.uint32(1) << s.astype(jnp.uint32)
             cfg_valid = jnp.arange(cap_local) < count
@@ -261,10 +355,12 @@ def _search_sharded_keys(ret_slot, active, slot_f, slot_v, pure, pred_mask,
                    pred_mask, keys, counts):
         count = counts[0]
         total0 = lax.psum(count, axis)
+        # Same monotone-closure ceiling as the multiword body above.
+        it_max = jnp.int32(4 * W + 16)
 
         def closure_cond(c):
-            _, _, _, changed, ovf = c
-            return changed & ~ovf
+            _, _, _, changed, ovf, it = c
+            return changed & ~ovf & (it < it_max)
 
         def row_body(carry):
             r, keys, count, total, dead, ovf = carry
@@ -276,7 +372,7 @@ def _search_sharded_keys(ret_slot, active, slot_f, slot_v, pure, pred_mask,
             s = ret_slot[r]
 
             def closure_body(c):
-                keys_in, count, total, _, ovf = c
+                keys_in, count, total, _, ovf, it = c
                 cand, cand_valid = _expand_keys(
                     keys_in, count, act, f_row, v_row, pure_row,
                     pred_row, cap=cap_local, W=W, b=b, nil_id=nil_id,
@@ -285,13 +381,13 @@ def _search_sharded_keys(ret_slot, active, slot_f, slot_v, pure, pred_mask,
                     cand, cand_valid, cap_local, axis)
                 changed = jnp.any(k2 != keys_in) | (tot2 != total)
                 changed = lax.psum(changed.astype(jnp.int32), axis) > 0
-                return (k2, n2, tot2, changed, ovf | o2)
+                return (k2, n2, tot2, changed, ovf | o2, it + 1)
 
-            init = (keys, count, total, jnp.bool_(True), ovf)
-            # lint: unbounded-ok — monotone closure fixpoint (same
-            # termination argument as the multiword body above).
-            keys, count, total, _, ovf = lax.while_loop(
-                closure_cond, closure_body, init)
+            init = (keys, count, total, jnp.bool_(True), ovf,
+                    jnp.int32(0))
+            keys, count, total, changed, ovf = lax.while_loop(
+                closure_cond, closure_body, init)[:5]
+            ovf = ovf | changed
 
             s_key_bit = jnp.uint32(1) << (b + s).astype(jnp.uint32)
             cfg_valid = jnp.arange(cap_local) < count
@@ -325,7 +421,224 @@ def _search_sharded_keys(ret_slot, active, slot_f, slot_v, pure, pred_mask,
     return keys, counts, r[0], dead[0], ovf[0], total[0]
 
 
+@partial(jax.jit, static_argnames=("cap_local", "step_fn", "mesh",
+                                   "axis", "b", "nil_id", "key_hi",
+                                   "crash_dom", "it_max", "dom_iters",
+                                   "preprune"))
+def _search_sharded_sched(n_rows, dropback, min_left, ret_slot, active,
+                          slot_v, pure, exp, lo, hi, counts, *,
+                          cap_local, step_fn, mesh, b, nil_id, key_hi,
+                          crash_dom, it_max, dom_iters, preprune,
+                          axis="d"):
+    """The compact-band mesh scheduler: ONE SPMD program that walks
+    return rows with a COMMITTED-frontier carry — the sharded analogue
+    of bfs._host_sched_rows, covering both the single-u32 and the
+    pair-key (lo, hi) crash-dom bands.
+
+    Per row: the shared bfs._expand_keys_compact candidate generation
+    (saturation tables + M mutator columns + chain/JIT gates —
+    identical pass semantics to the single-chip engine by
+    construction), then the _global_dedup_keys_dom collective. The
+    closure fixpoint is UNGROUPED (G=1: every device evaluates its
+    whole shard each pass — the round-5 orbit needs expansion groups)
+    and carries an in-program iteration ceiling, so a non-converging
+    prune surfaces as an honest ``trip=budget`` instead of a
+    watchdog-killed hang.
+
+    A row that converges COMMITS (frontier arrays, per-device counts,
+    committed-row counter); a row that overflows or exhausts its
+    budget leaves the commit untouched, so the host re-enters at the
+    committed row with the committed frontier — escalation re-runs
+    ONE row, not the chunk. ``dropback``/``min_left`` mirror the
+    host-row scheduler: after ``min_left`` rows the program returns
+    early once the GLOBAL frontier fits ``dropback`` (the episode
+    hands narrow waves back to the cheap chunk caps).
+
+    Returns (lo', hi'|None, counts'[n_dev], peaks[n_dev],
+    flags[7]) — flags = [committed_rows, trip(0 none/1 capacity/
+    2 budget), dead, closure_passes, peak_total, committed_total,
+    attempted_rows]; committed arrays are the balanced re-shard of the
+    last committed frontier."""
+    C, W = active.shape
+
+    def shard_body(n_rows, dropback, min_left, ret_slot, active,
+                   slot_v, pure, *rest):
+        exp_t = rest[:14]
+        if key_hi:
+            lo, hi, counts = rest[14], rest[15], rest[16]
+        else:
+            lo, counts = rest[14], rest[15]
+            hi = None
+        cnt0 = counts[0]
+        tot0 = lax.psum(cnt0, axis)
+        zero = jnp.int32(0)
+
+        def row_body(carry):
+            (r, lo, hi, cnt, clo, chi, ccnt, crow, tot, ctot, peak,
+             pk_loc, it_tot, _trip, _dead) = carry
+            act_r = active[r]
+            v_row = slot_v[r]
+            pure_r = pure[r]
+            exp_r = tuple(t[r] for t in exp_t)
+            # (crash_lo, crash_hi, read_lo, read_hi) — this row's
+            # dominance masks (expansion_tables indices 7-10).
+            masks = (exp_r[7], exp_r[8], exp_r[9], exp_r[10])
+
+            def cl_cond(c):
+                _, _, _, _, changed, ovf, it = c
+                return changed & ~ovf & (it < it_max)
+
+            def cl_body(c):
+                lo_in, hi_in, n_in, t_in, _, ovf, it = c
+                cand_lo, cand_hi, cand_valid = _expand_keys_compact(
+                    lo_in, hi_in, n_in, act_r, v_row, pure_r, exp_r,
+                    cap=cap_local, W=W, b=b, nil_id=nil_id,
+                    step_fn=step_fn)
+                l2, h2, n2, t2, o2 = _global_dedup_keys_dom(
+                    cand_lo, cand_hi, cand_valid, cap_local, axis,
+                    key_hi=key_hi, crash_dom=crash_dom, masks=masks,
+                    dom_iters=dom_iters, preprune=preprune)
+                changed = jnp.any(l2 != lo_in) | (t2 != t_in)
+                if key_hi:
+                    changed = changed | jnp.any(h2 != hi_in)
+                changed = lax.psum(changed.astype(jnp.int32), axis) > 0
+                return (l2, h2, n2, t2, changed, ovf | o2, it + 1)
+
+            lo2, hi2, n2, tot2, changed, ovf, it = lax.while_loop(
+                cl_cond, cl_body,
+                (lo, hi, cnt, tot, jnp.bool_(True), jnp.bool_(False),
+                 zero))
+            # Ceiling exhaustion is a budget trip, not convergence.
+            budget_hit = changed & ~ovf
+
+            # Return filter (bfs._filter_pass_keys semantics): keep
+            # configs holding the returner's key bit, drop the bit
+            # (injective on survivors: the bit is constant-1 across
+            # them), compact + re-shard through the PLAIN collective.
+            s = ret_slot[r]
+            pos = (b + s).astype(jnp.uint32)
+            live = jnp.arange(cap_local) < n2
+            if key_hi:
+                in_lo = pos < jnp.uint32(32)
+                bit_lo = jnp.where(in_lo, jnp.uint32(1) << (pos & 31),
+                                   jnp.uint32(0))
+                bit_hi = jnp.where(in_lo, jnp.uint32(0),
+                                   jnp.uint32(1) << (pos & 31))
+                keep = live & \
+                    (((lo2 & bit_lo) | (hi2 & bit_hi)) != 0)
+                f_lo = jnp.where(keep, lo2 & ~bit_lo, KEY_FILL)
+                f_hi = jnp.where(keep, hi2 & ~bit_hi, KEY_FILL)
+            else:
+                bit_lo = jnp.uint32(1) << pos
+                keep = live & ((lo2 & bit_lo) != 0)
+                f_lo = jnp.where(keep, lo2 & ~bit_lo, KEY_FILL)
+                f_hi = None
+            lo3, hi3, n3, tot3, _ = _global_dedup_keys_dom(
+                f_lo, f_hi, keep, cap_local, axis, key_hi=key_hi,
+                crash_dom=False, masks=masks, preprune=False)
+
+            converged = ~ovf & ~budget_hit
+            dead = converged & (tot3 == 0)
+            commit = converged & ~dead
+            trip = jnp.where(converged, zero,
+                             jnp.where(ovf, jnp.int32(1),
+                                       jnp.int32(2)))
+            clo2 = jnp.where(commit, lo3, clo)
+            chi2 = jnp.where(commit, hi3, chi) if key_hi else None
+            ccnt2 = jnp.where(commit, n3, ccnt)
+            crow2 = jnp.where(commit, r + 1, crow)
+            ctot2 = jnp.where(commit, tot3, ctot)
+            return (r + 1, lo3, hi3, n3, clo2, chi2, ccnt2, crow2,
+                    tot3, ctot2, jnp.maximum(peak, tot2),
+                    jnp.maximum(pk_loc, jnp.maximum(n2, n3)),
+                    it_tot + it, trip, dead)
+
+        def row_cond(carry):
+            (r, _, _, _, _, _, _, _, _, ctot, _, _, _, trip,
+             dead) = carry
+            return (r < n_rows) & (trip == 0) & ~dead & \
+                ((r < min_left) | (ctot > dropback))
+
+        init = (zero, lo, hi, cnt0, lo, hi, cnt0, zero, tot0, tot0,
+                tot0, cnt0, zero, zero, jnp.bool_(False))
+        (r, _, _, _, clo, chi, ccnt, crow, _, ctot, peak, pk_loc,
+         it_tot, trip, dead) = lax.while_loop(row_cond, row_body, init)
+        flags = jnp.stack([crow, trip, dead.astype(jnp.int32), it_tot,
+                           peak, ctot, r])
+        outs = (clo,) + ((chi,) if key_hi else ()) + \
+            (ccnt[None], pk_loc[None], flags[None, :])
+        return outs
+
+    n_rep = 7 + 14
+    args = [n_rows, dropback, min_left, ret_slot, active, slot_v,
+            pure, *exp]
+    spec_in = (P(),) * n_rep
+    if key_hi:
+        args += [lo, hi, counts]
+        spec_in += (P(axis), P(axis), P(axis))
+        spec_out = (P(axis),) * 5
+    else:
+        args += [lo, counts]
+        spec_in += (P(axis), P(axis))
+        spec_out = (P(axis),) * 4
+    fn = util.get_shard_map()(shard_body, mesh=mesh, in_specs=spec_in,
+                              out_specs=spec_out, check_vma=False)
+    out = fn(*args)
+    if key_hi:
+        clo, chi, ccnt, pk, flags = out
+    else:
+        clo, ccnt, pk, flags = out
+        chi = None
+    return clo, chi, ccnt, pk, flags[0]
+
+
 DEFAULT_CAP_PER_DEVICE = (64, 1024, 16384)
+
+# Episode cap ladder for the compact band: when a row overflows the
+# top CHUNK cap the host re-enters THAT row at these per-device caps
+# (the mesh twin of the host-row executor's cap ladder) — the 8-device
+# global capacity at the top rung matches the single-chip max-cap the
+# config-5 history needs (8 * 262144 = 2M > 524288 with margin for
+# shard imbalance transients).
+MESH_CAPS_DEFAULT = (16384, 65536, 262144)
+
+
+def _mesh_caps():
+    raw = os.environ.get("JEPSEN_TPU_MESH_CAPS", "")
+    if raw:
+        try:
+            caps = tuple(int(x) for x in raw.split(",") if x.strip())
+        except ValueError:
+            caps = ()
+        if caps:
+            return caps
+    return MESH_CAPS_DEFAULT
+
+
+def _mesh_queue():
+    return max(1, util.env_int("JEPSEN_TPU_MESH_QUEUE", 8))
+
+
+def _mesh_it_max(W):
+    v = util.env_int("JEPSEN_TPU_MESH_IT_MAX", 0)
+    return v if v > 0 else 4 * W + 16
+
+
+def _mesh_preprune():
+    return bool(util.env_int("JEPSEN_TPU_MESH_PREPRUNE", 1))
+
+
+def _mesh_stats_none(n_dev, **extra):
+    """The no-dispatch mesh-stats shape: EVERY verdict this module
+    returns carries a ``mesh-stats`` dict with at least these keys, so
+    bench/driver artifacts never branch on its presence (routing
+    errors and empty histories included)."""
+    out = {"devices": int(n_dev), "chunks": 0, "escalations": 0,
+           "episodes": 0, "dispatches": 0, "sched-rows": 0,
+           "dispatch-wall-s": 0.0, "peak-frontier": 0,
+           "cap-per-device": 0}
+    out.update(extra)
+    return out
 
 
 def check_packed(p: PackedHistory, mesh: Mesh | None = None,
@@ -353,25 +666,11 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
             return sharded_dense.check_packed(p, mesh=mesh, cancel=cancel,
                                               explain=explain)
 
+    n_dev = int(np.prod(mesh.devices.shape))
     if p.kernel is None:
         return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
+                "mesh-stats": _mesh_stats_none(n_dev),
                 "error": f"no device kernel for {type(p.model).__name__}"}
-    if p.window > MAX_DEVICE_WINDOW:
-        # Explicit routing error, not a silent ceiling: the sparse
-        # mesh frontier keeps single-word u32 dedup keys, so windows
-        # past 32 have no multi-chip path yet (the crash-dom mesh gap
-        # is a ROADMAP open item). The single-chip engine DOES cover
-        # this band — lin.device_check_packed routes windows up to 64
-        # through the pair-key crash-dom band + host-row executor.
-        return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
-                "error": (f"concurrency window {p.window} exceeds the "
-                          f"sharded engine's single-word key limit "
-                          f"{MAX_DEVICE_WINDOW}; re-check on the "
-                          "single-chip engine (lin.device_check_packed"
-                          ": pair-key crash-dom band, windows to 64) — "
-                          "no crash-dom mesh path exists yet")}
-    if p.R == 0:
-        return {"valid?": True, "analyzer": "tpu-bfs-sharded"}
 
     axis = mesh.axis_names[0]
 
@@ -380,22 +679,66 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
                                            READ_VALUE_MATCH_KERNELS,
                                            packed_state_bound)
 
-    # Packed-u32 keys when the window plus state id fit 31 bits: the
-    # collective dedup then all_gathers ONE u32 array instead of bits +
-    # state columns — far fewer ICI bytes per dedup. The packed path
-    # chunks (static 512-row table slices), so it needs neither the
-    # R-bucketing identity rows nor the pad slot of _pad_rows and runs
-    # exactly p.R rows on the raw tables.
+    # Packed-u32 keys when the window plus state id fit 31 bits; past
+    # that the read-value-match register band (b <= 6) packs the
+    # 64-bit config as a PAIR of u32 words to window+b <= 60 — the
+    # bfs.check_packed gate, mirrored exactly so the mesh and the
+    # single-chip engine route the same shapes to the same key
+    # widths. The packed path chunks (static 512-row table slices),
+    # so it needs neither the R-bucketing identity rows nor the pad
+    # slot of _pad_rows and runs exactly p.R rows on the raw tables.
+    read_value_match = p.kernel.name in READ_VALUE_MATCH_KERNELS
     state_bits = nil_id = None
+    key_hi = False
     if p.init_state.shape[0] == 1 \
             and p.kernel.name in PACKED_STATE_KERNELS:
         nid = packed_state_bound(p.kernel, len(p.unintern))
         bb = nid.bit_length()
         if p.window + bb <= 31:
             state_bits, nil_id = bb, nid
-    dedup_kind = "packed-keys" if state_bits is not None else "multiword"
+        elif read_value_match and bb <= 6 and p.window + bb <= 60:
+            state_bits, nil_id, key_hi = bb, nid, True
+
+    if p.window > MAX_DEVICE_WINDOW and not key_hi:
+        # Explicit routing error, not a silent ceiling: the MULTIWORD
+        # mesh frontier keeps single-word u32 dedup keys, and this
+        # shape is outside the pair-key compact band too (not a
+        # read-value-match register family, or window+b > 60). The
+        # single-chip engine covers it — lin.device_check_packed
+        # routes wide multiword windows through the sparse engine.
+        return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
+                "mesh-stats": _mesh_stats_none(n_dev),
+                "error": (f"concurrency window {p.window} exceeds the "
+                          f"sharded engine's single-word key limit "
+                          f"{MAX_DEVICE_WINDOW} and the shape is "
+                          "outside the pair-key compact band "
+                          "(read-value-match registers, window+b <= "
+                          "60); re-check on the single-chip engine "
+                          "(lin.device_check_packed)")}
+    if p.R == 0:
+        return {"valid?": True, "analyzer": "tpu-bfs-sharded",
+                "mesh-stats": _mesh_stats_none(n_dev)}
 
     if state_bits is not None:
+        # Mutator-compacted expansion columns (the crash-dom band's
+        # program shape): same engagement rule as bfs.check_packed —
+        # read-value-match registers with b <= 6.
+        exp_h = None
+        crash_dom = False
+        if read_value_match and state_bits <= 6:
+            exp_h = expansion_tables(p, state_bits, lazy=True)
+            crash_dom = bool(np.asarray(p.crashed).any())
+        if exp_h is not None:
+            # nw sized to the window (pair band reaches past 32); only
+            # the pure table is consumed — the compact program's chain
+            # masks live in the expansion tables.
+            pure_k, _ = reduction_bit_tables(p, (p.window + 31) // 32)
+            tables_h = (np.asarray(p.ret_slot), np.asarray(p.active),
+                        np.asarray(p.slot_v), pure_k)
+            return _run_compact_chunks(
+                p, mesh, axis, tables_h, exp_h, cap_schedule,
+                b=state_bits, nil_id=nil_id, key_hi=key_hi,
+                crash_dom=crash_dom, cancel=cancel, explain=explain)
         pure_k, pred_bit_k = reduction_bit_tables(p, 1)
         tables_h = (np.asarray(p.ret_slot), np.asarray(p.active),
                     np.asarray(p.slot_f), np.asarray(p.slot_v),
@@ -403,7 +746,7 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
         return _run_packed_chunks(
             p, mesh, axis, tables_h, cap_schedule,
             b=state_bits, nil_id=nil_id,
-            read_value_match=p.kernel.name in READ_VALUE_MATCH_KERNELS,
+            read_value_match=read_value_match,
             cancel=cancel, explain=explain)
 
     ret_slot_h, active_h, slot_f_h, slot_v_h = _pad_rows(p)
@@ -422,29 +765,39 @@ def check_packed(p: PackedHistory, mesh: Mesh | None = None,
     # chunking); past this bound a single dispatch risks watchdog kills.
     if p.R > MAX_SHARDED_ROWS:
         return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
+                "mesh-stats": _mesh_stats_none(n_dev),
                 "error": f"history length {p.R} exceeds the unchunked "
                          f"multiword mesh bound {MAX_SHARDED_ROWS}; "
                          f"use the single-chip engine"}
+    dispatches = 0
     for cap in cap_schedule:
         if cancel is not None and cancel.is_set():
             return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
+                    "mesh-stats": _mesh_stats_none(
+                        n_dev, dispatches=dispatches),
                     "error": "cancelled"}
         ok, dead_row, overflow, total = _search_sharded(
             *args, cap_local=cap, step_fn=p.kernel.step, mesh=mesh,
             axis=axis)
+        dispatches += 1
         if not bool(overflow):
             break
+    ms = _mesh_stats_none(n_dev, chunks=1, dispatches=dispatches,
+                          escalations=dispatches - 1)
+    ms["cap-per-device"] = int(cap)
     if bool(overflow):
         return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
-                "overflow": "capacity",
+                "overflow": "capacity", "mesh-stats": ms,
                 "error": f"frontier exceeded {cap_schedule[-1]} per device"}
+    ms["peak-frontier"] = int(total)
     if bool(ok):
         return {"valid?": True, "analyzer": "tpu-bfs-sharded",
-                "dedup": dedup_kind, "final-frontier-size": int(total)}
+                "dedup": "multiword", "mesh-stats": ms,
+                "final-frontier-size": int(total)}
     r = int(dead_row)
     ret = p.ops[int(p.ret_op[r])]
     out = {"valid?": False, "analyzer": "tpu-bfs-sharded",
-           "dedup": dedup_kind,
+           "dedup": "multiword", "mesh-stats": ms,
            "op": {"process": ret.process, "f": ret.f, "value": ret.value,
                   "index": ret.op_index, "ok": ret.ok},
            "configs": [], "final-paths": []}
@@ -512,12 +865,19 @@ def _run_packed_chunks(p, mesh, axis, tables_h, cap_schedule, *, b,
     obs_metrics.REGISTRY.start_run("lin-sharded", total=int(p.R),
                                    window=int(p.window))
 
+    n_dispatches = 0
+    wall = [0.0]
+
     def mesh_stats():
         # Observability twin of the single-chip engine's host-stats:
         # attached to EVERY verdict shape (success, death, overflow)
         # so bench/driver artifacts can read the dispatch and
-        # escalation profile without re-running.
-        out = {"chunks": n_chunks, "escalations": n_escalations,
+        # escalation profile without re-running. Key set is uniform
+        # with the compact band's (see _mesh_stats_none).
+        out = {"devices": n_dev, "chunks": n_chunks,
+               "escalations": n_escalations, "episodes": 0,
+               "dispatches": n_dispatches, "sched-rows": 0,
+               "dispatch-wall-s": round(wall[0], 3),
                "peak-frontier": peak_total,
                "cap-per-device": cap_schedule[level]}
         if sup_stats["watchdog_trips"] or sup_stats["faults"]:
@@ -527,7 +887,7 @@ def _run_packed_chunks(p, mesh, axis, tables_h, cap_schedule, *, b,
     while base < p.R:
         if cancel is not None and cancel.is_set():
             return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
-                    "error": "cancelled"}
+                    "mesh-stats": mesh_stats(), "error": "cancelled"}
         if snapshots is not None:
             # Only the last snapshot is replayed (the dead row is inside
             # the current chunk).
@@ -554,9 +914,12 @@ def _run_packed_chunks(p, mesh, axis, tables_h, cap_schedule, *, b,
                 "mesh-chunk", rows=SHARDED_CHUNK,
                 cap=cap_schedule[level], window=p.window,
                 kernel=p.kernel.name)
+            t0 = time.monotonic()
             outcome, val = supervise.run_guarded(
                 "mesh-chunk", mesh_key, _mesh_chunk, stats=sup_stats,
                 traceable=_mesh_chunk_prog)
+            wall[0] += time.monotonic() - t0
+            n_dispatches += 1
             if outcome == "wedge":
                 return {"valid?": "unknown",
                         "analyzer": "tpu-bfs-sharded",
@@ -632,6 +995,320 @@ def _run_packed_chunks(p, mesh, axis, tables_h, cap_schedule, *, b,
             # top-level chunks/peak/cap keys predate mesh-stats and
             # are kept for consumers (__graft_entry__ asserts them);
             # both spellings read the SAME mesh_stats() values.
+            "chunks": ms["chunks"], "peak-frontier": ms["peak-frontier"],
+            "cap-per-device": ms["cap-per-device"], "mesh-stats": ms,
+            "shard-occupancy": [int(x) for x in np.asarray(counts)]}
+
+
+def _run_compact_chunks(p, mesh, axis, tables_h, exp_h, cap_schedule,
+                        *, b, nil_id, key_hi, crash_dom, cancel=None,
+                        explain=False):
+    """Host scheduler for the COMPACT mesh band (both key widths,
+    crash-dom included): SHARDED_CHUNK-row dispatches of
+    _search_sharded_sched with a committed-frontier carry, per-ROW
+    capacity escalation (the program returns committed progress on a
+    trip, so escalation re-enters at the tripped row, never re-runs
+    the chunk), and — past the top chunk cap — EPISODES: the mesh
+    analogue of the single-chip host-row executor. An episode
+    re-shards the frontier across the JEPSEN_TPU_MESH_CAPS ladder,
+    walks rows in JEPSEN_TPU_MESH_QUEUE-row dispatches with deeper
+    dominance iterations (dom_iters=6, the host-row setting), and
+    drops back to the cheap chunk caps once the global frontier
+    narrows below a quarter of the top chunk capacity. A row that
+    exhausts the top mesh cap (or its closure budget there) returns
+    an honest ``overflow: capacity`` / ``overflow: budget`` unknown.
+
+    Frontier state between dispatches is the globally-packed key
+    array (+ per-device counts); _reshard's host repack preserves the
+    balanced prefix-fill invariant, and supervise checkpoints
+    (kind "mesh") make a killed long decide resumable at the last
+    committed row."""
+    from jepsen_tpu.lin import witness
+    from jepsen_tpu.lin.bfs import (_chunk_slice, _unpack_frontier_keys,
+                                    _unpack_frontier_keys2)
+    from jepsen_tpu.models.kernels import NIL
+
+    n_dev = int(np.prod(mesh.devices.shape))
+    step_fn = p.kernel.step
+    W = int(p.active.shape[1])
+    nw = (p.window + 31) // 32
+    it_max = _mesh_it_max(W)
+    preprune = _mesh_preprune()
+    mesh_caps = _mesh_caps()
+    queue_rows = _mesh_queue()
+    kernel_name = p.kernel.name
+    band = "pair" if key_hi else "single"
+
+    sv0 = int(p.init_state[0])
+    init_sid = np.uint32(nil_id if sv0 == int(NIL) else sv0)
+
+    def _reshard(lo_a, hi_a, total, new_cap):
+        """Host repack at a new per-device cap. The carried global
+        array is front-packed (the collective dedup sorts survivors
+        to the global front), so the repack is one prefix copy; the
+        per-device counts become the balanced prefix-fill."""
+        ln = np.full(n_dev * new_cap, KEY_FILL, np.uint32)
+        ln[:total] = np.asarray(lo_a)[:total]
+        hn = None
+        if key_hi:
+            hn = np.full(n_dev * new_cap, KEY_FILL, np.uint32)
+            hn[:total] = np.asarray(hi_a)[:total]
+        cnts = np.clip(total - np.arange(n_dev) * new_cap, 0,
+                       new_cap).astype(np.int32)
+        return (jnp.asarray(ln),
+                jnp.asarray(hn) if key_hi else None,
+                jnp.asarray(cnts))
+
+    level = 0
+    mlvl = 0
+    episode_mode = False
+    cap_now = cap_schedule[level]
+    base = 0
+    total = 1
+    lo_h = np.full(n_dev * cap_now, KEY_FILL, np.uint32)
+    lo_h[0] = init_sid
+    lo = jnp.asarray(lo_h)
+    hi = None
+    if key_hi:
+        hi_h = np.full(n_dev * cap_now, KEY_FILL, np.uint32)
+        hi_h[0] = np.uint32(0)
+        hi = jnp.asarray(hi_h)
+    counts = jnp.zeros(n_dev, jnp.int32).at[0].set(1)
+
+    # --- checkpoint/resume (supervise module docstring) -------------
+    ck = None
+    ck_path = supervise.ckpt_path()
+    if ck_path:
+        ck = supervise.Checkpointer(
+            ck_path, supervise.history_fingerprint(p))
+        rd = supervise.load_checkpoint(ck_path, ck.fingerprint)
+        if rd is not None and rd["kind"] == "mesh" \
+                and rd["meta"].get("b") == b \
+                and rd["meta"].get("key_hi") == key_hi:
+            base = rd["row"]
+            total = rd["count"]
+            if total <= n_dev * cap_schedule[-1]:
+                level = next(i for i, c in enumerate(cap_schedule)
+                             if total <= n_dev * c)
+                cap_now = cap_schedule[level]
+            else:
+                episode_mode = True
+                level = len(cap_schedule) - 1
+                mlvl = next((i for i, c in enumerate(mesh_caps)
+                             if total <= n_dev * c),
+                            len(mesh_caps) - 1)
+                cap_now = mesh_caps[mlvl]
+            lo, hi, counts = _reshard(rd["lo"][:total],
+                                      rd.get("hi"), total, cap_now)
+
+    n_chunks = 0
+    n_escalations = 0
+    n_episodes = 0
+    n_dispatches = 0
+    sched_rows = 0
+    peak_total = int(total)
+    wall = [0.0]
+    pk_dev = np.zeros(n_dev, np.int64)
+    sup_stats: dict = {"watchdog_trips": 0, "faults": 0}
+    _mesh_view = obs_metrics.REGISTRY.view("mesh-stats", {})
+    obs_metrics.REGISTRY.start_run("lin-sharded", total=int(p.R),
+                                   window=int(p.window))
+
+    def mesh_stats():
+        # The uniform verdict-attached stats shape (_mesh_stats_none
+        # keys) plus the compact band's per-device counters: every
+        # device's peak shard occupancy across all dispatches, the
+        # episode/scheduler row profile, and the accumulated guarded
+        # dispatch wall — the evidence bench.py's mesh probe and the
+        # perf ledger read.
+        out = {"devices": n_dev, "band": band, "crash-dom": crash_dom,
+               "chunks": n_chunks, "escalations": n_escalations,
+               "episodes": n_episodes, "sched-rows": sched_rows,
+               "dispatches": n_dispatches,
+               "dispatch-wall-s": round(wall[0], 3),
+               "peak-frontier": peak_total, "cap-per-device": cap_now,
+               "peak-occupancy": [int(x) for x in pk_dev]}
+        if sup_stats["watchdog_trips"] or sup_stats["faults"]:
+            out.update(sup_stats)
+        return out
+
+    def _unknown(kind, err):
+        return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
+                "overflow": kind, "mesh-stats": mesh_stats(),
+                "error": err}
+
+    while base < p.R:
+        if cancel is not None and cancel.is_set():
+            return {"valid?": "unknown", "analyzer": "tpu-bfs-sharded",
+                    "mesh-stats": mesh_stats(), "error": "cancelled"}
+        if episode_mode:
+            C = queue_rows
+            cap_now = mesh_caps[mlvl]
+            dropback = n_dev * cap_schedule[-1] // 4
+            min_left = 1
+            d_iters = 6
+            band_key = f"{band}-sched"
+        else:
+            C = SHARDED_CHUNK
+            cap_now = cap_schedule[level]
+            dropback = 0
+            min_left = C
+            d_iters = 2
+            band_key = band
+        n = min(C, p.R - base)
+        tbl = tuple(jnp.asarray(_chunk_slice(a, base, C))
+                    for a in tables_h)
+        exp_j = tuple(jnp.asarray(_chunk_slice(np.asarray(a), base, C))
+                      for a in exp_h)
+        util.progress_tick()   # liveness: one tick per dispatch
+
+        def _mesh_sched_prog(lo=lo, hi=hi, counts=counts, n=n,
+                             tbl=tbl, exp_j=exp_j, cap_now=cap_now,
+                             dropback=dropback, min_left=min_left,
+                             d_iters=d_iters):
+            return _search_sharded_sched(
+                jnp.int32(n), jnp.int32(dropback), jnp.int32(min_left),
+                *tbl, exp_j, lo, hi, counts, cap_local=cap_now,
+                step_fn=step_fn, mesh=mesh, b=b, nil_id=nil_id,
+                key_hi=key_hi, crash_dom=crash_dom, it_max=it_max,
+                dom_iters=d_iters, preprune=preprune, axis=axis)
+
+        def _mesh_sched():
+            out = _mesh_sched_prog()
+            return out, np.asarray(out[4])   # flags fetch = sync
+
+        mesh_key = supervise.shape_key(
+            "mesh-chunk", rows=C, cap=cap_now, window=p.window,
+            kernel=kernel_name, band=band_key)
+        t0 = time.monotonic()
+        outcome, val = supervise.run_guarded(
+            "mesh-chunk", mesh_key, _mesh_sched, stats=sup_stats,
+            traceable=_mesh_sched_prog)
+        wall[0] += time.monotonic() - t0
+        n_dispatches += 1
+        if outcome == "wedge":
+            return _unknown("wedge", str(val))
+        if outcome == "fault":
+            return _unknown("fault",
+                            f"dispatch fault near row {base}: {val!r}")
+        (clo, chi, ccnt, pk, _), flags = val
+        crow, trip, dead_f, it_tot, peak_d, ctot, attempted = \
+            (int(x) for x in flags)
+        # Commit the program's progress (trip or not — the committed
+        # carry is the last CONVERGED row's frontier).
+        base += crow
+        lo, hi, counts = clo, chi, ccnt
+        total = ctot
+        peak_total = max(peak_total, peak_d)
+        pk_dev = np.maximum(pk_dev, np.asarray(pk))
+        if episode_mode:
+            sched_rows += crow
+        obs_trace.tail_note(row=base, rows=crow, passes=it_tot,
+                            frontier=total, cap=cap_now)
+        _mesh_view.clear()
+        _mesh_view.update(mesh_stats())
+        obs_metrics.REGISTRY.progress(row=base, frontier=total)
+        if ck is not None and crow > 0 and ck.due():
+            arrays = {"lo": np.asarray(lo)}
+            if key_hi:
+                arrays["hi"] = np.asarray(hi)
+            ck.save("mesh", base, total, arrays,
+                    {"b": b, "key_hi": key_hi})
+        if dead_f:
+            # The dead row is the first uncommitted one; the carried
+            # frontier is exactly its ENTRY, so the counterexample
+            # replay spans ONE row.
+            r = base
+            ret = p.ops[int(p.ret_op[r])]
+            out = {"valid?": False, "analyzer": "tpu-bfs-sharded",
+                   "dedup": "packed-keys2" if key_hi else "packed-keys",
+                   "mesh-stats": mesh_stats(),
+                   "op": {"process": ret.process, "f": ret.f,
+                          "value": ret.value, "index": ret.op_index,
+                          "ok": ret.ok},
+                   "configs": [], "final-paths": []}
+            if explain:
+                tot = int(total)
+                cap_g = n_dev * cap_now
+                if key_hi:
+                    kb, ks = _unpack_frontier_keys2(
+                        jnp.asarray(np.asarray(lo)),
+                        jnp.asarray(np.asarray(hi)), tot, cap_g, b,
+                        nil_id, nw)
+                else:
+                    kb, ks = _unpack_frontier_keys(
+                        jnp.asarray(np.asarray(lo)), tot, cap_g, b,
+                        nil_id)
+                out.update(witness.tail_replay_sparse(
+                    p, [(r, kb, ks, tot)], r, cancel=cancel))
+            if ck is not None:
+                ck.clear()
+            return out
+        if trip:
+            if not episode_mode:
+                if level + 1 < len(cap_schedule):
+                    level += 1
+                    n_escalations += 1
+                    lo, hi, counts = _reshard(lo, hi, total,
+                                              cap_schedule[level])
+                    continue
+                episode_mode = True
+                n_episodes += 1
+                mlvl = next((i for i, c in enumerate(mesh_caps)
+                             if c > cap_now and total <= n_dev * c),
+                            len(mesh_caps) - 1)
+            else:
+                if mlvl + 1 >= len(mesh_caps):
+                    if trip == 1:
+                        return _unknown(
+                            "capacity",
+                            f"row {base} frontier exceeded the top "
+                            f"mesh cap {mesh_caps[-1]} per device "
+                            f"({n_dev} devices)")
+                    return _unknown(
+                        "budget",
+                        f"row {base} closure passed {it_max} "
+                        f"iterations without converging at the top "
+                        f"mesh cap (suspected non-terminating prune "
+                        f"orbit; see round-5 lore)")
+                mlvl += 1
+                n_escalations += 1
+            if total > n_dev * mesh_caps[mlvl]:
+                return _unknown(
+                    "capacity",
+                    f"row {base} frontier {total} exceeds mesh cap "
+                    f"{mesh_caps[mlvl]} x {n_dev} devices")
+            lo, hi, counts = _reshard(lo, hi, total, mesh_caps[mlvl])
+            obs_trace.instant("mesh-episode", row=base, total=total,
+                              cap=mesh_caps[mlvl])
+            continue
+        # Clean return: a finished chunk, or an episode that ran out
+        # of rows / narrowed below the dropback threshold.
+        if episode_mode:
+            if base >= p.R:
+                break
+            if total <= dropback:
+                episode_mode = False
+                level = len(cap_schedule) - 1
+                lo, hi, counts = _reshard(lo, hi, total,
+                                          cap_schedule[level])
+            continue
+        n_chunks += 1
+        # Shrink back to a smaller (faster) chunk program when the
+        # global frontier has room to spare (generic-loop precedent).
+        while level > 0 and total * 4 <= cap_schedule[level - 1]:
+            level -= 1
+            lo, hi, counts = _reshard(lo, hi, total,
+                                      cap_schedule[level])
+    cap_now = mesh_caps[mlvl] if episode_mode else cap_schedule[level]
+    if ck is not None:
+        ck.clear()
+    ms = mesh_stats()
+    return {"valid?": True, "analyzer": "tpu-bfs-sharded",
+            "dedup": "packed-keys2" if key_hi else "packed-keys",
+            "final-frontier-size": int(total),
+            # Same top-level compatibility keys as the generic loop
+            # (__graft_entry__ asserts them on mesh verdicts).
             "chunks": ms["chunks"], "peak-frontier": ms["peak-frontier"],
             "cap-per-device": ms["cap-per-device"], "mesh-stats": ms,
             "shard-occupancy": [int(x) for x in np.asarray(counts)]}

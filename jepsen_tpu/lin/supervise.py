@@ -396,11 +396,16 @@ def ledger_path() -> str | None:
 
 
 def shape_key(site: str, *, cap: int, window: int, kernel: str,
-              rows: int = 1) -> str:
+              rows: int = 1, band: str = "") -> str:
     """The traced-program-shape key: what the runtime objects to is
     (program family) x (rows x cap complexity) x (window/kernel
-    bucket) — the round 2-5 fault-lore coordinates."""
-    return f"{site}|rows{rows}|cap{cap}|w{window}|{kernel}"
+    bucket) — the round 2-5 fault-lore coordinates. ``band`` tags
+    program VARIANTS that share a site but compile different programs
+    (the mesh engine's single-key vs pair-key vs episode-scheduler
+    dispatches under ``mesh-chunk``): a faulting variant must not
+    quarantine its healthy siblings."""
+    base = f"{site}|rows{rows}|cap{cap}|w{window}|{kernel}"
+    return f"{base}|{band}" if band else base
 
 
 _ledger_cache: tuple[str, float, dict] | None = None

@@ -51,13 +51,19 @@ def test_big_frontier_spans_devices():
     assert got["valid?"] == want
 
 
-def test_overflow_escalates_per_device():
+def test_overflow_escalates_per_device(monkeypatch):
+    # Pin the episode cap ladder down to 1 as well: the compact band
+    # otherwise rescues an exhausted chunk cap_schedule by re-sharding
+    # at the JEPSEN_TPU_MESH_CAPS episode rungs and deciding anyway.
+    monkeypatch.setenv("JEPSEN_TPU_MESH_CAPS", "1")
     h = synth.generate_register_history(40, concurrency=6, seed=9,
                                         crash_prob=0.5, max_crashes=5)
     p = prepare.prepare(m.cas_register(), h)
     r = sharded.check_packed(p, mesh=mesh(2), cap_schedule=(1,),
                              engine="sparse")
     assert r["valid?"] == "unknown"
+    assert r["overflow"] == "capacity"
+    assert r["mesh-stats"]["episodes"] >= 1
 
 
 def test_mutex_sharded():
