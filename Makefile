@@ -2,7 +2,7 @@
 
 .PHONY: test test-quick integration integration-local bench \
 	probe-config5 serve-smoke txn-smoke trace-smoke stream-smoke \
-	fleet-smoke perf-smoke pack-smoke lint
+	fleet-smoke perf-smoke pack-smoke mesh-smoke lint
 
 # Unit + parity suite on the virtual 8-device CPU mesh (no cluster).
 # Hardware note: ~8 min on a 4-core box; the compile-heavy lin parity
@@ -156,6 +156,19 @@ PACK_SMOKE_TIMEOUT ?= 600
 pack-smoke:
 	timeout -k 15 $(PACK_SMOKE_TIMEOUT) \
 		python -m jepsen_tpu.lin.pack_smoke
+
+# Crash-dom mesh smoke (ISSUE 18, doc/sharding.md): chip-free proof on
+# the forced 8-device virtual CPU mesh that the sharded compact band
+# decides a crash-dom history with oracle parity (valid + corrupted
+# twin, same violating op, per-device mesh-stats on both verdicts) and
+# that a JEPSEN_TPU_WEDGE=mesh-chunk injected run returns an honest
+# `overflow: wedge` unknown. Appends its own perf-ledger record (mesh
+# sub-dict). Run it after touching lin/sharded.py, the collective
+# dedup, supervise's mesh-chunk site, or the JEPSEN_TPU_MESH_* knobs.
+MESH_SMOKE_TIMEOUT ?= 600
+mesh-smoke:
+	timeout -k 15 $(MESH_SMOKE_TIMEOUT) \
+		python -m jepsen_tpu.lin.mesh_smoke
 
 PROBE_CONFIG5_TIMEOUT ?= 5400
 # Frontier checkpoint: a probe killed by the timeout (or a fault)

@@ -81,7 +81,8 @@ PROBE_ORDER = (("pack", 300), ("mutex_c30", 600),
                ("wide_window_c30", 600),
                ("independent_keys", 900), ("service_c30", 900),
                ("txn_c30", 900), ("stream_c30", 900),
-               ("fused_pair", 900), ("partitioned_c30", 5300))
+               ("fused_pair", 900), ("mesh_c30", 900),
+               ("partitioned_c30", 5300))
 WORKER_RESTART_S = 75
 # Overall bench wall budget the partitioned probe must fit inside
 # (env-overridable for driver environments with different budgets).
@@ -685,6 +686,89 @@ def _probe_txn_c30():
     return out
 
 
+def _probe_mesh_c30():
+    """Crash-dom MESH rung (ISSUE 18): the sharded compact band
+    (lin/sharded.py, doc/sharding.md) driven over every visible
+    device, fault-ISOLATED in its own subprocess and ordered before
+    the partitioned ladder so a mesh fault can never cost the
+    single-chip config-5 number. Mesh env knobs are FORCED per leg so
+    the rung measures the documented defaults, not whatever the
+    driver environment happens to export. Legs, proven-small-first
+    per the fault lore: (0) the window-34 pair-band config-5 witness
+    (140 ops) — the scaled shape the crash-dom tests pin, seconds-
+    scale, any fault dies here; (1) the timed 5k partitioned shape
+    (window 25, single-key crash-dom band). Both legs attach the
+    per-device mesh-stats (dispatches, dispatch wall, peak shard
+    occupancy) that _probe_main forwards into the bench artifact and
+    the perf-ledger record — the before/after evidence for the
+    config-5 3217 s -> <600 s mesh target reads from here."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu.lin import prepare, sharded, synth
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), ("d",))
+    out = {"devices": len(devs),
+           "platform": devs[0].platform}
+    forced = {"JEPSEN_TPU_MESH_CAPS": "16384,65536,262144",
+              "JEPSEN_TPU_MESH_PREPRUNE": "1",
+              "JEPSEN_TPU_MESH_IT_MAX": "0"}
+    saved = {k: os.environ.get(k) for k in forced}
+    os.environ.update(forced)
+    try:
+        # Leg 0: small-input witness smoke (CLAUDE.md fault lore —
+        # probe new mesh shapes on SMALL inputs first).
+        hs = synth.generate_partitioned_register_history(
+            140, concurrency=40, seed=0, partition_every=60,
+            partition_len=20, max_crashes=10)
+        ps = prepare.prepare(m.cas_register(), hs)
+        t0 = time.time()
+        r = sharded.check_packed(ps, mesh=mesh,
+                                 cap_schedule=(64, 512),
+                                 engine="sparse")
+        out["smoke"] = {"events": len(hs), "window": ps.window,
+                        "verdict": r.get("valid?"),
+                        "seconds": round(time.time() - t0, 1),
+                        "mesh_stats": r.get("mesh-stats")}
+        if r.get("valid?") is not True:
+            out["error"] = \
+                f"mesh witness smoke verdict {r.get('valid?')!r}"
+            return out
+
+        # Leg 1: the 5k partitioned shape, timed (first run — the
+        # persistent compile cache amortizes compiles cross-process,
+        # the long-probe _timed_check warm=False precedent).
+        h = synth.generate_partitioned_register_history(
+            5000, seed=7, invoke_bias=0.45)
+        p = prepare.prepare(m.cas_register(), h)
+        t0 = time.time()
+        r = sharded.check_packed(p, mesh=mesh, engine="sparse")
+        dt = time.time() - t0
+        out.update({"n_ops": 5000, "window": p.window,
+                    "crashed": len(p.crashed_ops),
+                    "verdict": r.get("valid?"),
+                    "analyzer": r.get("analyzer"),
+                    "timed_run": "first",
+                    "seconds": round(dt, 1),
+                    "ops_per_sec": round(5000 / dt, 1),
+                    # the per-device evidence sub-dict: _probe_main
+                    # forwards it into the perf-ledger record.
+                    "mesh": r.get("mesh-stats")})
+        if r.get("valid?") is not True:
+            out["error"] = \
+                f"5k partitioned mesh verdict {r.get('valid?')!r}"
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 def _probe_pack():
     """Chip-free pack micro-rung (ISSUE 16): the literal config-5
     100k-op history packed under BOTH packer modes — the vectorized
@@ -842,7 +926,8 @@ PROBES = {"ping": _probe_ping, "mutex_c30": _probe_mutex_c30,
           "wave_smoke": _probe_wave_smoke,
           "service_c30": _probe_service_c30,
           "stream_c30": _probe_stream_c30,
-          "pack": _probe_pack, "fused_pair": _probe_fused_pair}
+          "pack": _probe_pack, "fused_pair": _probe_fused_pair,
+          "mesh_c30": _probe_mesh_c30}
 
 
 def _run_probe_subprocess(key: str, timeout: int, env_extra=None,
@@ -1362,6 +1447,11 @@ def _probe_main(key: str) -> None:
                 # gate rules, but `perf report`/`perf diff` trend it
                 # so a packer regression shows up cross-run.
                 extra["pack"] = r["pack"]
+            if isinstance(r.get("mesh"), dict):
+                # Per-device mesh-stats (ISSUE 18): dispatches,
+                # dispatch wall, peak shard occupancy — the mesh
+                # rung's before/after evidence in `perf report`.
+                extra["mesh"] = r["mesh"]
             perf_ledger.record(
                 os.environ.get("JEPSEN_TPU_PERF_TAG") or key,
                 kind="bench", wall_s=wall_s, verdict=r.get("verdict"),

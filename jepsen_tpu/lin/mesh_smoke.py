@@ -1,0 +1,137 @@
+"""`make mesh-smoke`: the crash-dom mesh engine's chip-free habit.
+
+The serve/txn/trace/stream/perf/pack-smoke pattern for the sharded
+compact band (lin/sharded.py, doc/sharding.md): a FRESH-process proof
+on the forced 8-device virtual CPU mesh that
+
+- a crash-dom register history (crashed mutators => the forced-lax
+  dominance prune is live) DECIDES on the mesh with verdict parity vs
+  the ``lin/cpu.py`` oracle, and its corrupted twin dies on the SAME
+  op — with the per-device mesh-stats counters attached to both
+  verdicts,
+- a ``JEPSEN_TPU_WEDGE=mesh-chunk`` injected run (the supervision test
+  hook, quarantine ledger redirected to a throwaway path) returns an
+  HONEST ``overflow: wedge`` unknown — never a hang, never a flipped
+  verdict — with the watchdog trip counted in its mesh-stats, and
+- the smoke's own perf-ledger record carries the mesh sub-dict
+  (dispatches / dispatch-wall-s / peak-occupancy) so `cli.py perf
+  report` trends the mesh path like every other surface.
+
+Prints one JSON result line and exits 0/1 — timeout-guarded by the
+Makefile so a wedge cannot hold the shell.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    t_start = time.time()
+    # 8-device CPU mesh BEFORE any jax backend init (CLAUDE.md: the TPU
+    # plugin force-selects its platform; the smoke must never take the
+    # chip, and the mesh needs the virtual device count).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from jepsen_tpu import models as m, util
+    from jepsen_tpu.lin import cpu, prepare, sharded, supervise, synth
+
+    util.enable_compile_cache()
+    mesh = Mesh(np.array(jax.devices()[:8]), ("d",))
+    out: dict = {"checks": []}
+    ok = True
+
+    # A crash-dom shape: crashed mutators put the forced-lax dominance
+    # prune (and its collective analogue) on the hot path; small caps
+    # keep the mesh programs seconds-scale on the CPU backend.
+    h = list(synth.generate_register_history(
+        60, concurrency=5, seed=11, value_range=4, crash_prob=0.25,
+        max_crashes=5))
+    p = prepare.prepare(m.cas_register(), h)
+    assert p.crashed.any(), "smoke history must carry crashed mutators"
+
+    def mesh_check(pp):
+        return sharded.check_packed(pp, mesh=mesh, cap_schedule=(8, 512),
+                                    engine="sparse")
+
+    # 1. Round trip: valid history decides, verdict parity vs oracle,
+    # mesh-stats flowing (crash-dom band, per-device occupancy).
+    want = cpu.check_packed(p)["valid?"]
+    r = mesh_check(p)
+    ms = r.get("mesh-stats", {})
+    good = (r["valid?"] == want
+            and ms.get("crash-dom") is True
+            and ms.get("devices") == 8
+            and ms.get("dispatches", 0) >= 1
+            and len(ms.get("peak-occupancy", [])) == 8)
+    out["checks"].append({"case": "crash-dom-valid", "want": want,
+                          "got": r["valid?"], "mesh": ms, "ok": good})
+    ok = ok and good
+    mesh_rec = ms
+
+    # 2. Corrupted twin: same violating op as the oracle.
+    pb = prepare.prepare(m.cas_register(),
+                         list(synth.corrupt_history(h, seed=4)))
+    wb = cpu.check_packed(pb, witness=True)
+    rb = mesh_check(pb)
+    good = (wb["valid?"] is False and rb["valid?"] is False
+            and rb["op"]["index"] == wb["op"]["index"])
+    out["checks"].append({"case": "crash-dom-corrupted",
+                          "want_op": (wb.get("op") or {}).get("index"),
+                          "got_op": (rb.get("op") or {}).get("index"),
+                          "ok": good})
+    ok = ok and good
+
+    # 3. Wedge leg (LAST — leftover armed injections must not leak into
+    # the parity legs): every mesh-chunk dispatch fake-wedges past a
+    # 0.2 s deadline, so detection + the bounded retry both trip and
+    # the engine must return an honest unknown, not hang or flip.
+    os.environ["JEPSEN_TPU_QUARANTINE"] = os.path.join(
+        util.cache_dir(), "mesh_smoke_quarantine.json")
+    os.environ["JEPSEN_TPU_WEDGE"] = "mesh-chunk:8:0.2"
+    supervise.reset_injections()
+    supervise._env_wedge_loaded = None
+    try:
+        rw = mesh_check(p)
+    finally:
+        os.environ.pop("JEPSEN_TPU_WEDGE", None)
+        os.environ.pop("JEPSEN_TPU_QUARANTINE", None)
+        supervise.reset_injections()
+    msw = rw.get("mesh-stats", {})
+    good = (rw["valid?"] == "unknown"
+            and rw.get("overflow") == "wedge"
+            and msw.get("watchdog_trips", 0) >= 1)
+    out["checks"].append({"case": "wedge-honest-unknown",
+                          "got": rw["valid?"],
+                          "overflow": rw.get("overflow"),
+                          "trips": msw.get("watchdog_trips"),
+                          "ok": good})
+    ok = ok and good
+
+    out["ok"] = ok
+    # Cross-run perf ledger (doc/observability.md § Perf ledger): the
+    # smoke's record carries the mesh sub-dict so `cli.py perf report`
+    # trends mesh dispatch wall/occupancy. record() never raises — a
+    # ledger failure cannot cost the smoke.
+    from jepsen_tpu.obs import ledger as perf_ledger
+
+    perf_ledger.record("mesh-smoke", kind="smoke",
+                       wall_s=time.time() - t_start, verdict=ok,
+                       extra={"mesh": mesh_rec})
+    print(json.dumps(out, default=str))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -212,6 +212,17 @@ def test_stream_probe_in_order_and_registry(bench):
     assert "stream_c30" in bench.PROBES
 
 
+def test_mesh_probe_in_order_and_registry(bench):
+    # The mesh probe contract (ISSUE 18): registered, fault-isolated
+    # in its own child, and ordered BEFORE the long/dangerous
+    # partitioned probe so a mesh fault can never cost the proven
+    # single-chip config-5 number.
+    keys = [k for k, _t in bench.PROBE_ORDER]
+    assert "mesh_c30" in keys
+    assert keys.index("mesh_c30") < keys.index("partitioned_c30")
+    assert "mesh_c30" in bench.PROBES
+
+
 def test_txn_probe_stats_pass_through(bench, monkeypatch, capsys):
     # edges/s, verdict, anomaly counts, and the device tier stats must
     # reach detail verbatim and be re-emitted the moment the probe

@@ -1,0 +1,301 @@
+"""The crash-dom MESH band (ISSUE 18 tentpole): the pair-key
+crash-dom compact path sharded over the conftest 8-device CPU mesh
+must agree with the single-chip engine AND the ``lin/cpu.py`` oracle
+on the scaled-down config-5 witness (window 34, pair keys, crashed
+mutators) — verdict, violating op, and final-path validity — and the
+collective dominance dedup must provably equal the single-chip prune.
+
+Prune equality is the load-bearing invariant: the windowed dominance
+CHAIN prune is EXACT (CLAUDE.md architecture invariants), so sharding
+it may change LAYOUT but never the surviving SET. The collective
+harness tests pin that down directly against ``bfs._dedup_keys2_dom``
+on the same candidate multiset, with the per-shard pre-prune both off
+(bit-equality) and on (set-equality), plus a forced-skew leg: all
+candidates crowded onto device 0 must come back as the balanced
+front-packed prefix re-shard.
+
+Round-5 lore holds on the mesh: every dedup here runs the FORCED-LAX
+dominance path (never the psort dom kernels), and the closure ceilings
+convert a non-terminating prune orbit into an honest
+``overflow: budget`` — the budget leg forces that with
+``JEPSEN_TPU_MESH_IT_MAX=1``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from jepsen_tpu import models as m, util
+from jepsen_tpu.lin import bfs, cpu, prepare, sharded, synth
+
+# quick (seconds-scale once .jax_cache holds the mesh programs) but it
+# compiles shard_map programs on a cold cache — exempt from the
+# conftest no-compile enforcement via the registered `compiles` marker.
+pytestmark = [pytest.mark.quick, pytest.mark.compiles]
+
+N_DEV = 8
+
+
+def mesh8():
+    return Mesh(np.array(jax.devices()[:N_DEV]), ("d",))
+
+
+def _pair_band_history():
+    # The test_lin_crashdom_witness recipe: scaled-down literal config-5
+    # shape — window 34 (past the 31-bit single-key bound), crashed
+    # mutators, pair keys. The 5k/window-25 shapes do NOT exercise
+    # these paths (CLAUDE.md round-5 lore).
+    return synth.generate_partitioned_register_history(
+        140, concurrency=40, seed=0, partition_every=60,
+        partition_len=20, max_crashes=10)
+
+
+def _mesh_check(p, **kw):
+    # cap 512/device (4096 global) fits the witness's 630-config peak
+    # and keeps the pair programs seconds-scale on the CPU backend —
+    # a 4096/device top cap measured ~9x slower for zero extra
+    # coverage.
+    return sharded.check_packed(p, mesh=mesh8(), cap_schedule=(64, 512),
+                                engine="sparse", **kw)
+
+
+class TestWitnessParity:
+    """Window-34 pair-band witness: mesh == single-chip == cpu oracle."""
+
+    def test_valid_witness(self):
+        p = prepare.prepare(m.cas_register(), _pair_band_history())
+        # Guard the routing assumptions this test exists for: pair keys
+        # (window past the single-key bound) with crashed mutators.
+        assert p.window + max(len(p.unintern), 2).bit_length() > 31
+        assert len(p.crashed_ops) > 0
+
+        r = _mesh_check(p)
+        assert r["dedup"] == "packed-keys2"
+        # True is the pinned oracle verdict for this seeded recipe:
+        # running cpu.check_packed here costs ~6 min of python frontier
+        # walk (valid = full enumeration), which the quick tier cannot
+        # afford — the slow-marked TestWitnessParityFull leg holds the
+        # live three-way valid parity, and the corrupted twin below
+        # runs the oracle cheaply (it dies at op 112).
+        assert r["valid?"] is True
+        ms = r["mesh-stats"]
+        assert ms["devices"] == N_DEV
+        assert ms["band"] == "pair"
+        assert ms["crash-dom"] is True
+        assert ms["dispatches"] >= 1
+        assert len(ms["peak-occupancy"]) == N_DEV
+        # __graft_entry__ asserts these top-level compatibility keys on
+        # every mesh verdict — keep them flowing from the compact path.
+        for key in ("chunks", "peak-frontier", "cap-per-device",
+                    "shard-occupancy"):
+            assert key in r, key
+
+    def test_corrupted_witness_death_row_and_final_paths(self):
+        h = synth.corrupt_history(_pair_band_history(), seed=3)
+        p = prepare.prepare(m.cas_register(), h)
+
+        want = cpu.check_packed(p, witness=True)
+        assert want["valid?"] is False, "corruption must invalidate"
+        single = bfs.check_packed(p, cap_schedule=(8,),
+                                  host_caps=(64, 4096), explain=True)
+        got = _mesh_check(p, explain=True)
+
+        assert got["valid?"] is single["valid?"] is False
+        assert got["op"] == want["op"]
+        assert got["op"] == single["op"]
+        assert got["final-paths"], "mesh violation must carry final-paths"
+        # Final-path VALIDITY, not set-equality (test_lin_crashdom_witness
+        # precedent): each engine enumerates paths for its own exact
+        # alive set, so replay every mesh path through the python step
+        # twin (the test_lin_witness replay idiom).
+        from jepsen_tpu.lin.prepare import py_step_fn
+        from jepsen_tpu.models.kernels import F_IDS, NIL
+
+        step = py_step_fn(p.kernel.name)
+        by_index = {o.op_index: o for o in p.ops}
+        for fp in got["final-paths"]:
+            st = tuple(int(x) for x in p.init_state)
+            for od in fp["path"]:
+                o = by_index[od["index"]]
+                f_id = F_IDS[o.f]
+                if o.f == "cas":
+                    v = (p.intern.get(o.value[0], int(NIL)),
+                         p.intern.get(o.value[1], int(NIL)))
+                else:
+                    v = (int(NIL) if o.value is None
+                         else p.intern.get(o.value, int(NIL)), int(NIL))
+                ok, st = step(st, f_id, v)
+                assert ok, f"mesh path op {od} illegal at state {st}"
+
+
+@pytest.mark.slow
+class TestWitnessParityFull:
+    """The expensive parity legs (run with ``-m slow``): live
+    three-way VALID parity on the witness, and the 5k partitioned
+    shape (window 25, single-key crash-dom band — the round-5 lore's
+    other family) mesh vs single-chip."""
+
+    def test_valid_witness_three_way(self):
+        p = prepare.prepare(m.cas_register(), _pair_band_history())
+        want = cpu.check_packed(p)["valid?"]
+        single = bfs.check_packed(p, cap_schedule=(8,),
+                                  host_caps=(64, 4096))["valid?"]
+        got = _mesh_check(p)
+        assert got["valid?"] is single is want is True
+
+    def test_partitioned_5k_single_key_band(self):
+        h = synth.generate_partitioned_register_history(
+            5000, seed=7, invoke_bias=0.45)
+        p = prepare.prepare(m.cas_register(), h)
+        b = max(len(p.unintern), 2).bit_length()
+        assert p.window + b <= 31, "5k shape must be single-key band"
+        single = bfs.check_packed(p)["valid?"]
+        got = sharded.check_packed(p, mesh=mesh8(), engine="sparse")
+        assert got["valid?"] == single
+        assert got["mesh-stats"]["band"] == "single"
+        assert got["mesh-stats"]["crash-dom"] is True
+
+
+class TestCollectivePruneEquality:
+    """_global_dedup_keys_dom vs the single-chip _dedup_keys2_dom on
+    the SAME candidate multiset: sharding must not change the prune."""
+
+    B = 6  # key-space state-bit width for the synthetic masks
+
+    def _masks(self):
+        # Synthetic key-space masks shaped like a pair-band row's
+        # (crash_lo, crash_hi, read_lo, read_hi): disjoint crash and
+        # read bit-bands above the state bits.
+        c_lo = np.uint32(0x00000FC0)
+        c_hi = np.uint32(0x0000000F)
+        r_lo = np.uint32(0x003F0000)
+        r_hi = np.uint32(0x00000F00)
+        return (jnp.uint32(c_lo), jnp.uint32(c_hi),
+                jnp.uint32(r_lo), jnp.uint32(r_hi))
+
+    def _candidates(self, seed, n=256):
+        # Random keys plus planted structure the prune must collapse:
+        # exact duplicates and crash-bit-superset dominators.
+        rng = np.random.default_rng(seed)
+        lo = rng.integers(0, 1 << 32, size=n, dtype=np.uint32)
+        hi = rng.integers(0, 1 << 28, size=n, dtype=np.uint32)
+        # duplicates across shard boundaries
+        lo[n // 2:n // 2 + 32] = lo[:32]
+        hi[n // 2:n // 2 + 32] = hi[:32]
+        # dominators: same key with extra crash bits set
+        lo[-32:] = lo[32:64] | np.uint32(0x00000040)
+        hi[-32:] = hi[32:64]
+        valid = rng.random(n) < 0.9
+        return lo, hi, valid
+
+    def _single_chip(self, lo, hi, valid, masks, dom_iters):
+        c_lo, c_hi, r_lo, r_hi = masks
+        n = lo.shape[0]
+        hi_p, lo_p, total, _ = bfs._dedup_keys2_dom(
+            jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(valid), n,
+            c_hi, c_lo, r_hi, r_lo, use_psort=False, dom_force=True,
+            dom_iters=dom_iters)
+        return np.asarray(hi_p), np.asarray(lo_p), int(total)
+
+    def _mesh_collective(self, lo, hi, valid, masks, cap_local, *,
+                         preprune, dom_iters=2):
+        def body(lo_s, hi_s, val_s):
+            l, h, cnt, tot, ovf = sharded._global_dedup_keys_dom(
+                lo_s, hi_s, val_s, cap_local, "d", key_hi=True,
+                crash_dom=True, masks=masks, dom_iters=dom_iters,
+                preprune=preprune)
+            return l, h, cnt[None], tot[None], ovf[None]
+
+        fn = util.get_shard_map()(
+            body, mesh=mesh8(),
+            in_specs=(P("d"), P("d"), P("d")),
+            out_specs=(P("d"), P("d"), P("d"), P("d"), P("d")),
+            check_vma=False)
+        lo_o, hi_o, cnt, tot, ovf = fn(
+            jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(valid))
+        return (np.asarray(lo_o), np.asarray(hi_o),
+                np.asarray(cnt), int(tot[0]), bool(np.any(ovf)))
+
+    def test_sharded_prune_bit_equals_single_chip(self):
+        # preprune OFF: the collective is ONE global forced-lax dom
+        # dedup at cap = gathered length — bit-identical to the
+        # single-chip helper on the same multiset, then sliced.
+        lo, hi, valid = self._candidates(seed=0)
+        masks = self._masks()
+        hi_ref, lo_ref, total = self._single_chip(lo, hi, valid, masks,
+                                                  dom_iters=2)
+        cap_local = lo.shape[0] // N_DEV
+        lo_m, hi_m, cnt, tot_m, ovf = self._mesh_collective(
+            lo, hi, valid, masks, cap_local, preprune=False)
+        assert tot_m == total
+        assert not ovf
+        # concatenated device slices == the single-chip packed arrays
+        np.testing.assert_array_equal(lo_m, lo_ref)
+        np.testing.assert_array_equal(hi_m, hi_ref)
+
+    def test_preprune_preserves_surviving_set(self):
+        # preprune ON: the per-shard pass may reorder the pre-gather
+        # layout but can only remove candidates the global pass would
+        # also remove — surviving SET and total unchanged.
+        lo, hi, valid = self._candidates(seed=1)
+        masks = self._masks()
+        hi_ref, lo_ref, total = self._single_chip(lo, hi, valid, masks,
+                                                  dom_iters=2)
+        cap_local = lo.shape[0] // N_DEV
+        lo_m, hi_m, cnt, tot_m, ovf = self._mesh_collective(
+            lo, hi, valid, masks, cap_local, preprune=True)
+        assert tot_m == total
+        assert not ovf
+        ref = {(int(h), int(l))
+               for h, l in zip(hi_ref[:total], lo_ref[:total])}
+        got = {(int(h), int(l)) for h, l in zip(hi_m[:tot_m], lo_m[:tot_m])}
+        assert got == ref
+
+    def test_forced_skew_rebalances(self):
+        # Every live candidate crowded onto device 0; the collective
+        # must hand back the balanced front-packed prefix re-shard:
+        # counts = clip(total - d*cap, 0, cap), survivors sorted into
+        # the leading devices.
+        n = 256
+        cap_local = n // N_DEV  # 32 per device
+        rng = np.random.default_rng(7)
+        lo = rng.integers(0, 1 << 32, size=n, dtype=np.uint32)
+        hi = rng.integers(0, 1 << 28, size=n, dtype=np.uint32)
+        valid = np.zeros(n, dtype=bool)
+        valid[:40] = True  # all live keys on shard 0 (rows 0..31) + 1
+        masks = self._masks()
+        hi_ref, lo_ref, total = self._single_chip(lo, hi, valid, masks,
+                                                  dom_iters=1)
+        lo_m, hi_m, cnt, tot_m, ovf = self._mesh_collective(
+            lo, hi, valid, masks, cap_local, preprune=True, dom_iters=1)
+        assert tot_m == total
+        assert total > cap_local, "skew must actually spill device 0"
+        want_cnt = np.clip(total - np.arange(N_DEV) * cap_local, 0,
+                           cap_local)
+        np.testing.assert_array_equal(cnt, want_cnt.astype(cnt.dtype))
+        got = {(int(h), int(l)) for h, l in zip(hi_m[:tot_m], lo_m[:tot_m])}
+        ref = {(int(h), int(l))
+               for h, l in zip(hi_ref[:total], lo_ref[:total])}
+        assert got == ref
+
+
+def test_budget_ceiling_is_honest_overflow(monkeypatch):
+    # The in-carry iteration ceiling (round-5 orbit defense): pin the
+    # closure budget to 1 so every row "orbits", and the engine must
+    # walk the (pinned-short) escalation ladder and return an honest
+    # budget unknown — never hang, never flip a verdict.
+    monkeypatch.setenv("JEPSEN_TPU_MESH_IT_MAX", "1")
+    monkeypatch.setenv("JEPSEN_TPU_MESH_CAPS", "4")
+    h = synth.generate_register_history(40, concurrency=4, seed=5,
+                                        crash_prob=0.3, max_crashes=4)
+    p = prepare.prepare(m.cas_register(), h)
+    assert p.crashed.any(), "budget leg needs the crash-dom route"
+    r = sharded.check_packed(p, mesh=mesh8(), cap_schedule=(4,),
+                             engine="sparse")
+    assert r["valid?"] == "unknown"
+    assert r["overflow"] == "budget"
+    assert r["mesh-stats"]["crash-dom"] is True
+    assert r["mesh-stats"]["episodes"] >= 1
+

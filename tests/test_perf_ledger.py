@@ -802,6 +802,24 @@ def test_probe_main_perf_tag_names_the_rung(bench, monkeypatch,
     assert rec["probe"] == "partitioned_c30.sched"
 
 
+def test_probe_main_forwards_mesh_stats(bench, monkeypatch, capsys,
+                                        tmp_path):
+    # ISSUE 18 acceptance: the mesh probe's per-device mesh-stats
+    # sub-dict rides into the perf-ledger record so `perf report`
+    # trends the mesh dispatch wall and shard occupancy.
+    p = tmp_path / "l.jsonl"
+    monkeypatch.setenv("JEPSEN_TPU_PERF_LEDGER", str(p))
+    ms = {"devices": 8, "band": "pair", "crash-dom": True,
+          "dispatches": 5, "dispatch-wall-s": 12.3,
+          "peak-occupancy": [630, 64, 14, 0, 0, 0, 0, 0]}
+    _drive_probe_main(bench, monkeypatch, capsys,
+                      result={"verdict": True, "seconds": 0.1,
+                              "mesh": ms})
+    (rec,) = ledger.load(str(p))
+    # make_record flattens extra into the record top level.
+    assert rec["mesh"] == ms
+
+
 def test_probe_main_ledger_failure_cannot_cost_the_result(
         bench, monkeypatch, capsys, tmp_path):
     # The acceptance criterion verbatim: a ledger I/O failure can
