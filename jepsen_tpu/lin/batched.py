@@ -38,7 +38,10 @@ class Decline:
     kernel), "dense-plan" (outside the dense engine's bounds),
     "rows" / "bitmap-words" / "table-cells" (dense batch resource
     ceilings), "window" (past the sparse bitset), "frontier-overflow"
-    (the vmapped sparse search overflowed its top capacity).
+    (the vmapped sparse search overflowed its top capacity). Stream
+    batches (:func:`try_stream_batch`) add "stream-group" (no
+    shape-sharing peer in the flush) and "stream-dead" (the lane found
+    a violation; the per-session solo path reproduces the witness).
     """
 
     axis: str
@@ -320,3 +323,172 @@ def _check_group(packed: dict) -> dict | Decline:
 
     return _result_rows(packed, ks, np.asarray(dead | overflow),
                         np.asarray(rows), "tpu-bfs-batch")
+
+
+def try_stream_batch(jobs: list) -> list:
+    """Run many sessions' pending stream increments as vmapped
+    carried-frontier programs (the daemon's svc-stream bins).
+
+    Each job is a :meth:`StreamChecker.increment_job` dict:
+    ``{"packed", "row0", "rows", "frontier", "checker"}``. Jobs are
+    grouped by the EXACT traced shape — (step fn, state shape, window,
+    value words) — and each group of >= 2 lanes runs as ONE
+    ``jax.vmap``'d :func:`bfs._search_chunk` over the lanes' sliced
+    row tables, with per-lane row counts traced (``n_rows`` masks each
+    lane's padding — rows past it are never processed) and per-lane
+    carried frontiers zero-padded to a shared capacity.
+
+    Exactness is the multiword engine's: every lane runs the same
+    general formulation ``check_packed`` uses whenever packed keys are
+    off, consuming the exact reduction tables
+    (:func:`bfs.reduction_bit_tables`) sliced at the lane's frontier
+    row — the same re-entry invariant as checkpoint resume, whichever
+    engine produced the carried frontier.
+
+    Returns a list parallel to ``jobs``: a result dict carrying
+    ``"stream-frontier"`` for a lane that walked clean, or a falsy
+    :class:`Decline` — the caller commits clean lanes via
+    ``commit_increment`` and falls back per-session (``drive()``) on
+    declines, including "stream-dead" lanes (the solo path re-runs
+    from the SAME uncommitted frontier and reproduces the violation
+    with its full witness machinery)."""
+    out: list = [None] * len(jobs)
+    groups: dict = {}
+    for i, j in enumerate(jobs):
+        p = j["packed"]
+        if p.window > bfs.MAX_DEVICE_WINDOW:
+            out[i] = Decline(
+                "window", f"window {p.window} > device bitset "
+                          f"{bfs.MAX_DEVICE_WINDOW}")
+            continue
+        sig = (p.kernel.step, tuple(p.init_state.shape),
+               int(p.window), int(p.slot_v.shape[2]))
+        groups.setdefault(sig, []).append(i)
+    for ixs in groups.values():
+        if len(ixs) < 2:
+            for i in ixs:
+                out[i] = Decline(
+                    "stream-group",
+                    "no shape-sharing peer in this flush")
+            continue
+        with obs_trace.span("dispatch", site="stream-batch-group",
+                            lanes=len(ixs)) as sp:
+            res = _stream_group([jobs[i] for i in ixs])
+            sp.note(declined=isinstance(res, Decline))
+        util.progress_tick()
+        if isinstance(res, Decline):
+            for i in ixs:
+                out[i] = res
+        else:
+            for i, r in zip(ixs, res):
+                out[i] = r
+    return out
+
+
+def _stream_group(jobs: list) -> list | Decline:
+    """One exact-shape group of stream increments through a vmapped
+    multiword search. A group-level Decline de-batches every lane;
+    per-lane entries can still individually decline (overflow, dead)."""
+    import jax
+    import jax.numpy as jnp
+
+    K = len(jobs)
+    p0 = jobs[0]["packed"]
+    window = int(p0.window)
+    nw = (window + 31) // 32
+    S = int(p0.init_state.shape[0])
+    vw = int(p0.slot_v.shape[2])
+    step_fn = p0.kernel.step
+    rows_max = max(j["rows"] for j in jobs)
+    r_pad = 1 << max(4, (rows_max - 1).bit_length())
+    if r_pad > MAX_BATCH_ROWS:
+        return Decline("rows", f"r_pad {r_pad} > {MAX_BATCH_ROWS}")
+    if K * r_pad * window > MAX_BATCH_TABLE_CELLS:
+        return Decline(
+            "table-cells",
+            f"{K} x {r_pad} x {window} cells > {MAX_BATCH_TABLE_CELLS}")
+    counts = [int(j["frontier"][2]) if j["frontier"] is not None else 1
+              for j in jobs]
+    caps = [c for c in BATCH_CAP_SCHEDULE if c >= max(counts)]
+    if not caps:
+        return Decline(
+            "frontier-overflow",
+            f"carried frontier {max(counts)} > cap "
+            f"{BATCH_CAP_SCHEDULE[-1]}")
+
+    n_rows = np.zeros(K, np.int32)
+    ret_slot = np.zeros((K, r_pad), np.int32)
+    active = np.zeros((K, r_pad, window), bool)
+    slot_f = np.zeros((K, r_pad, window), np.int32)
+    slot_v = np.zeros((K, r_pad, window, vw), np.int32)
+    pure = np.zeros((K, r_pad, window), bool)
+    pred_bit = np.zeros((K, r_pad, window, nw), np.uint32)
+    for i, j in enumerate(jobs):
+        p, row0, rows = j["packed"], j["row0"], j["rows"]
+        sl = slice(row0, row0 + rows)
+        n_rows[i] = rows
+        ret_slot[i, :rows] = np.asarray(p.ret_slot)[sl]
+        active[i, :rows] = np.asarray(p.active)[sl]
+        slot_f[i, :rows] = np.asarray(p.slot_f)[sl]
+        slot_v[i, :rows] = np.asarray(p.slot_v)[sl]
+        pure_k, pred_bit_k = bfs.reduction_bit_tables(p, nw)
+        pure[i, :rows] = pure_k[sl]
+        pred_bit[i, :rows] = pred_bit_k[sl]
+
+    for cap in caps:
+        bits0 = np.zeros((K, cap, nw), np.uint32)
+        state0 = np.zeros((K, cap, S), np.int32)
+        for i, j in enumerate(jobs):
+            fr = j["frontier"]
+            if fr is None:
+                state0[i, 0] = np.asarray(j["packed"].init_state,
+                                          np.int32)
+            else:
+                fb = np.asarray(fr[0], np.uint32)
+                fs = np.asarray(fr[1], np.int32)
+                fc = counts[i]
+                # The carried frontier may be NARROWER than this
+                # increment's window (the window grows with observed
+                # concurrency; slot indices are stable): zero-pad the
+                # high words, mirroring check_packed's re-entry.
+                w_common = min(fb.shape[1], nw)
+                bits0[i, :fc, :w_common] = fb[:fc, :w_common]
+                state0[i, :fc] = fs[:fc]
+
+        def one(n, rs, ac, sf, sv, pu, pb, b0, s0, c0):
+            return bfs._search_chunk(n, rs, ac, sf, sv, pu, pb,
+                                     b0, s0, c0, cap=cap,
+                                     step_fn=step_fn)
+
+        bits_o, state_o, count_o, _rows_done, dead, ovf = jax.vmap(one)(
+            jnp.asarray(n_rows), jnp.asarray(ret_slot),
+            jnp.asarray(active), jnp.asarray(slot_f),
+            jnp.asarray(slot_v), jnp.asarray(pure),
+            jnp.asarray(pred_bit), jnp.asarray(bits0),
+            jnp.asarray(state0), jnp.asarray(counts, jnp.int32))
+        if not bool(jnp.any(ovf)):
+            break
+
+    bits_h, state_h = np.asarray(bits_o), np.asarray(state_o)
+    count_h = np.asarray(count_o)
+    dead_h, ovf_h = np.asarray(dead), np.asarray(ovf)
+    res: list = []
+    for i, j in enumerate(jobs):
+        if ovf_h[i]:
+            res.append(Decline(
+                "frontier-overflow",
+                f"stream lane overflowed cap {cap}"))
+        elif dead_h[i]:
+            res.append(Decline(
+                "stream-dead",
+                "lane found a violation; the solo re-check from the "
+                "same frontier reproduces the witness"))
+        else:
+            c = max(1, int(count_h[i]))
+            res.append({
+                "valid?": True, "analyzer": "tpu-bfs-stream-batch",
+                "stream-frontier": {
+                    "bits": bits_h[i, :c].copy(),
+                    "state": state_h[i, :c].copy(),
+                    "count": c, "row": j["row0"] + j["rows"]}})
+    return res

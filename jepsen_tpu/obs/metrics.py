@@ -118,13 +118,16 @@ class Registry:
 
     def event(self, kind: str, **fields) -> None:
         """Append to the bounded event feed (watchdog trips, faults,
-        quarantine records — the /run page's triage column)."""
+        quarantine records — the /run page's triage column). Also
+        bumps a durable ``event_<kind>`` counter: the ring evicts past
+        MAX_EVENTS, so long chaos runs audit counts, not the feed."""
         with self._lock:
             e = {"t": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                     time.gmtime()),
                  "kind": kind}
             e.update(fields)
             self._events.append(e)
+            util.stat_bump(self._counters, f"event_{kind}")
 
     # --- run progress -------------------------------------------------------
 

@@ -23,6 +23,10 @@ Injected event kinds (all deterministic test hooks, doc/env.md):
 - ``worker-kill`` — ``CheckerService.inject_worker_kill``
   (``JEPSEN_TPU_SERVICE_KILL``): a worker THREAD dies with its batch
   in hand; the supervisor must requeue-once and respawn.
+- ``device-loss`` — ``CheckerService.inject_device_loss``
+  (``JEPSEN_TPU_SERVICE_DEVLOSS``): a worker's DEVICE dies (chip
+  gone): its bin homes re-place onto surviving devices, the respawn
+  rebinds off the lost device, and no verdict is lost or flipped.
 
 :func:`run_chaos` drives an in-process daemon (real engines, real
 sockets) through a seeded schedule — the chaos-gate tests run it at
@@ -45,7 +49,7 @@ import threading
 import time
 
 EVENT_KINDS = ("wedge-check", "wedge-batch", "fault-check",
-               "fault-batch", "worker-kill")
+               "fault-batch", "worker-kill", "device-loss")
 
 
 def seeded_jobs(n: int, seed: int) -> list[tuple[str, list]]:
@@ -160,6 +164,8 @@ def run_chaos(*, histories: int = 60, events: int = 20,
             supervise.inject_fault("service-batch", 1)
         elif kind == "worker-kill":
             svc.inject_worker_kill(1)
+        elif kind == "device-loss":
+            svc.inject_device_loss(1)
         injected[kind] = injected.get(kind, 0) + 1
 
     def nemesis() -> None:
@@ -236,6 +242,7 @@ def run_chaos(*, histories: int = 60, events: int = 20,
                   ("decided", "requeues", "honest_fails",
                    "wedged_requests", "worker_deaths", "worker_kills",
                    "worker_wedges", "worker_respawns",
+                   "device_losses", "placement_spills",
                    "watchdog_trips", "faults", "journal_replays",
                    "journal_depth", "dropped_responses")},
         # Soundness: no flipped verdict anywhere, every request
@@ -313,6 +320,33 @@ def main() -> int:
                           "injected": report["injected"],
                           "stats": report["stats"]})
     ok = ok and report["sound"]
+
+    # --- leg 1b: device loss under load (the placement re-home) -------------
+    # Every event is a device loss: the 2-worker pool must keep
+    # answering (survivor re-placement, zero lost/flipped verdicts)
+    # and the losses must be visible in the obs event feed and the
+    # worker counters — the chip-loss acceptance shape.
+    from jepsen_tpu.obs import metrics as obs_metrics
+
+    report = run_chaos(histories=16, events=3, workers=2, seed=11,
+                       journal=os.path.join(base, "devloss.jsonl"),
+                       event_kinds=("device-loss",))
+    snap = obs_metrics.REGISTRY.snapshot()
+    feed_kinds = [e.get("kind") for e in snap.get("events", [])]
+    losses = report["stats"].get("device_losses") or 0
+    rec = {"leg": "device-loss", "sound": report["sound"],
+           "verdicts": report["verdicts"],
+           "device_losses": losses,
+           "worker_respawns": report["stats"].get("worker_respawns"),
+           "event_counter":
+               snap.get("counters", {}).get("event_device-loss", 0),
+           "in_event_feed": "device-loss" in feed_kinds,
+           "ok": (report["sound"] and losses >= 1
+                  and "device-loss" in feed_kinds
+                  and snap.get("counters", {}).get(
+                      "event_device-loss", 0) >= losses)}
+    out["checks"].append(rec)
+    ok = ok and rec["ok"]
 
     # --- leg 2: SIGKILL mid-flight -> restart -> replay -> parity -----------
     journal = os.path.join(base, "restart.jsonl")

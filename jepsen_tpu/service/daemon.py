@@ -81,6 +81,7 @@ from jepsen_tpu import codec, util
 from jepsen_tpu.obs import metrics as obs_metrics
 from jepsen_tpu.obs import trace as obs_trace
 from jepsen_tpu.service import journal as journal_mod
+from jepsen_tpu.service import placement as placement_mod
 from jepsen_tpu.service import protocol
 from jepsen_tpu.suites.common import SocketIO
 
@@ -125,12 +126,24 @@ def stream_session_bound() -> int:
     return util.env_int("JEPSEN_TPU_STREAM_SESSIONS", 4)
 
 
+def stream_bins_enabled() -> bool:
+    """svc-stream bins (``JEPSEN_TPU_SERVICE_STREAM_BINS``, default
+    on): daemon stream sessions DEFER their increments into per-model
+    bins, and concurrent sessions sharing a traced shape decide as ONE
+    vmapped carried-frontier program
+    (:func:`jepsen_tpu.lin.batched.try_stream_batch`) — solo fallback
+    on decline, exactly like check bins. ``0`` restores the
+    per-session solo dispatch path."""
+    return os.environ.get("JEPSEN_TPU_SERVICE_STREAM_BINS", "1") != "0"
+
+
 def worker_count() -> int:
     """Decide workers (``JEPSEN_TPU_SERVICE_WORKERS``). Default 1 —
     one thread owning the one device, the single-chip driver shape.
-    CPU-mesh tests and multi-chip hosts raise it; device binding stays
-    per-worker (each worker just runs its dispatches on whatever its
-    thread's jax default device is)."""
+    CPU-mesh tests and multi-chip hosts raise it; with N > 1 each
+    worker binds to ``jax.devices()[slot % N_dev]`` and the placement
+    policy (placement.py) routes flushed bins by bin -> device
+    affinity with least-loaded spill."""
     return util.env_int("JEPSEN_TPU_SERVICE_WORKERS", 1)
 
 
@@ -174,15 +187,46 @@ class Request:
 
 @dataclass(eq=False)
 class _WorkerState:
-    """One decide worker: its thread plus the work item IN HAND — what
-    the supervisor requeues if the thread dies or wedges mid-item."""
+    """One decide worker: its thread, ITS OWN work queue (the
+    placement policy routes flushed bins to slots, so each slot
+    queues independently), its bound device, and the work item IN
+    HAND — what the supervisor requeues if the thread dies or wedges
+    mid-item. ``slot`` is the pool position: a respawned worker
+    inherits its predecessor's slot, queue, and device, so bin homes
+    survive worker deaths."""
 
     wid: int
+    slot: int = 0
     thread: threading.Thread | None = None
+    q: queue.Queue = field(default_factory=queue.Queue)
+    device: Any = None             # jax device (None: thread default)
+    device_ix: int | None = None
+    device_lost: bool = False      # chaos: respawn rebinds elsewhere
     busy: Any = None               # batch / ("stream", job) in hand
     busy_since: float = 0.0
+    items: int = 0                 # work items completed
+    busy_s: float = 0.0            # seconds spent on items
+    compiles: int = 0              # XLA compiles attributed (approx:
+    #                                process-meter delta per item)
     abandoned: bool = False        # supervisor gave up on it; the
     #                                thread exits at its next loop top
+
+
+@dataclass(eq=False)
+class _StreamIncr:
+    """One deferred stream increment riding the scheduler's bins: the
+    scheduler only touches ``bin`` (it bins these exactly like
+    Requests), the worker pool turns a flushed svc-stream bin into one
+    vmapped :func:`jepsen_tpu.lin.batched.try_stream_batch` program,
+    and the blocked connection handler wakes on ``done`` to send the
+    fresh session status."""
+
+    sess: "StreamSession"
+    bin: str
+    done: threading.Event = field(default_factory=threading.Event)
+    reply: dict | None = None
+    error: str | None = None
+    t_enqueue: float = field(default_factory=time.monotonic)
 
 
 @dataclass(eq=False)
@@ -237,6 +281,16 @@ def _txn_kw(msg: dict) -> dict:
             "algorithm": msg.get("algorithm", "tpu")}
 
 
+def stream_bin(model_name: str) -> str:
+    """The svc-stream bin family: one key per model name. Coarser
+    than the check bins on purpose — concurrent sessions of one model
+    usually share the traced increment shape (same kernel family;
+    try_stream_batch regroups by the EXACT (step, S, window) key and
+    declines mixes), and the placement policy keeps the whole family
+    on one device so a session's programs never migrate."""
+    return f"svc-stream|{model_name}"
+
+
 def _txn_bin(kw: dict) -> str:
     """Txn requests never bin (the daemon decides them per-request
     under the supervised fallthrough — ROADMAP's "txn-check on the
@@ -261,7 +315,8 @@ class CheckerService:
                  workers: int | None = None,
                  journal: str | None = None,
                  check_fn: Callable | None = None,
-                 batch_fn: Callable | None = None):
+                 batch_fn: Callable | None = None,
+                 stream_batch_fn: Callable | None = None):
         self.host = host
         self.port = port if port is not None else default_port()
         self.bound = bound if bound is not None else queue_bound()
@@ -280,6 +335,8 @@ class CheckerService:
             else journal_mod.journal_path()
         self._check_fn = check_fn
         self._batch_fn = batch_fn
+        self._stream_batch_fn = stream_batch_fn
+        self.stream_bins = stream_bins_enabled()
 
         # The admission queue itself is unbounded; the BOUND is on
         # requests IN FLIGHT (admitted, not yet answered) — bounding
@@ -287,7 +344,6 @@ class CheckerService:
         # drains it into (necessarily unbounded) shape bins.
         self._queue: queue.Queue[Request] = queue.Queue()
         self._inflight = 0
-        self._work: queue.Queue = queue.Queue()
         self._bins: dict[str, list[Request]] = {}
         self._bins_lock = threading.Lock()
         self._stop = threading.Event()
@@ -301,7 +357,12 @@ class CheckerService:
         self._abandoned: list[threading.Thread] = []
         self._worker_seq = 0
         self._kill_armed = util.env_int("JEPSEN_TPU_SERVICE_KILL", 0)
+        self._devloss_armed = util.env_int(
+            "JEPSEN_TPU_SERVICE_DEVLOSS", 0)
         self._kill_lock = threading.Lock()
+        self._placement = placement_mod.Placement(self.n_workers)
+        self._devices: list = []       # jax devices (n_workers > 1)
+        self._lost_devices: set[int] = set()
         self._crashed = False
         self._journal: journal_mod.Journal | None = None
 
@@ -368,6 +429,7 @@ class CheckerService:
         out["workers"] = len(self._workers) or self.n_workers
         out["workers_busy"] = sum(1 for w in self._workers
                                   if w.busy is not None)
+        out["placement"] = self._placement_block()
         if self._journal is not None:
             out.update(self._journal.stats())
         batches = out.get("batches", 0)
@@ -380,6 +442,28 @@ class CheckerService:
         out.update(_compile_meter_snapshot())
         out.update(_pack_meter_snapshot())
         return protocol.jsonable(out)
+
+    def _placement_block(self) -> dict:
+        """Per-device fleet telemetry: the placement policy's counters
+        plus each worker slot's device, queue depth, busy-seconds,
+        item and compile counts — the ISSUE's 'per-device queue depth
+        / busy-seconds / compile counts' surface for service-stats
+        and the /service page."""
+        block = self._placement.snapshot()
+        block["workers"] = [
+            {"wid": w.wid, "slot": w.slot,
+             "device": (str(self._devices[w.device_ix])
+                        if w.device_ix is not None and self._devices
+                        else None),
+             "queue_depth": w.q.qsize(),
+             "busy": w.busy is not None,
+             "items": w.items,
+             "busy_s": round(w.busy_s, 3),
+             "compiles": w.compiles}
+            for w in self._workers]
+        if self._lost_devices:
+            block["lost_devices"] = sorted(self._lost_devices)
+        return block
 
     def _write_stats_snapshot(self, force: bool = False) -> None:
         now = time.monotonic()
@@ -419,10 +503,21 @@ class CheckerService:
         # join timeout.
         self._listener.settimeout(0.5)
         self.port = self._listener.getsockname()[1]
+        # Device binding only exists at n_workers > 1: the workers=1
+        # driver shape must never import jax here (bit-identical to
+        # the pre-placement daemon — the device is whatever the one
+        # worker thread's jax default already is).
+        if self.n_workers > 1:
+            try:
+                import jax
+
+                self._devices = list(jax.devices())
+            except Exception:  # noqa: BLE001 - no backend: unbound
+                self._devices = []
         # Workers FIRST: the scheduler's supervisor tick dereferences
         # the pool on its first iteration.
-        self._workers = [self._spawn_worker()
-                         for _ in range(self.n_workers)]
+        self._workers = [self._spawn_worker(slot)
+                         for slot in range(self.n_workers)]
         for name, fn in (("accept", self._accept_loop),
                          ("scheduler", self._scheduler_loop)):
             t = threading.Thread(target=fn, daemon=True,
@@ -433,14 +528,45 @@ class CheckerService:
         self._replay_journal()
         return self
 
-    def _spawn_worker(self) -> _WorkerState:
+    def _spawn_worker(self, slot: int,
+                      inherit: _WorkerState | None = None) \
+            -> _WorkerState:
+        """Spawn the worker for ``slot``. A respawn (``inherit``)
+        keeps the predecessor's queue and device — pending work and
+        bin homes survive a worker death; only device LOSS rebinds
+        (to the least-loaded surviving device, after the placement
+        map forgot this slot's homes)."""
         self._worker_seq += 1
-        st = _WorkerState(wid=self._worker_seq)
+        st = _WorkerState(wid=self._worker_seq, slot=slot)
+        if inherit is not None:
+            st.q = inherit.q
+            if inherit.device_lost:
+                st.device_ix = self._rebind_device()
+            else:
+                st.device_ix = inherit.device_ix
+        elif self._devices:
+            st.device_ix = slot % len(self._devices)
+        if st.device_ix is not None and self._devices:
+            st.device = self._devices[st.device_ix]
         st.thread = threading.Thread(
             target=self._worker_loop, args=(st,), daemon=True,
             name=f"svc-worker-{st.wid}")
         st.thread.start()
         return st
+
+    def _rebind_device(self) -> int | None:
+        """Least-loaded surviving device (by bound worker count) for
+        a respawn after device loss."""
+        if not self._devices:
+            return None
+        alive = [i for i in range(len(self._devices))
+                 if i not in self._lost_devices] \
+            or list(range(len(self._devices)))
+        loads = {i: 0 for i in alive}
+        for w in self._workers:
+            if w.device_ix in loads:
+                loads[w.device_ix] += 1
+        return min(alive, key=lambda i: (loads[i], i))
 
     def serve_forever(self) -> None:
         while not self._stop.wait(0.5):
@@ -465,16 +591,16 @@ class CheckerService:
         for t in self._threads:
             t.join(timeout)
         # The scheduler flushed every bin before exiting; the
-        # sentinels queue BEHIND them, so the workers drain all
-        # pending work. One sentinel per live worker thread —
-        # including abandoned-but-alive ones, which also consume one.
-        live = [w.thread for w in self._workers
-                if w.thread is not None] \
-            + [t for t in self._abandoned if t.is_alive()]
-        for _ in live:
-            self._work.put(None)
-        for t in live:
-            t.join(timeout)
+        # sentinels queue BEHIND them on each slot queue, so the
+        # workers drain all pending work. Abandoned-but-alive threads
+        # never consume from a queue again (they exit on the
+        # ``abandoned`` flag at their loop top), so one sentinel per
+        # slot suffices.
+        for w in self._workers:
+            w.q.put(None)
+        for w in self._workers:
+            if w.thread is not None:
+                w.thread.join(timeout)
         with self._conns_lock:
             conns = list(self._conns)
         for c in conns:
@@ -517,8 +643,8 @@ class CheckerService:
                 pass
         # Unblock worker threads so test processes don't accumulate
         # them (each drops its work at the crashed check in its loop).
-        for _ in range(len(self._workers) + len(self._abandoned)):
-            self._work.put(None)
+        for w in self._workers:
+            w.q.put(None)
         self._stopped.set()
 
     # --- journal replay -----------------------------------------------------
@@ -649,6 +775,8 @@ class CheckerService:
                     break
                 elif mtype == "check":
                     self._admit(msg, respond)
+                elif mtype == "result-fetch":
+                    self._result_fetch(msg, respond)
                 elif mtype == "txn-check":
                     self._admit_txn(msg, respond)
                 elif mtype == "stream-open":
@@ -734,6 +862,39 @@ class CheckerService:
                                 **{k: list(v) if isinstance(v, tuple)
                                    else v for k, v in kw.items()}})
 
+    def _result_fetch(self, msg: dict, respond: Callable) -> None:
+        """Journal-aware reconnect: serve the SETTLED verdict for a
+        request fingerprint, or an honest structured not-found — a
+        client whose submit went indeterminate reads its durable
+        answer back without re-deciding (re-submitting would decide
+        the history twice). Never a guess: an unsettled or unknown
+        fingerprint answers an error naming its status."""
+        rid = msg.get("id")
+        fp = msg.get("fp")
+        self._bump("result_fetches")
+        if self._journal is None:
+            respond({"type": "error", "id": rid, "status": "unknown",
+                     "error": "result-fetch: this daemon runs without "
+                              "a journal"})
+            return
+        if not isinstance(fp, str) or not fp:
+            respond({"type": "error", "id": rid, "status": "unknown",
+                     "error": "result-fetch: missing fp"})
+            return
+        status, rec = self._journal.result_for(fp)
+        if status == "settled":
+            self._bump("result_fetch_hits")
+            respond({"type": "verdict", "id": rid, "fp": fp,
+                     "fetched": True,
+                     "result": protocol.jsonable(
+                         (rec or {}).get("result") or {})})
+            return
+        respond({"type": "error", "id": rid, "fp": fp,
+                 "status": status,
+                 "error": ("result-fetch: admitted but not yet "
+                           "settled — retry" if status == "pending"
+                           else "result-fetch: unknown fingerprint")})
+
     def _enqueue_admitted(self, req: Request, rid, respond: Callable,
                           journal_kind: str, payload: dict) -> None:
         with self._stats_lock:
@@ -816,7 +977,8 @@ class CheckerService:
                 sid, msg.get("model"),
                 StreamChecker(model, min_rows=1,
                               checkpoint=self._stream_ckpt_path(sid),
-                              view_name=f"stream-{sid}"), sock)
+                              view_name=f"stream-{sid}",
+                              defer=self.stream_bins), sock)
             self._streams[sid] = sess
         if jrec is not None:
             # Re-feed the journaled appends on the worker (the
@@ -827,6 +989,11 @@ class CheckerService:
                 for ops in jrec["appends"]:
                     last = sess.checker.append(
                         protocol.history_from_wire(ops))
+                if sess.checker.defer:
+                    # Deferred appends only settle; bring the check
+                    # current so the re-adopt reply carries the same
+                    # state a non-deferred session would report.
+                    last = sess.checker.drive()
                 return last
             outcome, r = self._stream_run(sess, refeed)
             if outcome != "ok":
@@ -897,7 +1064,10 @@ class CheckerService:
             finally:
                 done.set()
 
-        self._work.put(("stream", job))
+        # Route by the session's stream-bin key: every job for one
+        # model family lands on one device (its compiled programs
+        # live there), via the same placement policy as check bins.
+        self._dispatch(stream_bin(sess.model_name), ("stream", job))
         if not done.wait(self.deadline_s):
             # The job still runs (the worker serializes this session's
             # work), only this REPLY gives up — same currency as the
@@ -927,6 +1097,34 @@ class CheckerService:
         # checkpoint makes a re-fed settled prefix cheap).
         self._journal_stream("stream-append", sess.sid,
                              ops=msg.get("ops") or [])
+        if sess.checker.defer and not self._stop.is_set():
+            # svc-stream bins: feed + settle host-side NOW (handler
+            # thread — host packing parallelizes across connections),
+            # then route the pending increment through the scheduler's
+            # bins so concurrent sessions sharing a shape batch into
+            # one vmapped program on the worker pool.
+            try:
+                with sess.lock:
+                    sess.checker.append(ops)
+            except Exception as e:  # noqa: BLE001 - reported on wire
+                respond({"type": "error", "session": sess.sid,
+                         "error": f"stream session error: {e!r}"})
+                return
+            item = _StreamIncr(sess=sess,
+                               bin=stream_bin(sess.model_name))
+            self._queue.put(item)
+            if not item.done.wait(self.deadline_s):
+                respond({"type": "error", "session": sess.sid,
+                         "error": f"stream increment exceeded the "
+                                  f"{self.deadline_s:.0f}s deadline"})
+                return
+            if item.error is not None:
+                respond({"type": "error", "session": sess.sid,
+                         "error": item.error})
+                return
+            respond({"type": "stream-state", "session": sess.sid,
+                     **protocol.jsonable(item.reply or {})})
+            return
         outcome, r = self._stream_run(sess,
                                       lambda: sess.checker.append(ops))
         if outcome != "ok":
@@ -984,14 +1182,14 @@ class CheckerService:
                     self._bins.setdefault(req.bin, []).append(req)
                 oldest.setdefault(req.bin, time.monotonic())
             now = time.monotonic()
-            flush: list[list[Request]] = []
+            flush: list[tuple[str, list[Request]]] = []
             with self._bins_lock:
                 for key, reqs in list(self._bins.items()):
                     if not reqs:
                         continue
                     if len(reqs) >= self.max_batch or stopping or \
                             now - oldest.get(key, now) >= self.flush_s:
-                        flush.append(reqs[:self.max_batch])
+                        flush.append((key, reqs[:self.max_batch]))
                         rest = reqs[self.max_batch:]
                         if rest:
                             self._bins[key] = rest
@@ -999,8 +1197,8 @@ class CheckerService:
                         else:
                             del self._bins[key]
                             oldest.pop(key, None)
-            for batch in flush:
-                self._work.put(batch)
+            for key, batch in flush:
+                self._dispatch(key, batch)
             if not stopping:
                 self._supervise_workers()
             self._write_stats_snapshot()
@@ -1011,15 +1209,31 @@ class CheckerService:
         # workers, THEN the sentinels (stop() enqueues them after
         # joining this thread).
         with self._bins_lock:
-            for reqs in self._bins.values():
+            for key, reqs in self._bins.items():
                 if reqs:
-                    self._work.put(list(reqs))
+                    self._dispatch(key, list(reqs))
             self._bins.clear()
         while True:
             try:
-                self._work.put([self._queue.get_nowait()])
+                req = self._queue.get_nowait()
             except queue.Empty:
                 break
+            self._dispatch(req.bin, [req])
+
+    def _dispatch(self, key: str, item) -> None:
+        """Route one flushed work item to a worker slot via the
+        placement policy (bin -> device affinity, least-loaded
+        spill). Trivial at workers=1: the single slot takes
+        everything and the policy is never load-consulted."""
+        if len(self._workers) <= 1:
+            self._workers[0].q.put(item)
+            return
+        depths = [w.q.qsize() + (1 if w.busy is not None else 0)
+                  for w in self._workers]
+        slot, route = self._placement.place(key, depths)
+        if route == "spill":
+            self._bump("placement_spills")
+        self._workers[slot].q.put(item)
 
     # --- worker pool --------------------------------------------------------
 
@@ -1040,11 +1254,40 @@ class CheckerService:
         with self._kill_lock:
             self._kill_armed += n
 
+    def _consume_device_loss(self) -> bool:
+        """The device-loss chaos hook (``inject_device_loss()`` /
+        ``JEPSEN_TPU_SERVICE_DEVLOSS``): True means THIS worker's
+        device just died — the pool must re-place its bins onto
+        surviving devices with zero lost or flipped verdicts."""
+        with self._kill_lock:
+            if self._devloss_armed > 0:
+                self._devloss_armed -= 1
+                return True
+            return False
+
+    def inject_device_loss(self, n: int = 1) -> None:
+        """Arm the chaos hook: the next ``n`` work items each lose
+        their worker's DEVICE (the worker dies with the item in hand,
+        its bin homes re-place onto survivors, and the respawn binds
+        to the least-loaded surviving device)."""
+        with self._kill_lock:
+            self._devloss_armed += n
+
+    def _note_device_loss(self, state: _WorkerState) -> None:
+        state.device_lost = True
+        if state.device_ix is not None:
+            self._lost_devices.add(state.device_ix)
+        re_homed = self._placement.forget_slot(state.slot)
+        self._bump("device_losses")
+        obs_metrics.REGISTRY.event(
+            "device-loss", worker=state.wid, slot=state.slot,
+            device=state.device_ix, re_homed=len(re_homed))
+
     def _worker_loop(self, state: _WorkerState) -> None:
         while True:
             if state.abandoned or self._crashed:
                 return
-            batch = self._work.get()
+            batch = state.q.get()
             if batch is None:
                 return
             # busy_since BEFORE busy: the supervisor reads (busy,
@@ -1065,16 +1308,27 @@ class CheckerService:
                 # the supervisor must detect, requeue once, respawn.
                 self._bump("worker_kills")
                 return
+            if not self._stop.is_set() and self._consume_device_loss():
+                # Simulated DEVICE loss (chip gone): mark the device
+                # dead, forget this slot's bin homes so they re-place
+                # onto survivors, then die with the batch in hand —
+                # the proven death/requeue/respawn path carries the
+                # work, and the respawn rebinds off the lost device.
+                self._note_device_loss(state)
+                return
+            t_item = time.monotonic()
+            c0 = _compile_meter_snapshot().get("xla_compiles", 0)
             try:
-                if isinstance(batch, tuple) and batch and \
-                        batch[0] == "stream":
-                    # Stream-session job (already exception-proofed by
-                    # _stream_run's wrapper): runs on a worker thread
-                    # so increments serialize with batches on the
-                    # device, never race them.
-                    batch[1]()
-                    continue
-                self._process_batch(batch)
+                if state.device is not None:
+                    import jax
+
+                    # Thread-local device binding: every dispatch this
+                    # item runs lands on this worker's device, which
+                    # is the cache the placement policy is placing.
+                    with jax.default_device(state.device):
+                        self._run_item(batch)
+                else:
+                    self._run_item(batch)
             except Exception:  # noqa: BLE001 - the daemon must survive
                 self._bump("worker_errors")
                 import traceback
@@ -1084,15 +1338,36 @@ class CheckerService:
                 # answered connection would desync its synchronous
                 # client (an unsolicited frame becomes the next
                 # submit's "verdict").
-                for req in batch:
-                    if not req.done:
-                        self._finish(req, {
-                            "valid?": "unknown",
-                            "error": "service worker error: "
-                                     + traceback.format_exc(limit=3)},
-                            batch_n=len(batch), t0=time.monotonic())
+                if isinstance(batch, list):
+                    for req in batch:
+                        if isinstance(req, Request) and not req.done:
+                            self._finish(req, {
+                                "valid?": "unknown",
+                                "error": "service worker error: "
+                                         + traceback.format_exc(
+                                             limit=3)},
+                                batch_n=len(batch),
+                                t0=time.monotonic())
             finally:
                 state.busy = None
+                state.items += 1
+                state.busy_s += time.monotonic() - t_item
+                state.compiles += max(
+                    0, _compile_meter_snapshot().get(
+                        "xla_compiles", 0) - c0)
+
+    def _run_item(self, batch) -> None:
+        if isinstance(batch, tuple) and batch and batch[0] == "stream":
+            # Stream-session job (already exception-proofed by
+            # _stream_run's wrapper): runs on a worker thread so
+            # increments serialize with batches on the device, never
+            # race them.
+            batch[1]()
+            return
+        if batch and isinstance(batch[0], _StreamIncr):
+            self._process_stream_batch(batch)
+            return
+        self._process_batch(batch)
 
     def _touch_worker(self) -> None:
         """Refresh the calling worker's progress clock. The wedge
@@ -1134,11 +1409,12 @@ class CheckerService:
             if wedged and st.thread is not None:
                 self._abandoned.append(st.thread)
             if batch is not None:
-                self._requeue_worker_batch(batch, kind)
+                self._requeue_worker_batch(batch, kind, st.q)
             self._bump("worker_respawns")
-            self._workers[i] = self._spawn_worker()
+            self._workers[i] = self._spawn_worker(st.slot, inherit=st)
 
-    def _requeue_worker_batch(self, batch, kind: str) -> None:
+    def _requeue_worker_batch(self, batch, kind: str,
+                              q: queue.Queue) -> None:
         from jepsen_tpu.lin import supervise
 
         if isinstance(batch, tuple) and batch and batch[0] == "stream":
@@ -1150,12 +1426,29 @@ class CheckerService:
                 self._bump("stream_drops")
                 return
             # A DEAD worker never started the job (jobs are
-            # exception-proofed; only the kill hook — which fires
-            # BEFORE the job runs — kills a worker): re-put it, and
-            # the waiting connection handler picks up the late result
-            # within its deadline.
-            self._work.put(batch)
+            # exception-proofed; only the kill hooks — which fire
+            # BEFORE the job runs — kill a worker): re-put it on the
+            # slot queue (the respawn inherits it), and the waiting
+            # connection handler picks up the late result within its
+            # deadline.
+            q.put(batch)
             self._bump("stream_requeues")
+            return
+        if batch and isinstance(batch[0], _StreamIncr):
+            live = [it for it in batch if not it.done.is_set()]
+            if kind == "wedge":
+                # Like the solo stream wedge: the batch may still be
+                # running on the abandoned thread (holding session
+                # locks) — re-putting would wedge the replacement.
+                # The handlers answer their own deadlines.
+                self._bump("stream_drops", len(live))
+                return
+            if live:
+                # Dead worker: unanswered items re-run on the
+                # replacement (increment_job recomputes from session
+                # state, so a re-run never double-commits).
+                q.put(live)
+                self._bump("stream_requeues", len(live))
             return
         supervise.record_fault(batch[0].bin,
                                "wedge" if kind == "wedge" else "fault",
@@ -1268,6 +1561,98 @@ class CheckerService:
             if isinstance(k, str) and k.startswith("__svc_pad_"):
                 del res[k]
         return res
+
+    def _process_stream_batch(self, items: list) -> None:
+        """One flushed svc-stream bin: collect the member sessions'
+        pending increments and decide them as ONE vmapped
+        carried-frontier program (``lin.batched.try_stream_batch``),
+        committing each clean lane; a declined/dead lane (or a
+        wedged/faulted batch program) falls back to the session's solo
+        supervised path (``drive()``) from the SAME uncommitted
+        frontier — identical verdicts, full witness machinery. Every
+        item answers its blocked connection handler via ``done``."""
+        from jepsen_tpu.lin import batched, supervise
+
+        t0 = time.monotonic()
+        pending = [it for it in items if not it.done.is_set()]
+        # One global lock order (sorted sid) across the batch: solo
+        # stream jobs take single session locks, so ordered multi-lock
+        # acquisition here cannot deadlock against them.
+        pending.sort(key=lambda it: it.sess.sid)
+        locked: list = []
+        try:
+            for it in pending:
+                it.sess.lock.acquire()
+                locked.append(it.sess.lock)
+            jobs, carriers = [], []
+            for it in pending:
+                job = it.sess.checker.increment_job()
+                if job is not None:
+                    jobs.append(job)
+                    carriers.append(it)
+            if len(jobs) >= 2:
+                self._touch_worker()
+                scale = self.deadline_s / max(
+                    supervise.base_deadline_s(), 1e-6)
+                fn = self._stream_batch_fn or batched.try_stream_batch
+                outcome, res = supervise.run_guarded(
+                    "service-stream", pending[0].bin,
+                    lambda: fn(jobs), scale=scale,
+                    stats=self._supervise_stats())
+                dt = time.monotonic() - t0
+                if outcome == "ok" and isinstance(res, list) \
+                        and len(res) == len(jobs):
+                    lanes = 0
+                    for it, job, r in zip(carriers, jobs, res):
+                        if isinstance(r, dict):
+                            lanes += 1
+                            it.sess.checker.commit_increment(
+                                r, row0=job["row0"],
+                                dt=dt / len(jobs))
+                        else:
+                            if isinstance(r, batched.Decline):
+                                with self._stats_lock:
+                                    util.stat_bump(
+                                        self._stats["decline_axes"],
+                                        r.axis)
+                            it.sess.checker.drive()
+                    if lanes:
+                        with self._stats_lock:
+                            util.stat_bump(self._stats,
+                                           "stream_batches")
+                            util.stat_bump(self._stats,
+                                           "stream_batched_increments",
+                                           lanes)
+                            self._stats["stream_batch_max_occupancy"] \
+                                = max(self._stats.get(
+                                    "stream_batch_max_occupancy", 0),
+                                    lanes)
+                else:
+                    # Wedge/fault on the shared program: each session
+                    # falls back solo (its own supervised ladder) —
+                    # the batch program never poisons a session.
+                    self._bump("stream_batch_fallbacks")
+                    for it in carriers:
+                        it.sess.checker.drive()
+            elif len(jobs) == 1:
+                self._bump("stream_solo_increments")
+                carriers[0].sess.checker.drive()
+            for it in pending:
+                out = it.sess.checker.status()
+                v = it.sess.checker.verdict
+                if v is not None:
+                    out["result"] = v
+                it.reply = out
+        except Exception as e:  # noqa: BLE001 - answer, never strand
+            self._bump("stream_batch_errors")
+            for it in pending:
+                if it.reply is None:
+                    it.error = f"stream session error: {e!r}"
+        finally:
+            for lk in locked:
+                lk.release()
+            for it in pending:
+                it.done.set()
 
     def _check_single(self, req: Request) -> None:
         from jepsen_tpu.lin import supervise

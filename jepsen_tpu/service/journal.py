@@ -90,6 +90,7 @@ class Journal:
         self._frozen = False    # crash(): drop writes, never reopen
         self.replays = 0        # entries re-decided by a restart
         self._streams: dict[str, dict] = {}     # sid -> session record
+        self._results: dict[str, dict] = {}     # fp -> latest settle
         self._recover()
 
     # --- load / recovery ----------------------------------------------------
@@ -109,6 +110,13 @@ class Journal:
         elif kind == "settle":
             if self._unsettled.pop(rec.get("of", seq), None) is not None:
                 self._settled += 1
+            fp = rec.get("fp")
+            if fp:
+                # Latest settle wins (a replayed request re-settles
+                # the same fp): the record result-fetch serves.
+                self._results[fp] = {"of": rec.get("of", seq),
+                                     "verdict": rec.get("verdict"),
+                                     "result": rec.get("result")}
         elif kind == "stream-open":
             self._streams[rec["sid"]] = {"model": rec.get("model"),
                                          "appends": [], "closed": False,
@@ -218,6 +226,22 @@ class Journal:
         with self._lock:
             return [self._unsettled[k]
                     for k in sorted(self._unsettled)]
+
+    def result_for(self, fp: str) -> tuple[str, dict | None]:
+        """The journal-aware reconnect lookup (``result-fetch``):
+        ``("settled", record)`` when a settle for this fingerprint
+        exists; ``("pending", None)`` when it was admitted but not yet
+        settled; ``("unknown", None)`` when never admitted (or the
+        record was compacted away) — the settled record or an honest
+        not-found, never a guess."""
+        with self._lock:
+            rec = self._results.get(fp)
+            if rec is not None:
+                return "settled", dict(rec)
+            if any(r.get("fp") == fp
+                   for r in self._unsettled.values()):
+                return "pending", None
+            return "unknown", None
 
     def stream_sessions(self, open_only: bool = True) -> dict[str, dict]:
         """Journaled stream sessions (``sid -> {model, appends,

@@ -1,0 +1,102 @@
+"""Fleet placement policy: bin -> device affinity with least-loaded spill.
+
+The thing being placed is a COMPILED PROGRAM FAMILY, not a work item:
+a shape bin's vmapped program compiles once per (device, occupancy
+bucket), so the placement that matters is keeping a bin's dispatches
+on the device that already holds its XLA executables. The policy is
+therefore sticky-first:
+
+- A bin key's FIRST placement homes it on the least-loaded worker
+  slot (queue depth + busy flag at placement time), and the key
+  sticks to that slot — zero re-compile on the steady state.
+- When the home slot's depth exceeds the spill knob
+  (``JEPSEN_TPU_SERVICE_SPILL_DEPTH``) AND some other slot is
+  strictly less deep, the ONE flush spills to the least-loaded slot
+  (latency beats cache warmth past the knob). The home assignment is
+  unchanged — the next uncongested flush goes home again.
+- ``forget_slot`` (device loss) drops every home on the lost slot, so
+  each affected bin re-homes by least-loaded on its next flush — the
+  re-place-onto-survivors semantics the chaos leg asserts.
+
+Slots are POSITIONS in the worker pool, not thread identities: a
+respawned worker inherits its predecessor's slot, queue, and device,
+so homes survive worker deaths (the respawn keeps the device and its
+compile cache; only device LOSS re-homes).
+
+Pure host-side bookkeeping — no jax imports, safe at workers=1
+(where the daemon never consults it beyond the trivial one-slot
+answer).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from jepsen_tpu import util
+
+
+def spill_depth() -> int:
+    """Home-queue depth past which a flush spills to the least-loaded
+    slot (``JEPSEN_TPU_SERVICE_SPILL_DEPTH``). Depth counts queued
+    items plus the in-hand one; negative disables spilling (pure
+    affinity)."""
+    return util.env_int("JEPSEN_TPU_SERVICE_SPILL_DEPTH", 4)
+
+
+class Placement:
+    """Bin-key -> worker-slot affinity map with least-loaded spill."""
+
+    def __init__(self, n_slots: int,
+                 spill_depth_: int | None = None):
+        self.n_slots = max(1, n_slots)
+        self.spill_depth = spill_depth_ if spill_depth_ is not None \
+            else spill_depth()
+        self.home: dict[str, int] = {}
+        self.placed = 0      # placements answered
+        self.homed = 0       # ... that went to the home slot
+        self.spills = 0      # ... that spilled off a congested home
+        self.re_homes = 0    # home entries dropped by forget_slot
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _least_loaded(depths: list[int]) -> int:
+        return min(range(len(depths)), key=lambda i: (depths[i], i))
+
+    def place(self, key: str, depths: list[int]) -> tuple[int, str]:
+        """Pick the slot for one flush of ``key`` given current
+        per-slot depths. Returns ``(slot, route)`` with route one of
+        ``"new"`` (first placement, homes here), ``"home"``, or
+        ``"spill"`` (home congested; home assignment unchanged)."""
+        with self._lock:
+            self.placed += 1
+            h = self.home.get(key)
+            if h is None or h >= len(depths):
+                h = self._least_loaded(depths)
+                self.home[key] = h
+                return h, "new"
+            if 0 <= self.spill_depth < depths[h]:
+                alt = self._least_loaded(depths)
+                if depths[alt] < depths[h]:
+                    self.spills += 1
+                    return alt, "spill"
+            self.homed += 1
+            return h, "home"
+
+    def forget_slot(self, slot: int) -> list[str]:
+        """Drop every home on ``slot`` (device loss): the affected
+        keys re-home by least-loaded on their next placement."""
+        with self._lock:
+            keys = [k for k, s in self.home.items() if s == slot]
+            for k in keys:
+                del self.home[k]
+            self.re_homes += len(keys)
+            return keys
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"homes": dict(self.home),
+                    "placed": self.placed,
+                    "homed": self.homed,
+                    "spills": self.spills,
+                    "re_homes": self.re_homes,
+                    "spill_depth": self.spill_depth}

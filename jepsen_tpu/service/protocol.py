@@ -21,6 +21,15 @@ Messages (all dicts with a ``"type"`` key):
   The txn twin of ``check`` (v2): a list-append transaction history
   decided by ``checker.txn_cycles`` under the daemon's supervised
   per-request fallthrough (txn requests never bin).
+- ``{"type": "result-fetch", "id": I, "fp": FINGERPRINT}`` → a
+  ``verdict`` frame with ``"fetched": true`` when the journal holds a
+  SETTLED record for that request fingerprint, else a structured
+  ``error`` with ``"status": "pending" | "unknown"`` — the journal-
+  aware reconnect path: a client whose submit completed indeterminate
+  recomputes its fingerprint (:func:`request_fingerprint`) and reads
+  the durably settled verdict back after reconnecting. The fetch
+  returns the settled record or an honest not-found, NEVER a guess
+  (the ``:info`` contract, doc/service.md § Failure semantics).
 - ``{"type": "ping"}`` → ``{"type": "pong"}``
 - ``{"type": "stats"}`` → ``{"type": "stats", "stats": {...}}``
 - ``{"type": "shutdown"}`` → ``{"type": "ok"}`` then the daemon stops
@@ -140,6 +149,22 @@ def read_msg(io: SocketIO) -> dict:
     return codec.decode(io.read_exact(n))
 
 
+def request_fingerprint(model_name: str, history) -> str | None:
+    """The daemon's fingerprint for a check request, computed
+    CLIENT-side — the key ``result-fetch`` looks up. Must match the
+    admission path bit for bit: ``prepare.prepare`` then
+    ``supervise.history_fingerprint`` over the packed tables. Returns
+    None for an unpackable history (the daemon fingerprints those
+    randomly per-request, so their settles are honestly unfetchable)."""
+    from jepsen_tpu.lin import prepare, supervise
+
+    try:
+        packed = prepare.prepare(model_by_name(model_name), history)
+    except prepare.UnsupportedHistory:
+        return None
+    return supervise.history_fingerprint(packed)
+
+
 def history_to_wire(history) -> list[dict]:
     return [op.to_dict() if isinstance(op, Op) else dict(op)
             for op in history]
@@ -228,6 +253,47 @@ class CheckerClient:
         out = dict(resp.get("result") or {})
         if resp.get("timings"):
             out["_timings"] = resp["timings"]
+        return out
+
+    def result_fetch(self, model_name: str | None = None,
+                     history=None, *, fp: str | None = None,
+                     req_id=None) -> dict:
+        """Read a SETTLED verdict back from the daemon's journal by
+        request fingerprint — the reconnect path for a submit that
+        completed indeterminate (the check may have been decided and
+        the reply lost). Pass the original ``model_name``/``history``
+        (the fingerprint is recomputed exactly as admission computed
+        it) or an explicit ``fp``. Returns the settled result dict, or
+        an honest ``{"valid?": "unknown", "fetch_status": "pending" |
+        "unknown" | ...}`` — never a guess."""
+        if fp is None:
+            if model_name is None or history is None:
+                raise ValueError(
+                    "result_fetch needs (model_name, history) or fp=")
+            fp = request_fingerprint(model_name, history)
+            if fp is None:
+                return {"valid?": "unknown",
+                        "fetch_status": "unfetchable",
+                        "error": "unpackable history: the daemon "
+                                 "fingerprints these per-request, so "
+                                 "their settles cannot be fetched"}
+        self._next_id += 1
+        rid = req_id if req_id is not None else self._next_id
+        try:
+            resp = self._rpc({"type": "result-fetch", "id": rid,
+                              "fp": fp})
+            while resp.get("type") == "verdict" \
+                    and resp.get("id") != rid:
+                resp = read_msg(self.io)
+        except WireIndeterminate as e:
+            return {"valid?": "unknown", "fetch_status": "wire",
+                    "error": f"indeterminate: {e}"}
+        if resp.get("type") == "error":
+            return {"valid?": "unknown",
+                    "fetch_status": resp.get("status", "unknown"),
+                    "error": resp.get("error", "daemon error")}
+        out = dict(resp.get("result") or {})
+        out["fetched"] = True
         return out
 
     # --- stream-check sessions (doc/streaming.md) -----------------------

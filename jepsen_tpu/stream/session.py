@@ -88,11 +88,17 @@ class StreamChecker:
                  checkpoint: str | None = None, explain: bool = True,
                  check_kw: dict | None = None,
                  on_abort: Callable[[dict], None] | None = None,
-                 view_name: str = "stream"):
+                 view_name: str = "stream", defer: bool = False):
         self.model = model
         self.packer = IncrementalPacker(model)
         self.min_rows = min_rows if min_rows is not None \
             else default_min_rows()
+        # defer=True (the daemon's svc-stream bins): append() settles
+        # but never dispatches increments — the owner collects
+        # increment_job()s across sessions, batches them into one
+        # vmapped program, and commits each lane via
+        # commit_increment() (or falls back per-session via drive()).
+        self.defer = defer
         self.explain = explain
         self.check_kw = dict(check_kw or {})
         self.on_abort = on_abort
@@ -224,12 +230,58 @@ class StreamChecker:
             return
         if not self._maybe_resume(final):
             return   # resume decision pending: settle only, check later
+        if self.defer and not final:
+            return   # deferred: the owner batches/drives increments
+        self._run_increments(final)
+        obs_metrics.REGISTRY.write_snapshot()
+
+    def _run_increments(self, final: bool) -> None:
         while self._verdict is None and self._degraded is None:
             todo = self.packer.R - self._row
             if todo <= 0 or (not final and todo < self.min_rows):
                 break
             self._increment()
-        obs_metrics.REGISTRY.write_snapshot()
+
+    def increment_job(self) -> dict | None:
+        """The pending increment as DATA (deferred sessions): packed
+        tables, start row, carried frontier — what
+        ``lin.batched.try_stream_batch`` needs to run this session's
+        increment as one lane of a shared vmapped program. None when
+        nothing is pending (or the session cannot increment). The
+        session state is NOT advanced — the caller commits the lane's
+        result via :meth:`commit_increment`, or runs :meth:`drive`."""
+        if self._final is not None or self._verdict is not None \
+                or self._degraded is not None \
+                or not self.packer.incremental:
+            return None
+        if not self._maybe_resume(False):
+            return None
+        todo = self.packer.R - self._row
+        if todo <= 0 or todo < self.min_rows:
+            return None
+        p = self.packer.packed()
+        if p.kernel is None:
+            self._degrade("no device kernel")
+            return None
+        return {"packed": p, "row0": self._row,
+                "rows": p.R - self._row,
+                "frontier": self._frontier_arg(), "checker": self}
+
+    def drive(self) -> dict:
+        """Run any pending increments NOW on the calling thread (the
+        deferred session's solo path: single-session flushes and
+        batch-declined lanes fall back here — same supervised
+        ``stream-incr`` dispatch as a non-deferred session). Returns
+        :meth:`status` (plus the latched verdict under ``result``)."""
+        if self._final is None and self.packer.incremental \
+                and self._verdict is None and self._degraded is None \
+                and self._maybe_resume(False):
+            self._run_increments(False)
+            obs_metrics.REGISTRY.write_snapshot()
+        out = self.status()
+        if self._verdict is not None:
+            out["result"] = self._verdict
+        return out
 
     def _increment(self) -> None:
         from jepsen_tpu import lin
@@ -269,7 +321,16 @@ class StreamChecker:
                 self._degrade(f"increment {outcome} at row {row0}: {r}")
                 return
             sp.note(verdict=str(r.get("valid?")))
-        dt = time.monotonic() - t0
+        self.commit_increment(r, row0=row0,
+                              dt=time.monotonic() - t0)
+
+    def commit_increment(self, r: dict, *, row0: int,
+                         dt: float) -> None:
+        """Adopt one increment result — from the solo dispatch above
+        or from one LANE of a shared vmapped stream-batch program
+        (the daemon's svc-stream bins). Latches the early-abort
+        verdict, degrades on undecided, else carries the committed
+        frontier forward and checkpoints."""
         self.stats["increments"] += 1
         self.stats["increment_s"] = round(
             self.stats["increment_s"] + dt, 4)

@@ -1,0 +1,470 @@
+"""Fleet placement engine tests (doc/service.md § Placement).
+
+Four layers, mirroring the tentpole's pipeline:
+
+- Policy unit: the pure host-side bin->slot policy (service.placement)
+  with fabricated queue depths — homing, stickiness, bounded spill,
+  device-loss re-homing — no daemon, no jax.
+- Daemon routing: a 2-worker daemon with stub check fns — bins home to
+  one slot (affinity visible in the placement stats block), workers=1
+  never consults the policy (driver-shape bit-compat), injected device
+  loss re-homes with zero lost or flipped verdicts.
+- svc-stream bins: K concurrent wire sessions' pending increments
+  decide through ONE vmapped carried-frontier program (occupancy > 1
+  asserted) with verdicts identical to the solo path and the CPU
+  oracle; a declined batch falls back per-session with no verdict
+  change.
+- result-fetch: the journal-backed reconnect frame returns the settled
+  record by request fingerprint, or an HONEST pending/unknown — never
+  a guess.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+# Engine modules imported at COLLECTION time: bfs/dense build tiny
+# module-level jnp constants whose one-off compiles must land outside
+# the quick tier's per-test no-compile window (tests/conftest.py).
+import jepsen_tpu.lin.batched   # noqa: F401
+import jepsen_tpu.lin.dense     # noqa: F401
+
+pytestmark = pytest.mark.quick
+
+
+def _mk_service(tmp_path, monkeypatch, **kw):
+    from jepsen_tpu.service.daemon import CheckerService
+
+    monkeypatch.setenv("JEPSEN_TPU_QUARANTINE",
+                       str(tmp_path / "quarantine.json"))
+    kw.setdefault("stats_file", str(tmp_path / "service_stats.json"))
+    kw.setdefault("flush_ms_", 10)
+    return CheckerService("127.0.0.1", 0, **kw)
+
+
+def _stub_check(packed, model, history):
+    return {"valid?": True, "analyzer": "stub-single"}
+
+
+def _stub_batch(model, subs, declines=None):
+    return {rid: {"valid?": True, "analyzer": "stub-batch"}
+            for rid in subs}
+
+
+def _hist(n=20, concurrency=3, seed=0, **kw):
+    from jepsen_tpu.lin import synth
+
+    return synth.generate_register_history(
+        n, concurrency=concurrency, seed=seed, value_range=3, **kw)
+
+
+class TestPlacementPolicy:
+    def _mk(self, n=4, spill=4):
+        from jepsen_tpu.service.placement import Placement
+
+        return Placement(n, spill_depth_=spill)
+
+    def test_new_key_homes_least_loaded(self):
+        p = self._mk()
+        slot, route = p.place("bin-a", [3, 1, 2, 5])
+        assert (slot, route) == (1, "new")
+        # Tie breaks toward the lowest slot (deterministic).
+        slot2, route2 = p.place("bin-b", [2, 2, 2, 2])
+        assert (slot2, route2) == (0, "new")
+
+    def test_home_is_sticky_under_load_changes(self):
+        p = self._mk()
+        home, _ = p.place("bin-a", [0, 0, 0, 0])
+        for depths in ([1, 0, 0, 0], [2, 0, 1, 0], [4, 1, 1, 1]):
+            slot, route = p.place("bin-a", depths)
+            assert (slot, route) == (home, "home")
+
+    def test_spill_leaves_home_and_is_bounded(self):
+        p = self._mk(spill=2)
+        home, _ = p.place("bin-a", [0, 9, 9, 9])
+        assert home == 0
+        # Home backed up past the spill depth AND a strictly
+        # less-loaded alternative exists -> spill there, home KEPT.
+        slot, route = p.place("bin-a", [5, 1, 3, 4])
+        assert route == "spill" and slot == 1
+        assert p.snapshot()["homes"]["bin-a"] == home
+        # Next placement with a drained home goes home again.
+        slot, route = p.place("bin-a", [0, 1, 3, 4])
+        assert (slot, route) == (home, "home")
+
+    def test_no_spill_without_strictly_better_slot(self):
+        p = self._mk(spill=2)
+        home, _ = p.place("bin-a", [0, 9, 9, 9])
+        # Everyone is at least as backed up: stay home (a spill that
+        # doesn't help only costs the device cache).
+        slot, route = p.place("bin-a", [6, 6, 7, 6])
+        assert (slot, route) == (home, "home")
+
+    def test_forget_slot_rehomes_on_next_placement(self):
+        p = self._mk()
+        p.place("bin-a", [0, 5, 5, 5])
+        p.place("bin-b", [0, 5, 5, 5])
+        p.place("bin-c", [5, 0, 5, 5])
+        dropped = p.forget_slot(0)
+        assert sorted(dropped) == ["bin-a", "bin-b"]
+        snap = p.snapshot()
+        assert snap["re_homes"] == 2
+        assert set(snap["homes"]) == {"bin-c"}
+        # The orphaned bin re-homes by current load, not history.
+        slot, route = p.place("bin-a", [9, 9, 1, 2])
+        assert (slot, route) == (2, "new")
+
+    def test_snapshot_counters(self):
+        p = self._mk(spill=0)
+        p.place("bin-a", [0, 0])
+        p.place("bin-a", [0, 0])
+        p.place("bin-a", [3, 1])          # spill (home 0 backed up)
+        snap = p.snapshot()
+        assert snap["placed"] == 3
+        assert snap["homed"] == 1
+        assert snap["spills"] == 1
+        assert snap["spill_depth"] == 0
+
+
+class TestDaemonPlacement:
+    def test_bins_home_and_stats_block(self, tmp_path, monkeypatch):
+        from jepsen_tpu.lin import synth
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        svc = _mk_service(tmp_path, monkeypatch, workers=2,
+                          check_fn=_stub_check,
+                          batch_fn=_stub_batch).start()
+        try:
+            c = CheckerClient("127.0.0.1", svc.port)
+            for seed in range(3):
+                assert c.submit("cas-register",
+                                _hist(seed=seed))["valid?"] is True
+                assert c.submit(
+                    "mutex", synth.generate_mutex_history(
+                        20, concurrency=3, seed=seed))["valid?"] is True
+            st = c.stats()
+            block = st["placement"]
+            homes = block["homes"]
+            assert any(k.startswith("svc-dense|")
+                       and k.endswith("cas-register") for k in homes)
+            assert any("mutex" in k for k in homes)
+            workers = block["workers"]
+            assert len(workers) == 2
+            assert {w["slot"] for w in workers} == {0, 1}
+            assert sum(w["items"] for w in workers) >= 2
+            for w in workers:
+                assert {"wid", "queue_depth", "busy", "busy_s",
+                        "compiles"} <= set(w)
+            c.close()
+        finally:
+            svc.stop()
+
+    def test_single_worker_never_consults_policy(self, tmp_path,
+                                                 monkeypatch):
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        svc = _mk_service(tmp_path, monkeypatch,
+                          check_fn=_stub_check,
+                          batch_fn=_stub_batch).start()
+        try:
+            c = CheckerClient("127.0.0.1", svc.port)
+            assert c.submit("cas-register", _hist())["valid?"] is True
+            block = c.stats()["placement"]
+            # The driver shape: slot 0 takes everything, the policy
+            # holds no homes, no device is ever bound.
+            assert block["homes"] == {}
+            assert block["placed"] == 0
+            assert block["workers"][0]["device"] is None
+            c.close()
+        finally:
+            svc.stop()
+
+    def test_device_loss_rehomes_without_losing_verdicts(
+            self, tmp_path, monkeypatch):
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        svc = _mk_service(tmp_path, monkeypatch, workers=2,
+                          check_fn=_stub_check,
+                          batch_fn=_stub_batch).start()
+        try:
+            c = CheckerClient("127.0.0.1", svc.port)
+            # Seed a home, then lose the next item's device.
+            assert c.submit("cas-register",
+                            _hist(seed=0))["valid?"] is True
+            svc.inject_device_loss(1)
+            # Every submit still settles: the dying worker's batch is
+            # requeued by the supervisor and re-placed on a survivor.
+            for seed in range(1, 5):
+                r = c.submit("cas-register", _hist(seed=seed))
+                assert r["valid?"] is True, r
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                st = c.stats()
+                if st.get("device_losses") and \
+                        st["workers"] == 2:
+                    break
+                time.sleep(0.05)
+            assert st["device_losses"] == 1
+            assert st.get("worker_respawns", 0) >= 1
+            assert st["workers"] == 2          # pool is whole again
+            # The loss is visible in the obs event feed.
+            from jepsen_tpu.obs import metrics as obs_metrics
+
+            snap = obs_metrics.REGISTRY.snapshot()
+            kinds = [e.get("kind") for e in snap.get("events", [])]
+            assert "device-loss" in kinds
+            c.close()
+        finally:
+            svc.stop()
+
+
+class TestStreamBins:
+    """K concurrent wire sessions batch their pending increments
+    through ONE vmapped carried-frontier program — the acceptance
+    shape: occupancy > 1, verdicts identical to solo and the CPU
+    oracle."""
+
+    K = 4
+
+    def _histories(self):
+        from jepsen_tpu.lin import synth
+
+        # One traced shape shared by every lane: identical op counts
+        # and concurrency; distinct seeds keep the search non-trivial
+        # per lane.
+        return [list(synth.generate_register_history(
+            200, concurrency=5, seed=20 + i, value_range=5))
+            for i in range(self.K)]
+
+    @pytest.mark.compiles
+    def test_concurrent_sessions_batch_with_parity(self, tmp_path,
+                                                   monkeypatch):
+        from jepsen_tpu import models as m
+        from jepsen_tpu.lin import cpu, prepare
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        monkeypatch.setenv("JEPSEN_TPU_STREAM_SESSIONS", str(self.K))
+        svc = _mk_service(tmp_path, monkeypatch,
+                          flush_ms_=60).start()
+        hists = self._histories()
+        oracle = [cpu.check_packed(prepare.prepare(
+            m.cas_register(), list(h)))["valid?"] for h in hists]
+        rounds = 4
+        barrier = threading.Barrier(self.K)
+        results: list = [None] * self.K
+        errors: list = []
+
+        def lane(i):
+            try:
+                c = CheckerClient("127.0.0.1", svc.port)
+                sid = c.stream_open("cas-register")
+                h = hists[i]
+                n = max(1, len(h) // rounds)
+                for j in range(0, len(h), n):
+                    # Co-arrive inside one flush window so the bin
+                    # really holds K pending increments.
+                    barrier.wait(timeout=30)
+                    st = c.stream_append(sid, h[j:j + n])
+                    assert st.get("type") == "stream-state", st
+                results[i] = c.stream_finalize(sid)
+                c.close()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                barrier.abort()
+
+        threads = [threading.Thread(target=lane, args=(i,))
+                   for i in range(self.K)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        for i, r in enumerate(results):
+            assert r is not None and r["valid?"] == oracle[i], (i, r)
+        st = svc.stats()
+        assert st.get("stream_batches", 0) >= 1, st
+        assert st.get("stream_batch_max_occupancy", 0) > 1, st
+        assert st.get("stream_batched_increments", 0) >= 2, st
+        svc.stop()
+
+    @pytest.mark.compiles
+    def test_declined_batch_falls_back_solo_same_verdict(
+            self, tmp_path, monkeypatch):
+        from jepsen_tpu import models as m
+        from jepsen_tpu.lin import batched, cpu, prepare
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        def decline_all(jobs):
+            return [batched.Decline("stub", "forced decline",
+                                    [i for i in range(len(jobs))])
+                    for _ in jobs]
+
+        monkeypatch.setenv("JEPSEN_TPU_STREAM_SESSIONS", "2")
+        svc = _mk_service(tmp_path, monkeypatch, flush_ms_=60,
+                          stream_batch_fn=decline_all).start()
+        hists = self._histories()[:2]
+        oracle = [cpu.check_packed(prepare.prepare(
+            m.cas_register(), list(h)))["valid?"] for h in hists]
+        barrier = threading.Barrier(2)
+        results: list = [None] * 2
+        errors: list = []
+
+        def lane(i):
+            try:
+                c = CheckerClient("127.0.0.1", svc.port)
+                sid = c.stream_open("cas-register")
+                h = hists[i]
+                n = max(1, len(h) // 2)
+                for j in range(0, len(h), n):
+                    barrier.wait(timeout=30)
+                    c.stream_append(sid, h[j:j + n])
+                results[i] = c.stream_finalize(sid)
+                c.close()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                barrier.abort()
+
+        threads = [threading.Thread(target=lane, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        for i, r in enumerate(results):
+            assert r is not None and r["valid?"] == oracle[i], (i, r)
+        st = svc.stats()
+        # The decline axis is visible; no batched lanes were counted.
+        assert st.get("decline_axes", {}).get("stub", 0) >= 1, st
+        assert st.get("stream_batches", 0) == 0, st
+        svc.stop()
+
+    @pytest.mark.compiles
+    def test_stream_bins_off_keeps_solo_path(self, tmp_path,
+                                             monkeypatch):
+        from jepsen_tpu import models as m
+        from jepsen_tpu.lin import cpu, prepare
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        monkeypatch.setenv("JEPSEN_TPU_SERVICE_STREAM_BINS", "0")
+        svc = _mk_service(tmp_path, monkeypatch).start()
+        try:
+            c = CheckerClient("127.0.0.1", svc.port)
+            h = self._histories()[0]
+            want = cpu.check_packed(prepare.prepare(
+                m.cas_register(), list(h)))["valid?"]
+            sid = c.stream_open("cas-register")
+            n = len(h) // 3
+            for j in range(0, len(h), n):
+                c.stream_append(sid, h[j:j + n])
+            assert c.stream_finalize(sid)["valid?"] == want
+            st = c.stats()
+            assert "stream_batches" not in st
+            c.close()
+        finally:
+            svc.stop()
+
+
+class TestResultFetch:
+    def test_settled_round_trip(self, tmp_path, monkeypatch):
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        svc = _mk_service(tmp_path, monkeypatch,
+                          journal=str(tmp_path / "j.jsonl"),
+                          check_fn=_stub_check,
+                          batch_fn=_stub_batch).start()
+        try:
+            c = CheckerClient("127.0.0.1", svc.port)
+            h = _hist(seed=7)
+            r = c.submit("cas-register", list(h))
+            assert r["valid?"] is True
+            # A "reconnecting" client re-asks by fingerprint.
+            f = c.result_fetch("cas-register", list(h))
+            assert f.get("fetched") is True
+            assert f["valid?"] == r["valid?"]
+            st = c.stats()
+            assert st.get("result_fetches", 0) >= 1
+            assert st.get("result_fetch_hits", 0) >= 1
+            c.close()
+        finally:
+            svc.stop()
+
+    def test_unknown_is_honest(self, tmp_path, monkeypatch):
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        svc = _mk_service(tmp_path, monkeypatch,
+                          journal=str(tmp_path / "j.jsonl"),
+                          check_fn=_stub_check,
+                          batch_fn=_stub_batch).start()
+        try:
+            c = CheckerClient("127.0.0.1", svc.port)
+            f = c.result_fetch("cas-register", _hist(seed=99))
+            assert f["valid?"] == "unknown"
+            assert f["fetch_status"] == "unknown"
+            assert f.get("fetched") is not True
+            c.close()
+        finally:
+            svc.stop()
+
+    def test_pending_is_honest_not_a_guess(self, tmp_path,
+                                           monkeypatch):
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        gate = threading.Event()
+
+        def gated_check(packed, model, history):
+            gate.wait(timeout=30)
+            return {"valid?": True, "analyzer": "stub-gated"}
+
+        svc = _mk_service(tmp_path, monkeypatch,
+                          journal=str(tmp_path / "j.jsonl"),
+                          check_fn=gated_check,
+                          batch_fn=None).start()
+        try:
+            h = _hist(seed=5)
+            done: list = []
+            c1 = CheckerClient("127.0.0.1", svc.port)
+
+            def submit():
+                done.append(c1.submit("cas-register", list(h)))
+
+            t = threading.Thread(target=submit)
+            t.start()
+            c2 = CheckerClient("127.0.0.1", svc.port)
+            deadline = time.time() + 10
+            f = None
+            while time.time() < deadline:
+                f = c2.result_fetch("cas-register", list(h))
+                if f.get("fetch_status") == "pending":
+                    break
+                time.sleep(0.05)
+            assert f and f["fetch_status"] == "pending", f
+            assert f["valid?"] == "unknown"
+            gate.set()
+            t.join(timeout=30)
+            assert done and done[0]["valid?"] is True
+            f2 = c2.result_fetch("cas-register", list(h))
+            assert f2.get("fetched") is True
+            c1.close()
+            c2.close()
+        finally:
+            gate.set()
+            svc.stop()
+
+    def test_no_journal_is_an_error(self, tmp_path, monkeypatch):
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        svc = _mk_service(tmp_path, monkeypatch,
+                          check_fn=_stub_check,
+                          batch_fn=_stub_batch).start()
+        try:
+            c = CheckerClient("127.0.0.1", svc.port)
+            f = c.result_fetch("cas-register", _hist())
+            assert f["valid?"] == "unknown"
+            assert f["fetch_status"] == "unknown"
+            c.close()
+        finally:
+            svc.stop()
