@@ -515,6 +515,44 @@ def _probe_service_c30():
     if st.get("journal_depth"):
         out["note_fleet"] = (f"journal depth {st['journal_depth']} "
                              f"after drain: requests LOST (bug)")
+
+    # Fleet scaling leg (ISSUE 19): the mixed-traffic workers=1 vs
+    # workers=8 bench runs in a CHILD on the 8-device CPU mesh — this
+    # process holds the chip and must keep holding it; the child
+    # forces the CPU platform itself (fleet_bench._force_cpu_mesh).
+    import subprocess as _sp
+    import sys as _sys
+
+    from jepsen_tpu.service.chaos import _force_cpu_env
+
+    try:
+        proc = _sp.run(
+            [_sys.executable, "-m", "jepsen_tpu.service.fleet_bench"],
+            capture_output=True, text=True, timeout=900,
+            env=_force_cpu_env())
+        line = (proc.stdout or "").strip().splitlines()
+        fleet_scaling = json.loads(line[-1]) if line else None
+        if fleet_scaling is not None:
+            # The artifact keeps the headline surface; the per-run
+            # detail lives in the child's own perf-ledger record.
+            out["fleet_scaling"] = {
+                k: fleet_scaling.get(k) for k in
+                ("ratio_8v1", "target_ratio", "capacity",
+                 "stream_batch_max_occupancy", "ok", "note")}
+            out["fleet_scaling"]["hps"] = {
+                w: (fleet_scaling.get("runs", {}).get(w) or {})
+                .get("histories_per_sec") for w in ("1", "8")}
+            out["fleet_scaling"]["occupancy_8"] = \
+                (fleet_scaling.get("runs", {}).get("8") or {}) \
+                .get("occupancy")
+            if not fleet_scaling.get("ok"):
+                out["verdict"] = "unknown"
+                out["note_scaling"] = "fleet bench gate failed"
+        else:
+            out["fleet_scaling"] = {
+                "error": f"no output (rc {proc.returncode})"}
+    except Exception as e:  # noqa: BLE001 - the probe's other legs
+        out["fleet_scaling"] = {"error": repr(e)}  # must still land
     return out
 
 

@@ -324,6 +324,36 @@ def service_stats_cmd() -> dict:
 
 
 @command
+def fleet_bench_cmd() -> dict:
+    """Run the fleet scaling bench (jepsen_tpu.service.fleet_bench):
+    the seeded mixed workload (checks + streams + txn) at workers=1
+    then workers=8 on the 8-device CPU mesh, with verdict parity and
+    the 8v1 throughput ratio in the JSON artifact."""
+
+    def build_parser(p: argparse.ArgumentParser):
+        pass
+
+    def run_cmd(opts: argparse.Namespace) -> int:
+        from jepsen_tpu.service import fleet_bench
+
+        return fleet_bench.main()
+
+    return {"name": "fleet-bench", "parser": build_parser,
+            "run": run_cmd,
+            "help": "fleet scaling bench: workers=1 vs workers=8 "
+                    "mixed traffic on the CPU mesh (chip-free)",
+            "description":
+                "Drives the same seeded mixed workload (many-bin "
+                "check requests, concurrent wire stream sessions, a "
+                "txn minority) through an in-process daemon at "
+                "workers=1 and workers=8, audits every verdict "
+                "against the CPU oracle, and prints histories/s, the "
+                "8v1 ratio, per-device occupancy, and stream batch "
+                "occupancy. Appends a service-fleet-bench perf-ledger "
+                "record. Chip-free: forces the CPU platform itself."}
+
+
+@command
 def journal_cmd() -> dict:
     """Manage the checker daemon's durable request journal
     (jepsen_tpu.service.journal, doc/service.md § Fleet): ``list``

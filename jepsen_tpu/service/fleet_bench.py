@@ -1,0 +1,337 @@
+"""Fleet scaling bench: workers=1 vs workers=8 on mixed daemon traffic.
+
+The tentpole's evidence leg (``cli.py fleet-bench``, folded into
+``bench.py service_c30``): ONE seeded mixed workload — check requests
+across many distinct shape bins, K concurrent wire stream sessions,
+and a txn-check minority — driven twice through an in-process daemon
+on the 8-device CPU mesh, once at ``workers=1`` (the driver shape) and
+once at ``workers=8`` (one worker per device). The artifact records:
+
+- ``histories_per_sec`` per run and the 8v1 ``ratio`` — the headline.
+- Per-device occupancy (each slot's ``busy_s / wall``) from the
+  placement stats block — proof the fleet actually spread.
+- Stream batch occupancy — proof concurrent sessions shared vmapped
+  carried-frontier programs (``stream_batch_max_occupancy > 1``).
+- Full verdict parity against the CPU oracle in BOTH runs (zero
+  flips; unknowns are honest failures and fail the gate).
+
+The ratio gate scales to the machine: a fleet of N workers can beat
+one worker only as far as real parallel capacity goes, so the target
+is the ISSUE's 3x when ``min(workers, devices, cores) >= 4`` and a
+no-regression bound otherwise (a 1-core sandbox cannot parallelize
+compute; the honest number still lands in the artifact and the perf
+ledger either way).
+
+Chip-free: forces the CPU platform BEFORE jax backend init (CLAUDE.md)
+— never takes the chip, safe to run next to a TPU process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+
+def _force_cpu_mesh() -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+# Eight distinct shape families -> eight scheduler bins in flight: the
+# placement policy has real spreading to do (one fat bin would pin the
+# whole workload to one home slot and measure nothing).
+_FAMILIES = (
+    ("cas-register", dict(n=100, concurrency=4, value_range=5)),
+    ("cas-register", dict(n=200, concurrency=4, value_range=5)),
+    ("cas-register", dict(n=60, concurrency=4, value_range=3)),
+    ("cas-register", dict(n=100, concurrency=8, value_range=5)),
+    ("cas-register", dict(n=400, concurrency=4, value_range=5)),
+    ("mutex", dict(n=80, concurrency=4)),
+    ("mutex", dict(n=160, concurrency=4)),
+    ("register", dict(n=100, concurrency=4, value_range=5)),
+)
+
+
+def build_traffic(seed: int = 0, per_family: int = 6):
+    """The seeded mixed workload: ``(check_jobs, stream_hists,
+    txn_hists)``. Distinct seeds everywhere — fingerprint dedup must
+    never quietly collapse the load."""
+    from jepsen_tpu.lin import synth
+
+    jobs: list[tuple[str, list]] = []
+    for fi, (model_name, kw) in enumerate(_FAMILIES):
+        for i in range(per_family):
+            s = seed * 10000 + fi * 100 + i
+            if model_name == "mutex":
+                h = synth.generate_mutex_history(
+                    kw["n"], concurrency=kw["concurrency"], seed=s)
+            else:
+                h = synth.generate_register_history(
+                    kw["n"], concurrency=kw["concurrency"], seed=s,
+                    value_range=kw["value_range"], crash_prob=0.01,
+                    max_crashes=2)
+            jobs.append((model_name, list(h)))
+    streams = [list(synth.generate_register_history(
+        240, concurrency=5, seed=seed * 777 + i, value_range=5))
+        for i in range(4)]
+    txns = [_txn_history(n=10 + 2 * i) for i in range(2)]
+    return jobs, streams, txns
+
+
+def _txn_history(n: int = 12) -> list:
+    from jepsen_tpu.history import Op
+    from jepsen_tpu.suites import fakes, workloads
+
+    store = fakes.FakeTxnStore()
+    client = workloads.TxnClient(store)
+    h: list = []
+    for i in range(n):
+        op = Op("invoke", "txn",
+                [["append", i % 3, i + 1], ["r", i % 3, None]], 0)
+        h.append(op)
+        h.append(client.invoke(None, op))
+    return h
+
+
+def oracles(jobs, streams, txns):
+    from jepsen_tpu import models as m
+    from jepsen_tpu import txn as txn_mod
+    from jepsen_tpu.lin import cpu, prepare
+
+    factories = {"cas-register": m.cas_register, "mutex": m.mutex,
+                 "register": m.register}
+    want_jobs = [cpu.check_packed(prepare.prepare(
+        factories[name](), list(h)))["valid?"] for name, h in jobs]
+    want_streams = [cpu.check_packed(prepare.prepare(
+        m.cas_register(), list(h)))["valid?"] for h in streams]
+    want_txns = [txn_mod.check(h, algorithm="cpu")["valid?"]
+                 for h in txns]
+    return want_jobs, want_streams, want_txns
+
+
+def run_fleet(workers: int, jobs, streams, txns, *,
+              clients: int = 6, flush_ms: float = 25.0,
+              max_batch: int = 8) -> dict:
+    """One timed pass of the mixed workload through an in-process
+    daemon at ``workers``. A warm wave (one history per shape family,
+    untimed) compiles each bin's programs on its HOME device first, so
+    the timed wave measures the placed steady state."""
+    from jepsen_tpu.service.daemon import CheckerService
+    from jepsen_tpu.service.protocol import CheckerClient
+
+    svc = CheckerService("127.0.0.1", 0, workers=workers,
+                         flush_ms_=flush_ms,
+                         max_batch_=max_batch).start()
+    try:
+        # Warm wave: first job of each family (untimed).
+        warm = CheckerClient("127.0.0.1", svc.port, timeout=600)
+        seen: set = set()
+        for name, h in jobs:
+            key = (name, len(h))
+            if key in seen:
+                continue
+            seen.add(key)
+            warm.submit(name, h)
+        warm.close()
+
+        lock = threading.Lock()
+        results: dict[int, object] = {}
+        stream_results: list = [None] * len(streams)
+        txn_results: list = [None] * len(txns)
+        errors: list = []
+        it = iter(list(enumerate(jobs)))
+
+        def check_loop():
+            c = CheckerClient("127.0.0.1", svc.port, timeout=600)
+            while True:
+                with lock:
+                    nxt = next(it, None)
+                if nxt is None:
+                    break
+                i, (name, h) = nxt
+                r = c.submit(name, h, req_id=i)
+                with lock:
+                    results[i] = r.get("valid?")
+            c.close()
+
+        def stream_loop(i):
+            try:
+                c = CheckerClient("127.0.0.1", svc.port, timeout=600)
+                sid = c.stream_open("cas-register")
+                h = streams[i]
+                n = max(1, len(h) // 4)
+                for j in range(0, len(h), n):
+                    c.stream_append(sid, h[j:j + n])
+                stream_results[i] = c.stream_finalize(sid)
+                c.close()
+            except Exception as e:  # noqa: BLE001 - audit, don't hang
+                errors.append(f"stream[{i}]: {e!r}")
+
+        def txn_loop():
+            try:
+                c = CheckerClient("127.0.0.1", svc.port, timeout=600)
+                for i, h in enumerate(txns):
+                    txn_results[i] = c.txn_check(h)
+                c.close()
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"txn: {e!r}")
+
+        threads = [threading.Thread(target=check_loop)
+                   for _ in range(clients)]
+        threads += [threading.Thread(target=stream_loop, args=(i,))
+                    for i in range(len(streams))]
+        threads += [threading.Thread(target=txn_loop)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(1200)
+        wall = time.monotonic() - t0
+        sc = CheckerClient("127.0.0.1", svc.port)
+        stats = sc.stats()
+        sc.close()
+    finally:
+        svc.stop()
+
+    block = stats.get("placement", {})
+    occupancy = [
+        {"slot": w.get("slot"), "device": w.get("device"),
+         "items": w.get("items"),
+         "busy_s": w.get("busy_s"),
+         "occupancy": round((w.get("busy_s") or 0) / wall, 3),
+         "compiles": w.get("compiles")}
+        for w in block.get("workers", [])]
+    return {
+        "workers": workers,
+        "wall_s": round(wall, 2),
+        "checks": len(jobs),
+        "histories_per_sec": round(len(jobs) / wall, 2),
+        "check_verdicts": results,
+        "stream_verdicts": [
+            None if r is None else r.get("valid?")
+            for r in stream_results],
+        "stream_increments": [
+            None if r is None else (r.get("stream") or {})
+            .get("increments") for r in stream_results],
+        "txn_verdicts": [None if r is None else r.get("valid?")
+                         for r in txn_results],
+        "errors": errors,
+        "occupancy": occupancy,
+        "placement": {k: block.get(k) for k in
+                      ("placed", "homed", "spills", "re_homes")},
+        "homes": len(block.get("homes") or {}),
+        "stats": {k: stats.get(k) for k in
+                  ("decided", "avg_occupancy", "stream_batches",
+                   "stream_batched_increments",
+                   "stream_batch_max_occupancy",
+                   "stream_solo_increments", "dedup_hits",
+                   "placement_spills", "xla_compiles")},
+    }
+
+
+def audit(run: dict, want_jobs, want_streams, want_txns) -> dict:
+    """Zero-flip parity audit of one run against the CPU oracle.
+    Unknown/missing answers are honest failures: they fail the gate
+    but are reported as themselves, never as flips."""
+    flips, unknowns, missing = [], 0, 0
+    for i, w in enumerate(want_jobs):
+        got = run["check_verdicts"].get(i)
+        if got == w:
+            continue
+        if got == "unknown":
+            unknowns += 1
+        elif got is None:
+            missing += 1
+        else:
+            flips.append({"kind": "check", "i": i, "want": w,
+                          "got": got})
+    for kind, got_list, want_list in (
+            ("stream", run["stream_verdicts"], want_streams),
+            ("txn", run["txn_verdicts"], want_txns)):
+        for i, w in enumerate(want_list):
+            got = got_list[i]
+            if got == w:
+                continue
+            if got == "unknown":
+                unknowns += 1
+            elif got is None:
+                missing += 1
+            else:
+                flips.append({"kind": kind, "i": i, "want": w,
+                              "got": got})
+    return {"flips": flips, "unknowns": unknowns, "missing": missing,
+            "clean": not flips and not unknowns and not missing
+            and not run["errors"]}
+
+
+def main(argv=None) -> int:
+    t_start = time.time()
+    _force_cpu_mesh()
+    import jax
+
+    from jepsen_tpu import util
+
+    util.enable_compile_cache()
+    devices = len(jax.devices())
+    cores = os.cpu_count() or 1
+
+    jobs, streams, txns = build_traffic(seed=3)
+    want_jobs, want_streams, want_txns = oracles(jobs, streams, txns)
+
+    runs = {}
+    audits = {}
+    for workers in (1, 8):
+        runs[workers] = run_fleet(workers, jobs, streams, txns)
+        audits[workers] = audit(runs[workers], want_jobs,
+                                want_streams, want_txns)
+
+    ratio = (runs[8]["histories_per_sec"]
+             / max(runs[1]["histories_per_sec"], 1e-9))
+    capacity = min(8, devices, cores)
+    # The ISSUE's 3x gate where the machine can parallelize at all;
+    # a no-regression bound where it cannot (1-core CI sandbox).
+    target = 3.0 if capacity >= 4 else 0.7
+    stream_occ = runs[8]["stats"].get(
+        "stream_batch_max_occupancy") or 0
+    out = {
+        "devices": devices, "cores": cores, "capacity": capacity,
+        "runs": {str(k): {kk: vv for kk, vv in v.items()
+                          if kk != "check_verdicts"}
+                 for k, v in runs.items()},
+        "parity": {str(k): a for k, a in audits.items()},
+        "ratio_8v1": round(ratio, 2),
+        "target_ratio": target,
+        "stream_batch_max_occupancy": stream_occ,
+        "ok": (audits[1]["clean"] and audits[8]["clean"]
+               and ratio >= target and stream_occ > 1),
+    }
+    if capacity < 4:
+        out["note"] = (f"parallel capacity {capacity} "
+                       f"(cores={cores}): the 3x fleet target needs "
+                       f">=4; gating no-regression instead — the "
+                       f"honest ratio is recorded either way")
+
+    from jepsen_tpu.obs import ledger as perf_ledger
+
+    perf_ledger.record(
+        "service-fleet-bench", kind="bench",
+        wall_s=time.time() - t_start, verdict=out["ok"],
+        extra={"ratio_8v1": out["ratio_8v1"],
+               "hps_1": runs[1]["histories_per_sec"],
+               "hps_8": runs[8]["histories_per_sec"],
+               "stream_batch_max_occupancy": stream_occ,
+               "capacity": capacity})
+    print(json.dumps(out, default=str))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,6 +67,37 @@ def main() -> int:
         client.close()
     finally:
         svc.stop()
+
+    # 2-worker placement leg (doc/service.md § Placement): the same
+    # oracle-checked cases through a 2-slot pool — bins must HOME
+    # (affinity visible in the placement block) with zero flips.
+    svc2 = CheckerService("127.0.0.1", 0, flush_ms_=20,
+                          workers=2).start()
+    try:
+        client = CheckerClient("127.0.0.1", svc2.port)
+        placed_ok = True
+        for name, model, h in cases:
+            want = cpu.check_packed(prepare.prepare(model, h))["valid?"]
+            got = client.submit(name, h)
+            placed_ok = placed_ok and got.get("valid?") == want
+        block = client.stats().get("placement", {})
+        rec = {"leg": "placement-2w",
+               "homes": len(block.get("homes") or {}),
+               "placed": block.get("placed"),
+               "devices": [w.get("device")
+                           for w in block.get("workers", [])],
+               "items": [w.get("items")
+                         for w in block.get("workers", [])],
+               "verdicts_ok": placed_ok,
+               "ok": (placed_ok and (block.get("placed") or 0) >= 1
+                      and len(block.get("homes") or {}) >= 1
+                      and len(block.get("workers", [])) == 2)}
+        out["checks"].append(rec)
+        ok = ok and rec["ok"]
+        client.shutdown()
+        client.close()
+    finally:
+        svc2.stop()
     out["ok"] = ok
     # Cross-run perf ledger (doc/observability.md § Perf ledger): the
     # smoke is an evidence producer; record() never raises, so a

@@ -14,6 +14,16 @@ verdict rides in ``test["results"]["stream"]`` next to whatever checker
 the test configured (the post-hoc checker still runs — the stream
 verdict is an additional, earlier view of the same history, equal by
 the parity argument in doc/streaming.md).
+
+With ``JEPSEN_TPU_STREAM_WIRE=host:port`` additionally set, the live
+checker targets a checker-daemon STREAM SESSION over the wire instead
+of an in-process :class:`StreamChecker`: appends ride
+``CheckerClient.stream_*`` and the daemon's svc-stream bins batch this
+run's increments with other tenants'. Any wire loss (connect failure,
+socket error, daemon error reply) degrades to the in-process session —
+the buffered feed replays locally, so the verdict is never lost and
+``results["stream"]`` keeps its shape either way (a ``transport`` key
+says which path decided).
 """
 
 from __future__ import annotations
@@ -27,6 +37,130 @@ def enabled() -> bool:
     return os.environ.get("JEPSEN_TPU_STREAM", "0") == "1"
 
 
+def wire_target() -> tuple[str, int] | None:
+    """``JEPSEN_TPU_STREAM_WIRE=host:port`` — the daemon the live
+    checker should stream through (unset/empty/bad = in-process)."""
+    v = os.environ.get("JEPSEN_TPU_STREAM_WIRE", "").strip()
+    if not v or ":" not in v:
+        return None
+    host, _, port = v.rpartition(":")
+    try:
+        return host or "127.0.0.1", int(port)
+    except ValueError:
+        return None
+
+
+def _wire_model_name(model) -> str | None:
+    """A model instance's wire name (the daemon speaks names, the run
+    carries instances)."""
+    from jepsen_tpu.service import protocol
+
+    for name in protocol.MODEL_NAMES:
+        try:
+            if type(protocol.model_by_name(name)) is type(model):
+                return name
+        except Exception:  # noqa: BLE001 - unknown model: no wire name
+            pass
+    return None
+
+
+class _WireSession:
+    """StreamChecker-shaped adapter over a daemon stream session.
+
+    Implements the three members LiveChecker consumes — ``append`` /
+    ``aborted`` / ``finalize`` — and buffers every offered event so a
+    mid-run wire loss can replay the whole feed into a local
+    :class:`StreamChecker` (degrade, never lose the verdict)."""
+
+    def __init__(self, model, model_name: str, host: str, port: int,
+                 **session_kw):
+        from jepsen_tpu.service.protocol import CheckerClient
+
+        self._model = model
+        self._kw = session_kw
+        self._client = CheckerClient(host, port, timeout=60)
+        self._sid = self._client.stream_open(model_name)
+        self._events: list = []
+        self._aborted = False
+        self._degraded_from_wire: str | None = None
+        self._local = None          # in-process StreamChecker after loss
+
+    def _degrade(self, why: str):
+        """Replay the buffered feed into an in-process session; all
+        later calls go there."""
+        from jepsen_tpu.stream.session import StreamChecker
+
+        if self._local is None:
+            self._degraded_from_wire = why
+            self._local = StreamChecker(self._model, **self._kw)
+            if self._events:
+                self._local.append(list(self._events))
+            try:
+                self._client.close()
+            except Exception:  # noqa: BLE001 - already torn down
+                pass
+        return self._local
+
+    def append(self, events) -> dict:
+        events = list(events)
+        self._events.extend(events)
+        if self._local is not None:
+            return self._local.append(events)
+        try:
+            st = self._client.stream_append(self._sid, events)
+        except Exception as e:  # noqa: BLE001 - any wire loss degrades
+            return self._degrade(f"append: {e!r}").status()
+        if st.get("type") == "error":
+            return self._degrade(f"append error: {st.get('error')}") \
+                .status()
+        if st.get("aborted"):
+            self._aborted = True
+        return st
+
+    @property
+    def aborted(self) -> bool:
+        if self._local is not None:
+            return self._local.aborted
+        return self._aborted
+
+    def finalize(self) -> dict:
+        if self._local is None:
+            try:
+                r = self._client.stream_finalize(self._sid)
+                if r.get("valid?") in (True, False, "unknown"):
+                    r.setdefault("transport", "wire")
+                    self._client.close()
+                    return r
+                self._degrade(f"finalize reply: {r!r}")
+            except Exception as e:  # noqa: BLE001 - degrade, not lose
+                self._degrade(f"finalize: {e!r}")
+        r = self._local.finalize()
+        r.setdefault("transport", "local")
+        if self._degraded_from_wire:
+            r["wire_degraded"] = self._degraded_from_wire
+        return r
+
+
+def _open_session(model, **session_kw):
+    """The LiveChecker's session factory: a daemon-backed wire session
+    when ``JEPSEN_TPU_STREAM_WIRE`` names a reachable daemon and the
+    model has a wire name; the in-process StreamChecker otherwise
+    (including on any open failure — wire loss degrades, never
+    blocks a run)."""
+    from jepsen_tpu.stream.session import StreamChecker
+
+    target = wire_target()
+    if target is not None:
+        name = _wire_model_name(model)
+        if name is not None:
+            try:
+                return _WireSession(model, name, target[0], target[1],
+                                    **session_kw)
+            except Exception:  # noqa: BLE001 - daemon down: go local
+                pass
+    return StreamChecker(model, **session_kw)
+
+
 def abort_enabled() -> bool:
     """``JEPSEN_TPU_STREAM_ABORT=0`` keeps checking live but lets the
     run complete (observe-only mode: the abort latency numbers without
@@ -38,9 +172,7 @@ class LiveChecker:
     """Queue-fed, thread-driven StreamChecker for a live run."""
 
     def __init__(self, model, **session_kw):
-        from jepsen_tpu.stream.session import StreamChecker
-
-        self.session = StreamChecker(model, **session_kw)
+        self.session = _open_session(model, **session_kw)
         self._q: deque = deque()
         self._cv = threading.Condition()
         self._stop = False

@@ -200,8 +200,33 @@ def service_html(stats_file: str | None = None) -> str:
         parts.append("<p><b>fleet:</b> "
                      + _html.escape(" · ".join(fleet)) + "</p>")
     parts.append(table("counters & gauges", scalars))
+    # Placement block (doc/service.md § Placement): one row per worker
+    # SLOT — device, queue depth, busy-seconds, item/compile counts —
+    # the per-device surface the generic dict tables flatten badly.
+    placement = snap.get("placement") or {}
+    if placement.get("workers"):
+        cols = ("wid", "slot", "device", "queue_depth", "busy",
+                "items", "busy_s", "compiles")
+        rows = ["<tr>" + "".join(f"<th>{c}</th>" for c in cols)
+                + "</tr>"]
+        for w in placement["workers"]:
+            rows.append("<tr>" + "".join(
+                f"<td>{_html.escape(str(w.get(c)))}</td>"
+                for c in cols) + "</tr>")
+        summary = (f"{len(placement.get('homes') or {})} bin homes · "
+                   f"{placement.get('placed', 0)} placed "
+                   f"({placement.get('homed', 0)} home, "
+                   f"{placement.get('spills', 0)} spills, "
+                   f"{placement.get('re_homes', 0)} re-homes; "
+                   f"spill depth {placement.get('spill_depth')})")
+        lost = placement.get("lost_devices")
+        if lost:
+            summary += f" · LOST devices {lost}"
+        parts.append("<h2>placement</h2><p>"
+                     + _html.escape(summary) + "</p><table>"
+                     + "".join(rows) + "</table>")
     for k in sorted(k for k, v in snap.items() if isinstance(v, dict)):
-        if snap[k]:
+        if snap[k] and k != "placement":
             parts.append(table(k, sorted(snap[k].items())))
     parts.append("<h2>raw</h2><pre>"
                  + _html.escape(json.dumps(snap, indent=1,

@@ -395,7 +395,9 @@ def test_registry_snapshot_round_trip(tmp_path, monkeypatch):
     assert snap["run"]["row"] == 3
     # round_stats flowed through the codec (3 digits, nested).
     assert snap["views"]["host-stats"]["cap_seconds"] == {"8": 1.235}
-    assert snap["counters"] == {"ticks": 2}
+    # Every event bumps a durable event_<kind> counter (the ring
+    # holds MAX_EVENTS; the counter survives eviction).
+    assert snap["counters"] == {"ticks": 2, "event_quarantine": 1}
     assert snap["events"][0]["key"] == "chunk|cap8"
     assert "xla_compiles" in snap
 

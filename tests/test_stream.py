@@ -499,6 +499,81 @@ class TestRunner:
         assert result["results"][c.VALID] is True
         assert result["results"]["stream"]["valid?"] is True
 
+    def test_live_run_streams_over_wire(self, tmp_path, monkeypatch):
+        # JEPSEN_TPU_STREAM_WIRE: the live checker becomes a daemon
+        # stream-session client; the verdict still rides in
+        # results["stream"], now stamped transport=wire.
+        from jepsen_tpu import checker as c
+        from jepsen_tpu import core
+        from jepsen_tpu import generator as g
+        from jepsen_tpu import tests_support as ts
+        from jepsen_tpu.service.daemon import CheckerService
+
+        monkeypatch.setenv("JEPSEN_TPU_QUARANTINE",
+                           str(tmp_path / "q.json"))
+        svc = CheckerService(
+            "127.0.0.1", 0, flush_ms_=10,
+            stats_file=str(tmp_path / "svc.json")).start()
+        try:
+            monkeypatch.setenv("JEPSEN_TPU_STREAM", "1")
+            monkeypatch.setenv("JEPSEN_TPU_STREAM_WIRE",
+                               f"127.0.0.1:{svc.port}")
+            reg = ts.AtomRegister()
+            test = ts.noop_test(
+                client=ts.AtomClient(reg),
+                generator=g.clients(g.limit(40, g.cas(5))),
+                model=m.cas_register(),
+                checker=c.linearizable("cpu"),
+            )
+            result = core.run(test)
+            assert result["results"][c.VALID] is True
+            stream = result["results"]["stream"]
+            assert stream["valid?"] is True
+            assert stream.get("transport") == "wire"
+            assert svc.stats().get("stream_opens", 0) >= 1
+        finally:
+            svc.stop()
+
+    def test_wire_loss_degrades_to_local_same_verdict(
+            self, tmp_path, monkeypatch):
+        # Daemon dies mid-session: the buffered feed replays into an
+        # in-process StreamChecker — verdict kept, loss annotated.
+        from jepsen_tpu.service.daemon import CheckerService
+        from jepsen_tpu.stream import runner
+
+        monkeypatch.setenv("JEPSEN_TPU_QUARANTINE",
+                           str(tmp_path / "q.json"))
+        svc = CheckerService(
+            "127.0.0.1", 0, flush_ms_=10,
+            stats_file=str(tmp_path / "svc.json")).start()
+        monkeypatch.setenv("JEPSEN_TPU_STREAM_WIRE",
+                           f"127.0.0.1:{svc.port}")
+        h = list(synth.generate_register_history(
+            120, concurrency=4, seed=13, value_range=4))
+        want = cpu.check_packed(
+            prepare.prepare(m.cas_register(), list(h)))["valid?"]
+        sess = runner._open_session(m.cas_register())
+        assert isinstance(sess, runner._WireSession)
+        n = len(h) // 3
+        sess.append(h[:n])
+        svc.stop()                      # the wire goes away mid-feed
+        sess.append(h[n:])
+        r = sess.finalize()
+        assert r["valid?"] == want
+        assert r.get("transport") == "local"
+        assert "wire_degraded" in r
+
+    def test_dead_target_falls_back_in_process(self, monkeypatch):
+        from jepsen_tpu.stream import runner
+        from jepsen_tpu.stream.session import StreamChecker
+
+        # Nothing listens there: the session factory returns the
+        # plain in-process checker (a down daemon never blocks a run).
+        monkeypatch.setenv("JEPSEN_TPU_STREAM_WIRE",
+                           "127.0.0.1:9")
+        sess = runner._open_session(m.cas_register())
+        assert isinstance(sess, StreamChecker)
+
     def test_live_run_flags_lying_client(self, monkeypatch):
         from jepsen_tpu import checker as c
         from jepsen_tpu import core
