@@ -55,7 +55,7 @@ MODES = ("off", "warn", "route")
 # consults the quarantine ledger for routing (supervise docstring).
 ROUTED_SITES = frozenset(
     {"host-sched", "host-wave", "host-fixpoint", "host-pass",
-     "txn-scc"})
+     "txn-scc", "pack-dev"})
 
 # Per-site rule waivers: the jaxpr twin of the source-level
 # `# lint: unbounded-ok` comments. Empty since the mesh closure

@@ -55,6 +55,7 @@ COMPILE_TRIGGER_MODULES = (
     "jepsen_tpu.lin.dense_pallas", "jepsen_tpu.lin.batched",
     "jepsen_tpu.lin.psort", "jepsen_tpu.lin.sharded",
     "jepsen_tpu.lin.sharded_dense", "jepsen_tpu.txn.device",
+    "jepsen_tpu.lin.pack_dev",
 )
 
 

@@ -311,6 +311,20 @@ class TestShippedPrograms:
         assert rules == []
 
     @quick
+    def test_pack_dev_program_unflagged(self):
+        # The device packer (ISSUE 20): three <=4-operand sorts, no
+        # while loops at all (pointer doubling is a fixed unrolled
+        # chain), nothing in the fault-lore rule set — single-lane and
+        # vmapped alike. Its site routes (the numpy packer is the rung
+        # below), so a future flagged variant would skip the chip.
+        from jepsen_tpu.lin import pack_dev
+
+        shape = pack_dev.pad_shape(1 << 10, 200, 12, 2)
+        assert _rules(pack_dev.pack_traceable(shape)) == []
+        assert _rules(pack_dev.pack_traceable(shape, lanes=8)) == []
+        assert "pack-dev" in gate.ROUTED_SITES
+
+    @quick
     @pytest.mark.compiles
     def test_supervised_sites_analyze_clean_small_band(
             self, monkeypatch, small_packed):

@@ -1,0 +1,309 @@
+"""Bit-parity fuzz: the device packer (lin/pack_dev.py) vs prepare's
+spec walk — same tables, same fingerprints, same errors — plus the
+supervision discipline (wedge -> honest numpy fallback with zero
+verdict cost, quarantine routing) and the batched vmapped entry
+(ISSUE 20 tentpole)."""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu.history import History, invoke_op, ok_op
+from jepsen_tpu.lin import pack_dev, prepare, supervise, synth
+from jepsen_tpu.lin.prepare import UnsupportedHistory
+from jepsen_tpu.lin.supervise import history_fingerprint
+
+pytestmark = [pytest.mark.quick, pytest.mark.compiles]
+
+TABLES = ("ret_slot", "ret_op", "active", "slot_f", "slot_v",
+          "slot_op", "crashed", "init_state")
+
+
+@pytest.fixture(autouse=True)
+def _isolate(monkeypatch, tmp_path):
+    # Keep the wedge tests' ledger records out of the real quarantine
+    # file, and leaked injections out of the next test.
+    monkeypatch.setenv("JEPSEN_TPU_QUARANTINE", str(tmp_path / "q.json"))
+    pack_dev.reset_dev_stats()
+    yield
+    supervise.reset_injections()
+
+
+def _spec(model, h):
+    p = prepare.prepare(model, list(h))
+    return p, prepare.reduction_tables(p)
+
+
+def _assert_tables_equal(a, b):
+    assert a.window == b.window and a.R == b.R
+    for name in TABLES:
+        va, vb = getattr(a, name), getattr(b, name)
+        assert np.asarray(va).dtype == np.asarray(vb).dtype, name
+        np.testing.assert_array_equal(va, vb, err_msg=name)
+    assert (a.kernel.name if a.kernel else None) == \
+        (b.kernel.name if b.kernel else None)
+    assert a.intern == b.intern and a.unintern == b.unintern
+    assert a.ops == b.ops and a.crashed_ops == b.crashed_ops
+    assert history_fingerprint(a) == history_fingerprint(b)
+
+
+def _assert_dev_parity(model, h, expect_device=True):
+    spec, rspec = _spec(model, list(h))
+    pre = pack_dev.prepack(model, list(h))
+    before = pack_dev.dev_stats()["dev_packs"]
+    got = pack_dev.materialize(pre)
+    if expect_device:
+        assert pack_dev.dev_stats()["dev_packs"] == before + 1
+    _assert_tables_equal(got, spec)
+    rdev = prepare.reduction_tables(got)   # the device-built tables
+    np.testing.assert_array_equal(rdev[0], rspec[0], err_msg="pure")
+    assert rdev[0].dtype == rspec[0].dtype
+    np.testing.assert_array_equal(rdev[1], rspec[1], err_msg="pred")
+    assert rdev[1].dtype == rspec[1].dtype
+    return got
+
+
+# --- single-history parity across families ----------------------------------
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_dev_parity_partitioned_cas(seed):
+    h = synth.generate_partitioned_register_history(
+        3000, seed=seed, max_crashes=12, invoke_bias=0.5)
+    p = _assert_dev_parity(m.cas_register(), h)
+    assert len(p.crashed_ops) > 0
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_dev_parity_register_crash_mix(seed):
+    h = synth.generate_register_history(
+        1500, concurrency=7, seed=seed, crash_prob=0.02, max_crashes=9)
+    _assert_dev_parity(m.cas_register(), h)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_dev_parity_mutex(seed):
+    h = synth.generate_mutex_history(
+        600, concurrency=5, seed=seed, crash_prob=0.02, max_crashes=6)
+    _assert_dev_parity(m.mutex(), h)
+
+
+def test_dev_parity_tiny_and_single_op():
+    _assert_dev_parity(m.cas_register(), History.of(
+        invoke_op(0, "write", 5), ok_op(0, "write", 5)))
+    # Empty history: R == 0 is host-path by design, still identical.
+    _assert_dev_parity(m.cas_register(), History.of(),
+                       expect_device=False)
+
+
+def test_dev_parity_all_crashed():
+    # R == 0 but n > 0: nothing to paint, host path, identical.
+    h = History.of(invoke_op(0, "write", 1), invoke_op(1, "write", 2))
+    _assert_dev_parity(m.cas_register(), h, expect_device=False)
+
+
+def test_dev_parity_kernelless_set_model():
+    # Set histories have kernel=None here (generic CPU search):
+    # ineligible for the device program, identical via host path.
+    h = synth.generate_set_history(200, concurrency=3, seed=0)
+    _assert_dev_parity(m.set_model(), h, expect_device=False)
+
+
+# --- prepack: error + fingerprint contract -----------------------------------
+
+
+def test_prepack_window_overflow_error_parity():
+    ops = [invoke_op(i, "write", i) for i in range(70)]
+    ops += [ok_op(i, "write", i) for i in range(70)]
+    h = History.of(*ops)
+    with pytest.raises(UnsupportedHistory) as de:
+        pack_dev.prepack(m.cas_register(), list(h))
+    with pytest.raises(UnsupportedHistory) as se:
+        prepare.prepare(m.cas_register(), list(h))
+    assert str(de.value) == str(se.value)
+    assert de.value.kind == se.value.kind == "window"
+
+
+def test_prepack_double_invoke_error_parity():
+    h = History.of(invoke_op(0, "write", 1), invoke_op(0, "write", 2),
+                   ok_op(0, "write", 2))
+    with pytest.raises(UnsupportedHistory) as de:
+        pack_dev.prepack(m.cas_register(), list(h))
+    with pytest.raises(UnsupportedHistory) as se:
+        prepare.prepare(m.cas_register(), list(h))
+    assert str(de.value) == str(se.value)
+
+
+def test_prepack_fingerprint_mode_invariant(monkeypatch):
+    # The service-wire fingerprint must not depend on the host packer
+    # mode: client (protocol.request_fingerprint) and daemon admission
+    # must agree even when their FAST_PACK knobs differ.
+    h = synth.generate_partitioned_register_history(
+        800, seed=3, max_crashes=6, invoke_bias=0.5)
+    monkeypatch.setenv("JEPSEN_TPU_FAST_PACK", "1")
+    fast = pack_dev.prepack_fingerprint(
+        pack_dev.prepack(m.cas_register(), list(h)))
+    monkeypatch.setenv("JEPSEN_TPU_FAST_PACK", "0")
+    spec = pack_dev.prepack_fingerprint(
+        pack_dev.prepack(m.cas_register(), list(h)))
+    assert fast == spec
+    h2 = synth.generate_partitioned_register_history(
+        800, seed=4, max_crashes=6, invoke_bias=0.5)
+    assert fast != pack_dev.prepack_fingerprint(
+        pack_dev.prepack(m.cas_register(), list(h2)))
+
+
+def test_prepack_exposes_bin_attributes():
+    # bin_key/dense.plan read these without materializing the grids.
+    h = synth.generate_register_history(400, concurrency=5, seed=1)
+    pre = pack_dev.prepack(m.cas_register(), list(h))
+    p = prepare.prepare(m.cas_register(), list(h))
+    assert pre.kernel.name == p.kernel.name
+    assert pre.window == p.window and pre.R == p.R
+    assert pre.state_width == p.state_width
+    assert pre.unintern == p.unintern
+    np.testing.assert_array_equal(pre.init_state, p.init_state)
+    from jepsen_tpu.lin import dense
+
+    assert dense.plan(pre) == dense.plan(p)
+
+
+# --- knobs + supervision discipline ------------------------------------------
+
+
+def test_disabled_knob_takes_host_path(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_PACK_DEV", "0")
+    h = synth.generate_register_history(500, concurrency=5, seed=2)
+    _assert_dev_parity(m.cas_register(), h, expect_device=False)
+    assert pack_dev.dev_stats()["dev_packs"] == 0
+
+
+def test_wedge_falls_back_to_numpy_with_zero_verdict_cost(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_DISPATCH_RETRIES", "0")
+    h = synth.generate_register_history(
+        600, concurrency=6, seed=5, crash_prob=0.02, max_crashes=4)
+    spec, rspec = _spec(m.cas_register(), h)
+    supervise.inject_wedge("pack-dev", 1, deadline_s=0.05)
+    got = pack_dev.materialize(
+        pack_dev.prepack(m.cas_register(), list(h)))
+    st = pack_dev.dev_stats()
+    assert st["wedges"] == 1 and st["host_fallbacks"] == 1
+    assert st["dev_packs"] == 0
+    _assert_tables_equal(got, spec)
+    np.testing.assert_array_equal(
+        prepare.reduction_tables(got)[1], rspec[1])
+
+
+def test_repeat_wedges_quarantine_the_shape(monkeypatch, tmp_path):
+    qpath = str(tmp_path / "q.json")
+    monkeypatch.setenv("JEPSEN_TPU_QUARANTINE", qpath)
+    monkeypatch.setenv("JEPSEN_TPU_DISPATCH_RETRIES", "0")
+    h = synth.generate_register_history(
+        400, concurrency=5, seed=6, crash_prob=0.02, max_crashes=3)
+    spec, _ = _spec(m.cas_register(), h)
+    supervise.inject_wedge("pack-dev", 2, deadline_s=0.05)
+    for _ in range(2):                      # 2 wedges -> quarantined
+        pack_dev.materialize(
+            pack_dev.prepack(m.cas_register(), list(h)))
+    before = pack_dev.dev_stats()["quarantine_skips"]
+    got = pack_dev.materialize(
+        pack_dev.prepack(m.cas_register(), list(h)))
+    assert pack_dev.dev_stats()["quarantine_skips"] == before + 1
+    _assert_tables_equal(got, spec)
+    ledger = supervise.load_ledger(qpath)
+    assert any(k.startswith("pack-dev|") for k in ledger), ledger
+
+
+# --- batched entry ------------------------------------------------------------
+
+
+def test_batch_parity_same_bucket(monkeypatch):
+    # K identical-shape histories ride one vmapped dispatch.
+    monkeypatch.setenv("JEPSEN_TPU_PACK_DEV_MIN_K", "2")
+    hs = [synth.generate_register_history(
+        700, concurrency=6, seed=s, crash_prob=0.02, max_crashes=5)
+        for s in range(4)]
+    specs = [_spec(m.cas_register(), h) for h in hs]
+    pres = [pack_dev.prepack(m.cas_register(), list(h)) for h in hs]
+    got = pack_dev.materialize_batch(pres)
+    st = pack_dev.dev_stats()
+    assert st["dev_lanes"] == 4             # every lane went device
+    assert st["dev_packs"] < 4              # ...in < K dispatches
+    for g, (s, rs) in zip(got, specs):
+        _assert_tables_equal(g, s)
+        np.testing.assert_array_equal(
+            prepare.reduction_tables(g)[1], rs[1])
+
+
+def test_batch_mixed_eligibility_preserves_order(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_PACK_DEV_MIN_K", "2")
+    model = m.cas_register()
+    hs = [
+        synth.generate_register_history(300, concurrency=4, seed=0),
+        History.of(),                                   # host (R == 0)
+        synth.generate_register_history(300, concurrency=4, seed=1),
+        synth.generate_partitioned_register_history(
+            900, seed=2, max_crashes=5, invoke_bias=0.5),
+    ]
+    pres = [pack_dev.prepack(model, list(h)) for h in hs]
+    got = pack_dev.materialize_batch(pres)
+    for g, h in zip(got, hs):
+        s, _ = _spec(model, h)
+        _assert_tables_equal(g, s)
+
+
+def test_batch_below_min_k_takes_host(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_PACK_DEV_MIN_K", "64")
+    hs = [synth.generate_register_history(
+        300, concurrency=4, seed=s) for s in range(2)]
+    pres = [pack_dev.prepack(m.cas_register(), list(h)) for h in hs]
+    got = pack_dev.materialize_batch(pres)
+    assert pack_dev.dev_stats()["dev_packs"] == 0
+    for g, h in zip(got, hs):
+        s, _ = _spec(m.cas_register(), h)
+        _assert_tables_equal(g, s)
+
+
+# --- the streaming paint helper ----------------------------------------------
+
+
+def test_stream_paint_matches_numpy_reference():
+    rng = np.random.default_rng(0)
+    n1, n_new, W = 40, 12, 6
+    # Painters: each op j paints rows [r0, r1) at a fixed column; build
+    # non-overlapping per-column intervals the way the settle does.
+    p_gid, p_slot, r0, r1 = [], [], [], []
+    for col in range(W):
+        row = 0
+        while row < n_new:
+            span = int(rng.integers(1, 4))
+            gid = int(rng.integers(0, n1))
+            p_gid.append(gid)
+            p_slot.append(col)
+            r0.append(row)
+            r1.append(min(n_new, row + span))
+            row += span + int(rng.integers(0, 3))
+    p_gid = np.asarray(p_gid, np.int32)
+    p_slot = np.asarray(p_slot, np.int32)
+    r0 = np.asarray(r0, np.int32)
+    r1 = np.asarray(r1, np.int32)
+    op_f = rng.integers(0, 3, n1).astype(np.int32)
+    op_v = rng.integers(-5, 5, (n1, 2)).astype(np.int32)
+    op_crashed = rng.random(n1) < 0.3
+    got = pack_dev.paint_tables_dev(
+        p_slot, r0, r1, p_gid + 1, op_f, op_v, op_crashed,
+        n1, n_new, W, kernel="test")
+    assert got is not None
+    grid = np.zeros((n_new, W), np.int32)
+    for g, c, a, b in zip(p_gid, p_slot, r0, r1):
+        grid[a:b, c] = g + 1
+    active = grid != 0
+    slot_op = grid - 1
+    np.testing.assert_array_equal(got[0], grid)
+    np.testing.assert_array_equal(got[1], active)
+    np.testing.assert_array_equal(
+        got[2], np.where(active, op_f[np.clip(slot_op, 0, None)], 0))
+    np.testing.assert_array_equal(got[4], slot_op)
+    np.testing.assert_array_equal(
+        got[5], np.where(active,
+                         op_crashed[np.clip(slot_op, 0, None)], False))
