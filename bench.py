@@ -863,6 +863,51 @@ def _probe_pack():
               and np.array_equal(np.asarray(p_vec.slot_op),
                                  np.asarray(p_py.slot_op)))
     speedup = round(py_s / vec_s, 2) if vec_s else None
+
+    # Device leg (ISSUE 20, lin/pack_dev.py): K=8 same-shape lanes
+    # materialized three ways from the SAME prepacks — host finish
+    # (PACK_DEV=0), per-lane device dispatches, one batched vmapped
+    # dispatch — parity-checked against each other and timed best-of-3
+    # after a warmup dispatch absorbs the compile. The batched-vs-
+    # single gain is the amortization the daemon's bin waves ride.
+    from jepsen_tpu.lin import pack_dev
+
+    def _fp(p):
+        return (supervise.history_fingerprint(p),
+                np.asarray(p.slot_op).tobytes())
+
+    K = 8
+    hk = list(synth.generate_partitioned_register_history(
+        20_000, seed=9, invoke_bias=0.45))
+    pres = [pack_dev.prepack(model, list(hk)) for _ in range(K)]
+
+    def _leg(dev, batched):
+        os.environ["JEPSEN_TPU_PACK_DEV"] = "1" if dev else "0"
+        if batched:
+            return pack_dev.materialize_batch(list(pres))
+        return [pack_dev.materialize(p) for p in pres]
+
+    try:
+        _leg(True, True)                       # warm the compile
+        runs: dict[str, list[float]] = {"host": [], "single": [],
+                                        "batched": []}
+        outs: dict[str, list] = {}
+        for _ in range(3):
+            for name, dev, batched in (("host", False, False),
+                                       ("single", True, False),
+                                       ("batched", True, True)):
+                t0 = time.time()
+                outs[name] = _leg(dev, batched)
+                runs[name].append(time.time() - t0)
+    finally:
+        os.environ.pop("JEPSEN_TPU_PACK_DEV", None)
+    host_s, single_s, batched_s = (min(runs[k]) for k in
+                                   ("host", "single", "batched"))
+    want = _fp(outs["host"][0])
+    dev_parity = all(_fp(p) == want
+                     for leg in outs.values() for p in leg)
+    dev_speedup = round(host_s / batched_s, 2) if batched_s else None
+    batch_gain = round(single_s / batched_s, 2) if batched_s else None
     out = {"n_ops": len(h) // 2, "n_events": len(h),
            "return_events": int(p_vec.R),
            "window": p_vec.window,
@@ -871,14 +916,40 @@ def _probe_pack():
            "py_seconds": round(py_s, 3), "py_mode": py_mode,
            "py_seconds_runs": [round(w, 3) for w in py_runs],
            "speedup": speedup, "bit_parity": parity,
+           "dev_k": K,
+           "dev_host_seconds": round(host_s, 3),
+           "dev_single_seconds": round(single_s, 3),
+           "dev_batched_seconds": round(batched_s, 3),
+           "dev_speedup": dev_speedup,
+           "dev_batch_gain": batch_gain,
+           "dev_bit_parity": dev_parity,
            # pack sub-dict: _probe_main forwards it into the ledger
            # record so `perf report`/`perf diff` trend the pack wall.
            "pack": {"prepare_s": round(vec_s, 3), "mode": vec_mode,
-                    "py_s": round(py_s, 3), "speedup": speedup}}
+                    "py_s": round(py_s, 3), "speedup": speedup,
+                    "dev_batched_s": round(batched_s, 3),
+                    "dev_speedup": dev_speedup,
+                    "dev_batch_gain": batch_gain}}
+    if dev_speedup is not None and dev_speedup < 2.0:
+        # Honest record (ISSUE 20 acceptance): the batched device
+        # pack did not clear 2x over the host finish here. On the
+        # forced-CPU mesh that is EXPECTED — the per-dispatch cost
+        # batching amortizes is the TPU tunnel's ~100 ms round trip
+        # (CLAUDE.md), which the cpu backend does not pay, so the
+        # numpy finish wins outright and dev_batch_gain sits near 1.
+        # The ledger keeps trending both so a real-chip run of this
+        # rung shows the amortization where it exists.
+        import jax as _jax
+
+        out["pack"]["dev_note"] = (
+            f"batched device pack {dev_speedup}x vs host finish at "
+            f"K={K} on {_jax.devices()[0].platform}: no tunnel "
+            "dispatch overhead to amortize on this backend")
     # Contract: bit-parity always; the ISSUE 16 acceptance floor is
     # >=5x on this shape, but the probe's own soft gate is 3x so a
     # noisy shared box flags degradation without flapping the rung.
-    out["verdict"] = bool(parity and speedup and speedup >= 3.0)
+    out["verdict"] = bool(parity and dev_parity
+                          and speedup and speedup >= 3.0)
     if not out["verdict"]:
         out["error"] = "pack parity/speedup contract failed (see fields)"
     return out

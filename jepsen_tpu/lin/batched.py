@@ -211,7 +211,12 @@ def try_check_batch(model, subs: dict, declines: list | None = None) \
     ``declines``, when given a list, collects one :class:`Decline` per
     key/group that could NOT batch (the shape axis that failed), so a
     caller routing leftovers to a slow path can log WHY each bin fell
-    through instead of a bare None."""
+    through instead of a bare None.
+
+    A ``subs`` value may be a raw history OR an already-packed
+    :class:`PackedHistory` (the service daemon's admission tier packs
+    its bin waves as one batched device program before calling here —
+    doc/service.md § Device packing); packed values are used as-is."""
     if not subs:
         return {}
     packed: dict = {}
@@ -222,7 +227,8 @@ def try_check_batch(model, subs: dict, declines: list | None = None) \
         t0 = prepare.pack_stats()["prepare_s"]
         for k, sub in subs.items():
             try:
-                p = prepare.prepare(model, sub)
+                p = sub if isinstance(sub, PackedHistory) \
+                    else prepare.prepare(model, sub)
             except prepare.UnsupportedHistory as e:
                 if declines is not None:
                     declines.append(Decline("prepare", str(e), keys=[k]))

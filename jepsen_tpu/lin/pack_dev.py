@@ -585,7 +585,11 @@ def materialize_batch(pres: list, *, stats: dict | None = None
                       ) -> list:
     """Pack K histories; same-bucket eligible lanes ride ONE vmapped
     device dispatch (the daemon's bin-wave admission offload), the
-    rest take the host path. Order-preserving."""
+    rest take the host path. Waves below ``min_batch_k()`` —
+    singletons included — pack host-side: the device program only
+    amortizes its dispatch + compile overhead across K lanes
+    (doc/env.md § JEPSEN_TPU_PACK_DEV_MIN_K; :func:`materialize` is
+    the explicit single-pack device entry). Order-preserving."""
     out: list = [None] * len(pres)
     groups: dict = {}
     for i, pre in enumerate(pres):
@@ -597,7 +601,7 @@ def materialize_batch(pres: list, *, stats: dict | None = None
             out[i] = _host_materialize(pre)
     for shape, ix in groups.items():
         wave = [pres[i] for i in ix]
-        if len(wave) < max(1, min_batch_k()) and len(wave) > 1:
+        if len(wave) < max(1, min_batch_k()):
             packs = [_host_materialize(p) for p in wave]
         else:
             packs = _materialize_wave(

@@ -110,6 +110,74 @@ def main() -> int:
                           "ok": good})
     ok = ok and good
 
+    # 4. Device packer (lin/pack_dev.py, ISSUE 20): supervised
+    # materialization on the forced CPU platform must be BIT-IDENTICAL
+    # to the host pack (fingerprint + slot_op) on both families, and a
+    # 4-lane same-shape wave must ride ONE vmapped dispatch.
+    from jepsen_tpu import util
+    from jepsen_tpu.lin import pack_dev
+
+    os.environ["JEPSEN_TPU_PACK_DEV"] = "1"
+    pack_dev.reset_dev_stats()
+    dev_cases = [
+        ("partitioned-3k", m.cas_register(),
+         list(synth.generate_partitioned_register_history(
+             3000, seed=11, invoke_bias=0.45))),
+        ("mutex-1k", m.mutex(), list(synth.generate_mutex_history(
+            1000, concurrency=8, seed=5, crash_prob=0.01,
+            max_crashes=4)))]
+    for name, model, h in dev_cases:
+        spec = prepare.prepare(model, list(h))
+        got = pack_dev.materialize(pack_dev.prepack(model, list(h)))
+        good = parity(got, spec)
+        out["checks"].append({"case": f"pack-dev-{name}",
+                              "bit_parity": good, "ok": good})
+        ok = ok and good
+    _, model, h = dev_cases[0]
+    spec = prepare.prepare(model, list(h))
+    wave = pack_dev.materialize_batch(
+        [pack_dev.prepack(model, list(h)) for _ in range(4)])
+    st = pack_dev.dev_stats()
+    good = (st["dev_packs"] == 3 and st["dev_lanes"] == 6
+            and st["host_fallbacks"] == 0
+            and all(parity(g, spec) for g in wave))
+    out["checks"].append(
+        {"case": "pack-dev-batched-wave",
+         "stats": {k: (round(v, 4) if isinstance(v, float) else v)
+                   for k, v in st.items()}, "ok": good})
+    ok = ok and good
+    pack["dev_s"] = round(st["dev_pack_s"], 3)
+    pack["dev_packs"] = st["dev_packs"]
+
+    # 5. JEPSEN_TPU_WEDGE=pack-dev (the supervision test hook,
+    # quarantine redirected to a throwaway path): a wedged pack
+    # dispatch must degrade to the numpy pack with IDENTICAL tables —
+    # a pack wedge is observability, never a verdict cost or a hang.
+    os.environ["JEPSEN_TPU_QUARANTINE"] = os.path.join(
+        util.cache_dir(), "pack_smoke_quarantine.json")
+    os.environ["JEPSEN_TPU_WEDGE"] = "pack-dev:4:0.2"
+    os.environ["JEPSEN_TPU_DISPATCH_RETRIES"] = "0"
+    supervise.reset_injections()
+    supervise._env_wedge_loaded = None
+    pack_dev.reset_dev_stats()
+    try:
+        got = pack_dev.materialize(pack_dev.prepack(model, list(h)))
+    finally:
+        os.environ.pop("JEPSEN_TPU_WEDGE", None)
+        os.environ.pop("JEPSEN_TPU_QUARANTINE", None)
+        os.environ.pop("JEPSEN_TPU_DISPATCH_RETRIES", None)
+        os.environ.pop("JEPSEN_TPU_PACK_DEV", None)
+        supervise.reset_injections()
+    st = pack_dev.dev_stats()
+    good = (parity(got, spec) and st["wedges"] >= 1
+            and st["host_fallbacks"] >= 1 and st["dev_packs"] == 0)
+    out["checks"].append(
+        {"case": "pack-dev-wedge-fallback",
+         "bit_parity": parity(got, spec),
+         "wedges": st["wedges"],
+         "host_fallbacks": st["host_fallbacks"], "ok": good})
+    ok = ok and good
+
     out["ok"] = ok
     # Cross-run perf ledger (doc/observability.md § Perf ledger): the
     # smoke's own record carries the pack sub-dict so `cli.py perf

@@ -28,8 +28,11 @@ down into the library, per DISPATCH:
   machine state instead of CLAUDE.md prose. The base-rung sites
   (chunk, chunk-batch, spike, mesh-chunk) have no alternative rung:
   their entries are observability only (the `make probe-config5`
-  ledger delta and triage), not routing.
-  ``cli.py quarantine list|clear|diff`` manages it.
+  ledger delta and triage), not routing. The ``pack-dev`` site (the
+  device packer, lin/pack_dev.py) both routes AND stays sound on any
+  outcome: a quarantined/wedged/faulted pack shape falls back to the
+  bit-identical host packer, so its entries cost latency, never a
+  verdict. ``cli.py quarantine list|clear|diff`` manages it.
 - :class:`Checkpointer` / :func:`load_checkpoint` — **frontier
   checkpoint/resume**: at episode boundaries the engines serialize the
   packed frontier, row cursor, sticky level, and host-stats to an

@@ -124,7 +124,7 @@ def run_chaos(*, histories: int = 60, events: int = 20,
     The quarantine ledger is redirected to a throwaway path for the
     run: injected faults are FAKE evidence and must never pollute the
     repo's real fault lore (``.jax_cache/quarantine.json``)."""
-    from jepsen_tpu.lin import prepare, supervise
+    from jepsen_tpu.lin import pack_dev, supervise
     from jepsen_tpu import models as m
     from jepsen_tpu.service.daemon import CheckerService
     from jepsen_tpu.service.protocol import CheckerClient
@@ -134,8 +134,10 @@ def run_chaos(*, histories: int = 60, events: int = 20,
     factories = {"cas-register": m.cas_register, "mutex": m.mutex}
     oracle_by_fp = {}
     for (name, h), w in zip(jobs, want):
-        fp = supervise.history_fingerprint(
-            prepare.prepare(factories[name](), list(h)))
+        # The wire fingerprint (pre-pack columns — must match the
+        # daemon's admission bit for bit, doc/service.md).
+        fp = pack_dev.prepack_fingerprint(
+            pack_dev.prepack(factories[name](), list(h)))
         oracle_by_fp[fp] = w
 
     q_prev = os.environ.get("JEPSEN_TPU_QUARANTINE")
@@ -372,10 +374,10 @@ def main() -> int:
         prepare.prepare(m.cas_register(), list(h)))["valid?"]
     jobs = seeded_jobs(8, seed=31)
     want = oracle_verdicts(jobs)
-    from jepsen_tpu.lin import supervise
-    fps = [supervise.history_fingerprint(
-        prepare.prepare({"cas-register": m.cas_register,
-                         "mutex": m.mutex}[name](), list(hh)))
+    from jepsen_tpu.lin import pack_dev
+    fps = [pack_dev.prepack_fingerprint(
+        pack_dev.prepack({"cas-register": m.cas_register,
+                          "mutex": m.mutex}[name](), list(hh)))
         for name, hh in jobs]
     oracle_by_fp = dict(zip(fps, want))
 

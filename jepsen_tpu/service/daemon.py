@@ -3,9 +3,13 @@
 Pipeline (each stage its own thread(s), queues between them):
 
 1. **Admission** — one handler thread per client connection reads
-   framed requests (protocol.py), packs the history host-side
-   (``prepare.prepare``), fingerprints it, computes its shape-bin key,
-   and admits it under the IN-FLIGHT BOUND (admitted and not yet
+   framed requests (protocol.py), PREPACKS the history
+   (``pack_dev.prepack``: pairing, interning, window scan — the cheap
+   host half; the O(n·W) grid paint is deferred to the worker, where a
+   flushed bin's grids materialize as ONE batched device program on
+   the worker's placed device, doc/service.md § Device packing),
+   fingerprints it over the pre-pack columns, computes its shape-bin
+   key, and admits it under the IN-FLIGHT BOUND (admitted and not yet
    answered; bounding only the queue would leak, since the scheduler
    drains it into necessarily-unbounded shape bins). Past the bound a
    request is answered ``overload`` immediately — backpressure, never
@@ -175,6 +179,10 @@ class Request:
     bin: str                       # shape-bin key (supervise codec)
     fingerprint: str               # history identity (supervise codec)
     respond: Callable[[dict], None]
+    prepack: Any = None            # pack_dev.PrePacked | None: admitted
+    #                                but not yet materialized — the
+    #                                worker paints the grids (a batched
+    #                                device program when the wave allows)
     t_enqueue: float = field(default_factory=time.monotonic)
     attempts: int = 0              # fault requeues consumed
     no_batch: bool = False         # post-fault: keep off the batch path
@@ -682,8 +690,6 @@ class CheckerService:
             obs_metrics.REGISTRY.event("journal-replay", n=replayed)
 
     def _request_from_journal(self, rec: dict) -> Request:
-        from jepsen_tpu.lin import prepare, supervise
-
         history = protocol.history_from_wire(rec.get("history") or [])
         if rec.get("kind") == "txn-check":
             kw = _txn_kw(rec)
@@ -694,19 +700,45 @@ class CheckerService:
                            respond=lambda msg: None, kind="txn",
                            txn_kw=kw, no_batch=True)
         model = protocol.model_by_name(rec.get("model"))
-        try:
-            packed = prepare.prepare(model, history)
-            key = bin_key(packed)
-            fp = supervise.history_fingerprint(packed)
-        except prepare.UnsupportedHistory as e:
-            packed, key = None, f"svc-cpu|{e.kind}"
+        pre, key, fp = self._pack_admission(model, history)
+        if fp is None:
             fp = rec.get("fp")
         return Request(rid=f"journal-{rec.get('seq')}",
                        model_name=rec.get("model"), model=model,
-                       history=history, packed=packed, bin=key,
-                       fingerprint=fp, respond=lambda msg: None)
+                       history=history, packed=None, prepack=pre,
+                       bin=key, fingerprint=fp,
+                       respond=lambda msg: None)
 
     # --- admission ----------------------------------------------------------
+
+    def _pack_admission(self, model, history):
+        """The shared admission pack (wire ``_admit`` + journal
+        replay — ONE shape instead of two hand-rolled prepare blocks):
+        prepack only (pairing, interning, window scan), binned and
+        fingerprinted over the pre-pack columns
+        (``pack_dev.prepack_fingerprint`` — the same function
+        ``protocol.request_fingerprint`` computes client-side). The
+        grids materialize later on the worker's placed device
+        (doc/service.md § Device packing). Returns ``(pre, bin, fp)``;
+        ``(None, "svc-cpu|<kind>", None)`` for an unpackable history —
+        still a legitimate check (lin.analysis routes it to the
+        unbounded host search), it just never bins."""
+        from jepsen_tpu.lin import pack_dev, prepare
+
+        t0 = time.monotonic()
+        try:
+            with obs_trace.span("svc-pack",
+                                events=len(history)) as sp:
+                pre = pack_dev.prepack(model, history)
+                key = bin_key(pre)
+                fp = pack_dev.prepack_fingerprint(pre)
+                sp.note(bin=key)
+        except prepare.UnsupportedHistory as e:
+            return None, f"svc-cpu|{e.kind}", None
+        with self._stats_lock:
+            util.stat_time(self._stats, "bin_pack_s", key,
+                           time.monotonic() - t0)
+        return pre, key, fp
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -801,8 +833,6 @@ class CheckerService:
                 pass
 
     def _admit(self, msg: dict, respond: Callable) -> None:
-        from jepsen_tpu.lin import prepare, supervise
-
         rid = msg.get("id")
         self._bump("submitted")
         try:
@@ -813,19 +843,15 @@ class CheckerService:
             self._bump("bad_requests")
             respond({"type": "error", "id": rid, "error": str(e)})
             return
-        try:
-            packed = prepare.prepare(model, history)
-            key = bin_key(packed)
-            fp = supervise.history_fingerprint(packed)
-        except prepare.UnsupportedHistory as e:
-            # Window past the device bitset etc.: still a legitimate
-            # check (lin.analysis routes it to the unbounded host
-            # search) — it just never bins.
-            packed, key = None, f"svc-cpu|{e.kind}"
+        pre, key, fp = self._pack_admission(model, history)
+        if fp is None:
+            # Unpackable histories fingerprint randomly per request,
+            # so their settles are honestly unfetchable.
             fp = f"unpacked:{rid}:{time.monotonic()}"
         req = Request(rid=rid, model_name=msg.get("model"),
-                      model=model, history=history, packed=packed,
-                      bin=key, fingerprint=fp, respond=respond)
+                      model=model, history=history, packed=None,
+                      prepack=pre, bin=key, fingerprint=fp,
+                      respond=respond)
         self._enqueue_admitted(req, rid, respond, "check",
                                {"model": msg.get("model"),
                                 "history": msg.get("history") or []})
@@ -1459,10 +1485,39 @@ class CheckerService:
                 pending, RuntimeError(f"service worker {kind}"),
                 time.monotonic())
 
+    def _materialize_admitted(self, reqs: list[Request]) -> None:
+        """Device-resident pack of one flushed wave (doc/service.md §
+        Device packing): every prepacked request paints its grids
+        HERE, on the worker thread — same-bucket lanes ride ONE
+        vmapped ``pack-dev`` dispatch on this worker's placed device,
+        deduped by fingerprint (a resubmitted history packs once).
+        Supervised with an honest numpy fallback: a wedged, faulted,
+        quarantined, or static-flagged pack program costs pack wall,
+        never a verdict."""
+        from jepsen_tpu.lin import pack_dev
+
+        todo: dict[str, list[Request]] = {}
+        for r in reqs:
+            if r.packed is None and r.prepack is not None:
+                todo.setdefault(r.fingerprint, []).append(r)
+        if not todo:
+            return
+        t0 = time.monotonic()
+        packs = pack_dev.materialize_batch(
+            [rs[0].prepack for rs in todo.values()],
+            stats=self._supervise_stats())
+        for rs, p in zip(todo.values(), packs):
+            for r in rs:
+                r.packed, r.prepack = p, None
+        with self._stats_lock:
+            util.stat_time(self._stats, "bin_pack_s", reqs[0].bin,
+                           time.monotonic() - t0)
+
     def _process_batch(self, reqs: list[Request]) -> None:
         from jepsen_tpu.lin import supervise
 
         t0 = time.monotonic()
+        self._materialize_admitted(reqs)
         singles: list[Request] = []
         batchable: list[Request] = []
         for r in reqs:
@@ -1482,7 +1537,11 @@ class CheckerService:
             by_fp: dict[str, list[Request]] = {}
             for r in batchable:
                 by_fp.setdefault(r.fingerprint, []).append(r)
-            subs = {fp: reqs_fp[0].history
+            # Already-packed values: the admission tier prepacked and
+            # the wave above painted the grids (device-batched), so
+            # the batch program must not re-pack — try_check_batch
+            # accepts PackedHistory values as-is.
+            subs = {fp: reqs_fp[0].packed
                     for fp, reqs_fp in by_fp.items()}
             self._bump("dedup_hits", len(batchable) - len(by_fp))
             pad_ids = []
@@ -1658,6 +1717,10 @@ class CheckerService:
         from jepsen_tpu.lin import supervise
 
         t0 = time.monotonic()
+        if req.packed is None and req.prepack is not None:
+            # A single that skipped the wave (drain-time requeue,
+            # direct-call tests): materialize its grids now.
+            self._materialize_admitted([req])
         self._bump("single_requests")
         self._touch_worker()   # each single gets its own wedge budget
 
@@ -1809,16 +1872,22 @@ def _pack_meter_snapshot() -> dict:
     the packer mode that served the last pack. Best-effort: stats()
     must never fail because a pack counter could not be read."""
     try:
+        from jepsen_tpu.lin import pack_dev as _pack_dev
         from jepsen_tpu.lin import prepare as _prep
         from jepsen_tpu.txn import pack as _txn_pack
 
         ps = _prep.pack_stats()
         ts = _txn_pack.pack_stats()
+        ds = _pack_dev.dev_stats()
         return {"pack_seconds": round(
                     ps["prepare_s"] + ps["incr_s"] + ts["pack_s"], 3),
                 "pack_calls": (ps["prepare_calls"] + ps["incr_calls"]
                                + ts["pack_calls"]),
-                "pack_mode": ps["mode"]}
+                "pack_mode": ps["mode"],
+                "pack_dev_packs": ds["dev_packs"],
+                "pack_dev_lanes": ds["dev_lanes"],
+                "pack_dev_seconds": round(ds["dev_pack_s"], 3),
+                "pack_dev_fallbacks": ds["host_fallbacks"]}
     except Exception:  # noqa: BLE001 - observability only
         return {}
 

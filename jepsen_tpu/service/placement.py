@@ -23,6 +23,13 @@ respawned worker inherits its predecessor's slot, queue, and device,
 so homes survive worker deaths (the respawn keeps the device and its
 compile cache; only device LOSS re-homes).
 
+The placement covers the wave's PACK as well as its check: the worker
+loop wraps each batch in ``jax.default_device(slot device)``, so the
+admission tier's deferred batched pack (``lin/pack_dev``,
+doc/service.md § Device packing) materializes a bin's tables on the
+same device its check program reads them from — placement needs no
+extra wiring for it.
+
 Pure host-side bookkeeping — no jax imports, safe at workers=1
 (where the daemon never consults it beyond the trivial one-slot
 answer).

@@ -152,17 +152,20 @@ def read_msg(io: SocketIO) -> dict:
 def request_fingerprint(model_name: str, history) -> str | None:
     """The daemon's fingerprint for a check request, computed
     CLIENT-side — the key ``result-fetch`` looks up. Must match the
-    admission path bit for bit: ``prepare.prepare`` then
-    ``supervise.history_fingerprint`` over the packed tables. Returns
-    None for an unpackable history (the daemon fingerprints those
-    randomly per-request, so their settles are honestly unfetchable)."""
-    from jepsen_tpu.lin import prepare, supervise
+    admission path bit for bit: ``pack_dev.prepack`` then
+    ``pack_dev.prepack_fingerprint`` over the PRE-pack columns (the
+    grids never exist on this path — the client pays the cheap pack
+    half only, the mode-invariance the device-packer tests pin).
+    Returns None for an unpackable history (the daemon fingerprints
+    those randomly per-request, so their settles are honestly
+    unfetchable)."""
+    from jepsen_tpu.lin import pack_dev, prepare
 
     try:
-        packed = prepare.prepare(model_by_name(model_name), history)
+        pre = pack_dev.prepack(model_by_name(model_name), history)
     except prepare.UnsupportedHistory:
         return None
-    return supervise.history_fingerprint(packed)
+    return pack_dev.prepack_fingerprint(pre)
 
 
 def history_to_wire(history) -> list[dict]:

@@ -498,22 +498,42 @@ class IncrementalPacker:
                                  carried_gids.tolist()):
                     r1[ca_pos[g]] = rr + 1
             # Column-major paint (cumsum along the contiguous axis) of
-            # op id + 1, as in the one-shot walk.
-            occ = np.zeros((W, n_new + 1), np.int32)
-            flat = occ.reshape(-1)
+            # op id + 1, as in the one-shot walk. Settle batches at or
+            # above the stream device threshold run the O(rows x W)
+            # grid tail as one supervised jitted program
+            # (lin/pack_dev.py, doc/streaming.md § Device packing);
+            # the crashed flag crosses as a host bool column because
+            # the int64 _NEVER sentinel never fits the int32 device
+            # tables. Any non-ok outcome (wedge / fault / quarantine /
+            # static rule) returns None and the numpy paint below runs
+            # instead — same tables, no verdict cost.
             ids1 = (p_gid + 1).astype(np.int32)
-            np.add.at(flat, p_slot * (n_new + 1) + r0, ids1)
-            np.subtract.at(flat, p_slot * (n_new + 1) + r1, ids1)
-            np.cumsum(occ, axis=1, out=occ)
-            grid = np.ascontiguousarray(occ[:, :n_new].T)
-            active = grid != 0
-            slot_op = grid - 1
-            fview = self._op_f_a[:n1 + 1]
-            vview = self._op_v_a[:n1 + 1]
-            rview = self._ret_pos_a[:n1 + 1]
-            slot_f = fview[slot_op]
-            slot_v = vview[slot_op]
-            crashed = (rview[slot_op] >= _NEVER) & active
+            dev = None
+            from jepsen_tpu.lin import pack_dev
+            if (pack_dev.pack_dev_enabled()
+                    and n_new >= pack_dev.stream_min_rows()):
+                dev = pack_dev.paint_tables_dev(
+                    p_slot, r0, r1, ids1,
+                    self._op_f_a[:n1], self._op_v_a[:n1],
+                    self._ret_pos_a[:n1] >= _NEVER,
+                    n1, n_new, W, kernel=self.kernel.name)
+            if dev is not None:
+                grid, active, slot_f, slot_v, slot_op, crashed = dev
+            else:
+                occ = np.zeros((W, n_new + 1), np.int32)
+                flat = occ.reshape(-1)
+                np.add.at(flat, p_slot * (n_new + 1) + r0, ids1)
+                np.subtract.at(flat, p_slot * (n_new + 1) + r1, ids1)
+                np.cumsum(occ, axis=1, out=occ)
+                grid = np.ascontiguousarray(occ[:, :n_new].T)
+                active = grid != 0
+                slot_op = grid - 1
+                fview = self._op_f_a[:n1 + 1]
+                vview = self._op_v_a[:n1 + 1]
+                rview = self._ret_pos_a[:n1 + 1]
+                slot_f = fview[slot_op]
+                slot_v = vview[slot_op]
+                crashed = (rview[slot_op] >= _NEVER) & active
             b = self._blocks
             b["ret_slot"].append(slot_l[rlop].astype(np.int32,
                                                      copy=False))

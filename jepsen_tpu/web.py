@@ -199,6 +199,22 @@ def service_html(stats_file: str | None = None) -> str:
     if fleet:
         parts.append("<p><b>fleet:</b> "
                      + _html.escape(" · ".join(fleet)) + "</p>")
+    # Pack meter up front (doc/service.md § Device packing): total
+    # pack wall, the mode that served the last pack, and the device
+    # packer's dispatch/lane/fallback counts — the admission-offload
+    # surface next to the per-bin ``bin_pack_s`` table below.
+    if snap.get("pack_seconds") is not None:
+        pk = (f"{snap.get('pack_seconds')} s over "
+              f"{snap.get('pack_calls', 0)} packs "
+              f"(mode {snap.get('pack_mode')}")
+        if snap.get("pack_dev_packs"):
+            pk += (f"; device: {snap.get('pack_dev_packs')} dispatches"
+                   f" / {snap.get('pack_dev_lanes')} lanes in "
+                   f"{snap.get('pack_dev_seconds')} s, "
+                   f"{snap.get('pack_dev_fallbacks', 0)} host "
+                   f"fallbacks")
+        pk += ")"
+        parts.append("<p><b>pack:</b> " + _html.escape(pk) + "</p>")
     parts.append(table("counters & gauges", scalars))
     # Placement block (doc/service.md § Placement): one row per worker
     # SLOT — device, queue depth, busy-seconds, item/compile counts —

@@ -30,6 +30,17 @@ from jepsen_tpu.util import (  # noqa: E402
 
 enable_compile_cache()
 
+# Device-resident packing (lin/pack_dev.py) defaults OFF under pytest:
+# the daemon's admission tier and the stream settle would otherwise
+# compile their (tiny, cached) pack programs inside quick-marked
+# service/stream tests — a cold .jax_cache would break the quick
+# tier's no-compile promise. The runtime default stays ON
+# (doc/env.md § JEPSEN_TPU_PACK_DEV); device-packer coverage lives in
+# the compiles-marked tests/test_pack_dev.py (which re-enables it) and
+# the chip-free smokes (pack/serve/fleet/stream), which run with the
+# offload on.
+os.environ.setdefault("JEPSEN_TPU_PACK_DEV", "0")
+
 # --- quick-tier no-compile enforcement --------------------------------------
 # The quick tier's promise (pyproject marker, CLAUDE.md) is "no XLA
 # compiles": ~1 min wall even on one core. Every true backend compile

@@ -22,8 +22,12 @@ TABLES = ("ret_slot", "ret_op", "active", "slot_f", "slot_v",
 @pytest.fixture(autouse=True)
 def _isolate(monkeypatch, tmp_path):
     # Keep the wedge tests' ledger records out of the real quarantine
-    # file, and leaked injections out of the next test.
+    # file, and leaked injections out of the next test. conftest
+    # defaults the device packer OFF for the quick tier's no-compile
+    # promise — this file is the compiles-marked coverage, so turn it
+    # back on.
     monkeypatch.setenv("JEPSEN_TPU_QUARANTINE", str(tmp_path / "q.json"))
+    monkeypatch.setenv("JEPSEN_TPU_PACK_DEV", "1")
     pack_dev.reset_dev_stats()
     yield
     supervise.reset_injections()
@@ -218,8 +222,10 @@ def test_repeat_wedges_quarantine_the_shape(monkeypatch, tmp_path):
 
 
 def test_batch_parity_same_bucket(monkeypatch):
-    # K identical-shape histories ride one vmapped dispatch.
-    monkeypatch.setenv("JEPSEN_TPU_PACK_DEV_MIN_K", "2")
+    # Same-shape histories ride one vmapped dispatch. MIN_K=1 so a
+    # stray pad-bucket singleton devices too (waves below MIN_K —
+    # where the batch amortization buys nothing — host-pack).
+    monkeypatch.setenv("JEPSEN_TPU_PACK_DEV_MIN_K", "1")
     hs = [synth.generate_register_history(
         700, concurrency=6, seed=s, crash_prob=0.02, max_crashes=5)
         for s in range(4)]
@@ -307,3 +313,154 @@ def test_stream_paint_matches_numpy_reference():
     np.testing.assert_array_equal(
         got[5], np.where(active,
                          op_crashed[np.clip(slot_op, 0, None)], False))
+
+
+# --- the daemon's admission offload (doc/service.md § Device packing) --------
+
+
+def test_daemon_wave_packs_on_device_with_oracle_parity(monkeypatch,
+                                                        tmp_path):
+    # One flushed bin wave through the REAL worker path: admission
+    # prepacks, _process_batch materializes the wave as one vmapped
+    # pack-dev dispatch, and the verdicts match the CPU oracle.
+    from jepsen_tpu.lin import cpu
+    from jepsen_tpu.service.daemon import CheckerService, Request
+
+    monkeypatch.setenv("JEPSEN_TPU_PACK_DEV_MIN_K", "2")
+    monkeypatch.setenv("JEPSEN_TPU_SERVICE_STATS",
+                       str(tmp_path / "stats.json"))
+    svc = CheckerService("127.0.0.1", 0,
+                         stats_file=str(tmp_path / "stats.json"))
+    model = m.cas_register()
+    # Window/cap (and so the bin) vary with the synth draw — scan
+    # seeds for four histories sharing one bin so the wave is a
+    # single flush.
+    by_bin: dict = {}
+    for s in range(32):
+        h = list(synth.generate_register_history(
+            60, concurrency=4, seed=s, value_range=3, crash_prob=0.02,
+            max_crashes=2))
+        _, key, _ = svc._pack_admission(model, h)
+        by_bin.setdefault(key, []).append(h)
+        if len(by_bin[key]) == 4:
+            hs = by_bin[key]
+            break
+    else:
+        pytest.fail(f"no bin reached 4 histories: {by_bin.keys()}")
+    # Corrupt one lane for verdict diversity — only with a corruption
+    # that keeps the bin (it can change the cap bucket).
+    for cs in range(8):
+        hc = list(synth.corrupt_history(list(hs[2]), seed=cs))
+        if svc._pack_admission(model, hc)[1] == key:
+            hs[2] = hc
+            break
+    out: list = []
+    reqs = []
+    for i, h in enumerate(hs):
+        pre, key, fp = svc._pack_admission(model, list(h))
+        assert pre is not None and fp is not None
+        reqs.append(Request(
+            rid=i, model_name="cas-register", model=model,
+            history=list(h), packed=None, prepack=pre, bin=key,
+            fingerprint=fp,
+            respond=lambda msg, i=i: out.append((i, msg))))
+    assert len({r.bin for r in reqs}) == 1
+    svc._process_batch(reqs)
+    st = pack_dev.dev_stats()
+    assert st["dev_lanes"] == 4 and st["dev_packs"] == 1
+    assert len(out) == 4
+    for i, msg in out:
+        want = cpu.check_packed(
+            prepare.prepare(model, list(hs[i])))["valid?"]
+        assert msg["result"]["valid?"] == want, i
+    # Satellite 1: the admission pack wall is surfaced per bin.
+    assert reqs[0].bin in svc.stats()["bin_pack_s"]
+
+
+def test_wire_fingerprint_matches_admission(monkeypatch, tmp_path):
+    # protocol.request_fingerprint (client-side) must equal the
+    # daemon's admission fingerprint bit for bit — the result-fetch
+    # contract now rides the pre-pack columns.
+    from jepsen_tpu.service import protocol
+    from jepsen_tpu.service.daemon import CheckerService
+
+    svc = CheckerService("127.0.0.1", 0,
+                         stats_file=str(tmp_path / "stats.json"))
+    h = synth.generate_register_history(
+        80, concurrency=4, seed=9, value_range=3)
+    _, _, fp = svc._pack_admission(m.cas_register(), list(h))
+    assert fp == protocol.request_fingerprint("cas-register", list(h))
+
+
+# --- the stream settle's device paint (doc/streaming.md § Device packing) ----
+
+
+def _stream_pack_rows(model, events, step, monkeypatch, rows):
+    """Feed/settle in `step`-sized chunks with the stream device
+    threshold pinned to `rows` (1 = every settle paints on device,
+    huge = pure numpy)."""
+    from jepsen_tpu.stream import IncrementalPacker
+
+    monkeypatch.setenv("JEPSEN_TPU_PACK_DEV_STREAM_ROWS", str(rows))
+    pk = IncrementalPacker(model)
+    fps = []
+    for i in range(0, len(events), step):
+        pk.feed_many(events[i:i + step])
+        pk.settle()
+        fps.append(pk.prefix_fingerprint(pk.R))
+    pk.settle(final=True)
+    fps.append(pk.prefix_fingerprint(pk.R))
+    return pk, fps
+
+
+def _assert_stream_dev_parity(model, events, step, monkeypatch):
+    a, fa = _stream_pack_rows(model, list(events), step, monkeypatch, 1)
+    assert pack_dev.dev_stats()["dev_packs"] > 0
+    pack_dev.reset_dev_stats()
+    b, fb = _stream_pack_rows(model, list(events), step, monkeypatch,
+                              1 << 30)
+    assert pack_dev.dev_stats()["dev_packs"] == 0
+    assert fa == fb                       # per-increment fingerprints
+    pa, pb = a.packed(), b.packed()
+    assert pa.window == pb.window and pa.R == pb.R
+    for name in ("ret_slot", "ret_op", "active", "slot_f", "slot_v",
+                 "slot_op", "crashed"):
+        va, vb = getattr(pa, name), getattr(pb, name)
+        assert np.asarray(va).dtype == np.asarray(vb).dtype, name
+        np.testing.assert_array_equal(va, vb, err_msg=name)
+    np.testing.assert_array_equal(pa._reduction_tables[0],
+                                  pb._reduction_tables[0])
+    np.testing.assert_array_equal(pa._reduction_tables[1],
+                                  pb._reduction_tables[1])
+    assert a.max_used == b.max_used and a._free == b._free
+    assert a._slot_of == b._slot_of and a._cur_active == b._cur_active
+
+
+@pytest.mark.parametrize("seed,step", [(0, 37), (1, 120)])
+def test_stream_paint_dev_parity(seed, step, monkeypatch):
+    h = synth.generate_register_history(
+        500, concurrency=6, seed=seed, crash_prob=0.03, max_crashes=5)
+    _assert_stream_dev_parity(m.cas_register(), h, step, monkeypatch)
+
+
+def test_stream_paint_dev_parity_mutex(monkeypatch):
+    h = synth.generate_mutex_history(
+        300, concurrency=5, seed=2, crash_prob=0.03, max_crashes=4)
+    _assert_stream_dev_parity(m.mutex(), h, 60, monkeypatch)
+
+
+def test_stream_paint_wedge_falls_back(monkeypatch):
+    # A wedged stream paint must degrade to the numpy path with the
+    # increments' fingerprints unchanged — never a verdict cost.
+    monkeypatch.setenv("JEPSEN_TPU_DISPATCH_RETRIES", "0")
+    h = synth.generate_register_history(
+        400, concurrency=6, seed=7, crash_prob=0.02, max_crashes=3)
+    b, fb = _stream_pack_rows(m.cas_register(), list(h), 80,
+                              monkeypatch, 1 << 30)
+    pack_dev.reset_dev_stats()
+    supervise.inject_wedge("pack-dev", 99, deadline_s=0.05)
+    a, fa = _stream_pack_rows(m.cas_register(), list(h), 80,
+                              monkeypatch, 1)
+    st = pack_dev.dev_stats()
+    assert st["dev_packs"] == 0 and st["host_fallbacks"] > 0
+    assert fa == fb

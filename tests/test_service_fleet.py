@@ -414,7 +414,7 @@ class TestRestartRecovery:
 
     def test_kill_midbatch_replay_parity(self, tmp_path, monkeypatch):
         from jepsen_tpu import models as m
-        from jepsen_tpu.lin import cpu, prepare, supervise
+        from jepsen_tpu.lin import cpu, prepare
         from jepsen_tpu.service import journal as journal_mod
         from jepsen_tpu.service.protocol import CheckerClient
 
@@ -435,11 +435,15 @@ class TestRestartRecovery:
                 _hist(n=24, seed=2), seed=2)),
             _hist(n=24, seed=3),
         ]
+        from jepsen_tpu.lin import pack_dev
+
+        # Oracle keyed by the WIRE fingerprint (pre-pack columns) —
+        # the key the daemon journals and settles under.
         oracle = {}
         for h in hs:
             p = prepare.prepare(m.cas_register(), list(h))
-            oracle[supervise.history_fingerprint(p)] = \
-                cpu.check_packed(p)
+            oracle[pack_dev.prepack_fingerprint(pack_dev.prepack(
+                m.cas_register(), list(h)))] = cpu.check_packed(p)
         svc1 = _mk_service(tmp_path, monkeypatch, journal=path,
                            check_fn=gated_check,
                            batch_fn=lambda mo, s, declines=None: None
